@@ -1,0 +1,33 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Capabilities of Ray (tasks, actors, objects, placement groups, Data/Train/Tune/
+Serve/RLlib libraries), re-architected TPU-first: the data plane is XLA
+(pjit/shard_map collectives over ICI/DCN, Pallas kernels); the runtime around it
+is this package.  See SURVEY.md for the reference blueprint.
+
+Top-level import is lightweight (no jax): the compute-path modules
+(ray_tpu.parallel, ray_tpu.models, ray_tpu.ops) import jax lazily.
+"""
+
+from .core import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
+                   NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
+                   ObjectLostError, ObjectRef, PlacementGroup,
+                   PlacementGroupSchedulingStrategy, RayTpuError, TaskError,
+                   WorkerCrashedError, as_future, available_resources, cancel,
+                   cluster_resources, get, get_actor, get_async, get_runtime_context,
+                   init, is_initialized, kill, method, nodes, placement_group,
+                   placement_group_table, put, remote, remove_placement_group,
+                   shutdown, timeline, wait)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "method", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "get_async", "as_future", "nodes",
+    "cluster_resources", "available_resources", "timeline", "ObjectRef",
+    "placement_group", "remove_placement_group", "placement_group_table",
+    "PlacementGroup", "get_runtime_context", "TaskError", "RayTpuError",
+    "ActorDiedError", "ActorUnavailableError", "GetTimeoutError", "ObjectLostError",
+    "WorkerCrashedError", "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy", "PlacementGroupSchedulingStrategy", "__version__",
+]

@@ -1,0 +1,229 @@
+"""Common wire types: TaskSpec, resource sets, scheduling strategies, errors.
+
+TaskSpec mirrors the reference's ``TaskSpecification``
+(``src/ray/common/task/task_spec.h`` / ``src/ray/protobuf/common.proto``): one message
+covers normal tasks, actor-creation tasks, and actor method calls.  Functions travel by
+content hash through the GCS function registry (reference:
+``python/ray/_private/function_manager.py`` — ships pickled defs via GCS KV; workers
+lazy-import), so the spec itself stays small.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+
+# ---------------------------------------------------------------------------
+# Scheduling strategies (reference: python/ray/util/scheduling_strategies.py)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class NodeAffinitySchedulingStrategy:
+    node_id: str  # hex
+    soft: bool = False
+
+
+@dataclass(frozen=True)
+class PlacementGroupSchedulingStrategy:
+    placement_group: Any  # PlacementGroup handle
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass(frozen=True)
+class NodeLabelSchedulingStrategy:
+    hard: Dict[str, List[str]] = field(default_factory=dict)
+    soft: Dict[str, List[str]] = field(default_factory=dict)
+
+
+SchedulingStrategy = Any  # "DEFAULT" | "SPREAD" | one of the dataclasses above
+
+
+# ---------------------------------------------------------------------------
+# Task spec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    # function: registered blob hash; actor methods reference the actor's class
+    fn_id: Optional[bytes]
+    # serialized (args, kwargs) — SerializedObject.to_bytes(); top-level refs
+    # are wrapped in _TopLevelRef markers inside.
+    args: bytes
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    owner: str = ""                 # rpc address of owner core worker
+    scheduling_strategy: SchedulingStrategy = "DEFAULT"
+    max_retries: int = 0
+    retry_count: int = 0
+    retry_exceptions: bool = False
+    runtime_env: Optional[dict] = None
+    # actor creation
+    is_actor_creation: bool = False
+    actor_id: Optional[ActorID] = None
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async_actor: bool = False
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+    # actor method call
+    is_actor_task: bool = False
+    actor_method: Optional[str] = None
+    seq_no: int = 0
+    # bookkeeping
+    submitted_at: float = field(default_factory=time.time)
+
+    def scheduling_key(self) -> tuple:
+        """Tasks with the same key can reuse the same leased worker
+        (reference: SchedulingKey in direct_task_transport.h:151)."""
+        return (self.fn_id, tuple(sorted(self.resources.items())),
+                repr(self.scheduling_strategy), self.runtime_env is None)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+
+@dataclass
+class _TopLevelRef:
+    """Marker for a top-level ObjectRef argument: resolved to its value before the
+    user function runs (nested refs are passed through as refs — ray semantics)."""
+    ref: Any
+
+
+# ---------------------------------------------------------------------------
+# Errors (reference: python/ray/exceptions.py)
+# ---------------------------------------------------------------------------
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a task; re-raised at ray.get."""
+
+    def __init__(self, cause: BaseException, task_name: str = "", remote_tb: str = ""):
+        self.cause = cause
+        self.task_name = task_name
+        self.remote_traceback = remote_tb
+        super().__init__(f"task {task_name!r} failed: {type(cause).__name__}: {cause}"
+                         + (f"\n--- remote traceback ---\n{remote_tb}" if remote_tb else ""))
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id=None, msg: str = ""):
+        self.actor_id = actor_id
+        super().__init__(msg or f"actor {actor_id} died")
+
+
+class ActorUnavailableError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id, msg=""):
+        self.object_id = object_id
+        super().__init__(msg or f"object {object_id} lost and could not be reconstructed")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+def detect_node_resources(num_cpus: Optional[float] = None,
+                          num_tpus: Optional[float] = None,
+                          resources: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    """Autodetect CPU / TPU resources for a node.
+
+    TPU detection follows the reference's approach
+    (``python/ray/_private/accelerator.py:35-42,153`` — counts ``/dev/accel*`` chips,
+    honours ``TPU_VISIBLE_CHIPS``) without importing jax.
+    """
+    import os
+    out: Dict[str, float] = dict(resources or {})
+    if num_cpus is None:
+        num_cpus = os.cpu_count() or 1
+    out["CPU"] = float(num_cpus)
+    if num_tpus is None:
+        visible = os.environ.get("TPU_VISIBLE_CHIPS")
+        if visible:
+            num_tpus = len([c for c in visible.split(",") if c.strip()])
+        else:
+            try:
+                num_tpus = len([d for d in os.listdir("/dev") if d.startswith("accel")])
+            except OSError:
+                num_tpus = 0
+    if num_tpus:
+        out["TPU"] = float(num_tpus)
+    try:
+        mem = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+        out.setdefault("memory", float(int(mem * 0.7)))
+    except (ValueError, OSError):
+        pass
+    return out
+
+
+class ResourceSet:
+    """Float resource accounting with exact add/subtract semantics."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, amounts: Dict[str, float] | None = None):
+        self._r = {k: float(v) for k, v in (amounts or {}).items() if v}
+
+    def to_dict(self) -> Dict[str, float]:
+        return dict(self._r)
+
+    def get(self, k: str) -> float:
+        return self._r.get(k, 0.0)
+
+    def can_fit(self, demand: Dict[str, float]) -> bool:
+        return all(self._r.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)
+
+    def acquire(self, demand: Dict[str, float]) -> bool:
+        if not self.can_fit(demand):
+            return False
+        for k, v in demand.items():
+            if v > 0:
+                self._r[k] = self._r.get(k, 0.0) - v
+        return True
+
+    def release(self, demand: Dict[str, float]):
+        for k, v in demand.items():
+            if v > 0:
+                self._r[k] = self._r.get(k, 0.0) + v
+
+    def force_acquire(self, demand: Dict[str, float]):
+        """Subtract without feasibility check — used when a blocked worker
+        resumes and reclaims its released resources (temporary oversubscription,
+        like the reference raylet's unblock path)."""
+        for k, v in demand.items():
+            if v > 0:
+                self._r[k] = self._r.get(k, 0.0) - v
+
+    def utilization(self, total: "ResourceSet") -> float:
+        """Max utilization across resources present in `total` (critical resource)."""
+        u = 0.0
+        for k, tot in total._r.items():
+            if tot > 0:
+                u = max(u, 1.0 - self._r.get(k, 0.0) / tot)
+        return u

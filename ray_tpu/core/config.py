@@ -1,0 +1,130 @@
+"""Central config table for the runtime.
+
+Mirrors the *design* of the reference's ``RAY_CONFIG`` macro table
+(``src/ray/common/ray_config_def.h:18`` — 204 env-overridable tunables handed to every
+process), re-done as a typed Python dataclass whose every field can be overridden with an
+``RAYTPU_<NAME>`` environment variable or a ``_system_config`` dict passed to
+:func:`ray_tpu.init`.  Worker processes receive the serialized config via their
+environment so the whole cluster sees one consistent table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAYTPU_"
+
+
+@dataclasses.dataclass
+class Config:
+    # -- object store ------------------------------------------------------
+    #: Objects <= this many bytes are stored inline in the owner's in-process
+    #: memory store and travel inside RPC replies (reference:
+    #: ``max_direct_call_object_size``, ray_config_def.h).
+    max_direct_call_object_size: int = 100 * 1024
+    #: Capacity of the per-node shared-memory store in bytes (0 = 30% of RAM).
+    object_store_memory: int = 0
+    #: Chunk size for node-to-node object transfer.
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    #: Max concurrent inbound object pulls admitted per node.
+    object_pull_max_concurrency: int = 8
+    #: Spill directory ("" disables spilling).
+    object_spilling_dir: str = ""
+    #: Spill when store utilization exceeds this fraction.
+    object_spilling_threshold: float = 0.8
+
+    # -- scheduling --------------------------------------------------------
+    #: Top-k fraction of feasible nodes considered by the hybrid policy
+    #: (reference: ``scheduler_top_k_fraction``, hybrid_scheduling_policy.h:51).
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_top_k_absolute: int = 1
+    #: Prefer the local node until its critical-resource utilization passes
+    #: this threshold (reference: ``scheduler_spread_threshold``).
+    scheduler_spread_threshold: float = 0.5
+    #: Lease reuse window: an idle leased worker is returned to the pool after
+    #: this many seconds (reference: ``idle_worker_killing_time_threshold_ms``).
+    idle_worker_timeout_s: float = 2.0
+    #: Max workers a node agent will spawn beyond configured CPU count for
+    #: blocked-on-get tasks.
+    max_extra_workers: int = 2
+
+    # -- workers -----------------------------------------------------------
+    #: Workers pre-started per node at boot (reference: ``prestart_worker_first_driver``).
+    prestart_workers: int = 0
+    #: Seconds to wait for a worker process to register before declaring it dead.
+    worker_register_timeout_s: float = 30.0
+
+    # -- fault tolerance ---------------------------------------------------
+    #: Default task max_retries (reference: ``task_retry_delay_ms`` family).
+    default_task_max_retries: int = 3
+    task_retry_delay_s: float = 0.05
+    #: Enable lineage reconstruction of lost objects
+    #: (reference: ``lineage_pinning_enabled``, ray_config_def.h:155).
+    lineage_reconstruction_enabled: bool = True
+    #: Node agent heartbeat period / failure threshold
+    #: (reference: GcsHealthCheckManager defaults).
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+
+    # -- rpc ---------------------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    rpc_call_timeout_s: float = 120.0
+
+    # -- pubsub / syncer ---------------------------------------------------
+    #: Resource-view gossip period (reference: RaySyncer, ray_syncer.h:86).
+    resource_broadcast_period_s: float = 0.1
+
+    # -- metrics -----------------------------------------------------------
+    metrics_export_enabled: bool = True
+    task_events_enabled: bool = True
+    #: Ring buffer size for task state-transition events
+    #: (reference: TaskEventBuffer, task_event_buffer.h).
+    task_events_max_buffer: int = 100_000
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls(**json.loads(s))
+
+    @classmethod
+    def from_env(cls, overrides: Dict[str, Any] | None = None) -> "Config":
+        """Build a config: defaults < env vars < explicit overrides."""
+        kwargs: Dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                if f.type in ("int", int):
+                    kwargs[f.name] = int(env)
+                elif f.type in ("float", float):
+                    kwargs[f.name] = float(env)
+                elif f.type in ("bool", bool):
+                    kwargs[f.name] = env.lower() in ("1", "true", "yes")
+                else:
+                    kwargs[f.name] = env
+        if overrides:
+            unknown = set(overrides) - {f.name for f in dataclasses.fields(cls)}
+            if unknown:
+                raise ValueError(f"Unknown _system_config keys: {sorted(unknown)}")
+            kwargs.update(overrides)
+        return cls(**kwargs)
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        env = os.environ.get("RAYTPU_CONFIG_JSON")
+        _global_config = Config.from_json(env) if env else Config.from_env()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

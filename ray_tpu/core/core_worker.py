@@ -1,0 +1,1118 @@
+"""CoreWorker — the per-process runtime embedded in the driver and every worker.
+
+Equivalent of the reference's ``CoreWorker`` (``src/ray/core_worker/core_worker.h:285``),
+the single façade behind the public API:
+
+* **Ownership** — every object created here is owned by this process; the owner is the
+  source of truth for the value (small objects), its locations (large objects), and its
+  lifetime via distributed refcounting (reference: ``reference_count.h:61``,
+  ``ownership_based_object_directory.h``).
+* **Task submission** — lease-based direct task transport: pick a node from the gossiped
+  cluster view, request a worker lease (with spillback), push tasks straight to the
+  leased worker over RPC, reuse leases per scheduling key (reference:
+  ``direct_task_transport.h:75``, ``SchedulingKey`` lease reuse :151).
+* **Task management** — pending-task table with automatic retries and lineage kept for
+  reconstruction of lost objects (reference: ``task_manager.h``,
+  ``object_recovery_manager.h:41``).
+* **Actor calls** — direct peer-to-peer RPC to the actor's worker with per-handle
+  sequence numbers; restart-aware resubmission (reference:
+  ``direct_actor_task_submitter.h:68``).
+* **Execution** — in worker processes, tasks run on the *main* thread (important for
+  jax/TPU: the runtime owns the device in one thread); async actors run on a private
+  event loop; threaded actors use a bounded pool (reference: scheduling queues +
+  ``BoundedExecutor``/fiber concurrency groups, ``thread_pool.h:36``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+import pickle
+import queue as _queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .common import (ActorDiedError, GetTimeoutError, NodeAffinitySchedulingStrategy,
+                     ObjectLostError, PlacementGroupSchedulingStrategy, TaskError,
+                     TaskSpec, WorkerCrashedError, _TopLevelRef)
+from .config import get_config
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .object_store import ErrorRecord, MemoryStore, PlasmaRecord, ShmReader, ShmSegment
+from .rpc import ClientPool, ConnectionLost, RemoteError, RpcClient, RpcServer, get_loop, run_async
+from .scheduling import NodeView, pick_node
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["CoreWorker"]):
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+# ---------------------------------------------------------------------------
+# Reference counting (reference: src/ray/core_worker/reference_count.h:61)
+# ---------------------------------------------------------------------------
+
+class ReferenceCounter:
+    def __init__(self, worker: "CoreWorker"):
+        self._w = worker
+        self._lock = threading.Lock()
+        self.local: Dict[ObjectID, int] = collections.defaultdict(int)
+        self.submitted: Dict[ObjectID, int] = collections.defaultdict(int)
+        self.borrowers: Dict[ObjectID, int] = collections.defaultdict(int)
+        # Borrowed refs for which we told the owner we hold a copy; one
+        # add/remove note pair per 0->N->0 cycle of our local count
+        # (reference: borrower bookkeeping in reference_count.cc).
+        self._borrow_noted: set = set()
+
+    def add_local_ref(self, oid: ObjectID, owner: str = ""):
+        notify = False
+        with self._lock:
+            self.local[oid] += 1
+            if (owner and owner != self._w.address
+                    and oid not in self._borrow_noted):
+                self._borrow_noted.add(oid)
+                notify = True
+        if notify:
+            self._w.send_borrower_note(oid, owner, add=True)
+
+    def remove_local_ref(self, oid: ObjectID, owner: str):
+        with self._lock:
+            self.local[oid] -= 1
+            dead = self.local[oid] <= 0 and self.submitted.get(oid, 0) <= 0
+            noted = False
+            if dead:
+                self.local.pop(oid, None)
+                noted = oid in self._borrow_noted
+                self._borrow_noted.discard(oid)
+        if dead:
+            self._dead(oid, owner, noted)
+
+    def add_submitted(self, oid: ObjectID):
+        with self._lock:
+            self.submitted[oid] += 1
+
+    def remove_submitted(self, oid: ObjectID, owner: str):
+        with self._lock:
+            self.submitted[oid] -= 1
+            dead = self.submitted[oid] <= 0 and self.local.get(oid, 0) <= 0
+            noted = False
+            if dead:
+                self.submitted.pop(oid, None)
+                noted = oid in self._borrow_noted
+                self._borrow_noted.discard(oid)
+        if dead:
+            self._dead(oid, owner, noted)
+
+    def _dead(self, oid: ObjectID, owner: str, noted: bool):
+        if owner and owner != self._w.address:
+            if noted:
+                self._w.send_borrower_note(oid, owner, add=False)
+        else:
+            self._w.on_ref_count_zero(oid, owner)
+
+    def add_borrower(self, oid: ObjectID):
+        with self._lock:
+            self.borrowers[oid] += 1
+
+    def remove_borrower(self, oid: ObjectID):
+        with self._lock:
+            self.borrowers[oid] -= 1
+            dead = self.borrowers[oid] <= 0
+            if dead:
+                self.borrowers.pop(oid, None)
+        if dead:
+            self._w.on_ref_count_zero(oid, "")
+
+    def has_any_ref(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return (self.local.get(oid, 0) > 0 or self.submitted.get(oid, 0) > 0
+                    or self.borrowers.get(oid, 0) > 0)
+
+
+# ---------------------------------------------------------------------------
+# Task manager (reference: src/ray/core_worker/task_manager.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    arg_refs: List[ObjectRef] = field(default_factory=list)
+
+
+class TaskManager:
+    def __init__(self, worker: "CoreWorker"):
+        self._w = worker
+        self.pending: Dict[TaskID, PendingTask] = {}
+        self.lineage: "collections.OrderedDict[TaskID, TaskSpec]" = collections.OrderedDict()
+        self.num_finished = 0
+        self.num_failed = 0
+
+    def add_pending(self, spec: TaskSpec, arg_refs: List[ObjectRef]):
+        self.pending[spec.task_id] = PendingTask(spec, spec.max_retries, arg_refs)
+        for r in arg_refs:
+            self._w.reference_counter.add_submitted(r.id)
+
+    def _release_args(self, pt: PendingTask):
+        for r in pt.arg_refs:
+            self._w.reference_counter.remove_submitted(r.id, r.owner)
+        pt.arg_refs = []
+
+    def complete(self, task_id: TaskID, results: List[tuple]):
+        pt = self.pending.pop(task_id, None)
+        if pt is None:
+            return
+        self._release_args(pt)
+        spec = pt.spec
+        for i, res in enumerate(results):
+            oid = ObjectID.for_task_return(task_id, i)
+            self._w.store_task_result(oid, res)
+        self.num_finished += 1
+        if get_config().lineage_reconstruction_enabled and any(
+                r[0] == "plasma" for r in results):
+            self.lineage[task_id] = spec
+            while len(self.lineage) > 10000:
+                self.lineage.popitem(last=False)
+        self._w.task_event(spec, "FINISHED")
+
+    def fail(self, task_id: TaskID, exc: BaseException, tb: str = ""):
+        pt = self.pending.pop(task_id, None)
+        if pt is None:
+            return
+        self._release_args(pt)
+        err = ErrorRecord(pickle.dumps((exc, tb)))
+        for i in range(pt.spec.num_returns):
+            self._w.memory_store.put(ObjectID.for_task_return(task_id, i), err)
+        self.num_failed += 1
+        self._w.task_event(pt.spec, "FAILED", error=repr(exc))
+
+    def can_retry(self, task_id: TaskID) -> bool:
+        pt = self.pending.get(task_id)
+        return pt is not None and pt.retries_left > 0
+
+    def use_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
+        pt = self.pending.get(task_id)
+        if pt is None or pt.retries_left <= 0:
+            return None
+        pt.retries_left -= 1
+        pt.spec.retry_count += 1
+        return pt.spec
+
+
+# ---------------------------------------------------------------------------
+# Lease pools (reference: CoreWorkerDirectTaskSubmitter)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LeasedWorker:
+    address: str
+    worker_id: str
+    lease_id: str
+    node_id: str
+    agent_address: str
+    busy: bool = False
+    idle_since: float = field(default_factory=time.monotonic)
+    return_scheduled: bool = False
+
+
+class LeasePool:
+    """One per scheduling key: queue of tasks + leased workers executing them."""
+
+    MAX_LEASES = 64
+
+    def __init__(self, worker: "CoreWorker", key: tuple, resources: Dict[str, float],
+                 strategy, bundle: Optional[Tuple[str, int]]):
+        self.w = worker
+        self.key = key
+        self.resources = resources or {"CPU": 1.0}
+        self.strategy = strategy
+        self.bundle = bundle
+        self.queue: collections.deque[TaskSpec] = collections.deque()
+        self.leased: Dict[str, LeasedWorker] = {}
+        self.requesting = 0
+
+    def submit(self, spec: TaskSpec):
+        self.queue.append(spec)
+        self._pump()
+
+    def _pump(self):
+        # Dispatch queued tasks to idle leased workers.
+        idle = [lw for lw in self.leased.values() if not lw.busy]
+        while self.queue and idle:
+            lw = idle.pop()
+            spec = self.queue.popleft()
+            lw.busy = True
+            asyncio.ensure_future(self._run_on(lw, spec))
+        # Request more leases only for demand not already covered by idle
+        # leased workers or in-flight lease requests.
+        deficit = len(self.queue) - len(idle) - self.requesting
+        want = min(deficit, self.MAX_LEASES - len(self.leased) - self.requesting)
+        for _ in range(max(0, want)):
+            self.requesting += 1
+            asyncio.ensure_future(self._acquire_lease())
+        # Return leases that ended up idle with nothing queued (covers leases
+        # granted after the queue drained).
+        if not self.queue:
+            for lw in idle:
+                if not lw.return_scheduled:
+                    lw.return_scheduled = True
+                    asyncio.ensure_future(self._maybe_return(lw))
+
+    async def _acquire_lease(self):
+        try:
+            target_addr = None
+            hops = 0
+            while not self.w._shutdown:
+                try:
+                    view = await self.w.get_cluster_view()
+                except Exception:
+                    if self.w._shutdown:
+                        return
+                    await asyncio.sleep(0.2)
+                    continue
+                if target_addr is None:
+                    nid = pick_node(view, self.resources, self.strategy,
+                                    local_node_id=self.w.node_id)
+                    if nid is None:
+                        await asyncio.sleep(0.5)  # infeasible now; wait for nodes
+                        if not self.queue:
+                            return
+                        continue
+                    target_addr = view[nid].address
+                agent = self.w.agent_clients.get(target_addr)
+                try:
+                    grant = await agent.call("request_worker_lease",
+                                             resources=self.resources,
+                                             bundle=self.bundle,
+                                             allow_spillback=(hops < 4),
+                                             _timeout=3600.0)
+                except (ConnectionLost, OSError):
+                    target_addr = None
+                    await asyncio.sleep(0.2)
+                    continue
+                if "worker_address" in grant:
+                    lw = LeasedWorker(grant["worker_address"], grant["worker_id"],
+                                      grant["lease_id"], grant["node_id"], target_addr)
+                    self.leased[lw.lease_id] = lw
+                    return
+                if "spillback" in grant:
+                    target_addr = grant["spillback"]["address"]
+                    hops += 1
+                    continue
+                if grant.get("infeasible"):
+                    target_addr = None
+                    await asyncio.sleep(0.5)
+                    continue
+        finally:
+            self.requesting -= 1
+            self._pump()
+
+    async def _run_on(self, lw: LeasedWorker, spec: TaskSpec):
+        client = self.w.worker_clients.get(lw.address)
+        self.w.task_event(spec, "RUNNING", node_id=lw.node_id)
+        try:
+            results = await client.call("push_task", spec=spec, _timeout=86400.0)
+        except (ConnectionLost, RemoteError, OSError) as e:
+            await self._on_worker_failure(lw, spec, e)
+            return
+        self.w.task_manager.complete(spec.task_id, results)
+        lw.busy = False
+        lw.idle_since = time.monotonic()
+        self._pump()
+
+    async def _on_worker_failure(self, lw: LeasedWorker, spec: TaskSpec,
+                                 err: Exception):
+        self.leased.pop(lw.lease_id, None)
+        try:
+            agent = self.w.agent_clients.get(lw.agent_address)
+            await agent.call("return_worker_lease", lease_id=lw.lease_id,
+                             worker_id=lw.worker_id, worker_alive=False)
+        except Exception:
+            pass
+        retry_spec = self.w.task_manager.use_retry(spec.task_id)
+        if retry_spec is not None:
+            await asyncio.sleep(get_config().task_retry_delay_s)
+            self.queue.appendleft(retry_spec)
+            self._pump()
+        else:
+            self.w.task_manager.fail(
+                spec.task_id,
+                WorkerCrashedError(f"worker {lw.worker_id[:12]} died running "
+                                   f"{spec.name}: {err}"), "")
+
+    async def _maybe_return(self, lw: LeasedWorker):
+        try:
+            await asyncio.sleep(get_config().idle_worker_timeout_s)
+        finally:
+            lw.return_scheduled = False
+        if lw.busy or self.queue or lw.lease_id not in self.leased:
+            return
+        self.leased.pop(lw.lease_id, None)
+        try:
+            agent = self.w.agent_clients.get(lw.agent_address)
+            await agent.call("return_worker_lease", lease_id=lw.lease_id,
+                             worker_id=lw.worker_id, worker_alive=True)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Actor submission state (per ActorHandle target)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ActorTarget:
+    actor_id: str
+    address: Optional[str] = None
+    seq: int = 0
+    state: str = "PENDING"
+    lock: "asyncio.Lock" = field(default_factory=asyncio.Lock)
+
+
+# ---------------------------------------------------------------------------
+# The CoreWorker
+# ---------------------------------------------------------------------------
+
+class CoreWorker:
+    def __init__(self, mode: str, gcs_address: str, agent_address: Optional[str],
+                 node_id: Optional[str], job_id: Optional[JobID] = None,
+                 session_dir: str = "/tmp/raytpu"):
+        self.mode = mode  # "driver" | "worker"
+        self.worker_id = WorkerID.from_random()
+        self.job_id = job_id or JobID(b"\x00\x00\x00\x01")
+        self.gcs_address = gcs_address
+        self.agent_address = agent_address
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.server = RpcServer(self, "127.0.0.1", 0)
+        self.gcs: Optional[RpcClient] = None
+        self.agent: Optional[RpcClient] = None
+        self.agent_clients = ClientPool()
+        self.worker_clients = ClientPool()
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self)
+        self.task_manager = TaskManager(self)
+        self.shm_reader = ShmReader()
+        self.lease_pools: Dict[tuple, LeasePool] = {}
+        self.actor_targets: Dict[str, ActorTarget] = {}
+        self.fn_cache: Dict[bytes, Any] = {}
+        self._view_cache: Tuple[float, Dict[str, NodeView]] = (0.0, {})
+        self._task_events: List[dict] = []
+        self._bg: List[asyncio.Task] = []
+        # executor state (worker mode)
+        self.exec_queue: "_queue.Queue[tuple]" = _queue.Queue()
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._actor_threadpool = None
+        self._actor_async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._shutdown = False
+        self._blocked_depth = 0
+
+    # ------------------------------------------------------------------ boot
+
+    async def _start(self):
+        await self.server.start()
+        self.gcs = RpcClient(self.gcs_address)
+        if self.agent_address:
+            self.agent = self.agent_clients.get(self.agent_address)
+        if get_config().task_events_enabled:
+            self._bg.append(asyncio.ensure_future(self._flush_task_events_loop()))
+        return self
+
+    def start(self):
+        run_async(self._start())
+        set_global_worker(self)
+        return self
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def shutdown(self):
+        self._shutdown = True
+
+        async def _stop():
+            for t in self._bg:
+                t.cancel()
+            await self.server.stop()
+            await self.agent_clients.close_all()
+            await self.worker_clients.close_all()
+            if self.gcs:
+                await self.gcs.close()
+        try:
+            run_async(_stop(), timeout=5)
+        except Exception:
+            pass
+        self.shm_reader.close()
+        if global_worker_or_none() is self:
+            set_global_worker(None)
+
+    # -------------------------------------------------------------- telemetry
+
+    def task_event(self, spec: TaskSpec, state: str, **extra):
+        if not get_config().task_events_enabled:
+            return
+        self._task_events.append({
+            "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
+            "job_id": spec.job_id.hex(), "ts": time.time(),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            **extra})
+
+    async def _flush_task_events_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            if self._task_events and self.gcs:
+                batch, self._task_events = self._task_events, []
+                try:
+                    await self.gcs.call("add_task_events", events=batch)
+                except Exception:
+                    pass
+
+    # ---------------------------------------------------------- cluster view
+
+    async def get_cluster_view(self) -> Dict[str, NodeView]:
+        now = time.monotonic()
+        ts, view = self._view_cache
+        if now - ts < 0.1 and view:
+            return view
+        payload = await self.gcs.call("get_cluster_view")
+        view = {nid: NodeView(nid, d["address"], d["total"], d["available"],
+                              d.get("labels", {}), d.get("alive", True),
+                              d.get("queue_len", 0))
+                for nid, d in payload.items()}
+        self._view_cache = (now, view)
+        return view
+
+    # ------------------------------------------------------------------- put
+
+    def put(self, value: Any) -> ObjectRef:
+        return run_async(self.put_async(value))
+
+    async def put_async(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        so = serialization.serialize(value)
+        await self._store_serialized(oid, so)
+        return ObjectRef(oid, owner=self.address)
+
+    async def _store_serialized(self, oid: ObjectID, so: serialization.SerializedObject):
+        cfg = get_config()
+        size = so.flat_size()
+        if size <= cfg.max_direct_call_object_size or self.agent is None:
+            self.memory_store.put(oid, so.to_bytes())
+        else:
+            res = await self.agent.call("store_create", object_id=oid, size=size)
+            seg = ShmSegment(res["path"], size, create=False)
+            try:
+                so.write_into(seg.view())
+            finally:
+                seg.close()
+            await self.agent.call("store_seal", object_id=oid)
+            self.memory_store.put(
+                oid, PlasmaRecord(size, [(self.node_id, self.agent_address)]))
+
+    def store_task_result(self, oid: ObjectID, res: tuple):
+        """Record a task's return descriptor into the owner's memory store."""
+        kind = res[0]
+        if kind == "inline":
+            self.memory_store.put(oid, res[1])
+        elif kind == "plasma":
+            self.memory_store.put(oid, PlasmaRecord(res[1], res[2]))
+        elif kind == "error":
+            self.memory_store.put(oid, ErrorRecord(res[1]))
+        else:
+            raise ValueError(f"bad result kind {kind}")
+
+    # ------------------------------------------------------------------- get
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        self._on_block()
+        try:
+            values = run_async(self.get_async_many(refs, timeout),
+                               timeout=None if timeout is None else timeout + 10)
+        finally:
+            self._on_unblock()
+        return values[0] if single else values
+
+    async def get_async_many(self, refs: List[ObjectRef],
+                             timeout: Optional[float] = None) -> List[Any]:
+        return list(await asyncio.gather(*[self.get_async(r, timeout) for r in refs]))
+
+    async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        record = await self._resolve_record(ref, timeout)
+        return await self._record_to_value(ref, record)
+
+    async def _resolve_record(self, ref: ObjectRef, timeout: Optional[float]):
+        oid = ref.id
+        if self.memory_store.contains(oid):
+            return self.memory_store.get_if_exists(oid)
+        if ref.owner in ("", self.address):
+            ok = await self.memory_store.wait_ready(oid, timeout)
+            if not ok:
+                raise GetTimeoutError(f"timed out waiting for {ref}")
+            return self.memory_store.get_if_exists(oid)
+        # Borrowed ref: ask the owner (it blocks until the producing task finishes).
+        owner = self.worker_clients.get(ref.owner)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 30.0 if deadline is None else max(0.0, deadline - time.monotonic())
+            if deadline is not None and step <= 0:
+                raise GetTimeoutError(f"timed out waiting for {ref}")
+            try:
+                rec = await owner.call("locate_object", object_id=oid,
+                                       timeout=min(step, 30.0) if deadline else 30.0,
+                                       _timeout=(min(step, 30.0) if deadline else 30.0) + 15)
+            except ConnectionLost:
+                raise ObjectLostError(oid, f"owner {ref.owner} of {ref} died") from None
+            if rec is not None:
+                if rec[0] == "plasma":
+                    return PlasmaRecord(rec[1], rec[2])
+                if rec[0] == "inline":
+                    return rec[1]
+                return ErrorRecord(rec[1])
+
+    async def _record_to_value(self, ref: ObjectRef, record) -> Any:
+        if isinstance(record, ErrorRecord):
+            exc, tb = pickle.loads(record.error)
+            if isinstance(exc, TaskError):
+                raise exc
+            raise TaskError(exc, ref.hex()[:12], tb) from None
+        if isinstance(record, PlasmaRecord):
+            data = await self._fetch_plasma(ref, record)
+            so = serialization.SerializedObject.from_buffer(data)
+            return serialization.deserialize(so)
+        # inline bytes
+        return serialization.loads(record)
+
+    async def _fetch_plasma(self, ref: ObjectRef, record: PlasmaRecord):
+        if self.agent is None:
+            # Driver without an agent (shouldn't happen) — pull chunks directly.
+            node_id, addr = record.locations[0]
+            client = self.agent_clients.get(addr)
+            return await client.call("read_chunk", object_id=ref.id, offset=0,
+                                     length=record.size)
+        try:
+            res = await self.agent.call("fetch_object", object_id=ref.id,
+                                        size=record.size, locations=record.locations)
+            return self.shm_reader.read(res["path"], res["size"])
+        except (RemoteError, ConnectionLost):
+            return await self._try_reconstruct(ref, record)
+
+    async def _try_reconstruct(self, ref: ObjectRef, record: PlasmaRecord):
+        """Lineage reconstruction (reference: object_recovery_manager.h:41)."""
+        if not get_config().lineage_reconstruction_enabled:
+            raise ObjectLostError(ref.id)
+        if ref.owner not in ("", self.address):
+            owner = self.worker_clients.get(ref.owner)
+            ok = await owner.call("reconstruct_object", object_id=ref.id)
+            if not ok:
+                raise ObjectLostError(ref.id)
+            rec = await self._resolve_record(
+                ObjectRef(ref.id, owner=ref.owner, _register=False), None)
+            if isinstance(rec, PlasmaRecord):
+                res = await self.agent.call("fetch_object", object_id=ref.id,
+                                            size=rec.size, locations=rec.locations)
+                return self.shm_reader.read(res["path"], res["size"])
+            raise ObjectLostError(ref.id)
+        spec = self.task_manager.lineage.get(ref.id.task_id())
+        if spec is None:
+            raise ObjectLostError(ref.id)
+        self.memory_store.free(ref.id)
+        resub = pickle.loads(pickle.dumps(spec))  # fresh copy
+        resub.retry_count += 1
+        # Re-register as pending so the re-run's results are stored (complete()
+        # drops results for unknown tasks).
+        self.task_manager.add_pending(resub, [])
+        self._submit_spec(resub)
+        rec = await self._resolve_record(
+            ObjectRef(ref.id, owner=self.address, _register=False), None)
+        if isinstance(rec, PlasmaRecord):
+            res = await self.agent.call("fetch_object", object_id=ref.id,
+                                        size=rec.size, locations=rec.locations)
+            return self.shm_reader.read(res["path"], res["size"])
+        if isinstance(rec, ErrorRecord):
+            exc, tb = pickle.loads(rec.error)
+            raise TaskError(exc, "reconstruction", tb)
+        return rec  # inline bytes — caller deserializes? handled below
+
+    # ------------------------------------------------------------------ wait
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        self._on_block()
+        try:
+            return run_async(self.wait_async(refs, num_returns, timeout))
+        finally:
+            self._on_unblock()
+
+    async def wait_async(self, refs: List[ObjectRef], num_returns: int,
+                         timeout: Optional[float]):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        ready_set: set = set()
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def check_one(r: ObjectRef) -> bool:
+            if self.memory_store.contains(r.id):
+                return True
+            if r.owner in ("", self.address):
+                return False
+            try:
+                owner = self.worker_clients.get(r.owner)
+                rec = await owner.call("locate_object", object_id=r.id, timeout=0)
+                if rec is not None:
+                    return True
+            except Exception:
+                return True  # owner dead => resolved (to an error) on get
+            return False
+
+        while True:
+            for r in refs:
+                if r not in ready_set and await check_one(r):
+                    ready_set.add(r)
+            if len(ready_set) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.005)
+        ready = [r for r in refs if r in ready_set][:num_returns]
+        ready_ids = set(ready)
+        not_ready = [r for r in refs if r not in ready_ids]
+        return ready, not_ready
+
+    # ------------------------------------------------------------ submission
+
+    def submit_task(self, spec: TaskSpec, arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, owner=self.address)
+                for oid in spec.return_ids()]
+        run_async(self._submit_async(spec, arg_refs))
+        return refs
+
+    async def _submit_async(self, spec: TaskSpec, arg_refs: List[ObjectRef]):
+        self.task_manager.add_pending(spec, arg_refs)
+        self.task_event(spec, "SUBMITTED")
+        self._submit_spec(spec)
+
+    def _submit_spec(self, spec: TaskSpec):
+        bundle = None
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, tuple) and strategy and strategy[0] == "_pg":
+            bundle = (strategy[1], strategy[2])
+            strategy = NodeAffinitySchedulingStrategy(strategy[3], soft=False)
+        key = spec.scheduling_key() + ((bundle,) if bundle else ())
+        pool = self.lease_pools.get(key)
+        if pool is None:
+            pool = LeasePool(self, key, spec.resources, strategy, bundle)
+            self.lease_pools[key] = pool
+        pool.submit(spec)
+
+    # -------------------------------------------------------------- actors
+
+    def create_actor(self, spec: TaskSpec) -> str:
+        return run_async(self._create_actor_async(spec))
+
+    async def _create_actor_async(self, spec: TaskSpec) -> str:
+        aid = await self.gcs.call("register_actor", spec=spec)
+        self.actor_targets[aid] = ActorTarget(aid)
+        return aid
+
+    def submit_actor_task(self, actor_id: str, spec: TaskSpec,
+                          arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, owner=self.address) for oid in spec.return_ids()]
+        run_async(self._submit_actor_async(actor_id, spec, arg_refs))
+        return refs
+
+    async def _submit_actor_async(self, actor_id: str, spec: TaskSpec,
+                                  arg_refs: List[ObjectRef]):
+        self.task_manager.add_pending(spec, arg_refs)
+        self.task_event(spec, "SUBMITTED")
+        asyncio.ensure_future(self._run_actor_task(actor_id, spec))
+
+    async def _resolve_actor(self, actor_id: str, timeout: float = 120.0) -> ActorTarget:
+        tgt = self.actor_targets.setdefault(actor_id, ActorTarget(actor_id))
+        if tgt.state == "ALIVE" and tgt.address:
+            return tgt
+        info = await self.gcs.call("wait_actor_alive", actor_id=actor_id,
+                                   timeout=timeout, _timeout=timeout + 10)
+        if info is None or info.get("state") in ("DEAD",):
+            tgt.state = "DEAD"
+            raise ActorDiedError(actor_id, f"actor {actor_id[:12]} is dead: "
+                                           f"{(info or {}).get('death_cause')}")
+        if info.get("state") == "TIMEOUT":
+            raise ActorDiedError(actor_id, f"timed out resolving actor {actor_id[:12]}")
+        tgt.address = info["address"]
+        tgt.state = "ALIVE"
+        return tgt
+
+    async def _run_actor_task(self, actor_id: str, spec: TaskSpec):
+        retries = spec.max_retries  # = actor max_task_retries
+        while True:
+            # Hold the per-target lock across resolve + request *write* so that
+            # calls from this process hit the actor in submission order
+            # (reference: per-handle sequence numbers, actor_scheduling_queue.h:40).
+            tgt = self.actor_targets.setdefault(actor_id, ActorTarget(actor_id))
+            async with tgt.lock:
+                try:
+                    tgt = await self._resolve_actor(actor_id)
+                except ActorDiedError as e:
+                    self.task_manager.fail(spec.task_id, e)
+                    return
+                client = self.worker_clients.get(tgt.address)
+                spec.seq_no = tgt.seq = tgt.seq + 1
+                self.task_event(spec, "RUNNING")
+                try:
+                    fut = await client.call_start("actor_task", spec=spec)
+                except (ConnectionLost, OSError):
+                    fut = None
+            try:
+                if fut is None:
+                    raise ConnectionLost("actor connection lost before send")
+                results = await asyncio.wait_for(fut, 86400.0)
+                self.task_manager.complete(spec.task_id, results)
+                return
+            except ConnectionLost:
+                tgt.state = "RESTARTING"
+                tgt.address = None
+                info = await self.gcs.call("get_actor_info", actor_id=actor_id)
+                if info is None or info["state"] == "DEAD":
+                    self.task_manager.fail(
+                        spec.task_id,
+                        ActorDiedError(actor_id, f"actor {actor_id[:12]} died"))
+                    return
+                if retries == 0:
+                    self.task_manager.fail(
+                        spec.task_id,
+                        ActorDiedError(actor_id,
+                                       f"actor {actor_id[:12]} died while running "
+                                       f"{spec.name} (set max_task_retries to retry)"))
+                    return
+                if retries > 0:
+                    retries -= 1
+                await asyncio.sleep(0.1)
+            except RemoteError as e:
+                self.task_manager.fail(spec.task_id, e.cause, e.remote_traceback)
+                return
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        return run_async(self.gcs.call("kill_actor", actor_id=actor_id,
+                                       no_restart=no_restart))
+
+    # ----------------------------------------------------------- ref counting
+
+    def on_ref_count_zero(self, oid: ObjectID, owner: str):
+        """All owner-side counts (local/submitted/borrowers) hit zero."""
+        if self._shutdown:
+            return
+        try:
+            loop = get_loop()
+        except Exception:
+            return
+        asyncio.run_coroutine_threadsafe(self._free_owned(oid), loop)
+
+    def send_borrower_note(self, oid: ObjectID, owner: str, add: bool):
+        """Borrower-side: tell the owner we hold / released a copy of its object."""
+        if self._shutdown:
+            return
+        try:
+            loop = get_loop()
+        except Exception:
+            return
+
+        async def _notify():
+            try:
+                await self.worker_clients.get(owner).notify(
+                    "add_borrower_note" if add else "remove_borrower_note",
+                    object_id=oid)
+            except Exception:
+                pass
+
+        asyncio.run_coroutine_threadsafe(_notify(), loop)
+
+    async def _free_owned(self, oid: ObjectID):
+        if self.reference_counter.has_any_ref(oid):
+            return
+        rec = self.memory_store.get_if_exists(oid)
+        self.memory_store.free(oid)
+        if isinstance(rec, PlasmaRecord):
+            for node_id, addr in rec.locations:
+                try:
+                    await self.agent_clients.get(addr).call("store_free",
+                                                            object_ids=[oid])
+                except Exception:
+                    pass
+
+    def free(self, refs: List[ObjectRef]):
+        async def _free():
+            for r in refs:
+                await self._free_owned(r.id)
+        run_async(_free())
+
+    # ----------------------------------------------------- blocked accounting
+
+    def _on_block(self):
+        """Called when user code blocks on get/wait inside a task — tells the
+        agent to release the lease's resources so nested tasks can run
+        (reference: raylet releases resources for blocked workers,
+        ``local_task_manager.h``)."""
+        if self.mode != "worker" or self.agent is None:
+            return
+        self._blocked_depth += 1
+        if self._blocked_depth == 1:
+            self._notify_agent("worker_blocked")
+
+    def _on_unblock(self):
+        if self.mode != "worker" or self.agent is None:
+            return
+        self._blocked_depth -= 1
+        if self._blocked_depth == 0:
+            self._notify_agent("worker_unblocked")
+
+    def _notify_agent(self, method: str):
+        wid = self.worker_id.hex()
+
+        async def _send():
+            try:
+                await self.agent.notify(method, worker_id=wid)
+            except Exception:
+                pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(_send(), get_loop())
+        except Exception:
+            pass
+
+    # =========================================================== RPC handlers
+
+    async def handle_ping(self):
+        return "pong"
+
+    async def handle_locate_object(self, object_id: ObjectID, timeout: float = 30.0):
+        """Owner-side: return the record for an object, waiting for the producing
+        task up to `timeout`. None => not ready yet."""
+        if not self.memory_store.contains(object_id):
+            ok = await self.memory_store.wait_ready(object_id,
+                                                    timeout if timeout else 0.001)
+            if not ok:
+                return None
+        rec = self.memory_store.get_if_exists(object_id)
+        if isinstance(rec, PlasmaRecord):
+            return ("plasma", rec.size, rec.locations)
+        if isinstance(rec, ErrorRecord):
+            return ("error", rec.error)
+        return ("inline", rec)
+
+    async def handle_get_object(self, object_id: ObjectID):
+        return await self.handle_locate_object(object_id, timeout=30.0)
+
+    async def handle_reconstruct_object(self, object_id: ObjectID) -> bool:
+        spec = self.task_manager.lineage.get(object_id.task_id())
+        if spec is None:
+            return False
+        self.memory_store.free(object_id)
+        resub = pickle.loads(pickle.dumps(spec))
+        resub.retry_count += 1
+        self.task_manager.add_pending(resub, [])
+        self._submit_spec(resub)
+        return True
+
+    async def handle_remove_borrower_note(self, object_id: ObjectID):
+        self.reference_counter.remove_borrower(object_id)
+
+    async def handle_add_borrower_note(self, object_id: ObjectID):
+        self.reference_counter.add_borrower(object_id)
+
+    # -- execution (worker mode) ------------------------------------------
+
+    async def handle_push_task(self, spec: TaskSpec):
+        fut = asyncio.get_event_loop().create_future()
+        self.exec_queue.put(("task", spec, fut, asyncio.get_event_loop()))
+        return await fut
+
+    async def handle_create_actor(self, spec: TaskSpec):
+        fut = asyncio.get_event_loop().create_future()
+        self.exec_queue.put(("create_actor", spec, fut, asyncio.get_event_loop()))
+        return await fut
+
+    async def handle_actor_task(self, spec: TaskSpec):
+        if self.actor_spec is not None and self.actor_spec.is_async_actor:
+            return await self._run_async_actor_task(spec)
+        fut = asyncio.get_event_loop().create_future()
+        self.exec_queue.put(("task", spec, fut, asyncio.get_event_loop()))
+        return await fut
+
+    async def handle_exit_worker(self):
+        self.exec_queue.put(("exit", None, None, None))
+        return True
+
+    # -- executor loop (runs on the worker's MAIN thread) ------------------
+
+    def run_executor_loop(self):
+        """Main loop of a worker process: execute tasks from the queue.
+
+        Runs user code on the main thread so jax/TPU state is thread-stable.
+        Threaded actors (max_concurrency>1) fan out to a bounded pool
+        (reference: BoundedExecutor, thread_pool.h:36).
+        """
+        while not self._shutdown:
+            try:
+                item = self.exec_queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            kind, spec, fut, loop = item
+            if kind == "exit":
+                break
+            if (kind == "task" and self.actor_instance is not None
+                    and self.actor_spec.max_concurrency > 1):
+                self._actor_threadpool.submit(self._execute_and_reply, spec, fut, loop)
+            else:
+                self._execute_and_reply(spec, fut, loop)
+
+    def _execute_and_reply(self, spec: TaskSpec, fut, loop):
+        try:
+            if spec.is_actor_creation:
+                results = self._execute_actor_creation(spec)
+            else:
+                results = self._execute_task(spec)
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            results = [("error", pickle.dumps((_strip_exc(e), tb)))
+                       for _ in range(max(1, spec.num_returns))]
+        loop.call_soon_threadsafe(
+            lambda: fut.set_result(results) if not fut.done() else None)
+
+    def _load_function(self, fn_id: bytes):
+        fn = self.fn_cache.get(fn_id)
+        if fn is None:
+            blob = run_async(self.gcs.call("kv_get", ns="funcs", key=fn_id.hex()))
+            if blob is None:
+                raise RuntimeError(f"function {fn_id.hex()[:12]} not found in registry")
+            fn = serialization.loads_function(blob)
+            self.fn_cache[fn_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec):
+        so = serialization.SerializedObject.from_buffer(spec.args)
+        args, kwargs = serialization.deserialize(so)
+
+        def resolve(x):
+            if isinstance(x, _TopLevelRef):
+                return self.get(x.ref)
+            return x
+
+        return [resolve(a) for a in args], {k: resolve(v) for k, v in kwargs.items()}
+
+    def _execute_task(self, spec: TaskSpec):
+        from .runtime_context import _task_context
+        if spec.is_actor_task:
+            if self.actor_instance is None:
+                raise RuntimeError("actor task on a non-actor worker")
+            method = getattr(self.actor_instance, spec.actor_method)
+            fn = method
+        else:
+            fn = self._load_function(spec.fn_id)
+        args, kwargs = self._resolve_args(spec)
+        token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,
+                                   "actor_id": spec.actor_id, "name": spec.name})
+        try:
+            out = fn(*args, **kwargs)
+        finally:
+            _task_context.reset(token)
+        return self._package_returns(spec, out)
+
+    def _package_returns(self, spec: TaskSpec, out) -> List[tuple]:
+        n = spec.num_returns
+        values = [out] if n == 1 else list(out) if n > 1 else []
+        if n > 1 and len(values) != n:
+            raise ValueError(f"task {spec.name} declared num_returns={n} but "
+                             f"returned {len(values)} values")
+        results = []
+        cfg = get_config()
+        for v in values:
+            so = serialization.serialize(v)
+            size = so.flat_size()
+            if size <= cfg.max_direct_call_object_size or self.agent is None:
+                results.append(("inline", so.to_bytes()))
+            else:
+                oid = ObjectID.for_task_return(spec.task_id, len(results))
+                res = run_async(self.agent.call("store_create", object_id=oid,
+                                                size=size))
+                seg = ShmSegment(res["path"], size, create=False)
+                try:
+                    so.write_into(seg.view())
+                finally:
+                    seg.close()
+                run_async(self.agent.call("store_seal", object_id=oid))
+                results.append(("plasma", size, [(self.node_id, self.agent_address)]))
+        return results
+
+    def _execute_actor_creation(self, spec: TaskSpec):
+        cls = self._load_function(spec.fn_id)
+        args, kwargs = self._resolve_args(spec)
+        from .runtime_context import _task_context
+        token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,
+                                   "actor_id": spec.actor_id, "name": spec.name})
+        try:
+            self.actor_instance = cls(*args, **kwargs)
+        finally:
+            _task_context.reset(token)
+        self.actor_spec = spec
+        if spec.max_concurrency > 1 and not spec.is_async_actor:
+            from concurrent.futures import ThreadPoolExecutor
+            self._actor_threadpool = ThreadPoolExecutor(spec.max_concurrency)
+        if spec.is_async_actor:
+            self._actor_async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._actor_async_loop.run_forever,
+                                 name="actor-async", daemon=True)
+            t.start()
+        return [("inline", serialization.dumps(None))]
+
+    async def _run_async_actor_task(self, spec: TaskSpec):
+        """Async actors: run the coroutine on the actor's private loop with up to
+        max_concurrency concurrent tasks (reference: fiber/asyncio actors)."""
+        method = getattr(self.actor_instance, spec.actor_method)
+        args, kwargs = self._resolve_args(spec)
+
+        async def runner():
+            res = method(*args, **kwargs)
+            if asyncio.iscoroutine(res):
+                res = await res
+            return res
+
+        cfut = asyncio.run_coroutine_threadsafe(runner(), self._actor_async_loop)
+        try:
+            out = await asyncio.wrap_future(cfut)
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            return [("error", pickle.dumps((_strip_exc(e), tb)))
+                    for _ in range(max(1, spec.num_returns))]
+        return self._package_returns(spec, out)
+
+
+def _strip_exc(e: BaseException) -> BaseException:
+    """Make an exception picklable by dropping unpicklable attributes."""
+    try:
+        pickle.dumps(e)
+        return e
+    except Exception:
+        return RuntimeError(f"{type(e).__name__}: {e}")

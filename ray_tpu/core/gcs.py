@@ -1,0 +1,491 @@
+"""GCS-equivalent cluster control plane.
+
+One per cluster, like the reference's GCS server (``src/ray/gcs/gcs_server/gcs_server.h:79``,
+subsystems initialized at :120-177).  Owns:
+
+* **Node table + health checks** — agents register and heartbeat; missed heartbeats past
+  the failure threshold mark the node dead and publish it (reference:
+  ``GcsNodeManager`` + ``GcsHealthCheckManager``).
+* **Internal KV** — namespaced key/value store; also backs the function registry
+  (reference: ``GcsKvManager`` / ``function_manager.py`` shipping pickled defs via KV).
+* **Actor manager** — registration, placement via a node agent lease, restart-on-failure
+  up to ``max_restarts``, named/detached actors (reference: ``GcsActorManager``
+  ``gcs_actor_manager.cc:246,632`` + ``GcsActorScheduler``).
+* **Placement groups** — 2-phase prepare/commit bundle reservation across agents
+  (reference: ``GcsPlacementGroupScheduler``, ``node_manager.proto:388-395``).
+* **Pubsub** — long-lived subscriber connections receive one-way pushes per topic
+  (reference: ``src/ray/pubsub/``).
+* **Resource view broadcast** — aggregates agent heartbeats into the cluster view that
+  drives client-side scheduling (reference: RaySyncer gossip, ``ray_syncer.h:86``).
+* **Job table** and a bounded **task-event buffer** for the state API (reference:
+  ``GcsJobManager`` / ``GcsTaskManager``).
+
+State is optionally snapshotted to disk so a restarted GCS can recover cluster metadata
+(reference: Redis-backed ``gcs_table_storage.cc``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .common import TaskSpec
+from .config import get_config
+from .ids import ActorID, JobID, NodeID, PlacementGroupID
+from .rpc import ClientPool, RpcServer
+from .scheduling import NodeView, pack_bundles, pick_node
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persistence_path: Optional[str] = None):
+        self.server = RpcServer(self, host, port)
+        self.nodes: Dict[str, NodeView] = {}
+        self.node_last_seen: Dict[str, float] = {}
+        self._event_log: List[Tuple[int, str, dict]] = []
+        self._event_seq = 0
+        self._event_waiters: List[asyncio.Event] = []
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.actors: Dict[str, dict] = {}          # actor_id hex -> info
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor id hex
+        self.pgs: Dict[str, dict] = {}
+        self.jobs: Dict[str, dict] = {}
+        self.agent_clients = ClientPool()
+        self.task_events: deque = deque(maxlen=get_config().task_events_max_buffer)
+        self._job_counter = 0
+        self._bg: List[asyncio.Task] = []
+        self.persistence_path = persistence_path
+        self._started_at = time.time()
+
+    # ------------------------------------------------------------------ boot
+
+    async def start(self):
+        self._maybe_restore()
+        await self.server.start()
+        self._bg.append(asyncio.ensure_future(self._health_check_loop()))
+        return self
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        await self.agent_clients.close_all()
+        await self.server.stop()
+
+    # ------------------------------------------------------------- persistence
+
+    def _maybe_restore(self):
+        p = self.persistence_path
+        if p and os.path.exists(p):
+            with open(p, "rb") as f:
+                snap = pickle.load(f)
+            self.kv = snap.get("kv", {})
+            self.jobs = snap.get("jobs", {})
+            self.named_actors = snap.get("named_actors", {})
+            self.actors = snap.get("actors", {})
+            self._job_counter = snap.get("job_counter", 0)
+
+    def _persist(self):
+        p = self.persistence_path
+        if not p:
+            return
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"kv": self.kv, "jobs": self.jobs,
+                         "named_actors": self.named_actors, "actors": self.actors,
+                         "job_counter": self._job_counter}, f)
+        os.replace(tmp, p)
+
+    # ---------------------------------------------------------------- pubsub
+    #
+    # Long-poll pubsub (reference: GCS pubsub long-polling,
+    # ``core_worker.proto:436-441``): subscribers call ``pubsub_poll`` with a
+    # cursor; the call parks until an event past the cursor arrives for one of
+    # the requested topics.
+
+    def _publish(self, topic: str, payload: dict):
+        self._event_seq += 1
+        self._event_log.append((self._event_seq, topic, payload))
+        if len(self._event_log) > 10000:
+            del self._event_log[:5000]
+        for ev in self._event_waiters:
+            ev.set()
+
+    async def handle_pubsub_poll(self, topics: List[str], cursor: int,
+                                 timeout: float = 30.0):
+        def pending():
+            return [(seq, t, p) for seq, t, p in self._event_log
+                    if seq > cursor and t in topics]
+
+        got = pending()
+        if got:
+            return self._event_seq, got
+        ev = asyncio.Event()
+        self._event_waiters.append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            if ev in self._event_waiters:
+                self._event_waiters.remove(ev)
+        return self._event_seq, pending()
+
+    # ---------------------------------------------------------------- nodes
+
+    async def handle_register_node(self, node_id: str, address: str,
+                                   resources: Dict[str, float],
+                                   labels: Dict[str, str]):
+        self.nodes[node_id] = NodeView(node_id, address, dict(resources),
+                                       dict(resources), labels, True, 0)
+        self.node_last_seen[node_id] = time.monotonic()
+        self._publish("nodes", {"event": "alive", "node_id": node_id, "address": address})
+        return {"node_id": node_id, "cluster_view": self._view_payload()}
+
+    async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
+                               queue_len: int = 0, store_stats: dict | None = None):
+        n = self.nodes.get(node_id)
+        if n is None:
+            return {"unknown": True}  # agent should re-register
+        n.available = dict(available)
+        n.queue_len = queue_len
+        if not n.alive:
+            n.alive = True
+            self._publish("nodes", {"event": "alive", "node_id": node_id,
+                                    "address": n.address})
+        if store_stats:
+            n.labels["_store"] = store_stats
+        self.node_last_seen[node_id] = time.monotonic()
+        return {"view": self._view_payload()}
+
+    async def handle_drain_node(self, node_id: str):
+        await self._mark_node_dead(node_id, reason="drained")
+        return True
+
+    def _view_payload(self) -> Dict[str, dict]:
+        return {nid: {"address": n.address, "total": n.total,
+                      "available": n.available, "labels": {k: v for k, v in n.labels.items()
+                                                           if not k.startswith("_")},
+                      "alive": n.alive, "queue_len": n.queue_len}
+                for nid, n in self.nodes.items()}
+
+    async def handle_get_cluster_view(self):
+        return self._view_payload()
+
+    async def _health_check_loop(self):
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.health_check_period_s)
+            now = time.monotonic()
+            deadline = cfg.health_check_period_s * cfg.health_check_failure_threshold
+            for nid, n in list(self.nodes.items()):
+                if n.alive and now - self.node_last_seen.get(nid, now) > deadline:
+                    await self._mark_node_dead(nid, reason="heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        n = self.nodes.get(node_id)
+        if n is None or not n.alive:
+            return
+        n.alive = False
+        self._publish("nodes", {"event": "dead", "node_id": node_id, "reason": reason})
+        # Restart or fail actors that lived there (reference:
+        # GcsActorManager::OnNodeDead).
+        for aid, info in list(self.actors.items()):
+            if info.get("node_id") == node_id and info["state"] in ("ALIVE", "PENDING"):
+                await self._on_actor_failure(aid, f"node {node_id[:12]} died: {reason}")
+
+    # ------------------------------------------------------------------- KV
+
+    async def handle_kv_put(self, ns: str, key: str, value: bytes,
+                            overwrite: bool = True):
+        k = (ns, key)
+        if not overwrite and k in self.kv:
+            return False
+        self.kv[k] = value
+        self._persist()
+        return True
+
+    async def handle_kv_get(self, ns: str, key: str):
+        return self.kv.get((ns, key))
+
+    async def handle_kv_multi_get(self, ns: str, keys: List[str]):
+        return {k: self.kv[(ns, k)] for k in keys if (ns, k) in self.kv}
+
+    async def handle_kv_del(self, ns: str, key: str):
+        return self.kv.pop((ns, key), None) is not None
+
+    async def handle_kv_keys(self, ns: str, prefix: str = ""):
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    async def handle_kv_exists(self, ns: str, key: str):
+        return (ns, key) in self.kv
+
+    # ---------------------------------------------------------------- actors
+
+    async def handle_register_actor(self, spec: TaskSpec):
+        aid = spec.actor_id.hex()
+        if spec.actor_name:
+            key = (spec.namespace or "default", spec.actor_name)
+            if key in self.named_actors:
+                existing = self.named_actors[key]
+                if self.actors.get(existing, {}).get("state") != "DEAD":
+                    raise ValueError(f"actor name {spec.actor_name!r} already taken")
+            self.named_actors[key] = aid
+        self.actors[aid] = {
+            "actor_id": aid, "state": "PENDING", "spec": spec, "address": None,
+            "node_id": None, "restarts_left": spec.max_restarts, "name": spec.actor_name,
+            "namespace": spec.namespace or "default", "owner": spec.owner,
+            "death_cause": None, "num_restarts": 0, "class_name": spec.name,
+        }
+        asyncio.ensure_future(self._schedule_actor(aid))
+        return aid
+
+    async def _schedule_actor(self, aid: str, delay: float = 0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        info = self.actors.get(aid)
+        if info is None or info["state"] == "DEAD":
+            return
+        spec: TaskSpec = info["spec"]
+        for attempt in range(120):
+            nid = pick_node(self.nodes, spec.resources, spec.scheduling_strategy)
+            if nid is not None:
+                agent = self.agent_clients.get(self.nodes[nid].address)
+                try:
+                    res = await agent.call("create_actor", spec=spec)
+                    info.update(state="ALIVE", address=res["worker_address"],
+                                node_id=nid, worker_id=res["worker_id"])
+                    self._publish("actors", {"actor_id": aid, "state": "ALIVE",
+                                             "address": res["worker_address"]})
+                    return
+                except Exception as e:  # noqa: BLE001 — placement failure, retry
+                    info["last_error"] = repr(e)
+            await asyncio.sleep(0.25)
+        await self._fail_actor(aid, f"could not place actor: {info.get('last_error')}")
+
+    async def _on_actor_failure(self, aid: str, reason: str):
+        info = self.actors.get(aid)
+        # RESTARTING guard: the worker-death report that follows a deliberate
+        # restart-kill must not burn a second restart.
+        if info is None or info["state"] in ("DEAD", "RESTARTING"):
+            return
+        if info["restarts_left"] != 0:
+            if info["restarts_left"] > 0:
+                info["restarts_left"] -= 1
+            info["num_restarts"] += 1
+            info.update(state="RESTARTING", address=None, node_id=None)
+            self._publish("actors", {"actor_id": aid, "state": "RESTARTING"})
+            asyncio.ensure_future(self._schedule_actor(aid, delay=0.1))
+        else:
+            await self._fail_actor(aid, reason)
+
+    async def _fail_actor(self, aid: str, reason: str):
+        info = self.actors.get(aid)
+        if info is None:
+            return
+        info.update(state="DEAD", death_cause=reason)
+        self._publish("actors", {"actor_id": aid, "state": "DEAD", "reason": reason})
+
+    async def handle_report_actor_death(self, actor_id: str, reason: str,
+                                        expected: bool = False):
+        if expected:
+            await self._fail_actor(actor_id, reason)
+        else:
+            await self._on_actor_failure(actor_id, reason)
+        return True
+
+    async def handle_get_actor_info(self, actor_id: Optional[str] = None,
+                                    name: Optional[str] = None,
+                                    namespace: str = "default"):
+        if actor_id is None:
+            actor_id = self.named_actors.get((namespace, name))
+            if actor_id is None:
+                return None
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        return {k: v for k, v in info.items() if k != "spec"}
+
+    async def handle_wait_actor_alive(self, actor_id: str, timeout: float = 60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return None
+            if info["state"] == "ALIVE":
+                return {k: v for k, v in info.items() if k != "spec"}
+            if info["state"] == "DEAD":
+                return {k: v for k, v in info.items() if k != "spec"}
+            await asyncio.sleep(0.02)
+        return {"state": "TIMEOUT", "actor_id": actor_id}
+
+    async def handle_kill_actor(self, actor_id: str, no_restart: bool = True):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        if no_restart:
+            info["restarts_left"] = 0
+        addr = info.get("address")
+        nid = info.get("node_id")
+        if addr and nid and nid in self.nodes:
+            agent = self.agent_clients.get(self.nodes[nid].address)
+            try:
+                await agent.call("kill_worker", worker_id=info.get("worker_id"),
+                                 reason="ray.kill")
+            except Exception:
+                pass
+        if no_restart:
+            await self._fail_actor(actor_id, "killed via ray.kill")
+        else:
+            # Restartable kill: treat like a crash so max_restarts applies
+            # (reference: GcsActorManager::DestroyActor vs restart path).
+            await self._on_actor_failure(actor_id, "killed via ray.kill(no_restart=False)")
+        return True
+
+    async def handle_list_actors(self):
+        return [{k: v for k, v in info.items() if k != "spec"}
+                for info in self.actors.values()]
+
+    # ---------------------------------------------------------- placement groups
+
+    async def handle_create_placement_group(self, pg_id: str,
+                                            bundles: List[Dict[str, float]],
+                                            strategy: str, name: str = "",
+                                            lifetime: Optional[str] = None):
+        self.pgs[pg_id] = {"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+                           "state": "PENDING", "name": name, "placement": None,
+                           "lifetime": lifetime, "created_at": time.time()}
+        asyncio.ensure_future(self._schedule_pg(pg_id))
+        return pg_id
+
+    async def _schedule_pg(self, pg_id: str):
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return
+        for attempt in range(200):
+            placement = pack_bundles(self.nodes, info["bundles"], info["strategy"])
+            if placement is not None:
+                # 2-phase: prepare on all nodes, then commit (reference:
+                # PrepareBundleResources/CommitBundleResources RPCs).
+                prepared: List[Tuple[str, int]] = []
+                ok = True
+                for i, nid in enumerate(placement):
+                    agent = self.agent_clients.get(self.nodes[nid].address)
+                    try:
+                        good = await agent.call("prepare_bundle", pg_id=pg_id,
+                                                bundle_index=i,
+                                                resources=info["bundles"][i])
+                    except Exception:
+                        good = False
+                    if not good:
+                        ok = False
+                        break
+                    prepared.append((nid, i))
+                if ok:
+                    for i, nid in enumerate(placement):
+                        agent = self.agent_clients.get(self.nodes[nid].address)
+                        await agent.call("commit_bundle", pg_id=pg_id, bundle_index=i)
+                    info.update(state="CREATED",
+                                placement=[(nid, self.nodes[nid].address)
+                                           for nid in placement])
+                    self._publish("pgs", {"pg_id": pg_id, "state": "CREATED"})
+                    return
+                for nid, i in prepared:  # rollback
+                    agent = self.agent_clients.get(self.nodes[nid].address)
+                    try:
+                        await agent.call("return_bundle", pg_id=pg_id, bundle_index=i)
+                    except Exception:
+                        pass
+            if self.pgs.get(pg_id) is None:
+                return
+            await asyncio.sleep(0.25)
+        info["state"] = "INFEASIBLE"
+
+    async def handle_get_placement_group(self, pg_id: str):
+        return self.pgs.get(pg_id)
+
+    async def handle_wait_placement_group(self, pg_id: str, timeout: float = 60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.pgs.get(pg_id)
+            if info is None:
+                return None
+            if info["state"] in ("CREATED", "INFEASIBLE"):
+                return info
+            await asyncio.sleep(0.02)
+        return self.pgs.get(pg_id)
+
+    async def handle_remove_placement_group(self, pg_id: str):
+        info = self.pgs.pop(pg_id, None)
+        if info is None:
+            return False
+        if info.get("placement"):
+            for i, (nid, addr) in enumerate(info["placement"]):
+                if nid in self.nodes:
+                    agent = self.agent_clients.get(addr)
+                    try:
+                        await agent.call("return_bundle", pg_id=pg_id, bundle_index=i)
+                    except Exception:
+                        pass
+        self._publish("pgs", {"pg_id": pg_id, "state": "REMOVED"})
+        return True
+
+    async def handle_list_placement_groups(self):
+        return list(self.pgs.values())
+
+    # ----------------------------------------------------------------- jobs
+
+    async def handle_register_job(self, metadata: dict | None = None):
+        self._job_counter += 1
+        jid = JobID(self._job_counter.to_bytes(4, "big"))
+        self.jobs[jid.hex()] = {"job_id": jid.hex(), "state": "RUNNING",
+                                "start_time": time.time(),
+                                "metadata": metadata or {}}
+        self._persist()
+        return jid.hex()
+
+    async def handle_finish_job(self, job_id: str):
+        j = self.jobs.get(job_id)
+        if j:
+            j.update(state="FINISHED", end_time=time.time())
+            self._persist()
+        return True
+
+    async def handle_list_jobs(self):
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------ task events
+
+    async def handle_add_task_events(self, events: List[dict]):
+        self.task_events.extend(events)
+        return True
+
+    async def handle_list_task_events(self, limit: int = 1000,
+                                      filters: dict | None = None):
+        out = []
+        for ev in reversed(self.task_events):
+            if filters and any(ev.get(k) != v for k, v in filters.items()):
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
+    # ------------------------------------------------------------- debug/info
+
+    async def handle_cluster_info(self):
+        return {"started_at": self._started_at,
+                "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                "num_actors": len(self.actors),
+                "num_pgs": len(self.pgs),
+                "num_jobs": len(self.jobs)}
+
+    async def handle_ping(self):
+        return "pong"

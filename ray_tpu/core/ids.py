@@ -1,0 +1,112 @@
+"""Binary IDs for jobs, tasks, actors, objects, nodes, workers.
+
+Design follows the reference's ``src/ray/common/id.h``: fixed-width random binary ids with
+hex rendering; object ids embed the id of the task that produced them plus a return-index,
+so ownership and lineage can be derived from the id itself (reference: ``ObjectID::ForTaskReturn``).
+Sizes are chosen for compactness, not wire-compatibility.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_NIL = b""
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bin",)
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(f"{type(self).__name__} must be {self.SIZE} bytes, got {len(binary)}")
+        self._bin = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    @classmethod
+    def from_hex(cls, h: str):
+        return cls(bytes.fromhex(h))
+
+    def binary(self) -> bytes:
+        return self._bin
+
+    def hex(self) -> str:
+        return self._bin.hex()
+
+    def is_nil(self) -> bool:
+        return self._bin == b"\x00" * self.SIZE
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bin == self._bin
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bin))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bin,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    SIZE = 16
+
+
+class TaskID(BaseID):
+    SIZE = 16
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+
+class ObjectID(BaseID):
+    """TaskID (16B) + 4-byte big-endian return index."""
+
+    SIZE = 20
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + index.to_bytes(4, "big"))
+
+    @classmethod
+    def from_random(cls):  # for ray.put objects: synthesize a put-task id
+        return cls(os.urandom(16) + (0).to_bytes(4, "big"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bin[:16])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bin[16:], "big")
+
+
+class _Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._v += 1
+            return self._v

@@ -1,0 +1,595 @@
+"""Node agent — the per-node runtime daemon (raylet-equivalent).
+
+Plays the role of the reference's raylet (``src/ray/raylet/node_manager.h:125``):
+
+* **Worker pool** — spawns/pools worker subprocesses, prestart, idle reaping
+  (reference: ``worker_pool.h:152``).
+* **Worker leases** — clients request a lease for a task's resource demand; the agent
+  grants an idle/new worker, queues when saturated, or replies with a *spillback* target
+  chosen from the cluster view (reference: ``ClusterTaskManager`` queue + spillback,
+  ``cluster_task_manager.h:42``; ``HandleRequestWorkerLease`` ``node_manager.cc:1776``).
+* **Actor creation** — GCS delegates placement here: the agent leases a dedicated worker
+  and pushes the actor-creation task to it (reference: ``GcsActorScheduler`` leasing via
+  the same RequestWorkerLease path).
+* **Placement-group bundles** — 2-phase prepare/commit resource reservation
+  (reference: ``placement_group_resource_manager.h``, ``node_manager.proto:388-395``).
+* **Object store service** — hosts the node's shared-memory store; serves create/seal/
+  get/free plus chunked node-to-node pulls with admission control (reference: plasma in
+  raylet + ``ObjectManager``/``PullManager``, ``object_manager.h:117``, ``pull_manager.h:52``).
+* **Health** — heartbeats to GCS with available resources + queue length; monitors worker
+  subprocesses and reports actor deaths (reference: heartbeats +
+  ``NodeManager::HandleUnexpectedWorkerFailure``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .common import ResourceSet, TaskSpec, detect_node_resources
+from .config import get_config
+from .ids import NodeID, ObjectID, WorkerID
+from .object_store import NodeObjectStore, ObjectStoreFullError
+from .rpc import ClientPool, RpcClient, RpcServer
+from .scheduling import NodeView, pick_node
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: str
+    proc: Optional[asyncio.subprocess.Process]
+    state: str = "STARTING"          # STARTING | IDLE | LEASED | DEAD
+    address: str = ""
+    pid: int = 0
+    lease_id: Optional[str] = None
+    is_actor: bool = False
+    actor_id: Optional[str] = None
+    blocked: bool = False
+    idle_since: float = field(default_factory=time.monotonic)
+    registered: "asyncio.Event" = field(default_factory=asyncio.Event)
+
+
+@dataclass
+class LeaseRequest:
+    lease_id: str
+    resources: Dict[str, float]
+    bundle: Optional[Tuple[str, int]]  # (pg_id, bundle_index)
+    future: "asyncio.Future"
+    runtime_env: Optional[dict] = None
+    allow_spillback: bool = True
+
+
+class NodeAgent:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1", port: int = 0,
+                 num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 session_dir: str = "/tmp/raytpu",
+                 worker_env: Optional[Dict[str, str]] = None,
+                 object_store_memory: int = 0):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.server = RpcServer(self, host, port)
+        self.total = ResourceSet(detect_node_resources(num_cpus, num_tpus, resources))
+        self.available = ResourceSet(self.total.to_dict())
+        self.labels = dict(labels or {})
+        self.labels.setdefault("node_id", self.node_id.hex())
+        self.store = NodeObjectStore(self.node_id.hex()[:12], object_store_memory)
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.lease_queue: List[LeaseRequest] = []
+        self.bundles: Dict[Tuple[str, int], ResourceSet] = {}       # committed
+        self.prepared_bundles: Dict[Tuple[str, int], ResourceSet] = {}
+        self.gcs: Optional[RpcClient] = None
+        self.worker_clients = ClientPool()
+        self.agent_clients = ClientPool()
+        self.cluster_view: Dict[str, NodeView] = {}
+        self.session_dir = session_dir
+        self.worker_env = dict(worker_env or {})
+        self._bg: List[asyncio.Task] = []
+        self._pull_sem = asyncio.Semaphore(get_config().object_pull_max_concurrency)
+        self._lease_counter = 0
+        self._shutting_down = False
+
+    # ------------------------------------------------------------------ boot
+
+    async def start(self):
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        await self.server.start()
+        self.gcs = RpcClient(self.gcs_address)
+        res = await self.gcs.call("register_node", node_id=self.node_id.hex(),
+                                  address=self.server.address,
+                                  resources=self.total.to_dict(), labels=self.labels)
+        self._apply_view(res["cluster_view"])
+        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._bg.append(asyncio.ensure_future(self._idle_reaper_loop()))
+        cfg = get_config()
+        for _ in range(cfg.prestart_workers):
+            asyncio.ensure_future(self._spawn_worker())
+        return self
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    async def stop(self):
+        self._shutting_down = True
+        for t in self._bg:
+            t.cancel()
+        for w in list(self.workers.values()):
+            await self._kill_worker_proc(w)
+        await self.worker_clients.close_all()
+        await self.agent_clients.close_all()
+        if self.gcs:
+            await self.gcs.close()
+        await self.server.stop()
+        self.store.shutdown()
+
+    def _apply_view(self, payload: Dict[str, dict]):
+        self.cluster_view = {
+            nid: NodeView(nid, d["address"], d["total"], d["available"],
+                          d.get("labels", {}), d.get("alive", True),
+                          d.get("queue_len", 0))
+            for nid, d in payload.items()}
+
+    async def _heartbeat_loop(self):
+        cfg = get_config()
+        while not self._shutting_down:
+            try:
+                res = await self.gcs.call(
+                    "heartbeat", node_id=self.node_id.hex(),
+                    available=self.available.to_dict(),
+                    queue_len=len(self.lease_queue),
+                    store_stats=self.store.stats())
+                if res.get("unknown"):
+                    res2 = await self.gcs.call(
+                        "register_node", node_id=self.node_id.hex(),
+                        address=self.server.address,
+                        resources=self.total.to_dict(), labels=self.labels)
+                    self._apply_view(res2["cluster_view"])
+                elif "view" in res:
+                    self._apply_view(res["view"])
+                if self.lease_queue:
+                    await self._process_lease_queue()
+            except Exception:
+                await asyncio.sleep(0.5)
+            await asyncio.sleep(cfg.resource_broadcast_period_s)
+
+    async def _idle_reaper_loop(self):
+        cfg = get_config()
+        while not self._shutting_down:
+            await asyncio.sleep(max(cfg.idle_worker_timeout_s / 2, 0.5))
+            now = time.monotonic()
+            idle = [w for w in self.workers.values()
+                    if w.state == "IDLE" and now - w.idle_since > cfg.idle_worker_timeout_s]
+            # Keep a small warm pool; reap the rest (reference:
+            # idle_worker_killing_time_threshold_ms).
+            keep = int(self.total.get("CPU"))
+            n_idle = sum(1 for w in self.workers.values() if w.state == "IDLE")
+            for w in idle:
+                if n_idle <= keep:
+                    break
+                await self._kill_worker_proc(w)
+                n_idle -= 1
+
+    # ----------------------------------------------------------- worker pool
+
+    async def _spawn_worker(self, is_actor: bool = False) -> WorkerHandle:
+        worker_id = WorkerID.from_random().hex()
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        # Ensure spawned workers can import ray_tpu regardless of their cwd.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "RAYTPU_GCS_ADDRESS": self.gcs_address,
+            "RAYTPU_AGENT_ADDRESS": self.server.address,
+            "RAYTPU_NODE_ID": self.node_id.hex(),
+            "RAYTPU_WORKER_ID": worker_id,
+            "RAYTPU_CONFIG_JSON": get_config().to_json(),
+            "RAYTPU_SESSION_DIR": self.session_dir,
+        })
+        log = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
+        logf = open(log, "ab", buffering=0)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ray_tpu.core.worker_main",
+            stdout=logf, stderr=logf, env=env)
+        w = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid,
+                         is_actor=is_actor)
+        self.workers[worker_id] = w
+        asyncio.ensure_future(self._monitor_worker(w))
+        return w
+
+    async def _monitor_worker(self, w: WorkerHandle):
+        if w.proc is None:
+            return
+        await w.proc.wait()
+        await self._on_worker_exit(w, f"worker process exited with code {w.proc.returncode}")
+
+    async def _on_worker_exit(self, w: WorkerHandle, reason: str):
+        if w.state == "DEAD":
+            return
+        prev_state = w.state
+        w.state = "DEAD"
+        self.workers.pop(w.worker_id, None)
+        if prev_state == "LEASED" and w.lease_id and not w.is_actor:
+            if w.blocked:  # resources were already released at block time
+                self._lease_resources.pop(w.lease_id, None)
+            else:
+                self._release_lease_resources(w.lease_id)
+        if w.is_actor and w.actor_id and not self._shutting_down:
+            try:
+                await self.gcs.call("report_actor_death", actor_id=w.actor_id,
+                                    reason=reason)
+            except Exception:
+                pass
+            if w.lease_id:
+                if w.blocked:
+                    self._lease_resources.pop(w.lease_id, None)
+                else:
+                    self._release_lease_resources(w.lease_id)
+        await self._process_lease_queue()
+
+    async def _kill_worker_proc(self, w: WorkerHandle):
+        w.state = "DEAD"
+        self.workers.pop(w.worker_id, None)
+        if w.proc is not None:
+            try:
+                w.proc.kill()
+            except ProcessLookupError:
+                pass
+
+    async def handle_register_worker(self, worker_id: str, address: str, pid: int):
+        w = self.workers.get(worker_id)
+        if w is None:
+            return {"shutdown": True}
+        w.address = address
+        w.pid = pid
+        if w.state == "STARTING":
+            w.state = "IDLE"
+            w.idle_since = time.monotonic()
+        w.registered.set()
+        await self._process_lease_queue()
+        return {"node_id": self.node_id.hex(), "store_name": self.store.name}
+
+    # --------------------------------------------------------------- leases
+
+    @property
+    def _lease_resources(self) -> Dict[str, Dict[str, float]]:
+        if not hasattr(self, "_lease_res_map"):
+            self._lease_res_map: Dict[str, Dict[str, float]] = {}
+        return self._lease_res_map
+
+    def _next_lease_id(self) -> str:
+        self._lease_counter += 1
+        return f"{self.node_id.hex()[:8]}-{self._lease_counter}"
+
+    def _resource_pool_for(self, bundle: Optional[Tuple[str, int]]) -> ResourceSet:
+        if bundle is not None:
+            rs = self.bundles.get(tuple(bundle))
+            if rs is None:
+                raise ValueError(f"unknown placement bundle {bundle}")
+            return rs
+        return self.available
+
+    async def handle_request_worker_lease(self, resources: Dict[str, float],
+                                          bundle: Optional[Tuple[str, int]] = None,
+                                          runtime_env: Optional[dict] = None,
+                                          allow_spillback: bool = True):
+        """Grant {worker_address, worker_id, lease_id} | {spillback: node} | queue."""
+        pool = self._resource_pool_for(bundle)
+        if bundle is None and not ResourceSet(self.total.to_dict()).can_fit(resources):
+            return {"infeasible": True}
+        if pool.can_fit(resources):
+            return await self._grant_lease(resources, bundle, runtime_env)
+        # Saturated: spill to a node that can run it now (reference spillback).
+        spill = self._spillback_target(resources) if (allow_spillback and
+                                                      bundle is None) else None
+        if spill is not None:
+            return spill
+        fut = asyncio.get_event_loop().create_future()
+        req = LeaseRequest(self._next_lease_id(), resources,
+                           tuple(bundle) if bundle else None, fut, runtime_env,
+                           allow_spillback=allow_spillback)
+        self.lease_queue.append(req)
+        return await fut
+
+    def _spillback_target(self, resources: Dict[str, float]) -> Optional[dict]:
+        others = {nid: v for nid, v in self.cluster_view.items()
+                  if nid != self.node_id.hex()}
+        target = pick_node(others, resources, "DEFAULT")
+        if target is not None and others[target].can_fit_now(resources):
+            return {"spillback": {"node_id": target,
+                                  "address": others[target].address}}
+        return None
+
+    async def _grant_lease(self, resources, bundle, runtime_env) -> dict:
+        pool = self._resource_pool_for(bundle)
+        pool.acquire(resources)
+        lease_id = self._next_lease_id()
+        if bundle is None:
+            self._lease_resources[lease_id] = dict(resources)
+        else:
+            self._lease_resources[lease_id] = {}
+            self._bundle_of_lease[lease_id] = (tuple(bundle), dict(resources))
+        w = self._pop_idle_worker()
+        if w is None:
+            w = await self._spawn_worker()
+        w.state = "LEASED"
+        w.lease_id = lease_id
+        try:
+            await asyncio.wait_for(w.registered.wait(),
+                                   get_config().worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            await self._kill_worker_proc(w)
+            self._release_lease_resources(lease_id)
+            raise RuntimeError("worker failed to register in time")
+        return {"worker_address": w.address, "worker_id": w.worker_id,
+                "lease_id": lease_id, "node_id": self.node_id.hex()}
+
+    @property
+    def _bundle_of_lease(self) -> Dict[str, Tuple[Tuple[str, int], Dict[str, float]]]:
+        if not hasattr(self, "_bundle_lease_map"):
+            self._bundle_lease_map = {}
+        return self._bundle_lease_map
+
+    def _release_lease_resources(self, lease_id: str):
+        if lease_id in self._bundle_of_lease:
+            bundle, res = self._bundle_of_lease.pop(lease_id)
+            rs = self.bundles.get(bundle)
+            if rs is not None:
+                rs.release(res)
+        else:
+            self.available.release(self._lease_resources.get(lease_id, {}))
+        self._lease_resources.pop(lease_id, None)
+
+    def _pop_idle_worker(self) -> Optional[WorkerHandle]:
+        best = None
+        for w in self.workers.values():
+            if w.state == "IDLE":
+                if best is None or w.idle_since > best.idle_since:
+                    best = w  # MRU: keep caches warm
+        return best
+
+    async def handle_worker_blocked(self, worker_id: str):
+        """A leased worker blocked on get/wait: release its lease resources so
+        nested tasks can run on this node (reference: raylet releases CPU for
+        blocked workers — local_task_manager dispatch accounting)."""
+        w = self.workers.get(worker_id)
+        if (w is not None and w.state == "LEASED" and w.lease_id
+                and not w.blocked):
+            res = self._lease_resources.get(w.lease_id)
+            if res:
+                w.blocked = True
+                self.available.release(res)
+                await self._process_lease_queue()
+        return True
+
+    async def handle_worker_unblocked(self, worker_id: str):
+        w = self.workers.get(worker_id)
+        if w is not None and w.blocked:
+            w.blocked = False
+            res = self._lease_resources.get(w.lease_id or "", {})
+            self.available.force_acquire(res)
+        return True
+
+    async def handle_return_worker_lease(self, lease_id: str, worker_id: str,
+                                         worker_alive: bool = True):
+        w0 = self.workers.get(worker_id)
+        if w0 is not None and w0.blocked and w0.lease_id == lease_id:
+            # Block already released the resources; just drop the record.
+            w0.blocked = False
+            self._lease_resources.pop(lease_id, None)
+            self._bundle_of_lease.pop(lease_id, None)
+        else:
+            self._release_lease_resources(lease_id)
+        w = self.workers.get(worker_id)
+        if w is not None and w.lease_id == lease_id:
+            if worker_alive and w.state == "LEASED":
+                w.state = "IDLE"
+                w.lease_id = None
+                w.idle_since = time.monotonic()
+            elif not worker_alive:
+                await self._kill_worker_proc(w)
+        await self._process_lease_queue()
+        return True
+
+    async def _process_lease_queue(self):
+        i = 0
+        while i < len(self.lease_queue):
+            req = self.lease_queue[i]
+            try:
+                pool = self._resource_pool_for(req.bundle)
+            except ValueError:
+                self.lease_queue.pop(i)
+                if not req.future.done():
+                    req.future.set_exception(ValueError(f"bundle {req.bundle} removed"))
+                continue
+            if pool.can_fit(req.resources):
+                self.lease_queue.pop(i)
+                try:
+                    grant = await self._grant_lease(req.resources, req.bundle,
+                                                    req.runtime_env)
+                    if not req.future.done():
+                        req.future.set_result(grant)
+                except Exception as e:  # noqa: BLE001
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                continue
+            # Re-evaluate spillback for queued requests: the cluster view may
+            # have been stale (or other nodes freed up) since the request was
+            # queued (reference: ClusterTaskManager retries spillback on each
+            # scheduling pass).
+            if req.allow_spillback and req.bundle is None:
+                spill = self._spillback_target(req.resources)
+                if spill is not None:
+                    self.lease_queue.pop(i)
+                    if not req.future.done():
+                        req.future.set_result(spill)
+                    continue
+            i += 1
+
+    async def handle_kill_worker(self, worker_id: str, reason: str = ""):
+        w = self.workers.get(worker_id)
+        if w is None:
+            return False
+        await self._kill_worker_proc(w)
+        return True
+
+    # --------------------------------------------------------------- actors
+
+    async def handle_create_actor(self, spec: TaskSpec):
+        """Lease a dedicated worker and run the actor-creation task on it
+        (reference: GcsActorScheduler lease + PushTask of the creation task)."""
+        grant = await self.handle_request_worker_lease(
+            resources=spec.resources, runtime_env=spec.runtime_env,
+            allow_spillback=False)
+        if "worker_address" not in grant:
+            raise RuntimeError(f"cannot place actor here: {grant}")
+        w = self.workers[grant["worker_id"]]
+        w.is_actor = True
+        w.actor_id = spec.actor_id.hex()
+        client = self.worker_clients.get(grant["worker_address"])
+        try:
+            await client.call("create_actor", spec=spec)
+        except Exception:
+            await self._kill_worker_proc(w)
+            self._release_lease_resources(grant["lease_id"])
+            raise
+        return {"worker_address": grant["worker_address"],
+                "worker_id": grant["worker_id"]}
+
+    # ------------------------------------------------------ placement bundles
+
+    async def handle_prepare_bundle(self, pg_id: str, bundle_index: int,
+                                    resources: Dict[str, float]) -> bool:
+        key = (pg_id, bundle_index)
+        if key in self.prepared_bundles or key in self.bundles:
+            return True
+        if not self.available.can_fit(resources):
+            return False
+        self.available.acquire(resources)
+        self.prepared_bundles[key] = ResourceSet(resources)
+        return True
+
+    async def handle_commit_bundle(self, pg_id: str, bundle_index: int) -> bool:
+        key = (pg_id, bundle_index)
+        rs = self.prepared_bundles.pop(key, None)
+        if rs is None:
+            return key in self.bundles
+        self.bundles[key] = rs
+        return True
+
+    async def handle_return_bundle(self, pg_id: str, bundle_index: int) -> bool:
+        key = (pg_id, bundle_index)
+        rs = self.prepared_bundles.pop(key, None) or self.bundles.pop(key, None)
+        if rs is not None:
+            self.available.release(rs.to_dict())
+        await self._process_lease_queue()
+        return True
+
+    # ----------------------------------------------------------- object store
+
+    async def handle_store_create(self, object_id: ObjectID, size: int):
+        try:
+            path = self.store.create(object_id, size)
+        except ObjectStoreFullError as e:
+            raise e
+        return {"path": path}
+
+    async def handle_store_seal(self, object_id: ObjectID):
+        self.store.seal(object_id)
+        return True
+
+    async def handle_store_put(self, object_id: ObjectID, data: bytes):
+        self.store.create_and_write(object_id, data)
+        return {"path": self.store.get_path(object_id)[0]}
+
+    async def handle_store_get(self, object_id: ObjectID,
+                               timeout: Optional[float] = 0.0):
+        if not self.store.contains(object_id):
+            if not timeout:
+                return None
+            ok = await self.store.wait_sealed(object_id, timeout)
+            if not ok:
+                return None
+        path, size = self.store.get_path(object_id)
+        return {"path": path, "size": size}
+
+    async def handle_store_free(self, object_ids: List[ObjectID]):
+        for oid in object_ids:
+            self.store.free(oid)
+        return True
+
+    async def handle_store_contains(self, object_id: ObjectID) -> bool:
+        return self.store.contains(object_id)
+
+    async def handle_store_stats(self):
+        return self.store.stats()
+
+    # -------------------------------------------------------- object transfer
+
+    async def handle_read_chunk(self, object_id: ObjectID, offset: int, length: int):
+        """Serve a chunk of a sealed local object to a remote agent
+        (reference: chunked object push/pull, object_manager.proto:61)."""
+        return self.store.read_chunk(object_id, offset, length)
+
+    async def handle_fetch_object(self, object_id: ObjectID, size: int,
+                                  locations: List[Tuple[str, str]]):
+        """Ensure `object_id` is in the local store, pulling from a remote node
+        if needed. Returns {path, size} (reference: PullManager admission-
+        controlled prioritized pulls)."""
+        if self.store.contains(object_id):
+            path, sz = self.store.get_path(object_id)
+            return {"path": path, "size": sz}
+        async with self._pull_sem:
+            if self.store.contains(object_id):
+                path, sz = self.store.get_path(object_id)
+                return {"path": path, "size": sz}
+            cfg = get_config()
+            last_err: Optional[Exception] = None
+            for node_id, addr in locations:
+                if addr == self.server.address:
+                    continue
+                client = self.agent_clients.get(addr)
+                try:
+                    path = self.store.create(object_id, size)
+                    from .object_store import ShmSegment
+                    seg = self.store._entries[object_id].segment
+                    off = 0
+                    while off < size:
+                        n = min(cfg.object_transfer_chunk_bytes, size - off)
+                        chunk = await client.call("read_chunk", object_id=object_id,
+                                                  offset=off, length=n)
+                        seg.view()[off:off + len(chunk)] = chunk
+                        off += len(chunk)
+                    self.store.seal(object_id)
+                    path, sz = self.store.get_path(object_id)
+                    return {"path": path, "size": sz}
+                except Exception as e:  # noqa: BLE001 — try next location
+                    last_err = e
+                    self.store.free(object_id)
+            raise RuntimeError(
+                f"failed to fetch {object_id} from {locations}: {last_err}")
+
+    # ----------------------------------------------------------------- misc
+
+    async def handle_ping(self):
+        return "pong"
+
+    async def handle_node_info(self):
+        return {"node_id": self.node_id.hex(), "address": self.server.address,
+                "total": self.total.to_dict(), "available": self.available.to_dict(),
+                "num_workers": len(self.workers),
+                "workers": {wid: {"state": w.state, "pid": w.pid,
+                                  "actor_id": w.actor_id}
+                            for wid, w in self.workers.items()},
+                "store": self.store.stats(),
+                "queue_len": len(self.lease_queue),
+                "queued_demands": [r.resources for r in self.lease_queue],
+                "cluster_view": {nid: {"available": v.available, "alive": v.alive}
+                                 for nid, v in self.cluster_view.items()}}

@@ -1,0 +1,363 @@
+"""Object storage: per-process memory store + per-node shared-memory (plasma-equivalent) store.
+
+Two tiers, like the reference:
+
+* **MemoryStore** — in-process store for small objects and for location records of large
+  ones (reference: ``src/ray/core_worker/store_provider/memory_store/memory_store.h:43``).
+  Values <= ``max_direct_call_object_size`` live here in full and travel inline in RPC
+  replies; larger objects are represented by a :class:`PlasmaRecord` pointing at the node
+  that holds the primary copy.
+
+* **NodeObjectStore** — per-node shared-memory store (reference: plasma,
+  ``src/ray/object_manager/plasma/store.h:55``).  Implemented as mmap'd files under
+  ``/dev/shm`` (one per object — the same mmap+fd design plasma uses, minus the custom
+  dlmalloc arena; an arena allocator is a planned C++ upgrade).  Any process on the node
+  attaches segments by path for zero-copy reads.  Create/seal/get/free run inside the node
+  agent; eviction is LRU over sealed, unpinned objects with optional spill-to-disk
+  (reference: ``src/ray/raylet/local_object_manager.h:41``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .config import get_config
+from .ids import ObjectID
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory segments
+# ---------------------------------------------------------------------------
+
+class ShmSegment:
+    """One mmap'd file; create-mode unlinks on free, attach-mode is read-only."""
+
+    def __init__(self, path: str, size: int, create: bool):
+        self.path = path
+        self.size = size
+        self.created = create
+        flags = os.O_RDWR | (os.O_CREAT | os.O_EXCL if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+
+    def view(self) -> memoryview:
+        return memoryview(self.mm)
+
+    def close(self):
+        try:
+            self.mm.close()
+        except (BufferError, ValueError):
+            pass  # outstanding zero-copy views keep the map alive until GC
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def shm_path_for(store_name: str, object_id: ObjectID) -> str:
+    return os.path.join(_SHM_DIR, f"raytpu-{store_name}-{object_id.hex()}")
+
+
+# ---------------------------------------------------------------------------
+# Node-level store (runs inside the node agent)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Entry:
+    segment: ShmSegment
+    size: int
+    sealed: bool = False
+    pinned: int = 0          # pin count from in-flight gets/pending transfers
+    last_access: float = field(default_factory=time.monotonic)
+
+
+class NodeObjectStore:
+    """Plasma-equivalent store; all methods are called on the agent's IO loop."""
+
+    def __init__(self, name: str, capacity: int = 0):
+        cfg = get_config()
+        if capacity <= 0:
+            capacity = cfg.object_store_memory
+        if capacity <= 0:
+            try:
+                total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+            except (ValueError, OSError):
+                total = 8 << 30
+            capacity = int(total * 0.3)
+        self.name = name
+        self.capacity = capacity
+        self.used = 0
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._sealed_events: Dict[ObjectID, asyncio.Event] = {}
+        self.num_creates = 0
+        self.num_evictions = 0
+        self.spill_dir = cfg.object_spilling_dir or None
+
+    # -- creation ---------------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> str:
+        """Allocate a segment; returns the shm path the writer should mmap."""
+        if object_id in self._entries:
+            return self._entries[object_id].segment.path
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object {object_id} ({size} B) exceeds store capacity {self.capacity} B")
+        if self.used + size > self.capacity:
+            self._evict(self.used + size - self.capacity)
+        path = shm_path_for(self.name, object_id)
+        try:
+            seg = ShmSegment(path, size, create=True)
+        except FileExistsError:
+            os.unlink(path)
+            seg = ShmSegment(path, size, create=True)
+        self._entries[object_id] = _Entry(segment=seg, size=size)
+        self.used += size
+        self.num_creates += 1
+        return path
+
+    def create_and_write(self, object_id: ObjectID, data) -> str:
+        path = self.create(object_id, len(data))
+        e = self._entries[object_id]
+        e.segment.view()[: len(data)] = data
+        self.seal(object_id)
+        return path
+
+    def seal(self, object_id: ObjectID):
+        e = self._entries[object_id]
+        e.sealed = True
+        ev = self._sealed_events.pop(object_id, None)
+        if ev:
+            ev.set()
+
+    # -- reads ------------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        e = self._entries.get(object_id)
+        return e is not None and e.sealed
+
+    async def wait_sealed(self, object_id: ObjectID, timeout: float | None = None) -> bool:
+        e = self._entries.get(object_id)
+        if e is not None and e.sealed:
+            return True
+        ev = self._sealed_events.setdefault(object_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def get_path(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
+        e = self._entries.get(object_id)
+        if e is None or not e.sealed:
+            if e is None:
+                self._maybe_restore(object_id)
+                e = self._entries.get(object_id)
+                if e is None or not e.sealed:
+                    return None
+            else:
+                return None
+        e.last_access = time.monotonic()
+        return e.segment.path, e.size
+
+    def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
+        e = self._entries.get(object_id)
+        if e is None:
+            self._maybe_restore(object_id)
+            e = self._entries[object_id]
+        e.last_access = time.monotonic()
+        return bytes(e.segment.view()[offset:offset + length])
+
+    def size_of(self, object_id: ObjectID) -> Optional[int]:
+        e = self._entries.get(object_id)
+        return e.size if e else None
+
+    # -- lifetime ---------------------------------------------------------
+
+    def pin(self, object_id: ObjectID):
+        e = self._entries.get(object_id)
+        if e:
+            e.pinned += 1
+
+    def unpin(self, object_id: ObjectID):
+        e = self._entries.get(object_id)
+        if e and e.pinned > 0:
+            e.pinned -= 1
+
+    def free(self, object_id: ObjectID):
+        # A freed object may live in shm, on the spill disk, or both.
+        spilled = self._spilled.pop(object_id, None)
+        if spilled:
+            try:
+                os.unlink(spilled)
+            except OSError:
+                pass
+        e = self._entries.pop(object_id, None)
+        if e is None:
+            return
+        self.used -= e.size
+        e.segment.close()
+        e.segment.unlink()
+
+    def _evict(self, need_bytes: int):
+        """LRU-evict sealed unpinned entries; spill them first if configured."""
+        victims = sorted(
+            (e for oid, e in self._entries.items() if e.sealed and e.pinned == 0),
+            key=lambda e: e.last_access)
+        freed = 0
+        for e in victims:
+            if freed >= need_bytes:
+                break
+            oid = next(k for k, v in self._entries.items() if v is e)
+            if self.spill_dir:
+                self._spill(oid, e)
+            self._entries.pop(oid)
+            self.used -= e.size
+            freed += e.size
+            e.segment.close()
+            e.segment.unlink()
+            self.num_evictions += 1
+        if freed < need_bytes:
+            raise ObjectStoreFullError(
+                f"store {self.name}: need {need_bytes} B but only {freed} B evictable "
+                f"(used={self.used}/{self.capacity})")
+
+    def _spill(self, object_id: ObjectID, e: _Entry):
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, f"{self.name}-{object_id.hex()}.spill")
+        with open(path, "wb") as f:
+            f.write(e.segment.view())
+        self._spilled.setdefault(object_id, path)
+
+    @property
+    def _spilled(self) -> Dict[ObjectID, str]:
+        if not hasattr(self, "_spilled_map"):
+            self._spilled_map: Dict[ObjectID, str] = {}
+        return self._spilled_map
+
+    def _maybe_restore(self, object_id: ObjectID):
+        path = self._spilled.pop(object_id, None)
+        if path is None:
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        self.create_and_write(object_id, data)
+        os.unlink(path)
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "used": self.used,
+            "num_objects": len(self._entries),
+            "num_creates": self.num_creates,
+            "num_evictions": self.num_evictions,
+        }
+
+    def shutdown(self):
+        for oid in list(self._entries):
+            self.free(oid)
+
+
+# ---------------------------------------------------------------------------
+# Per-process attach-side client
+# ---------------------------------------------------------------------------
+
+class ShmReader:
+    """Attach-side cache of mapped segments for zero-copy reads."""
+
+    def __init__(self):
+        self._maps: Dict[str, ShmSegment] = {}
+
+    def read(self, path: str, size: int) -> memoryview:
+        seg = self._maps.get(path)
+        if seg is None:
+            seg = ShmSegment(path, size, create=False)
+            self._maps[path] = seg
+        return seg.view()[:size]
+
+    def drop(self, path: str):
+        seg = self._maps.pop(path, None)
+        if seg:
+            seg.close()
+
+    def close(self):
+        for seg in self._maps.values():
+            seg.close()
+        self._maps.clear()
+
+
+# ---------------------------------------------------------------------------
+# In-process memory store (owner-side)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PlasmaRecord:
+    """Location record for a large object (primary copy + replicas)."""
+    size: int
+    locations: list  # list of (node_id_hex, agent_address)
+
+
+@dataclass
+class ErrorRecord:
+    """A task error stored in place of a value; raised on get."""
+    error: bytes  # pickled exception
+
+
+class MemoryStore:
+    """Owner-side store: object id -> inline bytes | PlasmaRecord | ErrorRecord.
+
+    Readiness is an asyncio.Event per pending id, so `get`/`wait` can await
+    completion of the producing task (reference: GetRequest futures in
+    memory_store.cc).
+    """
+
+    def __init__(self):
+        self._values: Dict[ObjectID, object] = {}
+        self._events: Dict[ObjectID, asyncio.Event] = {}
+
+    def put(self, object_id: ObjectID, record) -> None:
+        self._values[object_id] = record
+        ev = self._events.pop(object_id, None)
+        if ev:
+            ev.set()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._values
+
+    def get_if_exists(self, object_id: ObjectID):
+        return self._values.get(object_id)
+
+    async def wait_ready(self, object_id: ObjectID, timeout: float | None = None) -> bool:
+        if object_id in self._values:
+            return True
+        ev = self._events.setdefault(object_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def free(self, object_id: ObjectID):
+        self._values.pop(object_id, None)
+        self._events.pop(object_id, None)
+
+    def __len__(self):
+        return len(self._values)

@@ -1,0 +1,120 @@
+"""@remote functions (reference: ``python/ray/remote_function.py`` — RemoteFunction :40,
+``_remote`` :257 builds the TaskSpec options).
+
+Functions ship by content hash through the GCS KV function registry once per process
+(reference: ``python/ray/_private/function_manager.py``); the TaskSpec carries only the
+hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+from . import serialization
+from .common import PlacementGroupSchedulingStrategy, TaskSpec, _TopLevelRef
+from .config import get_config
+from .ids import TaskID
+from .object_ref import ObjectRef
+from .rpc import run_async
+
+
+def _wrap_args(args, kwargs):
+    """Wrap top-level ObjectRefs so the executor resolves them to values
+    (nested refs pass through as refs — ray argument semantics)."""
+    wargs = [(_TopLevelRef(a) if isinstance(a, ObjectRef) else a) for a in args]
+    wkwargs = {k: (_TopLevelRef(v) if isinstance(v, ObjectRef) else v)
+               for k, v in kwargs.items()}
+    return wargs, wkwargs
+
+
+def serialize_args(args, kwargs):
+    wargs, wkwargs = _wrap_args(args, kwargs)
+    so = serialization.serialize((wargs, wkwargs))
+    return so.to_bytes(), list(so.contained_refs)
+
+
+class RemoteFunction:
+    def __init__(self, fn, default_options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._opts = dict(default_options or {})
+        self._blob: Optional[bytes] = None
+        self._fn_id: Optional[bytes] = None
+        self._registered_in: set = set()
+        self.__name__ = getattr(fn, "__name__", "anonymous")
+
+    # -- registration ------------------------------------------------------
+
+    def _ensure_registered(self, worker) -> bytes:
+        if self._blob is None:
+            self._blob = serialization.dumps_function(self._fn)
+            self._fn_id = hashlib.sha1(self._blob).digest()[:16]
+        key = id(worker)
+        if key not in self._registered_in:
+            run_async(worker.gcs.call("kv_put", ns="funcs", key=self._fn_id.hex(),
+                                      value=self._blob, overwrite=False))
+            self._registered_in.add(key)
+        return self._fn_id
+
+    # -- public API --------------------------------------------------------
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(opts)
+        rf = RemoteFunction(self._fn, merged)
+        rf._blob, rf._fn_id = self._blob, self._fn_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from .core_worker import global_worker
+        w = global_worker()
+        fn_id = self._ensure_registered(w)
+        o = self._opts
+        resources = dict(o.get("resources") or {})
+        resources["CPU"] = float(o.get("num_cpus", 1))
+        if o.get("num_tpus"):
+            resources["TPU"] = float(o["num_tpus"])
+        if o.get("num_gpus"):
+            resources["GPU"] = float(o["num_gpus"])
+        if o.get("memory"):
+            resources["memory"] = float(o["memory"])
+        strategy = o.get("scheduling_strategy", "DEFAULT")
+        strategy = resolve_pg_strategy(strategy)
+        args_blob, arg_refs = serialize_args(args, kwargs)
+        num_returns = o.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=w.job_id,
+            name=o.get("name") or self.__name__,
+            fn_id=fn_id,
+            args=args_blob,
+            num_returns=num_returns,
+            resources=resources,
+            owner=w.address,
+            scheduling_strategy=strategy,
+            max_retries=o.get("max_retries", get_config().default_task_max_retries),
+            retry_exceptions=bool(o.get("retry_exceptions", False)),
+            runtime_env=o.get("runtime_env"),
+        )
+        refs = w.submit_task(spec, arg_refs)
+        if num_returns == 0:
+            return None
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Remote function '{self.__name__}' cannot be called directly. "
+                        f"Use '{self.__name__}.remote()'.")
+
+
+def resolve_pg_strategy(strategy):
+    """Resolve a PlacementGroupSchedulingStrategy to a bundle-pinned node affinity
+    (the PG manager placed bundles on concrete nodes at creation)."""
+    if not isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return strategy
+    pg = strategy.placement_group
+    idx = strategy.placement_group_bundle_index
+    if idx < 0:
+        idx = 0
+    placement = pg.bundle_placement()
+    node_id, _addr = placement[idx]
+    return ("_pg", pg.id, idx, node_id)

@@ -1,0 +1,296 @@
+"""Asyncio RPC: length-prefixed pickled messages over TCP.
+
+Plays the role of the reference's gRPC wrapper layer (``src/ray/rpc/`` — ``grpc_server.h``,
+``client_call.h``): every control-plane service (GCS-equivalent, node agents, workers)
+exposes coroutine handlers on an :class:`RpcServer`; clients hold persistent connections
+with request/response correlation, automatic reconnect, and call timeouts (reference:
+retryable gRPC clients).  The wire format is ``4-byte length | pickle((req_id, method,
+args))``; responses are ``(req_id, ok, payload)``.  Messages with ``req_id < 0`` are
+one-way notifications (used by pubsub long-polls, reference ``src/ray/pubsub/``).
+
+A single background event-loop thread per process hosts every server and client
+(reference analogue: the single-threaded asio io_context per component,
+``src/ray/common/asio/``) — this keeps handler code free of locks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from .config import get_config
+
+_loop_lock = threading.Lock()
+_loop_thread: Optional[threading.Thread] = None
+_loop: Optional[asyncio.AbstractEventLoop] = None
+
+
+def get_loop() -> asyncio.AbstractEventLoop:
+    """The process-wide background event loop (started lazily)."""
+    global _loop, _loop_thread
+    with _loop_lock:
+        if _loop is None or _loop.is_closed():
+            loop = asyncio.new_event_loop()
+            started = threading.Event()
+
+            def _run():
+                asyncio.set_event_loop(loop)
+                loop.call_soon(started.set)
+                loop.run_forever()
+
+            t = threading.Thread(target=_run, name="raytpu-io", daemon=True)
+            t.start()
+            started.wait()
+            _loop, _loop_thread = loop, t
+        return _loop
+
+
+def run_async(coro, timeout: float | None = None):
+    """Run a coroutine on the IO loop from a synchronous caller."""
+    loop = get_loop()
+    if threading.current_thread() is _loop_thread:
+        raise RuntimeError("run_async called from the IO loop thread (would deadlock)")
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+    return fut.result(timeout)
+
+
+def _encode(msg) -> bytes:
+    payload = pickle.dumps(msg, protocol=5)
+    return len(payload).to_bytes(4, "big") + payload
+
+
+async def _read_msg(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(4)
+    n = int.from_bytes(hdr, "big")
+    return pickle.loads(await reader.readexactly(n))
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """Handler raised; carries the remote traceback string."""
+
+    def __init__(self, cause: BaseException, tb: str):
+        super().__init__(f"{type(cause).__name__}: {cause}\n--- remote traceback ---\n{tb}")
+        self.cause = cause
+        self.remote_traceback = tb
+
+
+class RpcServer:
+    """Dispatches ``(req_id, method, kwargs)`` to ``handler.handle_<method>`` coroutines."""
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    def start_sync(self) -> "RpcServer":
+        return run_async(self.start())
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._conns.add(writer)
+        peer = writer.get_extra_info("peername")
+        if hasattr(self.handler, "on_connect"):
+            await self.handler.on_connect(peer, writer)
+        try:
+            while True:
+                try:
+                    req_id, method, kwargs = await _read_msg(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                # Handle each request concurrently so a slow handler (e.g. a
+                # blocking Get) doesn't head-of-line-block the connection.
+                asyncio.ensure_future(self._dispatch(writer, req_id, method, kwargs))
+        finally:
+            self._conns.discard(writer)
+            if hasattr(self.handler, "on_disconnect"):
+                try:
+                    await self.handler.on_disconnect(peer, writer)
+                except Exception:
+                    pass
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, req_id, method, kwargs):
+        try:
+            fn = getattr(self.handler, "handle_" + method)
+            result = await fn(**kwargs)
+            ok = True
+        except BaseException as e:  # noqa: BLE001 — errors must travel back
+            result = (e, traceback.format_exc())
+            ok = False
+        if req_id >= 0:
+            try:
+                payload = _encode((req_id, ok, result))
+            except Exception:
+                # Unpicklable result/exception: degrade to a picklable error so
+                # the caller fails fast instead of timing out.
+                err = RuntimeError(f"handler {method!r} produced an unpicklable "
+                                   f"{'result' if ok else 'exception'}: "
+                                   f"{result!r:.500}")
+                payload = _encode((req_id, False, (err, "")))
+            try:
+                writer.write(payload)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    def stop_sync(self):
+        try:
+            run_async(self.stop(), timeout=5)
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer; safe to share across coroutines."""
+
+    def __init__(self, address: str):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req_ids = itertools.count(1)
+        self._connect_lock: asyncio.Lock | None = None
+        self._closed = False
+        self._push_handler: Callable[[str, dict], None] | None = None
+
+    def on_push(self, fn: Callable[[str, dict], None]):
+        """Register a callback for server-initiated one-way messages."""
+        self._push_handler = fn
+
+    async def _ensure_connected(self):
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            cfg = get_config()
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self._host, self._port),
+                timeout=cfg.rpc_connect_timeout_s)
+            asyncio.ensure_future(self._read_loop(self._reader))
+
+    async def _read_loop(self, reader):
+        try:
+            while True:
+                msg = await _read_msg(reader)
+                req_id, ok, payload = msg
+                if req_id < 0:  # server push
+                    if self._push_handler:
+                        try:
+                            self._push_handler(ok, payload)  # ok field carries topic
+                        except Exception:
+                            traceback.print_exc()
+                    continue
+                fut = self._pending.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    if ok:
+                        fut.set_result(payload)
+                    else:
+                        cause, tb = payload
+                        fut.set_exception(RemoteError(cause, tb))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._writer = None
+            err = ConnectionLost(f"connection to {self.address} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call_start(self, method: str, **kwargs) -> "asyncio.Future":
+        """Issue the request and return its response future without awaiting it.
+        Successive call_start invocations hit the server in program order —
+        used for actor-call sequencing (reference: per-handle sequence numbers
+        in CoreWorkerDirectActorTaskSubmitter)."""
+        if self._closed:
+            raise RpcError("client closed")
+        await self._ensure_connected()
+        req_id = next(self._req_ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        self._writer.write(_encode((req_id, method, kwargs)))
+        await self._writer.drain()
+        return fut
+
+    async def call(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
+        fut = await self.call_start(method, **kwargs)
+        timeout = _timeout if _timeout is not None else get_config().rpc_call_timeout_s
+        return await asyncio.wait_for(fut, timeout)
+
+    async def notify(self, method: str, **kwargs):
+        await self._ensure_connected()
+        self._writer.write(_encode((-1, method, kwargs)))
+        await self._writer.drain()
+
+    def call_sync(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
+        return run_async(self.call(method, _timeout=_timeout, **kwargs),
+                         timeout=(_timeout or get_config().rpc_call_timeout_s) + 5)
+
+    async def close(self):
+        self._closed = True
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address (reference: rpc client pools)."""
+
+    def __init__(self):
+        self._clients: Dict[str, RpcClient] = {}
+
+    def get(self, address: str) -> RpcClient:
+        c = self._clients.get(address)
+        if c is None or c._closed:
+            c = RpcClient(address)
+            self._clients[address] = c
+        return c
+
+    async def close_all(self):
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()

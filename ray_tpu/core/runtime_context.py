@@ -1,0 +1,56 @@
+"""Runtime context — ids and resources visible to running code.
+
+Reference: ``python/ray/runtime_context.py`` (job/task/actor/node ids, assigned
+resources).  Task-scoped fields use a contextvar set by the executor.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Optional
+
+_task_context: contextvars.ContextVar[dict] = contextvars.ContextVar(
+    "raytpu_task_context", default=None)
+
+
+class RuntimeContext:
+    @property
+    def _worker(self):
+        from .core_worker import global_worker
+        return global_worker()
+
+    def get_job_id(self) -> str:
+        ctx = _task_context.get()
+        if ctx:
+            return ctx["job_id"].hex()
+        return self._worker.job_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        ctx = _task_context.get()
+        return ctx["task_id"].hex() if ctx else None
+
+    def get_actor_id(self) -> Optional[str]:
+        ctx = _task_context.get()
+        if ctx and ctx.get("actor_id"):
+            return ctx["actor_id"].hex()
+        w = self._worker
+        return w.actor_spec.actor_id.hex() if w.actor_spec else None
+
+    def get_node_id(self) -> Optional[str]:
+        return self._worker.node_id
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get(self) -> dict:
+        return {"job_id": self.get_job_id(), "task_id": self.get_task_id(),
+                "actor_id": self.get_actor_id(), "node_id": self.get_node_id(),
+                "worker_id": self.get_worker_id()}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

@@ -1,0 +1,160 @@
+"""Cluster-level scheduling policies over the gossiped resource view.
+
+Re-implements the behavior of the reference's pluggable policy set
+(``src/ray/raylet/scheduling/policy/``):
+
+* :func:`hybrid_policy` — the default (``hybrid_scheduling_policy.h:51``, doc comment
+  :29-49): prefer the local node while its critical-resource utilization is below the
+  spread threshold, then pick among the top-k least-utilized feasible nodes, breaking
+  ties randomly to avoid herding.
+* :func:`spread_policy` — round-robin over feasible nodes (``spread_scheduling_policy.h``).
+* node-affinity / node-label / placement-group strategies are resolved before the
+  policies run (reference: ``affinity_with_bundle_scheduling_policy.h``).
+
+The *node view* is ``{node_id_hex: NodeView}`` maintained from GCS resource broadcasts
+(reference analogue: RaySyncer gossip feeding ClusterResourceManager).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .common import (NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
+                     PlacementGroupSchedulingStrategy)
+from .config import get_config
+
+
+@dataclass
+class NodeView:
+    node_id: str              # hex
+    address: str              # agent rpc address
+    total: Dict[str, float]
+    available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    queue_len: int = 0
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)
+
+    def can_fit_now(self, demand: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)
+
+    def utilization(self) -> float:
+        u = 0.0
+        for k, tot in self.total.items():
+            if tot > 0:
+                u = max(u, 1.0 - self.available.get(k, 0.0) / tot)
+        return u
+
+
+_spread_rr = {"i": 0}
+
+
+def pick_node(view: Dict[str, NodeView],
+              demand: Dict[str, float],
+              strategy="DEFAULT",
+              local_node_id: Optional[str] = None,
+              rng: random.Random | None = None) -> Optional[str]:
+    """Return the chosen node_id hex, or None if no feasible node exists."""
+    rng = rng or random
+    alive = {nid: n for nid, n in view.items() if n.alive}
+
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        n = alive.get(strategy.node_id)
+        if n is not None and n.feasible(demand):
+            return strategy.node_id
+        if not strategy.soft:
+            return None
+        strategy = "DEFAULT"
+
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        def match(n: NodeView, conds: Dict[str, List[str]]) -> bool:
+            return all(n.labels.get(k) in vals for k, vals in conds.items())
+        hard = [nid for nid, n in alive.items()
+                if n.feasible(demand) and match(n, strategy.hard)]
+        if not hard:
+            return None
+        soft = [nid for nid in hard if match(alive[nid], strategy.soft)]
+        pool = soft or hard
+        return rng.choice(pool)
+
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        # Resolved earlier into a NodeAffinity by the PG manager; reaching here
+        # means the bundle lookup failed.
+        return None
+
+    feasible = [nid for nid, n in alive.items() if n.feasible(demand)]
+    if not feasible:
+        return None
+    fit_now = [nid for nid in feasible if alive[nid].can_fit_now(demand)]
+
+    if strategy == "SPREAD":
+        pool = fit_now or feasible
+        pool = sorted(pool)
+        _spread_rr["i"] = (_spread_rr["i"] + 1) % len(pool)
+        return pool[_spread_rr["i"]]
+
+    # DEFAULT: hybrid policy.
+    cfg = get_config()
+    if (local_node_id is not None and local_node_id in alive
+            and alive[local_node_id].can_fit_now(demand)
+            and alive[local_node_id].utilization() < cfg.scheduler_spread_threshold):
+        return local_node_id
+
+    pool = fit_now or feasible
+    ranked = sorted(pool, key=lambda nid: (alive[nid].utilization(), alive[nid].queue_len))
+    k = max(cfg.scheduler_top_k_absolute,
+            int(len(ranked) * cfg.scheduler_top_k_fraction))
+    return rng.choice(ranked[:k])
+
+
+def pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
+                 strategy: str) -> Optional[List[str]]:
+    """Placement-group bundle packing (reference: bundle_scheduling_policy.h).
+
+    Returns a node_id per bundle or None if infeasible.  STRICT_PACK puts every
+    bundle on one node; PACK prefers few nodes; SPREAD prefers distinct nodes;
+    STRICT_SPREAD requires distinct nodes.
+    """
+    alive = {nid: NodeView(n.node_id, n.address, dict(n.total), dict(n.available),
+                           n.labels, n.alive, n.queue_len)
+             for nid, n in view.items() if n.alive}
+
+    def try_place(order_nodes_for_bundle) -> Optional[List[str]]:
+        placement: List[str] = []
+        for i, b in enumerate(bundles):
+            placed = False
+            for nid in order_nodes_for_bundle(i, placement):
+                n = alive[nid]
+                if n.can_fit_now(b):
+                    for k, v in b.items():
+                        n.available[k] = n.available.get(k, 0.0) - v
+                    placement.append(nid)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement
+
+    if strategy == "STRICT_PACK":
+        for nid in sorted(alive, key=lambda x: alive[x].utilization()):
+            saved = {k: dict(v.available) for k, v in alive.items()}
+            p = try_place(lambda i, pl, nid=nid: [nid])
+            if p is not None:
+                return p
+            for k, v in saved.items():
+                alive[k].available = v
+        return None
+    if strategy == "PACK":
+        return try_place(lambda i, pl: sorted(
+            alive, key=lambda nid: (nid not in pl, alive[nid].utilization())))
+    if strategy == "SPREAD":
+        return try_place(lambda i, pl: sorted(
+            alive, key=lambda nid: (pl.count(nid), alive[nid].utilization())))
+    if strategy == "STRICT_SPREAD":
+        return try_place(lambda i, pl: [nid for nid in sorted(
+            alive, key=lambda n2: alive[n2].utilization()) if nid not in pl])
+    raise ValueError(f"unknown placement strategy {strategy}")

@@ -1,0 +1,139 @@
+"""Serialization: cloudpickle for code, pickle-5 out-of-band buffers for data.
+
+The reference splits serialization the same way (``python/ray/_private/serialization.py``):
+cloudpickle for closures/classes shipped through the function registry, and a zero-copy
+buffer protocol (Arrow / pickle5) for array payloads so large tensors move as raw bytes
+into the object store without an extra copy.  Here the out-of-band buffers are what lands
+in the shared-memory store; deserialization reconstructs numpy arrays as views over the
+store's mmap when possible.
+
+ObjectRefs found inside arguments are collected during serialization (for dependency
+tracking) exactly like the reference's ``SerializationContext`` does with
+``_postprocess_serialized_object``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+
+class SerializedObject:
+    """A picked value split into a metadata stream + zero-copy buffers."""
+
+    __slots__ = ("inband", "buffers", "contained_refs")
+
+    def __init__(self, inband: bytes, buffers: List[pickle.PickleBuffer | memoryview | bytes],
+                 contained_refs: list):
+        self.inband = inband
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(len(memoryview(b).cast("B")) for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to one contiguous byte string: header + inband + buffers."""
+        parts = [self.inband] + [bytes(memoryview(b).cast("B")) for b in self.buffers]
+        header = pickle.dumps([len(p) for p in parts], protocol=5)
+        out = io.BytesIO()
+        out.write(len(header).to_bytes(4, "big"))
+        out.write(header)
+        for p in parts:
+            out.write(p)
+        return out.getvalue()
+
+    def header_and_sizes(self) -> tuple[bytes, list[int]]:
+        sizes = [len(self.inband)] + [len(memoryview(b).cast("B")) for b in self.buffers]
+        header = pickle.dumps(sizes, protocol=5)
+        return header, sizes
+
+    def flat_size(self) -> int:
+        header, sizes = self.header_and_sizes()
+        return 4 + len(header) + sum(sizes)
+
+    def write_into(self, view: memoryview) -> int:
+        """Serialize directly into a writable buffer (e.g. a store mmap)."""
+        header, sizes = self.header_and_sizes()
+        off = 0
+        view[0:4] = len(header).to_bytes(4, "big")
+        off = 4
+        view[off:off + len(header)] = header
+        off += len(header)
+        for part in [self.inband] + self.buffers:
+            mv = memoryview(part).cast("B")
+            view[off:off + len(mv)] = mv
+            off += len(mv)
+        return off
+
+    @classmethod
+    def from_buffer(cls, buf) -> "SerializedObject":
+        """Reconstruct from a flattened buffer (zero-copy views into ``buf``)."""
+        mv = memoryview(buf)
+        hlen = int.from_bytes(bytes(mv[:4]), "big")
+        sizes = pickle.loads(bytes(mv[4:4 + hlen]))
+        off = 4 + hlen
+        parts = []
+        for s in sizes:
+            parts.append(mv[off:off + s])
+            off += s
+        return cls(bytes(parts[0]), list(parts[1:]), [])
+
+
+def serialize(value: Any) -> SerializedObject:
+    contained: list = []
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+        buffers.append(pb)
+        return False  # out-of-band
+
+    # cloudpickle handles closures/lambdas/local classes; protocol 5 gives us
+    # out-of-band buffer extraction for numpy and friends.
+    from .object_ref import ObjectRef  # local import to break cycle
+
+    class _Pickler(cloudpickle.CloudPickler):
+        def persistent_id(self, obj):  # intercept ObjectRefs
+            if isinstance(obj, ObjectRef):
+                contained.append(obj)
+                return ("rayref", obj.id.binary(), obj.owner)
+            return None
+
+    sio = io.BytesIO()
+    p = _Pickler(sio, protocol=5, buffer_callback=buffer_callback)
+    p.dump(value)
+    return SerializedObject(sio.getvalue(), buffers, contained)
+
+
+def deserialize(so: SerializedObject) -> Any:
+    from .object_ref import ObjectRef
+
+    class _Unpickler(pickle.Unpickler):
+        def persistent_load(self, pid):
+            tag, idbin, owner = pid
+            if tag != "rayref":
+                raise pickle.UnpicklingError(f"unknown persistent id {tag}")
+            from .ids import ObjectID
+            return ObjectRef(ObjectID(idbin), owner=owner)
+
+    return _Unpickler(io.BytesIO(so.inband), buffers=so.buffers).load()
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot flat serialize (for RPC payloads, function registry)."""
+    return serialize(value).to_bytes()
+
+
+def loads(data) -> Any:
+    return deserialize(SerializedObject.from_buffer(data))
+
+
+def dumps_function(fn) -> bytes:
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(data: bytes):
+    return pickle.loads(data)

@@ -1,0 +1,48 @@
+"""Worker process entrypoint (reference: ``python/ray/_private/workers/default_worker.py``).
+
+Spawned by the node agent with connection info in the environment.  Starts the
+CoreWorker RPC server on the IO thread, registers with the agent, then parks the
+main thread in the executor loop so user tasks run on the main thread.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    gcs_address = os.environ["RAYTPU_GCS_ADDRESS"]
+    agent_address = os.environ["RAYTPU_AGENT_ADDRESS"]
+    node_id = os.environ["RAYTPU_NODE_ID"]
+    worker_id = os.environ["RAYTPU_WORKER_ID"]
+    session_dir = os.environ.get("RAYTPU_SESSION_DIR", "/tmp/raytpu")
+
+    from .config import Config, set_config
+    cfg_json = os.environ.get("RAYTPU_CONFIG_JSON")
+    if cfg_json:
+        set_config(Config.from_json(cfg_json))
+
+    from .core_worker import CoreWorker
+    from .ids import WorkerID
+    from .rpc import run_async
+
+    w = CoreWorker(mode="worker", gcs_address=gcs_address,
+                   agent_address=agent_address, node_id=node_id,
+                   session_dir=session_dir)
+    w.worker_id = WorkerID.from_hex(worker_id)
+    w.start()
+    res = run_async(w.agent.call("register_worker", worker_id=worker_id,
+                                 address=w.address, pid=os.getpid()))
+    if res.get("shutdown"):
+        sys.exit(0)
+    try:
+        w.run_executor_loop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        w.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Actor tests (reference analogue: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, by=1):
+            self.v += by
+            return self.v
+
+        def value(self):
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+        def get_items(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray_tpu.get(a.get_items.remote()) == list(range(20))
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor kaboom")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(b.boom.remote())
+    # Actor survives method errors.
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get_key(self, k):
+            return self.d.get(k)
+
+    r = Registry.options(name="the-registry").remote()
+    ray_tpu.get(r.set.remote("x", 1))
+    handle = ray_tpu.get_actor("the-registry")
+    assert ray_tpu.get(handle.get_key.remote("x")) == 1
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get_v(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(store, v):
+        ray_tpu.get(store.set.remote(v))
+        return "ok"
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, 123)) == "ok"
+    assert ray_tpu.get(s.get_v.remote()) == 123
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    refs = [a.work.remote(i) for i in range(10)]
+    assert ray_tpu.get(refs) == [i * 2 for i in range(10)]
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.5)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.TaskError)):
+        ray_tpu.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_restart(ray_start_regular):
+    # max_restarts=3: the retried suicidal task kills the restarted actor once
+    # more before its retry budget runs out, consuming two restarts.
+    @ray_tpu.remote(max_restarts=3, max_task_retries=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def maybe_die(self, die):
+            if die:
+                import os
+                os._exit(1)
+            self.n += 1
+            return self.n
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.maybe_die.remote(False)) == 1
+    # Kill the actor process; the GCS restarts it and the task retries.
+    with pytest.raises((ray_tpu.TaskError, ray_tpu.ActorDiedError)):
+        ray_tpu.get(p.maybe_die.remote(True), timeout=30)
+    # State reset after restart (fresh instance).
+    assert ray_tpu.get(p.maybe_die.remote(False), timeout=30) == 1

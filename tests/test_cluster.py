@@ -1,0 +1,102 @@
+"""Multi-node tests with the cluster-in-one-machine fixture (reference analogue:
+python/ray/tests/test_multi_node.py, test_object_reconstruction.py via
+cluster_utils.Cluster + NodeKillerActor fault injection)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_two_nodes_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(2)
+    cluster.connect_driver()
+
+    @ray_tpu.remote
+    def where():
+        # Sleep so tasks overlap: with instant tasks a single reused lease can
+        # drain the queue before other leases are granted.
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # SPREAD strategy should land tasks on both nodes.
+    refs = [where.options(scheduling_strategy="SPREAD").remote() for _ in range(8)]
+    nodes = set(ray_tpu.get(refs, timeout=60))
+    assert len(nodes) == 2
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    cluster = ray_start_cluster
+    n1 = cluster.add_node(num_cpus=2, resources={"left": 1})
+    n2 = cluster.add_node(num_cpus=2, resources={"right": 1})
+    cluster.wait_for_nodes(2)
+    cluster.connect_driver()
+
+    @ray_tpu.remote(resources={"left": 1}, num_cpus=1)
+    def produce():
+        return np.full(300_000, 7.0)
+
+    @ray_tpu.remote(resources={"right": 1}, num_cpus=1)
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    out = ray_tpu.get(consume.remote(ref), timeout=90)
+    assert out == 7.0 * 300_000
+
+
+def test_saturation_spillback(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.connect_driver()
+
+    @ray_tpu.remote(num_cpus=1)
+    def busy(t):
+        time.sleep(t)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # 4 one-second tasks on 2 single-cpu nodes: must use both nodes to finish
+    # in reasonable time.
+    refs = [busy.remote(1.0) for _ in range(4)]
+    t0 = time.time()
+    nodes = set(ray_tpu.get(refs, timeout=120))
+    elapsed = time.time() - t0
+    assert len(nodes) == 2, f"tasks did not spread: {nodes}"
+    assert elapsed < 60
+
+
+def test_node_failure_task_retry(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)          # stable node
+    doomed = cluster.add_node(num_cpus=2, resources={"doomed": 2})
+    cluster.wait_for_nodes(2)
+    cluster.connect_driver()
+
+    @ray_tpu.remote(num_cpus=1)
+    def steady(x):
+        return x + 1
+
+    # Warm up the stable node.
+    assert ray_tpu.get(steady.remote(1), timeout=60) == 2
+
+    @ray_tpu.remote(resources={"doomed": 1}, num_cpus=0, max_retries=0)
+    def long_task():
+        time.sleep(30)
+        return "done"
+
+    ref = long_task.remote()
+    time.sleep(2.0)  # let it start on the doomed node
+    cluster.kill_node(doomed)
+    # The task should fail (max_retries=0 and its node is gone).
+    with pytest.raises((ray_tpu.TaskError, ray_tpu.WorkerCrashedError,
+                        ray_tpu.GetTimeoutError)):
+        ray_tpu.get(ref, timeout=30)
+    # Cluster still healthy for new work.
+    assert ray_tpu.get(steady.remote(10), timeout=60) == 11
