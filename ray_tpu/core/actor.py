@@ -157,6 +157,7 @@ class ActorClass:
             is_async_actor=self._is_async(),
             actor_name=o.get("name"),
             namespace=o.get("namespace"),
+            lifetime=lifetime,
             runtime_env=o.get("runtime_env"),
         )
         if o.get("get_if_exists") and o.get("name"):

@@ -202,16 +202,23 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
-    # Cooperative cancellation: drop from lease queues if still pending.
+    """Cooperative cancellation: drop the task from lease queues if it has not
+    been dispatched yet.  Runs on the IO loop — the lease pools are loop-
+    confined state (reference: CancelTask RPC is best-effort there too)."""
     w = global_worker()
     tid = ref.id.task_id()
-    for pool in w.lease_pools.values():
-        for spec in list(pool.queue):
-            if spec.task_id == tid:
-                pool.queue.remove(spec)
-                w.task_manager.fail(tid, asyncio.CancelledError("task cancelled"))
-                return True
-    return False
+
+    async def _cancel():
+        for pool in w.lease_pools.values():
+            for spec in list(pool.queue):
+                if spec.task_id == tid:
+                    pool.queue.remove(spec)
+                    w.task_manager.fail(
+                        tid, asyncio.CancelledError("task cancelled"))
+                    return True
+        return False
+
+    return run_async(_cancel())
 
 
 def remote(*args, **options):

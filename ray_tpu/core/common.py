@@ -74,6 +74,7 @@ class TaskSpec:
     is_async_actor: bool = False
     actor_name: Optional[str] = None
     namespace: Optional[str] = None
+    lifetime: Optional[str] = None    # None (job-scoped) | "detached"
     # actor method call
     is_actor_task: bool = False
     actor_method: Optional[str] = None

@@ -1089,24 +1089,27 @@ class CoreWorker:
 
     async def _run_async_actor_task(self, spec: TaskSpec):
         """Async actors: run the coroutine on the actor's private loop with up to
-        max_concurrency concurrent tasks (reference: fiber/asyncio actors)."""
+        max_concurrency concurrent tasks (reference: fiber/asyncio actors).
+
+        Arg resolution and result packaging must happen on the actor loop's
+        thread too — they block on IO-loop round-trips (run_async), which would
+        deadlock if done here on the IO loop thread itself."""
         method = getattr(self.actor_instance, spec.actor_method)
-        args, kwargs = self._resolve_args(spec)
 
         async def runner():
+            args, kwargs = self._resolve_args(spec)
             res = method(*args, **kwargs)
             if asyncio.iscoroutine(res):
                 res = await res
-            return res
+            return self._package_returns(spec, res)
 
         cfut = asyncio.run_coroutine_threadsafe(runner(), self._actor_async_loop)
         try:
-            out = await asyncio.wrap_future(cfut)
+            return await asyncio.wrap_future(cfut)
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
             return [("error", pickle.dumps((_strip_exc(e), tb)))
                     for _ in range(max(1, spec.num_returns))]
-        return self._package_returns(spec, out)
 
 
 def _strip_exc(e: BaseException) -> BaseException:

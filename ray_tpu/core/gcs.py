@@ -243,6 +243,7 @@ class GcsServer:
             "node_id": None, "restarts_left": spec.max_restarts, "name": spec.actor_name,
             "namespace": spec.namespace or "default", "owner": spec.owner,
             "death_cause": None, "num_restarts": 0, "class_name": spec.name,
+            "lifetime": spec.lifetime, "job_id": spec.job_id.hex(),
         }
         asyncio.ensure_future(self._schedule_actor(aid))
         return aid
@@ -255,11 +256,25 @@ class GcsServer:
             return
         spec: TaskSpec = info["spec"]
         for attempt in range(120):
+            # Re-check each attempt: a kill while PENDING/RESTARTING must not be
+            # overwritten back to ALIVE by a late placement success.
+            if self.actors.get(aid) is not info or info["state"] == "DEAD":
+                return
             nid = pick_node(self.nodes, spec.resources, spec.scheduling_strategy)
             if nid is not None:
                 agent = self.agent_clients.get(self.nodes[nid].address)
                 try:
                     res = await agent.call("create_actor", spec=spec)
+                    if self.actors.get(aid) is not info or info["state"] == "DEAD":
+                        # Killed while the creation RPC was in flight: reap the
+                        # freshly created worker instead of resurrecting.
+                        try:
+                            await agent.call("kill_worker",
+                                             worker_id=res["worker_id"],
+                                             reason="actor killed during creation")
+                        except Exception:
+                            pass
+                        return
                     info.update(state="ALIVE", address=res["worker_address"],
                                 node_id=nid, worker_id=res["worker_id"])
                     self._publish("actors", {"actor_id": aid, "state": "ALIVE",
@@ -456,6 +471,12 @@ class GcsServer:
         if j:
             j.update(state="FINISHED", end_time=time.time())
             self._persist()
+        # Job-scoped actor GC: non-detached actors die with their job
+        # (reference: GcsActorManager::OnJobFinished); detached ones survive.
+        for aid, info in list(self.actors.items()):
+            if (info.get("job_id") == job_id and info.get("lifetime") != "detached"
+                    and info["state"] not in ("DEAD",)):
+                await self.handle_kill_actor(aid, no_restart=True)
         return True
 
     async def handle_list_jobs(self):

@@ -235,13 +235,26 @@ class NodeAgent:
         await self._process_lease_queue()
 
     async def _kill_worker_proc(self, w: WorkerHandle):
+        was_dead = w.state == "DEAD"
         w.state = "DEAD"
         self.workers.pop(w.worker_id, None)
+        # Release any lease the victim held (kill paths bypass _on_worker_exit,
+        # which early-returns once the state is DEAD).
+        if not was_dead and w.lease_id:
+            if w.blocked:
+                w.blocked = False
+                self._lease_resources.pop(w.lease_id, None)
+                self._bundle_of_lease.pop(w.lease_id, None)
+            else:
+                self._release_lease_resources(w.lease_id)
+            w.lease_id = None
         if w.proc is not None:
             try:
                 w.proc.kill()
             except ProcessLookupError:
                 pass
+        if not was_dead and not self._shutting_down:
+            await self._process_lease_queue()
 
     async def handle_register_worker(self, worker_id: str, address: str, pid: int):
         w = self.workers.get(worker_id)
@@ -325,8 +338,7 @@ class NodeAgent:
             await asyncio.wait_for(w.registered.wait(),
                                    get_config().worker_register_timeout_s)
         except asyncio.TimeoutError:
-            await self._kill_worker_proc(w)
-            self._release_lease_resources(lease_id)
+            await self._kill_worker_proc(w)  # releases the lease resources
             raise RuntimeError("worker failed to register in time")
         return {"worker_address": w.address, "worker_id": w.worker_id,
                 "lease_id": lease_id, "node_id": self.node_id.hex()}

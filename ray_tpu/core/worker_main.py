@@ -36,6 +36,26 @@ def main():
                                  address=w.address, pid=os.getpid()))
     if res.get("shutdown"):
         sys.exit(0)
+
+    # Agent watchdog: if our node agent dies (crash, node kill), exit instead
+    # of lingering as an orphan (reference: workers die with their raylet).
+    import threading
+    import time as _time
+
+    def _watchdog():
+        misses = 0
+        while True:
+            _time.sleep(2.0)
+            try:
+                run_async(w.agent.call("ping", _timeout=3.0), timeout=5)
+                misses = 0
+            except Exception:
+                misses += 1
+                if misses >= 3:
+                    os._exit(0)
+
+    threading.Thread(target=_watchdog, name="agent-watchdog",
+                     daemon=True).start()
     try:
         w.run_executor_loop()
     except KeyboardInterrupt:

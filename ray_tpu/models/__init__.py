@@ -1,0 +1,9 @@
+"""ray_tpu.models — first-class TPU-native model zoo (GPT-2 / Llama-3 / Mixtral)."""
+
+from .config import (PRESETS, TransformerConfig, gpt2_small, llama3_8b,
+                     llama3_70b, llama_1b, mixtral_8x7b, tiny)
+from .transformer import (ParallelContext, apply, causal_lm_loss, init_params)
+
+__all__ = ["TransformerConfig", "PRESETS", "gpt2_small", "llama3_8b",
+           "llama3_70b", "llama_1b", "mixtral_8x7b", "tiny", "init_params",
+           "apply", "causal_lm_loss", "ParallelContext"]

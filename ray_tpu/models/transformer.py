@@ -1,0 +1,271 @@
+"""Decoder-only transformer: one implementation for GPT-2 / Llama-3 / Mixtral.
+
+TPU-first design choices:
+* **Stacked layer params + lax.scan** — compile time independent of depth; XLA sees one
+  block body (the reference's torch models unroll layers in Python).
+* **bf16 compute, fp32 params/optimizer** — matmuls hit the MXU in bf16; the cast sits
+  next to each einsum so XLA fuses it.
+* **Static shapes everywhere** — no data-dependent control flow inside jit.
+* Attention dispatches to plain XLA / Pallas flash / ring attention (`sp` axis)
+  based on a `ParallelContext`.
+
+Params are a plain pytree (dict) so sharding rules (models/sharding.py) are specs over
+the same tree structure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import moe as moe_ops
+from ..ops.attention import attend, mha
+from .config import TransformerConfig
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelContext:
+    """How to run attention/MoE under a mesh. None mesh = single device."""
+    mesh: Optional[Any] = None
+    sp_axis: Optional[str] = None     # sequence-parallel axis name (ring attn)
+    batch_axes: Tuple[str, ...] = ("dp",)
+
+    @property
+    def use_ring(self) -> bool:
+        return (self.mesh is not None and self.sp_axis is not None
+                and self.mesh.shape.get(self.sp_axis, 1) > 1)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: TransformerConfig,
+                dtype=jnp.float32) -> Params:
+    h, hd = cfg.hidden_size, cfg.head_dim
+    nh, nkv, m, L = cfg.num_heads, cfg.num_kv_heads, cfg.mlp_size, cfg.num_layers
+    keys = iter(jax.random.split(key, 32))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype) * (fan_in ** -0.5)).astype(dtype)
+
+    def norm_p():
+        p = {"scale": jnp.ones((L, h), dtype)}
+        if not cfg.use_rmsnorm:
+            p["bias"] = jnp.zeros((L, h), dtype)
+        return p
+
+    blocks: Params = {
+        "attn_norm": norm_p(),
+        "attn": {
+            "wq": dense(next(keys), (L, h, nh * hd), h),
+            "wk": dense(next(keys), (L, h, nkv * hd), h),
+            "wv": dense(next(keys), (L, h, nkv * hd), h),
+            "wo": dense(next(keys), (L, nh * hd, h), nh * hd),
+        },
+        "mlp_norm": norm_p(),
+    }
+    if not cfg.use_rmsnorm:  # GPT-2 style biases
+        blocks["attn"]["bq"] = jnp.zeros((L, nh * hd), dtype)
+        blocks["attn"]["bk"] = jnp.zeros((L, nkv * hd), dtype)
+        blocks["attn"]["bv"] = jnp.zeros((L, nkv * hd), dtype)
+        blocks["attn"]["bo"] = jnp.zeros((L, h), dtype)
+    if cfg.num_experts > 1:
+        e = cfg.num_experts
+        blocks["moe"] = {
+            "router": dense(next(keys), (L, h, e), h),
+            "w_gate": dense(next(keys), (L, e, h, m), h),
+            "w_in": dense(next(keys), (L, e, h, m), h),
+            "w_out": dense(next(keys), (L, e, m, h), m),
+        }
+    else:
+        mlp: Params = {
+            "w_in": dense(next(keys), (L, h, m), h),
+            "w_out": dense(next(keys), (L, m, h), m),
+        }
+        if cfg.use_swiglu:
+            mlp["w_gate"] = dense(next(keys), (L, h, m), h)
+        else:
+            mlp["b_in"] = jnp.zeros((L, m), dtype)
+            mlp["b_out"] = jnp.zeros((L, h), dtype)
+        blocks["mlp"] = mlp
+
+    params: Params = {
+        "embed": {"tokens": (jax.random.normal(next(keys), (cfg.vocab_size, h),
+                                               dtype) * 0.02)},
+        "blocks": blocks,
+        "final_norm": {"scale": jnp.ones((h,), dtype)},
+    }
+    if not cfg.use_rope:
+        params["embed"]["pos"] = (
+            jax.random.normal(next(keys), (cfg.max_seq_len, h), dtype) * 0.01)
+    if not cfg.use_rmsnorm:
+        params["final_norm"]["bias"] = jnp.zeros((h,), dtype)
+    if not cfg.tied_embeddings:
+        params["lm_head"] = dense(next(keys), (h, cfg.vocab_size), h)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def _norm(x, p, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.use_rmsnorm:
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True)
+                                  + cfg.norm_eps)
+        return (x32 * p["scale"].astype(jnp.float32)).astype(x.dtype)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+    return (x32 * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """x: [B, S, H, D]; positions: [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention_block(x, p, cfg: TransformerConfig, positions, pctx: ParallelContext):
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    cast = x.dtype
+    q = x @ p["wq"].astype(cast)
+    k = x @ p["wk"].astype(cast)
+    v = x @ p["wv"].astype(cast)
+    if "bq" in p:
+        q, k, v = q + p["bq"].astype(cast), k + p["bk"].astype(cast), v + p["bv"].astype(cast)
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nkv, hd)
+    v = v.reshape(b, s, nkv, hd)
+    if cfg.use_rope:
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    if pctx.use_ring:
+        from ..ops.ring_attention import ring_attention
+        out = ring_attention(q, k, v, pctx.mesh, pctx.sp_axis,
+                             causal=cfg.causal, batch_axes=pctx.batch_axes,
+                             logit_softcap=cfg.attn_logit_softcap)
+    else:
+        out = mha(q, k, v, causal=cfg.causal,
+                  logit_softcap=cfg.attn_logit_softcap)
+    out = out.reshape(b, s, nh * hd) @ p["wo"].astype(cast)
+    if "bo" in p:
+        out = out + p["bo"].astype(cast)
+    return out
+
+
+def _mlp_block(x, p, cfg: TransformerConfig):
+    cast = x.dtype
+    if cfg.use_swiglu:
+        gate = jax.nn.silu(x @ p["w_gate"].astype(cast))
+        up = x @ p["w_in"].astype(cast)
+        return (gate * up) @ p["w_out"].astype(cast)
+    hmid = x @ p["w_in"].astype(cast) + p["b_in"].astype(cast)
+    hmid = jax.nn.gelu(hmid)
+    return hmid @ p["w_out"].astype(cast) + p["b_out"].astype(cast)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def apply(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
+          pctx: ParallelContext = ParallelContext(),
+          compute_dtype=jnp.bfloat16,
+          remat: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """tokens: [B, S] int32 -> (logits [B, S, V] f32, aux dict)."""
+    b, s = tokens.shape
+    x = params["embed"]["tokens"][tokens].astype(compute_dtype)
+    # Positions are global sequence positions; under jit with a sequence-sharded
+    # batch XLA partitions this computation (only ring attention, which runs in
+    # shard_map, handles per-shard offsets itself).
+    positions = jnp.arange(s)
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][:s][None].astype(compute_dtype)
+
+    def block(x, layer_params):
+        attn_out = _attention_block(
+            _norm(x, layer_params["attn_norm"], cfg), layer_params["attn"],
+            cfg, positions, pctx)
+        x = x + attn_out
+        y = _norm(x, layer_params["mlp_norm"], cfg)
+        if cfg.num_experts > 1:
+            out, aux = moe_ops.moe_mlp(
+                y, layer_params["moe"]["router"], layer_params["moe"]["w_gate"],
+                layer_params["moe"]["w_in"], layer_params["moe"]["w_out"],
+                cfg.experts_per_token, cfg.expert_capacity_factor)
+        else:
+            out, aux = _mlp_block(y, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
+        return x + out, aux
+
+    def scan_body(x, layer_params):
+        x, aux = block(x, layer_params)
+        return x, aux
+
+    if remat:
+        # Per-layer rematerialization: backward recomputes one block at a time,
+        # so peak activation memory is O(1) in depth (HBM is the bottleneck —
+        # trade FLOPs for memory). Checkpointing the whole loss instead would
+        # still materialize every layer's residuals during the backward replay.
+        scan_body = jax.checkpoint(
+            scan_body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    x, aux_losses = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _norm_final(x, params["final_norm"], cfg)
+    if cfg.tied_embeddings:
+        logits = x @ params["embed"]["tokens"].T.astype(x.dtype)
+    else:
+        logits = x @ params["lm_head"].astype(x.dtype)
+    return logits.astype(jnp.float32), {"moe_aux_loss": aux_losses.mean()}
+
+
+def _norm_final(x, p, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.use_rmsnorm:
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True)
+                                  + cfg.norm_eps)
+        return (x32 * p["scale"].astype(jnp.float32)).astype(x.dtype)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+    return (x32 * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def causal_lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
+                   cfg: TransformerConfig,
+                   pctx: ParallelContext = ParallelContext(),
+                   compute_dtype=jnp.bfloat16,
+                   moe_aux_weight: float = 0.01,
+                   remat: bool = False):
+    """batch: {"tokens": [B, S+1] or "tokens"+"targets"}. Returns (loss, metrics)."""
+    if "targets" in batch:
+        tokens, targets = batch["tokens"], batch["targets"]
+    else:
+        tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits, aux = apply(params, tokens, cfg, pctx, compute_dtype, remat=remat)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        loss = nll.mean()
+        denom = nll.size
+    else:
+        loss = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+        denom = mask.sum()
+    total = loss + moe_aux_weight * aux["moe_aux_loss"]
+    return total, {"loss": loss, "moe_aux_loss": aux["moe_aux_loss"],
+                   "tokens": denom}

@@ -1,0 +1,73 @@
+"""Mixture-of-Experts layer: top-k routing with capacity-based dense dispatch.
+
+TPU-first: dispatch/combine are einsums against one-hot routing tensors so everything
+stays on the MXU with static shapes (the standard TPU MoE formulation; dynamic gather/
+scatter routing is hostile to XLA).  With the expert dimension sharded over the ``ep``
+mesh axis, XLA lowers the dispatch einsum into the expert all-to-all over ICI
+(SURVEY §2.3 EP row: the reference has no MoE support in core — this is first-class).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(router_logits: jnp.ndarray, k: int,
+                  capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """router_logits: [T, E] -> (dispatch [T, E, C] bool, combine [T, E, C], aux_loss).
+
+    Capacity-based: each expert accepts at most C tokens (overflow dropped),
+    keeping shapes static for XLA.
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, k)          # [T, k]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)  # renorm (Mixtral)
+
+    # Position of each (token, choice) in its expert's capacity buffer:
+    # earlier tokens with the same choice + tokens admitted by earlier choices.
+    dispatch = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    combine = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    counts = jnp.zeros((e,), dtype=jnp.int32)
+    for choice in range(k):
+        idx = top_idx[:, choice]                                  # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)          # [T, E]
+        prior = jnp.cumsum(onehot, axis=0) - onehot
+        pos = (onehot * (prior + counts[None, :])).sum(-1)        # [T]
+        ok = pos < capacity
+        disp = (jax.nn.one_hot(idx, e)[:, :, None]
+                * jax.nn.one_hot(pos, capacity)[:, None, :]
+                * ok[:, None, None].astype(jnp.float32))
+        dispatch = dispatch + disp
+        combine = combine + disp * top_p[:, choice][:, None, None]
+        counts = counts + (onehot * ok[:, None].astype(jnp.int32)).sum(0)
+
+    # Load-balancing auxiliary loss (Switch Transformer style).
+    me = probs.mean(axis=0)                            # [E] mean router prob
+    ce = jax.nn.one_hot(top_idx[:, 0], e).mean(axis=0)  # [E] fraction routed
+    aux_loss = e * jnp.sum(me * ce)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, w_gate: jnp.ndarray,
+            w_in: jnp.ndarray, w_out: jnp.ndarray, experts_per_token: int,
+            capacity_factor: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sparse SwiGLU MLP. x: [B, S, H]; router_w: [H, E];
+    w_gate/w_in: [E, H, M]; w_out: [E, M, H]. Returns (out [B,S,H], aux_loss)."""
+    b, s, h = x.shape
+    e = router_w.shape[-1]
+    tokens = x.reshape(b * s, h)
+    capacity = max(1, int(capacity_factor * experts_per_token * b * s / e))
+    logits = tokens @ router_w.astype(tokens.dtype)
+    dispatch, combine, aux = top_k_routing(logits, experts_per_token, capacity)
+    # Dispatch to expert buffers: [E, C, H]
+    xs = jnp.einsum("tec,th->ech", dispatch.astype(tokens.dtype), tokens)
+    gate = jnp.einsum("ech,ehm->ecm", xs, w_gate.astype(xs.dtype))
+    up = jnp.einsum("ech,ehm->ecm", xs, w_in.astype(xs.dtype))
+    act = jax.nn.silu(gate) * up
+    out_e = jnp.einsum("ecm,emh->ech", act, w_out.astype(act.dtype))
+    out = jnp.einsum("tec,ech->th", combine.astype(out_e.dtype), out_e)
+    return out.reshape(b, s, h), aux

@@ -1,0 +1,9 @@
+"""ray_tpu.parallel — mesh construction, sharding, and the pjit train step."""
+
+from .mesh import AXIS_ORDER, MeshSpec, make_mesh, named_sharding
+from .train_step import (TrainState, init_sharded_state, make_eval_step,
+                         make_optimizer, make_train_step, state_shardings)
+
+__all__ = ["MeshSpec", "make_mesh", "named_sharding", "AXIS_ORDER",
+           "TrainState", "make_optimizer", "init_sharded_state",
+           "make_train_step", "make_eval_step", "state_shardings"]

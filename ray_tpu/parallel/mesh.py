@@ -1,0 +1,95 @@
+"""Device mesh construction: named axes for dp/fsdp/sp/ep/tp(/pp).
+
+The TPU-native replacement for the reference's process-group setup
+(``python/ray/train/torch/config.py:63-160`` ``_setup_torch_process_group``): instead
+of rendezvous + NCCL communicators, every host builds the same ``jax.sharding.Mesh``
+and XLA compiles collectives over ICI/DCN.  Axis order is chosen so the most
+communication-intensive axis (tp) maps to the innermost (closest) devices on the
+physical topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per axis; -1 on at most one axis = fill with remaining devices."""
+    dp: int = 1
+    fsdp: int = -1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                "sp": self.sp, "ep": self.ep, "tp": self.tp}
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = self.sizes()
+        fill = [k for k, v in sizes.items() if v == -1]
+        if len(fill) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if fill:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[fill[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} needs {fixed} devices, "
+                             f"have {n_devices}")
+        return sizes
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolve(len(devices))
+        shape = tuple(sizes[a] for a in AXIS_ORDER)
+        arr = np.array(devices).reshape(shape)
+        return Mesh(arr, AXIS_ORDER)
+
+
+def make_mesh(n_devices: Optional[int] = None, **axis_sizes) -> Mesh:
+    """Convenience: make_mesh(fsdp=4, tp=2)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return MeshSpec(**axis_sizes).build(devices)
+
+
+def named_sharding(mesh: Mesh, spec_tree):
+    """Map a PartitionSpec tree to a NamedSharding tree for the given mesh,
+    dropping axis names the mesh doesn't have (so the same rules work on a
+    dp-only mesh and a full dp×fsdp×tp×sp×ep mesh)."""
+    mesh_axes = set(mesh.axis_names)
+
+    def fix_spec(spec: PartitionSpec) -> NamedSharding:
+        parts = []
+        for entry in spec:
+            if entry is None:
+                parts.append(None)
+            elif isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in mesh_axes
+                             and mesh.shape[a] > 0)
+                parts.append(kept if kept else None)
+            else:
+                parts.append(entry if entry in mesh_axes else None)
+        return NamedSharding(mesh, PartitionSpec(*parts))
+
+    return jax.tree.map(fix_spec, spec_tree,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") else (
+        dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1))

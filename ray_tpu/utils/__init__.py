@@ -1,0 +1,1 @@
+"""ray_tpu.utils — shared helpers (testing, logging, metrics)."""

@@ -1,0 +1,35 @@
+"""Test helpers: virtual CPU device meshes (SURVEY §4 takeaway — a fake mesh/ICI
+backend so multi-host pjit code paths run in CI without TPUs)."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Force jax onto `n` virtual CPU devices for this process.
+
+    Must run before the first jax backend use.  Overrides both the env and
+    jax.config because TPU-terminal environments (axon) force
+    ``jax_platforms`` from sitecustomize at interpreter start.
+    """
+    flag = f"--xla_force_host_platform_device_count={n}"
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = (xf + " " + flag).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+#: Environment for subprocess workers that should see the virtual CPU mesh.
+#: PALLAS_AXON_POOL_IPS="" disables the axon sitecustomize registration hook
+#: so JAX_PLATFORMS from the env is honored in the child.
+CPU_WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}

@@ -9,11 +9,11 @@ mesh/ICI backend so multi-host pjit paths run in CI without TPUs).
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    (os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8").strip())
+from ray_tpu.utils.testing import CPU_WORKER_ENV, force_cpu_devices
+
+# Force the 8-device virtual CPU mesh before any jax backend use (overrides
+# TPU-terminal sitecustomize hooks that pin jax_platforms to the TPU).
+force_cpu_devices(8)
 
 import pytest  # noqa: E402
 
@@ -21,8 +21,7 @@ import pytest  # noqa: E402
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu
-    info = ray_tpu.init(num_cpus=4,
-                        worker_env={"JAX_PLATFORMS": "cpu"})
+    info = ray_tpu.init(num_cpus=4, worker_env=dict(CPU_WORKER_ENV))
     yield info
     ray_tpu.shutdown()
 

@@ -1,0 +1,144 @@
+"""Model + parallel layer tests on the virtual 8-device CPU mesh
+(SURVEY §4: fake mesh backend so multi-host pjit paths run in CI)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import (ParallelContext, TransformerConfig, apply,
+                            causal_lm_loss, init_params, tiny)
+from ray_tpu.ops.attention import attend
+from ray_tpu.parallel import (MeshSpec, init_sharded_state, make_mesh,
+                              make_optimizer, make_train_step)
+
+
+def test_forward_shapes_gpt2_style():
+    cfg = tiny()
+    cfg = TransformerConfig(**{**cfg.__dict__, "use_rope": False,
+                               "use_rmsnorm": False, "use_swiglu": False,
+                               "tied_embeddings": True})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits, _ = apply(params, toks, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_forward_llama_style():
+    cfg = tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits, _ = apply(params, toks, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_causal_masking():
+    """Changing future tokens must not change current logits."""
+    cfg = tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    t1 = jnp.zeros((1, 16), jnp.int32)
+    t2 = t1.at[0, 10:].set(5)
+    l1, _ = apply(params, t1, cfg)
+    l2, _ = apply(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
+
+
+def test_loss_decreases_with_training():
+    cfg = tiny()
+    mesh = make_mesh(dp=2, fsdp=2, tp=2)
+    opt = make_optimizer(learning_rate=1e-3, warmup_steps=2, total_steps=50)
+    state, sh = init_sharded_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, sh)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(2), (8, 33), 0,
+                                          cfg.vocab_size)}
+    state, m0 = step(state, batch)
+    first = float(m0["loss"])
+    for _ in range(15):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < first, (first, float(m["loss"]))
+
+
+def test_ring_attention_matches_plain():
+    from ray_tpu.ops.ring_attention import ring_attention
+    mesh = make_mesh(dp=2, sp=4)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (2, 32, 4, 16))
+    k = jax.random.normal(ks[1], (2, 32, 2, 16))
+    v = jax.random.normal(ks[2], (2, 32, 2, 16))
+    ref = attend(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, "sp",
+                                                 batch_axes=("dp",)))(q, k, v)
+    np.testing.assert_allclose(ref, out, atol=1e-5)
+
+
+def test_ulysses_matches_plain():
+    from ray_tpu.ops.ring_attention import ulysses_attention
+    mesh = make_mesh(dp=2, sp=4)
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (2, 32, 8, 16))
+    k = jax.random.normal(ks[1], (2, 32, 4, 16))
+    v = jax.random.normal(ks[2], (2, 32, 4, 16))
+    ref = attend(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh, "sp",
+                                                    batch_axes=("dp",)))(q, k, v)
+    np.testing.assert_allclose(ref, out, atol=1e-5)
+
+
+def test_sp_train_step_with_ring_attention():
+    """Full train step with the sequence axis sharded (ring attention path)."""
+    cfg = tiny(seq=64)
+    mesh = make_mesh(dp=2, sp=4)
+    opt = make_optimizer(total_steps=20)
+    state, sh = init_sharded_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, sh, sp_axis="sp")
+    # With a sequence-sharded batch, tokens/targets must each be divisible by
+    # the sp degree — pass them pre-shifted instead of slicing inside.
+    toks = jax.random.randint(jax.random.PRNGKey(3), (4, 65), 0, cfg.vocab_size)
+    batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+    state, m = step(state, batch)
+    assert bool(jnp.isfinite(m["loss"]))
+
+
+def test_moe_training_expert_parallel():
+    cfg = tiny(experts=4)
+    mesh = make_mesh(dp=2, fsdp=2, ep=2)
+    opt = make_optimizer(learning_rate=1e-3, warmup_steps=2, total_steps=50)
+    state, sh = init_sharded_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, sh)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(4), (8, 33), 0,
+                                          cfg.vocab_size)}
+    state, m0 = step(state, batch)
+    for _ in range(10):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert float(m["moe_aux_loss"]) > 0
+
+
+def test_moe_routing_capacity():
+    from ray_tpu.ops.moe import top_k_routing
+    logits = jax.random.normal(jax.random.PRNGKey(0), (32, 4))
+    dispatch, combine, aux = top_k_routing(logits, k=2, capacity=8)
+    # Each expert accepts at most `capacity` tokens.
+    per_expert = dispatch.sum(axis=(0, 2))
+    assert (per_expert <= 8 + 1e-6).all()
+    # Each token dispatched at most k times.
+    per_token = dispatch.sum(axis=(1, 2))
+    assert (per_token <= 2 + 1e-6).all()
+    # Combine weights for a token sum to <= 1 (renormalized top-k).
+    w = combine.sum(axis=(1, 2))
+    assert (w <= 1 + 1e-5).all()
+
+
+def test_mesh_spec_fill():
+    sizes = MeshSpec(dp=2, fsdp=-1, tp=2).resolve(8)
+    assert sizes["fsdp"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+
+
+def test_param_count_estimates():
+    from ray_tpu.models.config import gpt2_small, llama3_8b
+    assert abs(gpt2_small().num_params() - 124e6) / 124e6 < 0.1
+    assert abs(llama3_8b().num_params() - 8.0e9) / 8.0e9 < 0.1
