@@ -96,7 +96,7 @@ def main():
     toks = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
                               cfg.vocab_size)
     batch_dict = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
-    for _ in range(args.warmup):
+    for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch_dict)
     # Force with a value read: on relay-backed TPU terminals block_until_ready
     # can return before remote execution completes; a host read cannot.

@@ -1,0 +1,101 @@
+"""ray_tpu.data — distributed datasets with streaming execution.
+
+Reference surface: ``python/ray/data/__init__.py`` — read_* constructors,
+from_* converters, Dataset, aggregations, DataContext.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from . import logical as L
+from .aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from .dataset import Dataset, GroupedData
+from .datasource import (BinaryDatasource, BlocksDatasource, CSVDatasource,
+                         Datasource, ItemsDatasource, JSONDatasource,
+                         NumpyDatasource, ParquetDatasource, RangeDatasource,
+                         ReadTask, TextDatasource)
+from .iterator import DataIterator
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(datasource=datasource, parallelism=parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    return read_datasource(RangeDatasource(n, tensor_shape=tuple(shape)),
+                           parallelism=parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(ItemsDatasource(list(items)), parallelism=parallelism)
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return read_datasource(BlocksDatasource(dfs))
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return read_datasource(BlocksDatasource(tables))
+
+
+def from_numpy(arrays) -> Dataset:
+    import numpy as np
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    blocks = [BlockAccessor.for_block([{"data": row} for row in a]).to_arrow()
+              for a in arrays]
+    return read_datasource(BlocksDatasource(blocks))
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Zero-copy from a HuggingFace datasets.Dataset (arrow-backed)."""
+    table = hf_dataset.data.table if hasattr(hf_dataset.data, "table") \
+        else hf_dataset.data
+    return from_arrow(table.combine_chunks())
+
+
+def read_parquet(paths, *, parallelism: int = -1, columns=None) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, columns=columns),
+                           parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **arrow_csv_args) -> Dataset:
+    return read_datasource(CSVDatasource(paths, **arrow_csv_args),
+                           parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(TextDatasource(paths), parallelism=parallelism)
+
+
+__all__ = [
+    "Dataset", "GroupedData", "DataContext", "DataIterator", "Datasource",
+    "ReadTask", "Block", "BlockAccessor", "BlockMetadata",
+    "AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std", "AbsMax",
+    "read_datasource", "range", "range_tensor", "from_items", "from_pandas",
+    "from_arrow", "from_numpy", "from_huggingface", "read_parquet", "read_csv",
+    "read_json", "read_numpy", "read_binary_files", "read_text",
+]

@@ -1,0 +1,254 @@
+"""Datasources: pluggable readers/writers producing/consuming blocks.
+
+Reference: ``python/ray/data/datasource/`` — ``Datasource.get_read_tasks`` returns
+serializable ``ReadTask`` thunks that execute remotely and yield blocks;
+``file_based_datasource.py`` is the shared framework for parquet/csv/json/numpy.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .block import Block, BlockAccessor, BlockMetadata, VALUE_COL
+
+
+@dataclass
+class ReadTask:
+    """A serializable zero-arg callable producing an iterable of blocks, plus
+    metadata estimated at planning time (before any data is read)."""
+    read_fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata
+
+    def __call__(self) -> Iterable[Block]:
+        return self.read_fn()
+
+
+class Datasource:
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, *, tensor_shape: Optional[tuple] = None):
+        self._n = n
+        self._tensor_shape = tensor_shape
+
+    def estimate_inmemory_data_size(self):
+        per = 8 if not self._tensor_shape else 8 * int(np.prod(self._tensor_shape))
+        return self._n * per
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n)) if self._n else 1
+        tasks = []
+        chunk = -(-self._n // parallelism) if self._n else 0
+        shape = self._tensor_shape
+        for i in range(parallelism):
+            lo, hi = i * chunk, min((i + 1) * chunk, self._n)
+            if lo >= hi:
+                break
+
+            def make(lo=lo, hi=hi):
+                if shape is None:
+                    return [pa.table({"id": pa.array(range(lo, hi), type=pa.int64())})]
+                data = np.stack([np.full(shape, v, dtype=np.int64) for v in range(lo, hi)])
+                return [BlockAccessor.for_block(
+                    [{"data": row} for row in data]).to_arrow()]
+
+            nbytes = (hi - lo) * (8 if shape is None else 8 * int(np.prod(shape)))
+            tasks.append(ReadTask(make, BlockMetadata(num_rows=hi - lo, size_bytes=nbytes)))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        chunk = -(-n // parallelism) if n else 0
+        tasks = []
+        for i in range(parallelism):
+            part = self._items[i * chunk:(i + 1) * chunk]
+            if not part:
+                break
+
+            def make(part=part):
+                if part and isinstance(part[0], dict):
+                    return [BlockAccessor.for_block(part).to_arrow()]
+                return [part]
+
+            tasks.append(ReadTask(make, BlockMetadata(num_rows=len(part), size_bytes=None)))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """Pre-materialized in-memory blocks (from_pandas / from_arrow / from_numpy)."""
+
+    def __init__(self, blocks: List[Block]):
+        self._blocks = blocks
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for b in self._blocks:
+            acc = BlockAccessor.for_block(b)
+            tasks.append(ReadTask(lambda b=b: [b], acc.metadata()))
+        return tasks
+
+
+def _expand_paths(paths, ext: Optional[str]) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, "**", f"*{ext}" if ext else "*")
+            out.extend(sorted(f for f in globlib.glob(pat, recursive=True)
+                              if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """Framework for path-list datasources — one or more files per read task.
+
+    Reference: ``python/ray/data/datasource/file_based_datasource.py``.
+    """
+
+    _FILE_EXTENSION: Optional[str] = None
+
+    def __init__(self, paths, **reader_args):
+        self._paths = _expand_paths(paths, self._FILE_EXTENSION)
+        self._reader_args = reader_args
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self):
+        try:
+            return sum(os.path.getsize(p) for p in self._paths)
+        except OSError:
+            return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._paths)
+        parallelism = max(1, min(parallelism, n))
+        per = -(-n // parallelism)
+        tasks = []
+        for i in range(parallelism):
+            chunk = self._paths[i * per:(i + 1) * per]
+            if not chunk:
+                break
+            read_file = self._read_file
+
+            def make(chunk=chunk, read_file=read_file):
+                def gen():
+                    for p in chunk:
+                        yield from read_file(p)
+                return gen()
+
+            size = None
+            try:
+                size = sum(os.path.getsize(p) for p in chunk)
+            except OSError:
+                pass
+            tasks.append(ReadTask(make, BlockMetadata(num_rows=None, size_bytes=size,
+                                                      input_files=chunk)))
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _FILE_EXTENSION = ".parquet"
+
+    def _read_file(self, path):
+        import pyarrow.parquet as pq
+        columns = self._reader_args.get("columns")
+        yield pq.read_table(path, columns=columns)
+
+
+class CSVDatasource(FileBasedDatasource):
+    _FILE_EXTENSION = ".csv"
+
+    def _read_file(self, path):
+        from pyarrow import csv as pcsv
+        yield pcsv.read_csv(path, **self._reader_args)
+
+
+class JSONDatasource(FileBasedDatasource):
+    _FILE_EXTENSION = ".json"
+
+    def _read_file(self, path):
+        from pyarrow import json as pjson
+        yield pjson.read_json(path)
+
+
+class NumpyDatasource(FileBasedDatasource):
+    _FILE_EXTENSION = ".npy"
+
+    def _read_file(self, path):
+        arr = np.load(path, allow_pickle=False)
+        yield BlockAccessor.for_block([{"data": row} for row in arr]).to_arrow()
+
+
+class BinaryDatasource(FileBasedDatasource):
+    _FILE_EXTENSION = None
+
+    def _read_file(self, path):
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": pa.array([data], type=pa.binary()),
+                        "path": pa.array([path])})
+
+
+class TextDatasource(FileBasedDatasource):
+    _FILE_EXTENSION = None
+
+    def _read_file(self, path):
+        with open(path, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        yield pa.table({"text": pa.array(lines)})
+
+
+# ---------------------------------------------------------------------------
+# Write path
+# ---------------------------------------------------------------------------
+
+def write_block(block: Block, path: str, file_format: str, index: int,
+                **writer_args) -> str:
+    os.makedirs(path, exist_ok=True)
+    acc = BlockAccessor.for_block(block)
+    fname = os.path.join(path, f"part-{index:06d}.{file_format}")
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(acc.to_arrow(), fname, **writer_args)
+    elif file_format == "csv":
+        from pyarrow import csv as pcsv
+        pcsv.write_csv(acc.to_arrow(), fname)
+    elif file_format == "json":
+        df = acc.to_pandas()
+        df.to_json(fname, orient="records", lines=True)
+    elif file_format == "npy":
+        cols = acc.to_numpy()
+        key = "data" if "data" in cols else list(cols)[0]
+        np.save(fname[:-4], cols[key])
+    else:
+        raise ValueError(f"unknown write format {file_format}")
+    return fname

@@ -225,24 +225,12 @@ def apply(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
             scan_body, policy=jax.checkpoint_policies.nothing_saveable)
 
     x, aux_losses = jax.lax.scan(scan_body, x, params["blocks"])
-    x = _norm_final(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg)
     if cfg.tied_embeddings:
         logits = x @ params["embed"]["tokens"].T.astype(x.dtype)
     else:
         logits = x @ params["lm_head"].astype(x.dtype)
     return logits.astype(jnp.float32), {"moe_aux_loss": aux_losses.mean()}
-
-
-def _norm_final(x, p, cfg: TransformerConfig):
-    x32 = x.astype(jnp.float32)
-    if cfg.use_rmsnorm:
-        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True)
-                                  + cfg.norm_eps)
-        return (x32 * p["scale"].astype(jnp.float32)).astype(x.dtype)
-    mean = x32.mean(-1, keepdims=True)
-    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
-    x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
-    return (x32 * p["scale"] + p["bias"]).astype(x.dtype)
 
 
 def causal_lm_loss(params: Params, batch: Dict[str, jnp.ndarray],

@@ -71,7 +71,7 @@ def attend_blockwise(q, k, v, m, l, o, causal, q_offset, kv_offset,
     s = s.astype(jnp.float32)
     if logit_softcap > 0:
         s = jnp.tanh(s / logit_softcap) * logit_softcap
-    if causal is not None:
+    if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = kv_offset + jnp.arange(k.shape[1])
         mask = q_pos[:, None] >= k_pos[None, :]
@@ -95,12 +95,14 @@ def mha(q, k, v, causal: bool = True, logit_softcap: float = 0.0,
         use_flash: Optional[bool] = None):
     """Dispatch between the Pallas flash kernel (TPU, long seq) and plain XLA."""
     if use_flash is None:
+        # The flash kernel does not implement logit softcap; fall back when set.
         use_flash = (jax.default_backend() == "tpu" and q.shape[1] >= 1024
-                     and q.shape[-1] in (64, 128, 256))
+                     and q.shape[-1] in (64, 128, 256) and logit_softcap == 0.0)
     if use_flash:
         try:
             from .flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal)
-        except Exception:
+        except ImportError:
             pass
+        else:
+            return flash_attention(q, k, v, causal=causal)
     return attend(q, k, v, causal=causal, logit_softcap=logit_softcap)
