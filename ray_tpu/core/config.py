@@ -47,6 +47,11 @@ class Config:
     #: Lease reuse window: an idle leased worker is returned to the pool after
     #: this many seconds (reference: ``idle_worker_killing_time_threshold_ms``).
     idle_worker_timeout_s: float = 2.0
+    #: Escrow grace for distributed refcounting: delay owner-side frees and
+    #: borrower-side remove-notes so refs in flight between processes (task
+    #: results / actor replies) can be registered by the receiver before the
+    #: owner evaluates "no references left".
+    ref_escrow_grace_s: float = 10.0
     #: Max workers a node agent will spawn beyond configured CPU count for
     #: blocked-on-get tasks.
     max_extra_workers: int = 2

@@ -821,14 +821,29 @@ class CoreWorker:
     # ----------------------------------------------------------- ref counting
 
     def on_ref_count_zero(self, oid: ObjectID, owner: str):
-        """All owner-side counts (local/submitted/borrowers) hit zero."""
+        """All owner-side counts (local/submitted/borrowers) hit zero.
+
+        The free is delayed by a short escrow grace and the counts re-checked:
+        when a ref is in flight between processes (serialized into a task
+        result / actor reply), the sender's count can hit zero before the
+        receiver's add_borrower_note lands at the owner.  The reference closes
+        this window with full borrower-list bookkeeping
+        (``reference_count.cc`` WaitForRefRemoved); the grace window covers the
+        same hand-off race because receivers note borrows immediately on
+        deserialization.
+        """
         if self._shutdown:
             return
         try:
             loop = get_loop()
         except Exception:
             return
-        asyncio.run_coroutine_threadsafe(self._free_owned(oid), loop)
+
+        async def _delayed_free():
+            await asyncio.sleep(get_config().ref_escrow_grace_s)
+            await self._free_owned(oid)  # re-checks has_any_ref
+
+        asyncio.run_coroutine_threadsafe(_delayed_free(), loop)
 
     def send_borrower_note(self, oid: ObjectID, owner: str, add: bool):
         """Borrower-side: tell the owner we hold / released a copy of its object."""
@@ -907,6 +922,10 @@ class CoreWorker:
     async def handle_ping(self):
         return "pong"
 
+    async def handle_owned_object_count(self) -> int:
+        """Number of live objects this process owns (idle-reap guard)."""
+        return len(self.memory_store)
+
     async def handle_locate_object(self, object_id: ObjectID, timeout: float = 30.0):
         """Owner-side: return the record for an object, waiting for the producing
         task up to `timeout`. None => not ready yet."""
@@ -937,6 +956,12 @@ class CoreWorker:
         return True
 
     async def handle_remove_borrower_note(self, object_id: ObjectID):
+        # Owner-side escrow: apply the removal only after the grace window, so
+        # a ref the borrower *forwarded* (task result / actor reply) has time
+        # to be re-registered by the receiver's add note.  Processing the
+        # delay here (not at the sender) means a borrower exiting right after
+        # sending cannot lose the note.
+        await asyncio.sleep(get_config().ref_escrow_grace_s)
         self.reference_counter.remove_borrower(object_id)
 
     async def handle_add_borrower_note(self, object_id: ObjectID):

@@ -172,6 +172,23 @@ class NodeAgent:
             for w in idle:
                 if n_idle <= keep:
                     break
+                # A worker that owns live objects (in-process store non-empty)
+                # must not be reaped: borrowers would lose the data (the
+                # reference keeps object data in node-level plasma precisely so
+                # worker exit doesn't destroy it; our inline small objects live
+                # with their owner).
+                try:
+                    client = self.worker_clients.get(w.address)
+                    owned = await client.call("owned_object_count",
+                                              _timeout=2.0)
+                except Exception:
+                    continue  # fail closed: don't kill what we can't probe
+                if owned:
+                    continue
+                # Re-check after the await: the worker may have been leased
+                # while the probe was in flight.
+                if w.state != "IDLE":
+                    continue
                 await self._kill_worker_proc(w)
                 n_idle -= 1
 
@@ -457,9 +474,17 @@ class NodeAgent:
     async def handle_create_actor(self, spec: TaskSpec):
         """Lease a dedicated worker and run the actor-creation task on it
         (reference: GcsActorScheduler lease + PushTask of the creation task)."""
+        # PG-placed actors lease out of the reserved bundle pool, NOT the free
+        # pool — the bundle already holds those resources (prepare/commit), so
+        # leasing from the free pool would double-count them.
+        strategy = spec.scheduling_strategy
+        bundle = None
+        if (isinstance(strategy, (tuple, list)) and strategy
+                and strategy[0] == "_pg"):
+            bundle = (strategy[1], strategy[2])
         grant = await self.handle_request_worker_lease(
-            resources=spec.resources, runtime_env=spec.runtime_env,
-            allow_spillback=False)
+            resources=spec.resources, bundle=bundle,
+            runtime_env=spec.runtime_env, allow_spillback=False)
         if "worker_address" not in grant:
             raise RuntimeError(f"cannot place actor here: {grant}")
         w = self.workers[grant["worker_id"]]

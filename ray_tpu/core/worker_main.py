@@ -12,6 +12,13 @@ import sys
 
 
 def main():
+    # SIGUSR1 → dump all thread stacks to stderr (lands in the worker log).
+    # Debug hook behind `ray stack`-style tooling (reference: py-spy via the
+    # dashboard reporter; here faulthandler is dependency-free).
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     gcs_address = os.environ["RAYTPU_GCS_ADDRESS"]
     agent_address = os.environ["RAYTPU_AGENT_ADDRESS"]
     node_id = os.environ["RAYTPU_NODE_ID"]

@@ -1,0 +1,30 @@
+"""ray_tpu.train — distributed training orchestration over the actor substrate.
+
+Reference surface: ``python/ray/train`` (SURVEY.md §2.5).  The reference wires
+torch process groups + DDP/FSDP around actor worker groups
+(``train/torch/config.py:63-160``); here the data plane is jax: every worker
+process joins one ``jax.distributed`` namespace, builds the same
+``jax.sharding.Mesh`` and runs the same pjit-compiled train step — gradient
+reduction, ZeRO sharding, tensor/sequence/expert parallelism are all XLA
+collectives over ICI/DCN (see ray_tpu.parallel), not framework code.
+"""
+
+from .checkpoint import Checkpoint, CheckpointConfig
+from .config import FailureConfig, RunConfig, ScalingConfig
+from .context import (TrainContext, get_checkpoint, get_context,
+                      get_dataset_shard, report)
+from .result import Result
+from .backend import Backend, BackendConfig, JaxBackendConfig
+from .worker_group import WorkerGroup
+from .backend_executor import BackendExecutor, TrainingFailedError
+from .trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
+from .jax_utils import load_pytree, save_pytree
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "ScalingConfig", "TrainContext", "get_context", "get_checkpoint",
+    "get_dataset_shard", "report", "Result", "Backend", "BackendConfig",
+    "JaxBackendConfig", "WorkerGroup", "BackendExecutor",
+    "TrainingFailedError", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
+    "save_pytree", "load_pytree",
+]

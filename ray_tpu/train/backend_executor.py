@@ -1,0 +1,155 @@
+"""Orchestrates a training run over a WorkerGroup.
+
+Reference: ``python/ray/train/_internal/backend_executor.py:46`` (``start``
+:105 boots the worker group + backend hooks, ``start_training`` :344 launches
+the loop on all workers).  The result-collection protocol: each round, fetch
+one result per live worker (barrier), surface rank-0 metrics, register any
+checkpoint, release the barrier.  Worker-group death → ``TrainingFailedError``
+which the trainer turns into an elastic restart from the latest checkpoint
+(FailureConfig, reference ``air/config.py:523``).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import ActorDiedError, GetTimeoutError, TaskError
+
+from .backend import BackendConfig
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    """The worker group failed (actor death / user exception)."""
+
+    def __init__(self, msg: str, cause: Optional[BaseException] = None,
+                 worker_rank: Optional[int] = None):
+        super().__init__(msg)
+        self.cause = cause
+        self.worker_rank = worker_rank
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig,
+                 run_config: RunConfig,
+                 trial_name: str,
+                 trial_dir: str,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 ckpt_manager: Optional[CheckpointManager] = None):
+        self.backend_config = backend_config
+        self.backend = backend_config.backend_cls(backend_config)
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.trial_name = trial_name
+        self.trial_dir = trial_dir
+        self.worker_env = worker_env
+        self.worker_group: Optional[WorkerGroup] = None
+        # Shared across elastic restarts (the checkpoint index/top-k state
+        # must survive worker-group re-creation).
+        self.ckpt_manager = ckpt_manager or CheckpointManager(
+            run_config.checkpoint_config, trial_dir)
+
+    def start(self) -> None:
+        # PG bundles from the ScalingConfig: optional trainer bundle first
+        # (reserved for driver-side work), then one bundle per worker
+        # (reference: backend_executor places the worker group via the
+        # ScalingConfig's placement group, trainer_resources in bundle 0).
+        from ray_tpu import placement_group
+        bundles = self.scaling.as_placement_group_bundles()
+        pg = placement_group(bundles,
+                             strategy=self.scaling.placement_strategy)
+        self.worker_group = WorkerGroup(
+            num_workers=self.scaling.num_workers,
+            resources_per_worker=self.scaling._resources_per_worker_not_none,
+            placement_strategy=self.scaling.placement_strategy,
+            worker_env=self.worker_env,
+            pg=pg, bundle_offset=self.scaling.num_bundle_offset,
+            owns_pg=True)
+        self.backend.on_start(self.worker_group)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       datasets: Optional[Dict[str, Any]] = None,
+                       checkpoint: Optional[Checkpoint] = None) -> None:
+        wg = self.worker_group
+        assert wg is not None, "call start() first"
+        self.backend.on_training_start(wg)
+        n = len(wg)
+        # Per-worker dataset shards: streaming_split(n) gives coherent,
+        # locality-aware shards (reference data_config.py default).
+        shard_sets: Dict[int, Dict[str, Any]] = {i: {} for i in range(n)}
+        for name, ds in (datasets or {}).items():
+            if hasattr(ds, "streaming_split"):
+                iters = ds.streaming_split(n, equal=True)
+                for i in range(n):
+                    shard_sets[i][name] = iters[i]
+            else:
+                for i in range(n):
+                    shard_sets[i][name] = ds
+        trial_id = uuid.uuid4().hex[:8]
+        refs = []
+        for i, w in enumerate(wg.workers):
+            refs.append(w.init_session.remote(
+                world_rank=i, world_size=n,
+                local_rank=wg.local_rank_of[i],
+                local_world_size=wg.local_world_size_of[i],
+                node_rank=wg.node_rank_of[i],
+                experiment_name=self.run_config.name or "train",
+                trial_name=self.trial_name, trial_id=trial_id,
+                trial_dir=self.trial_dir,
+                checkpoint_path=checkpoint.path if checkpoint else None,
+                dataset_shards=shard_sets[i],
+                mesh_spec=self.scaling.mesh))
+        ray_tpu.get(refs, timeout=60)
+        ray_tpu.get([w.start_training.remote(train_fn, config)
+                     for w in wg.workers], timeout=60)
+
+    def fetch_next(self, timeout: float = 3600.0):
+        """One barrier round.  Returns ("report", rank0_metrics, ckpt) or
+        ("done", rank0_value)."""
+        wg = self.worker_group
+        refs = [w.next_result.remote(timeout) for w in wg.workers]
+        try:
+            results = ray_tpu.get(refs, timeout=timeout)
+        except (ActorDiedError, GetTimeoutError) as e:
+            raise TrainingFailedError(f"worker group failed: {e}", cause=e)
+        except TaskError as e:
+            raise TrainingFailedError(
+                f"train loop raised: {e}", cause=e)
+        kinds = {kind for kind, _, _ in results}
+        if kinds == {"done"}:
+            return ("done", results[0][1])
+        if "done" in kinds:
+            raise TrainingFailedError(
+                "mismatched session calls: some workers finished while "
+                "others are still reporting (all workers must call "
+                "train.report the same number of times)")
+        # register checkpoint (rank0's path; multi-host writers share the dir)
+        ckpt = None
+        for kind, metrics, ckpt_path in results:
+            if ckpt_path:
+                ckpt = Checkpoint(ckpt_path)
+                break
+        tracked = None
+        if ckpt is not None:
+            tracked = self.ckpt_manager.register(ckpt, results[0][1])
+        ray_tpu.get([w.resume.remote() for w in wg.workers], timeout=60)
+        return ("report", results[0][1], tracked)
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return self.ckpt_manager.latest

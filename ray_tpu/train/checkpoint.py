@@ -1,0 +1,156 @@
+"""Path-based checkpoints + top-k retention.
+
+Reference: ``python/ray/train/_checkpoint.py:55`` (``Checkpoint`` = directory +
+filesystem), ``train/_internal/checkpoint_manager.py`` (top-k by score),
+``train/_internal/storage.py:350`` (``StorageContext`` — consistent experiment
+layout across head/workers).
+
+TPU-native note: sharded ``jax.Array`` trees are written per-host (each host
+persists only its addressable shards — see ``jax_utils.save_pytree``), so a
+checkpoint directory is the union of per-host writes on a shared filesystem,
+exactly how multi-host orbax lays it out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A directory of files; the unit of train/tune fault-tolerance."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="raytpu-ckpt-")
+        with open(os.path.join(d, "_dict_checkpoint.json"), "w") as f:
+            json.dump(data, f, default=repr)
+        import pickle
+        with open(os.path.join(d, "_dict_checkpoint.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        import pickle
+        with open(os.path.join(self.path, "_dict_checkpoint.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None or os.path.abspath(path) == self.path:
+            return self.path
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        meta = self.get_metadata()
+        meta.update(metadata)
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path})"
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Top-k retention — reference ``air/config.py:574``."""
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    metrics: Dict[str, Any]
+    index: int
+
+
+class CheckpointManager:
+    """Registers reported checkpoints into the run dir, keeps top-k."""
+
+    def __init__(self, config: Optional[CheckpointConfig], run_dir: str):
+        self.config = config or CheckpointConfig()
+        self.run_dir = run_dir
+        self.tracked: List[_TrackedCheckpoint] = []
+        self._index = 0
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        dest = os.path.join(self.run_dir, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            os.makedirs(dest, exist_ok=True)
+            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        tracked = _TrackedCheckpoint(Checkpoint(dest), dict(metrics),
+                                     self._index)
+        self._index += 1
+        self.tracked.append(tracked)
+        self._enforce_retention()
+        return tracked.checkpoint
+
+    def _score(self, t: _TrackedCheckpoint) -> float:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return float(t.index)  # recency
+        v = t.metrics.get(attr)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return float("-inf")
+        return v if self.config.checkpoint_score_order == "max" else -v
+
+    def _enforce_retention(self) -> None:
+        k = self.config.num_to_keep
+        if k is None or len(self.tracked) <= k:
+            return
+        # the most recent is always kept (needed for failure recovery) and
+        # counts against the budget; the rest of the k slots go to the best.
+        latest = self.tracked[-1]
+        ranked = sorted((t for t in self.tracked if t is not latest),
+                        key=self._score, reverse=True)
+        keep = set(id(t) for t in ranked[:max(k - 1, 0)])
+        keep.add(id(latest))
+        for t in list(self.tracked):
+            if id(t) not in keep:
+                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+                self.tracked.remove(t)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        return self.tracked[-1].checkpoint if self.tracked else None
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self.tracked:
+            return None
+        return max(self.tracked, key=self._score).checkpoint

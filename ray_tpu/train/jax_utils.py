@@ -1,0 +1,74 @@
+"""Checkpoint IO for jax pytrees (params / TrainState).
+
+Reference analogue: torch.save/load inside Train checkpoints
+(``train/torch/train_loop_utils.py``); here trees of (possibly sharded)
+``jax.Array`` are persisted.  Two paths:
+
+- msgpack (flax.serialization) single-file — small states, single host.
+- orbax ``PyTreeCheckpointer`` — sharded multi-host states: each host writes
+  only its addressable shards; restore takes the target shardings so arrays
+  come back resident on the right devices (no replicated materialization).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def save_pytree(path: str, tree: Any, *, use_orbax: Optional[bool] = None) -> str:
+    """Save a pytree under `path` (a directory). Returns the path."""
+    os.makedirs(path, exist_ok=True)
+    import jax
+    if use_orbax is None:
+        use_orbax = _should_use_orbax(tree)
+    if use_orbax:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        dest = os.path.join(path, "state.orbax")
+        ckptr.save(dest, jax.tree.map(lambda x: x, tree), force=True)
+        return path
+    from flax import serialization
+    host_tree = jax.tree.map(lambda x: jax.device_get(x), tree)
+    with open(os.path.join(path, "state.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(host_tree))
+    return path
+
+
+def load_pytree(path: str, target: Any = None, *, shardings: Any = None) -> Any:
+    """Load a pytree saved by save_pytree.  `target` gives tree structure for
+    the msgpack path; `shardings` (a NamedSharding tree) makes orbax restore
+    arrays directly sharded onto the mesh."""
+    orbax_path = os.path.join(path, "state.orbax")
+    msgpack_path = os.path.join(path, "state.msgpack")
+    if os.path.exists(orbax_path):
+        import jax
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        restore_args = None
+        if shardings is not None:
+            restore_args = jax.tree.map(
+                lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
+            return ckptr.restore(orbax_path, restore_args=restore_args)
+        return ckptr.restore(orbax_path)
+    if os.path.exists(msgpack_path):
+        from flax import serialization
+        with open(msgpack_path, "rb") as f:
+            data = f.read()
+        if target is not None:
+            return serialization.from_bytes(target, data)
+        return serialization.msgpack_restore(data)
+    raise FileNotFoundError(f"no checkpoint state under {path}")
+
+
+def _should_use_orbax(tree) -> bool:
+    """Sharded/multi-host arrays need orbax; host-local trees msgpack."""
+    import jax
+    leaves = jax.tree.leaves(tree)
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            if not leaf.is_fully_addressable:
+                return True
+            if len(leaf.sharding.device_set) > 1:
+                return True
+    return False

@@ -1,0 +1,24 @@
+"""Training result — reference ``python/ray/train/_internal/result.py`` /
+``ray.air.Result``: final metrics + best/latest checkpoint + error."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    path: Optional[str] = None
+    error: Optional[BaseException] = None
+    metrics_history: Optional[List[Dict[str, Any]]] = None
+    best_checkpoints: Optional[List[Tuple[Checkpoint, Dict[str, Any]]]] = None
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+        return pd.DataFrame(self.metrics_history or [])

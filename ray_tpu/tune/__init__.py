@@ -1,0 +1,32 @@
+"""ray_tpu.tune — hyperparameter search over the trial-as-actor substrate.
+
+Reference surface: ``python/ray/tune`` (SURVEY.md §2.6): ``Tuner.fit`` →
+controller event loop → trials as actors; search spaces; ASHA / median /
+PBT schedulers; per-trial checkpoints; experiment state snapshots.
+"""
+
+from .search import (BasicVariantGenerator, Categorical, Domain, Float,
+                     GridSearch, Integer, Searcher, choice, grid_search,
+                     lograndint, loguniform, qloguniform, quniform, randint,
+                     randn, sample_from, uniform)
+from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                         MedianStoppingRule, PopulationBasedTraining,
+                         TrialScheduler)
+from .session import (get_checkpoint, get_session, get_trial_dir,
+                      get_trial_id, report, report_bridge)
+from .trial import Trial
+from .controller import TuneController
+from .tuner import ResultGrid, TuneConfig, Tuner
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TuneController", "Trial",
+    "Searcher", "BasicVariantGenerator", "uniform", "loguniform", "quniform",
+    "qloguniform", "randint", "lograndint", "choice", "sample_from", "randn",
+    "grid_search", "Domain", "Float", "Integer", "Categorical", "GridSearch",
+    "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+    "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "report", "get_checkpoint", "get_session", "get_trial_id",
+    "get_trial_dir", "report_bridge",
+]

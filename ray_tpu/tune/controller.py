@@ -1,0 +1,207 @@
+"""TuneController — the experiment event loop.
+
+Reference: ``python/ray/tune/execution/tune_controller.py:81``: manage N
+trials as actors, pump results, apply searcher + scheduler decisions, retry
+failed trials, snapshot experiment state.  Differences are deliberate: trial
+results multiplex over ``ray_tpu.wait`` on the runner actors' ``next_result``
+calls instead of a callback event manager, and PBT checkpoint transplants are
+a directory copy + actor restart (checkpoints are directories, train/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import ActorDiedError, TaskError
+
+from . import schedulers as sched_mod
+from .schedulers import CONTINUE, PERTURB, STOP, FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+from .trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial, TrialRunner)
+
+
+class TuneController:
+    def __init__(self, trainable: Callable,
+                 searcher: Searcher,
+                 scheduler: Optional[TrialScheduler],
+                 experiment_dir: str,
+                 *,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 max_concurrent: Optional[int] = None,
+                 max_failures_per_trial: int = 0,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 result_poll_timeout: float = 3600.0):
+        self.trainable = trainable
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler(metric, mode)
+        if self.scheduler.metric is None:
+            self.scheduler.metric = metric
+            self.scheduler.mode = mode
+        self.experiment_dir = experiment_dir
+        self.metric, self.mode = metric, mode
+        self.max_concurrent = max_concurrent or 8
+        self.max_failures = max_failures_per_trial
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.worker_env = worker_env
+        self.poll_timeout = result_poll_timeout
+        self.trials: List[Trial] = []
+        self._exhausted = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _next_trial(self) -> Optional[Trial]:
+        if self._exhausted:
+            return None
+        t = Trial.new({}, self.experiment_dir)
+        config = self.searcher.suggest(t.trial_id)
+        if config is None:
+            self._exhausted = True
+            return None
+        t.config = config
+        self.trials.append(t)
+        return t
+
+    def _start_trial(self, trial: Trial,
+                     checkpoint_path: Optional[str] = None) -> None:
+        cls = ray_tpu.remote(TrialRunner)
+        opts: Dict[str, Any] = {"num_cpus": self.resources.get("CPU", 1)}
+        if self.resources.get("TPU"):
+            opts["num_tpus"] = self.resources["TPU"]
+        extra = {k: v for k, v in self.resources.items()
+                 if k not in ("CPU", "TPU", "GPU")}
+        if extra:
+            opts["resources"] = extra
+        trial._pending_ref = None
+        trial.runner = cls.options(**opts).remote(self.worker_env)
+        # Fire-and-forget: do NOT block on actor readiness here — when trials
+        # oversubscribe the cluster the creation queues at the lease layer,
+        # and blocking would deadlock the event loop (running trials wait for
+        # the controller, the queued actor waits for them to finish).  Actor
+        # method ordering guarantees run() precedes the next_result() poll.
+        trial.runner.run.remote(
+            self.trainable, trial.config, trial.trial_id, trial.trial_dir,
+            checkpoint_path or trial.latest_checkpoint)
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED) -> None:
+        trial.status = status
+        trial._pending_ref = None
+        if trial.runner is not None:
+            try:
+                ray_tpu.kill(trial.runner)
+            except Exception:
+                pass
+            trial.runner = None
+
+    # ---------------------------------------------------------------- events
+
+    def _on_report(self, trial: Trial, metrics: Dict[str, Any],
+                   ckpt_path: Optional[str]) -> None:
+        trial.last_result = metrics
+        trial.metrics_history.append(metrics)
+        trial.iteration = metrics.get("training_iteration", trial.iteration + 1)
+        if ckpt_path:
+            dest = os.path.join(trial.trial_dir,
+                                f"checkpoint_{trial.iteration:06d}")
+            if os.path.abspath(ckpt_path) != os.path.abspath(dest):
+                shutil.copytree(ckpt_path, dest, dirs_exist_ok=True)
+            trial.latest_checkpoint = dest
+        self.searcher.on_trial_result(trial.trial_id, metrics)
+        decision = self.scheduler.on_result(trial, metrics)
+        if decision == CONTINUE:
+            trial.runner.resume.remote()
+        elif decision == STOP:
+            self._stop_trial(trial)
+            self.searcher.on_trial_complete(trial.trial_id, metrics)
+        elif isinstance(decision, tuple) and decision[0] == PERTURB:
+            _, new_config, donor_id = decision
+            donor = next((t for t in self.trials
+                          if t.trial_id == donor_id), None)
+            self._stop_trial(trial, status=PENDING)
+            trial.config = new_config
+            ckpt = donor.latest_checkpoint if donor else None
+            trial.restarts += 1
+            self._start_trial(trial, checkpoint_path=ckpt)
+
+    def _on_failure(self, trial: Trial, err: BaseException) -> None:
+        self._stop_trial(trial, status=ERROR)
+        trial.error = repr(err)
+        if trial.restarts < self.max_failures:
+            trial.restarts += 1
+            trial.status = PENDING
+            self._start_trial(trial)
+        else:
+            self.searcher.on_trial_complete(trial.trial_id, error=True)
+
+    # ------------------------------------------------------------------ loop
+
+    def run(self) -> List[Trial]:
+        # One outstanding next_result ref per running trial; ray_tpu.wait
+        # multiplexes — a slow trial never blocks processing of fast ones.
+        pending: Dict[Any, Trial] = {}
+        while True:
+            running = [t for t in self.trials if t.status == RUNNING]
+            while len(running) < self.max_concurrent:
+                t = self._next_trial()
+                if t is None:
+                    break
+                self._start_trial(t)
+                running.append(t)
+            for t in running:
+                if t.runner is not None and t._pending_ref is None:
+                    ref = t.runner.next_result.remote(self.poll_timeout)
+                    t._pending_ref = ref
+                    pending[ref] = t
+            # Drop refs whose trial was stopped/restarted meanwhile.
+            for ref in [r for r, t in pending.items()
+                        if t._pending_ref is not r]:
+                pending.pop(ref)
+            if not pending:
+                break
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                    timeout=self.poll_timeout)
+            for ref in ready:
+                trial = pending.pop(ref)
+                if trial._pending_ref is ref:
+                    trial._pending_ref = None
+                else:
+                    continue  # stale (trial restarted)
+                try:
+                    kind, payload, ckpt = ray_tpu.get(ref)
+                except (TaskError, ActorDiedError) as e:
+                    self._on_failure(trial, e)
+                    continue
+                if kind == "done":
+                    self._stop_trial(trial)
+                    self.searcher.on_trial_complete(trial.trial_id,
+                                                    trial.last_result)
+                else:
+                    self._on_report(trial, payload, ckpt)
+            self._save_state()
+        self._save_state()
+        return self.trials
+
+    # ------------------------------------------------------------- state io
+
+    def _save_state(self) -> None:
+        state = [{
+            "trial_id": t.trial_id, "status": t.status, "config": repr(t.config),
+            "last_result": {k: v for k, v in (t.last_result or {}).items()
+                            if isinstance(v, (int, float, str, bool))},
+            "iterations": t.iteration, "error": t.error,
+            "checkpoint": t.latest_checkpoint,
+        } for t in self.trials]
+        try:
+            with open(os.path.join(self.experiment_dir,
+                                   "experiment_state.json"), "w") as f:
+                json.dump({"timestamp": time.time(), "trials": state}, f,
+                          indent=2)
+        except OSError:
+            pass

@@ -1,0 +1,173 @@
+"""Trial schedulers — reference ``python/ray/tune/schedulers/``:
+FIFO (default), ASHA (``async_hyperband.py``), median stopping
+(``median_stopping_rule.py``), PBT (``pbt.py``).
+
+Decisions are made per reported result: CONTINUE, STOP (early termination) or
+a PBT exploit/explore restart (returned as (PERTURB, new_config,
+clone_from_trial_id) — the controller handles the checkpoint transplant).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PERTURB = "PERTURB"
+
+
+class TrialScheduler:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric, self.mode = metric, mode
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        v = float(v)
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result: Dict[str, Any]):
+        return CONTINUE
+
+    def on_complete(self, trial, result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """No early stopping."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: rungs at r*eta^k iterations; a trial stops at a rung if its
+    score is below the top-1/eta quantile of completed rung entries
+    (asynchronous successive halving — no waiting for full brackets)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4.0):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        # rung iteration -> list of scores recorded at that rung
+        self.rungs: Dict[int, List[float]] = {}
+        r = grace_period
+        while r < max_t:
+            self.rungs[int(r)] = []
+            r *= reduction_factor
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung_t in sorted(self.rungs, reverse=True):
+            if t >= rung_t and rung_t not in trial.rungs_passed:
+                trial.rungs_passed.add(rung_t)
+                scores = self.rungs[rung_t]
+                scores.append(score)
+                k = max(1, int(len(scores) / self.eta))
+                cutoff = sorted(scores, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+                break
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score so far is below the median of other
+    trials' running averages at the same point in time."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, Tuple[float, int]] = {}  # trial -> (sum, n)
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        s, n = self._avgs.get(trial.trial_id, (0.0, 0))
+        self._avgs[trial.trial_id] = (s + score, n + 1)
+        if t < self.grace or len(self._avgs) < self.min_samples:
+            return CONTINUE
+        others = [s / n for tid, (s, n) in self._avgs.items()
+                  if tid != trial.trial_id and n > 0]
+        if not others:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        mine_s, mine_n = self._avgs[trial.trial_id]
+        if mine_s / mine_n < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: every perturbation_interval, bottom-quantile trials exploit a
+    top-quantile trial (clone its checkpoint) and explore (mutate config) —
+    reference ``pbt.py`` exploit/explore."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, float] = {}  # trial_id -> latest score
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+        new = dict(config)
+        for key, mut in self.mutations.items():
+            if self.rng.random() < self.resample_p or key not in new:
+                if isinstance(mut, Domain):
+                    new[key] = mut.sample(self.rng)
+                elif isinstance(mut, list):
+                    new[key] = self.rng.choice(mut)
+                elif callable(mut):
+                    new[key] = mut()
+            else:
+                factor = self.rng.choice([0.8, 1.2])
+                if isinstance(new[key], (int, float)):
+                    new[key] = new[key] * factor
+                    if isinstance(mut, list):  # snap to closest allowed
+                        new[key] = min(mut, key=lambda v: abs(v - new[key]))
+        return new
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is not None:
+            self.latest[trial.trial_id] = score
+        if t is None or t == 0 or t % self.interval != 0:
+            return CONTINUE
+        if len(self.latest) < 2:
+            return CONTINUE
+        ranked = sorted(self.latest.items(), key=lambda kv: kv[1])
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom = [tid for tid, _ in ranked[:k]]
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom and trial.trial_id not in top:
+            donor = self.rng.choice(top)
+            return (PERTURB, self._explore(trial.config), donor)
+        return CONTINUE

@@ -1,0 +1,199 @@
+"""Search spaces + search algorithms.
+
+Reference: ``python/ray/tune/search/sample.py`` (Domain/Float/Integer/
+Categorical samplers), ``search/basic_variant.py`` (grid × random variant
+generation), ``search/search_algorithm.py`` (Searcher interface).  External
+optimizer wrappers (hyperopt/optuna/ax/...) are out of scope on this image —
+the Searcher ABC is the plug point they'd use.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            return math.exp(rng.uniform(math.log(self.lower),
+                                        math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+    def quantized(self, q: float) -> "Quantized":
+        return Quantized(self, q)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            return int(round(math.exp(rng.uniform(math.log(self.lower),
+                                                  math.log(self.upper - 1)))))
+        return rng.randint(self.lower, self.upper - 1)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Quantized(Domain):
+    def __init__(self, inner: Domain, q: float):
+        self.inner, self.q = inner, q
+
+    def sample(self, rng):
+        v = self.inner.sample(rng)
+        return round(v / self.q) * self.q
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+class GridSearch:
+    """Marker for exhaustive expansion (cross product with other grids)."""
+
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+# -- public constructors (reference tune.uniform/loguniform/choice/...) -----
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def quniform(lower: float, upper: float, q: float) -> Quantized:
+    return Quantized(Float(lower, upper), q)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> Quantized:
+    return Quantized(Float(lower, upper, log=True), q)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Function:
+    return Function(lambda: random.gauss(mean, sd))
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+# ---------------------------------------------------------------- searchers
+
+class Searcher:
+    """Suggest configs; receive results (reference Searcher interface)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+def _split_grid(space: Dict[str, Any]):
+    """Separate grid axes from sampleable/constant leaves (nested dicts ok)."""
+    grids: List[Tuple[Tuple[str, ...], GridSearch]] = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        elif isinstance(node, GridSearch):
+            grids.append((path, node))
+
+    walk(space, ())
+    return grids
+
+
+def _instantiate(space, rng: random.Random, grid_assignment):
+    def build(node, path):
+        if isinstance(node, dict):
+            return {k: build(v, path + (k,)) for k, v in node.items()}
+        if isinstance(node, GridSearch):
+            return grid_assignment[path]
+        if isinstance(node, Domain):
+            return node.sample(rng)
+        return node
+
+    return build(space, ())
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random samples — reference
+    ``basic_variant.py`` semantics: num_samples repeats the whole grid."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None,
+                 points_to_evaluate: Optional[List[Dict[str, Any]]] = None):
+        super().__init__()
+        self.space = space
+        self.rng = random.Random(seed)
+        self._preset = list(points_to_evaluate or [])
+        grids = _split_grid(space)
+        paths = [p for p, _ in grids]
+        combos = list(itertools.product(*[g.values for _, g in grids])) or [()]
+        self._variants: Iterator = iter([
+            dict(zip(paths, combo))
+            for _ in range(num_samples) for combo in combos
+        ])
+        self.total = num_samples * len(combos) + len(self._preset)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._preset:
+            return self._preset.pop(0)
+        try:
+            assignment = next(self._variants)
+        except StopIteration:
+            return None
+        return _instantiate(self.space, self.rng, assignment)

@@ -1,0 +1,100 @@
+"""Tune worker-side session: ``tune.report`` / ``tune.get_checkpoint``.
+
+Reference: ``python/ray/tune/trainable/session.py`` — the function-trainable
+API.  Also hosts the bridge that lets a Trainer.fit() running inside a tune
+trial forward its per-report metrics upward (reference: Train's
+``as_trainable`` wraps the trainer in a Tune Trainable).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ..train.checkpoint import Checkpoint
+from ..train.context import SessionFinished
+
+
+class _TuneSession:
+    def __init__(self, trial_id: str, trial_dir: str,
+                 checkpoint: Optional[Checkpoint] = None):
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self.checkpoint = checkpoint
+        self._q: "queue.Queue" = queue.Queue()
+        self._evt = threading.Event()
+        self._aborted = False
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        if self._aborted:
+            raise SessionFinished()
+        self.iteration += 1
+        metrics = dict(metrics)
+        metrics.setdefault("training_iteration", self.iteration)
+        self._evt.clear()
+        self._q.put(("report", metrics, checkpoint.path if checkpoint else None))
+        self._evt.wait()
+        if self._aborted:
+            raise SessionFinished()
+
+    def _finish(self, value: Any) -> None:
+        self._q.put(("done", value, None))
+
+    def _fail(self, err: BaseException) -> None:
+        self._q.put(("error", err, None))
+
+    def _next(self, timeout: Optional[float] = None):
+        return self._q.get(timeout=timeout)
+
+    def _resume(self) -> None:
+        self._evt.set()
+
+    def _abort(self) -> None:
+        self._aborted = True
+        self._evt.set()
+
+
+_session: Optional[_TuneSession] = None
+
+
+def _set_session(s: Optional[_TuneSession]) -> None:
+    global _session
+    _session = s
+
+
+def get_session() -> _TuneSession:
+    if _session is None:
+        raise RuntimeError("tune.report()/get_checkpoint() called outside a "
+                           "tune trial")
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    get_session().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().checkpoint
+
+
+def get_trial_id() -> str:
+    return get_session().trial_id
+
+
+def get_trial_dir() -> str:
+    return get_session().trial_dir
+
+
+def report_bridge(metrics: Dict[str, Any], checkpoint=None) -> None:
+    """Forward a Train-side report into the enclosing tune trial, if any
+    (used by Trainer.as_trainable)."""
+    if _session is not None:
+        ckpt = None
+        if checkpoint is not None:
+            ckpt = checkpoint if isinstance(checkpoint, Checkpoint) \
+                else Checkpoint(str(checkpoint))
+        _session.report(metrics, checkpoint=ckpt)

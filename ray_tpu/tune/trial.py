@@ -1,0 +1,102 @@
+"""Trial state + the trial-runner actor.
+
+Reference: ``python/ray/tune/experiment/trial.py`` (Trial state machine) and
+the trainable actor the TuneController drives.  The runner actor uses the same
+thread + result-queue protocol as the Train worker (worker_group.py) — the
+controller pulls one result at a time and releases the barrier, so scheduler
+decisions (stop/perturb) apply at report boundaries exactly like the
+reference's ``Trainable.train()`` stepping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import uuid
+from typing import Any, Dict, Optional, Set
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    experiment_dir: str
+    status: str = PENDING
+    last_result: Optional[Dict[str, Any]] = None
+    metrics_history: list = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    latest_checkpoint: Optional[str] = None
+    runner: Any = None  # actor handle
+    iteration: int = 0
+    rungs_passed: Set[int] = dataclasses.field(default_factory=set)
+    restarts: int = 0
+    _pending_ref: Any = None  # outstanding next_result ref (controller-owned)
+
+    @property
+    def trial_dir(self) -> str:
+        d = os.path.join(self.experiment_dir, self.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @staticmethod
+    def new(config: Dict[str, Any], experiment_dir: str,
+            name: Optional[str] = None) -> "Trial":
+        tid = name or f"trial_{uuid.uuid4().hex[:8]}"
+        return Trial(trial_id=tid, config=config,
+                     experiment_dir=experiment_dir)
+
+
+class TrialRunner:
+    """Actor: runs the trainable function, reports via the tune session."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        self._session = None
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self, trainable, config: Dict[str, Any], trial_id: str,
+            trial_dir: str, checkpoint_path: Optional[str]) -> None:
+        from . import session as tune_session
+        from ..train.checkpoint import Checkpoint
+        from ..train.context import SessionFinished
+
+        sess = tune_session._TuneSession(
+            trial_id=trial_id, trial_dir=trial_dir,
+            checkpoint=Checkpoint(checkpoint_path) if checkpoint_path else None)
+        self._session = sess
+        tune_session._set_session(sess)
+
+        def target():
+            try:
+                out = trainable(config)
+                sess._finish(out)
+            except SessionFinished:
+                sess._finish(None)
+            except BaseException as e:  # noqa: BLE001 — forwarded to driver
+                sess._fail(e)
+
+        self._thread = threading.Thread(target=target, daemon=True,
+                                        name=f"tune-{trial_id}")
+        self._thread.start()
+
+    def next_result(self, timeout: float = 3600.0):
+        kind, payload, ckpt = self._session._next(timeout)
+        if kind == "error":
+            raise payload
+        return kind, payload, ckpt
+
+    def resume(self) -> None:
+        self._session._resume()
+
+    def abort(self) -> None:
+        if self._session is not None:
+            self._session._abort()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

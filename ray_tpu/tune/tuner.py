@@ -1,0 +1,123 @@
+"""Tuner — the public entrypoint.  Reference: ``python/ray/tune/tuner.py:59``
+(``Tuner``, ``fit`` :337), ``tune/tune_config.py`` (``TuneConfig``),
+``result_grid.py`` (``ResultGrid``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..train.checkpoint import Checkpoint
+from ..train.config import RunConfig
+from ..train.result import Result
+from .controller import TuneController
+from .search import BasicVariantGenerator, Searcher
+from .schedulers import TrialScheduler
+from .trial import ERROR, Trial
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    max_concurrent_trials: Optional[int] = None
+    seed: Optional[int] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self.trials = trials
+        self._metric, self._mode = metric, mode
+        self.results = [
+            Result(metrics=t.last_result,
+                   checkpoint=Checkpoint(t.latest_checkpoint)
+                   if t.latest_checkpoint else None,
+                   path=os.path.join(t.experiment_dir, t.trial_id),
+                   error=RuntimeError(t.error) if t.error else None,
+                   metrics_history=t.metrics_history)
+            for t in trials
+        ]
+
+    def __len__(self):
+        return len(self.results)
+
+    def __getitem__(self, i):
+        return self.results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self.results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        scored = [r for r in self.results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise RuntimeError("no trial reported metric " + metric)
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for t in self.trials:
+            row = dict(t.last_result or {})
+            row["trial_id"] = t.trial_id
+            row.update({f"config/{k}": v for k, v in t.config.items()
+                        if isinstance(v, (int, float, str, bool))})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Any,
+                 *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+        self.worker_env = worker_env
+        # A Trainer instance is converted to its function trainable
+        # (reference: BaseTrainer.fit routes through Tuner the other way).
+        from ..train.trainer import BaseTrainer
+        if isinstance(trainable, BaseTrainer):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        name = self.run_config.name or \
+            f"tune_{getattr(self.trainable, '__name__', 'exp')}_{int(time.time())}"
+        experiment_dir = os.path.join(
+            self.run_config.resolved_storage_path(), name)
+        os.makedirs(experiment_dir, exist_ok=True)
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=cfg.num_samples, seed=cfg.seed)
+        if searcher.metric is None:
+            searcher.metric, searcher.mode = cfg.metric, cfg.mode
+        failure_cfg = self.run_config.failure_config
+        controller = TuneController(
+            self.trainable, searcher, cfg.scheduler, experiment_dir,
+            metric=cfg.metric, mode=cfg.mode,
+            max_concurrent=cfg.max_concurrent_trials,
+            max_failures_per_trial=(failure_cfg.max_failures
+                                    if failure_cfg else 0),
+            resources_per_trial=self.resources_per_trial,
+            worker_env=self.worker_env)
+        trials = controller.run()
+        return ResultGrid(trials, cfg.metric, cfg.mode)

@@ -1,0 +1,11 @@
+"""ray_tpu.util — utility layer over the core runtime.
+
+Reference surface: ``python/ray/util`` — ActorPool, Queue, collective,
+scheduling strategies (those live in ray_tpu.core), state API
+(ray_tpu.util.state).
+"""
+
+from .actor_pool import ActorPool
+from .queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full"]

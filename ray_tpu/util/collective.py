@@ -1,0 +1,259 @@
+"""ray_tpu.util.collective — collective communication groups.
+
+Reference: ``python/ray/util/collective/collective.py`` (``init_collective_group``
+:120, ``allreduce`` :258, ``barrier`` :298, ``broadcast`` :373, ``allgather``
+:423, ``reducescatter`` :472, ``send``/``recv`` :531/:594) with NCCL/Gloo
+backends (``collective_group/nccl_collective_group.py``, ``gloo_…``).
+
+TPU-native stance (SURVEY §2.3/§5.8): *in-mesh* tensor collectives are not a
+runtime service — they are ``jax.lax`` ops (psum/all_gather/ppermute/
+all_to_all) compiled into the pjit program and executed over ICI.  This module
+therefore provides two things:
+
+1. ``mesh_collectives`` — thin functional wrappers over the XLA collectives,
+   for code written with ``shard_map`` that wants a backend-shaped API.
+2. A **host-side collective group** (the Gloo analogue) over the object store
+   for control-plane coordination *between actor processes* — barrier,
+   broadcast, allreduce of small host arrays (rendezvous state, metrics,
+   elastic membership).  This is deliberately NOT a data-plane path: bulk
+   tensors should live in sharded jax.Arrays inside compiled programs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+# ---------------------------------------------------------------------------
+# 1. In-mesh collectives (XLA / ICI): functional wrappers
+# ---------------------------------------------------------------------------
+
+class mesh_collectives:
+    """Use inside shard_map-ped functions: axis names bind to the mesh."""
+
+    @staticmethod
+    def allreduce(x, axis: str, op: str = "sum"):
+        import jax
+        from jax import lax
+        if op == "sum":
+            return lax.psum(x, axis)
+        if op == "max":
+            return lax.pmax(x, axis)
+        if op == "min":
+            return lax.pmin(x, axis)
+        if op == "mean":
+            return lax.pmean(x, axis)
+        raise ValueError(f"unsupported op {op}")
+
+    @staticmethod
+    def allgather(x, axis: str, *, tiled: bool = False):
+        from jax import lax
+        return lax.all_gather(x, axis, tiled=tiled)
+
+    @staticmethod
+    def reducescatter(x, axis: str, *, scatter_dimension: int = 0):
+        from jax import lax
+        return lax.psum_scatter(x, axis,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+    @staticmethod
+    def alltoall(x, axis: str, *, split_axis: int = 0, concat_axis: int = 0):
+        from jax import lax
+        return lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+    @staticmethod
+    def permute(x, axis: str, perm: List[tuple]):
+        from jax import lax
+        return lax.ppermute(x, axis, perm)
+
+    @staticmethod
+    def broadcast(x, axis: str, root: int = 0):
+        import jax
+        from jax import lax
+        # select root's shard and gather it everywhere
+        idx = lax.axis_index(axis)
+        src = lax.all_gather(x, axis)[root]
+        return src
+
+
+# ---------------------------------------------------------------------------
+# 2. Host-side collective group (control plane; Gloo analogue)
+# ---------------------------------------------------------------------------
+
+class _GroupState:
+    """Named actor holding rendezvous + reduction state for one group."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self.rounds: Dict[str, Dict[int, Any]] = {}
+        self.results: Dict[str, Any] = {}
+
+    def contribute(self, op_id: str, rank: int, payload: Any) -> bool:
+        slot = self.rounds.setdefault(op_id, {})
+        slot[rank] = payload
+        return len(slot) == self.world
+
+    def fetch(self, op_id: str):
+        slot = self.rounds.get(op_id)
+        if slot is None or len(slot) < self.world:
+            return None
+        return [slot[r] for r in range(self.world)]
+
+    def finalize(self, op_id: str, result: Any) -> None:
+        self.results[op_id] = result
+
+    def result(self, op_id: str, rank: int):
+        """Fetch the op result; auto-gc once every rank has fetched it."""
+        if op_id not in self.results:
+            return "\x00missing"
+        out = self.results[op_id]
+        acks = self.rounds.setdefault(op_id + ":ack", {})
+        acks[rank] = True
+        if len(acks) == self.world:
+            self.rounds.pop(op_id, None)
+            self.rounds.pop(op_id + ":ack", None)
+            self.results.pop(op_id, None)
+        return out
+
+    # point-to-point mailbox
+    def p2p_put(self, key: str, val: Any) -> None:
+        self.results[key] = val
+
+    def p2p_take(self, key: str):
+        if key in self.results:
+            return self.results.pop(key)
+        return "\x00missing"
+
+
+_groups: Dict[str, "CollectiveGroup"] = {}
+
+
+class CollectiveGroup:
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.name = group_name
+        self.world = world_size
+        self.rank = rank
+        state_cls = ray_tpu.remote(_GroupState)
+        self.state = state_cls.options(
+            name=f"_collective:{group_name}", get_if_exists=True,
+            lifetime="detached", num_cpus=0.1).remote(world_size)
+        self._seq = 0
+
+    def _op_id(self, kind: str) -> str:
+        self._seq += 1
+        return f"{kind}:{self._seq}"
+
+    def _sync(self, kind: str, payload: Any, reduce_fn) -> Any:
+        """All ranks contribute; rank 0 reduces; everyone polls the result
+        (which auto-gcs in the state actor after the last fetch)."""
+        op = self._op_id(kind)
+        ray_tpu.get(self.state.contribute.remote(op, self.rank, payload))
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if self.rank == 0:
+                parts = ray_tpu.get(self.state.fetch.remote(op))
+                if parts is not None:
+                    ray_tpu.get(self.state.finalize.remote(op,
+                                                           reduce_fn(parts)))
+            res = ray_tpu.get(self.state.result.remote(op, self.rank))
+            if not (isinstance(res, str) and res == "\x00missing"):
+                return res
+            time.sleep(0.01)
+        raise TimeoutError(f"collective {op} on group {self.name} timed out")
+
+    def barrier(self) -> None:
+        self._sync("barrier", None, lambda parts: True)
+
+    def allreduce(self, array, op: str = "sum"):
+        red = {"sum": lambda p: np.sum(p, axis=0),
+               "max": lambda p: np.max(p, axis=0),
+               "min": lambda p: np.min(p, axis=0),
+               "mean": lambda p: np.mean(p, axis=0)}[op]
+        return self._sync("allreduce", np.asarray(array), red)
+
+    def allgather(self, array) -> List[np.ndarray]:
+        return self._sync("allgather", np.asarray(array), lambda p: list(p))
+
+    def broadcast(self, array, src_rank: int = 0):
+        return self._sync("broadcast", np.asarray(array),
+                          lambda p: p[src_rank])
+
+    def reducescatter(self, array, op: str = "sum"):
+        summed = self.allreduce(array, op)
+        chunks = np.array_split(summed, self.world)
+        return chunks[self.rank]
+
+    # Channel keys are (src,dst,sequence-per-pair): each side tracks how many
+    # messages it has sent to / received from the peer.
+    def send(self, array, dst_rank: int) -> None:
+        seq = self._p2p_seq("send", dst_rank)
+        key = f"p2p:{self.rank}->{dst_rank}:{seq}"
+        ray_tpu.get(self.state.p2p_put.remote(key, np.asarray(array)))
+
+    def recv(self, src_rank: int) -> np.ndarray:
+        seq = self._p2p_seq("recv", src_rank)
+        key = f"p2p:{src_rank}->{self.rank}:{seq}"
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            val = ray_tpu.get(self.state.p2p_take.remote(key))
+            if not (isinstance(val, str) and val == "\x00missing"):
+                return val
+            time.sleep(0.005)
+        raise TimeoutError(f"recv from {src_rank} timed out")
+
+    def _p2p_seq(self, kind: str, peer: int) -> int:
+        if not hasattr(self, "_p2p_counters"):
+            self._p2p_counters: Dict[tuple, int] = {}
+        k = (kind, peer)
+        self._p2p_counters[k] = self._p2p_counters.get(k, 0) + 1
+        return self._p2p_counters[k]
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "objectstore",
+                          group_name: str = "default") -> CollectiveGroup:
+    """Reference ``collective.py:120``; backend is informational here — the
+    host group always rides the object store, in-mesh collectives ride XLA."""
+    g = CollectiveGroup(group_name, world_size, rank)
+    _groups[group_name] = g
+    return g
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    return _groups[group_name]
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        try:
+            ray_tpu.kill(g.state)
+        except Exception:
+            pass
+
+
+def allreduce(array, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(array, op)
+
+
+def barrier(group_name: str = "default") -> None:
+    get_group(group_name).barrier()
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank)
+
+
+def allgather(array, group_name: str = "default"):
+    return get_group(group_name).allgather(array)
+
+
+def reducescatter(array, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).reducescatter(array, op)

@@ -1,0 +1,108 @@
+"""State API — reference ``python/ray/util/state/api.py`` (``list_actors``
+:782, ``list_tasks`` :1014, ``summarize_tasks`` :1375) backed by the GCS
+(the reference routes through the dashboard's StateHead aggregator;
+here the GCS-equivalent is queried directly over RPC)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+from ..core.core_worker import global_worker
+from ..core.rpc import run_async
+
+
+def _gcs_call(method: str, **kwargs):
+    w = global_worker()
+    return run_async(w.gcs.call(method, **kwargs))
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[tuple]]) -> List[Dict[str, Any]]:
+    """Filters are (key, predicate, value) with predicate '=' or '!='."""
+    for key, pred, value in (filters or []):
+        if pred == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif pred == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported predicate {pred!r}")
+    return rows
+
+
+def list_actors(filters: Optional[List[tuple]] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs_call("list_actors")
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_nodes(filters: Optional[List[tuple]] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    view = _gcs_call("get_cluster_view")
+    rows = [{"node_id": nid, **info} for nid, info in view.items()]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_tasks(filters: Optional[List[tuple]] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs_call("list_task_events", limit=limit)
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_jobs(filters: Optional[List[tuple]] = None,
+              limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs_call("list_jobs")
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters: Optional[List[tuple]] = None,
+                          limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _gcs_call("list_placement_groups")
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters: Optional[List[tuple]] = None,
+                 limit: int = 1000) -> List[Dict[str, Any]]:
+    """Owner-side view of this process's objects (the reference aggregates
+    per-worker ownership tables the same way, scoped cluster-wide)."""
+    w = global_worker()
+    rows = []
+    for oid, rec in list(w.memory_store._values.items())[:limit]:
+        rows.append({
+            "object_id": oid.hex(),
+            "type": type(rec).__name__,
+            "size": getattr(rec, "size", None) or (
+                len(rec) if isinstance(rec, (bytes, bytearray)) else None),
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    events = _gcs_call("list_task_events", limit=100_000)
+    by_name: Dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter)
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        tid = ev.get("task_id")
+        if tid is not None:
+            latest[tid] = ev
+    for ev in latest.values():
+        by_name[ev.get("name", "?")][ev.get("state", "?")] += 1
+    return {"cluster": {name: dict(states)
+                        for name, states in sorted(by_name.items())},
+            "total_tasks": len(latest)}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    actors = list_actors()
+    by_class: Dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter)
+    for a in actors:
+        by_class[a.get("class_name", "?")][a.get("state", "?")] += 1
+    return {"cluster": {cls: dict(states)
+                        for cls, states in sorted(by_class.items())},
+            "total_actors": len(actors)}
+
+
+def cluster_info() -> Dict[str, Any]:
+    return _gcs_call("cluster_info")

@@ -100,3 +100,33 @@ def test_node_failure_task_retry(ray_start_cluster):
         ray_tpu.get(ref, timeout=30)
     # Cluster still healthy for new work.
     assert ray_tpu.get(steady.remote(10), timeout=60) == 11
+
+
+def test_pg_actor_uses_bundle_resources(ray_start_regular):
+    """Actors placed in a PG bundle must lease from the bundle reservation,
+    not the free pool (double-counting starves subsequent tasks)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "ok"
+
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    actors = [
+        A.options(scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)
+    ]
+    assert ray_tpu.get([a.ping.remote() for a in actors], timeout=30) == ["ok"] * 2
+    # 4 CPUs total - 2 reserved by the PG = 2 free; the actors inside the PG
+    # must not consume the free pool.
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) >= 2, avail
+    # and plain tasks still run
+    @ray_tpu.remote
+    def f():
+        return 1
+    assert ray_tpu.get([f.remote() for _ in range(4)], timeout=30) == [1] * 4
+    ray_tpu.remove_placement_group(pg)

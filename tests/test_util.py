@@ -1,0 +1,107 @@
+"""Utility-layer tests: ActorPool, Queue, host collective group, state API
+(reference: python/ray/tests/test_actor_pool.py, test_queue.py,
+util/collective tests, test_state_api.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_actor_pool(ray_start_regular):
+    from ray_tpu.util import ActorPool
+
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Worker.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    [5, 6, 7]))
+    assert out == [10, 12, 14]
+
+
+def test_queue(ray_start_regular):
+    from ray_tpu.util import Empty, Queue
+
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.full()
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_collective_group_host(ray_start_regular):
+    """2 actor ranks do barrier + allreduce + broadcast over the host group."""
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def run(self):
+            from ray_tpu.util import collective as col
+            import numpy as np
+            g = col.init_collective_group(self.world, self.rank,
+                                          group_name="g1")
+            g.barrier()
+            s = g.allreduce(np.array([1.0 * (self.rank + 1)]), op="sum")
+            b = g.broadcast(np.array([42.0 + self.rank]), src_rank=1)
+            gathered = g.allgather(np.array([self.rank]))
+            if self.rank == 0:
+                g.send(np.array([7.0]), dst_rank=1)
+                return s[0], b[0], [int(x[0]) for x in gathered], None
+            else:
+                r = g.recv(src_rank=0)
+                return s[0], b[0], [int(x[0]) for x in gathered], r[0]
+
+    ranks = [Rank.remote(i, 2) for i in range(2)]
+    out = ray_tpu.get([r.run.remote() for r in ranks], timeout=120)
+    for s, b, gathered, _ in out:
+        assert s == 3.0          # 1 + 2
+        assert b == 43.0         # rank1's value
+        assert gathered == [0, 1]
+    assert out[1][3] == 7.0      # p2p send/recv
+
+
+def test_state_api(ray_start_regular):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return 1
+
+    a = Named.options(name="stateapi_actor").remote()
+    ray_tpu.get(a.ping.remote())
+
+    actors = state.list_actors()
+    assert any(x.get("class_name") == "Named" for x in actors)
+    alive = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert all(x["state"] == "ALIVE" for x in alive)
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1
+
+    @ray_tpu.remote
+    def tiny_task():
+        return 1
+
+    ray_tpu.get(tiny_task.remote())
+    tasks = state.list_tasks()
+    assert any(t.get("name") == "tiny_task" for t in tasks)
+    summary = state.summarize_tasks()
+    assert summary["total_tasks"] >= 1
+    asum = state.summarize_actors()
+    assert asum["total_actors"] >= 1
+    info = state.cluster_info()
+    assert isinstance(info, dict)
