@@ -40,6 +40,33 @@ def detect_peak_flops(device) -> float:
     return PEAK_BF16_FLOPS["cpu"]
 
 
+def estimate_hbm_bytes(cfg, batch: int, seq: int, n_devices: int) -> float:
+    """Per-device HBM for one train step (fsdp over n devices, remat on,
+    chunked cross-entropy).
+
+    Round 1 OOMed because the estimate was `params * 12 * 1.35`, which missed
+    the f32 gradients, the hoisted bf16 casts of the stacked params, and the
+    f32 logits.  This models what the round-1 HLO allocation dump actually
+    showed:
+      * train state: f32 params (4) + f32 grads (4, coexist with state under
+        donation) + adam mu/nu (8)
+      * bf16 param casts: XLA hoists the `.astype(bf16)` of the loop-invariant
+        stacked weights out of the layer scan (+2)
+      * activations: scan carry checkpointed per layer (L*B*S*H*2) + one
+        layer's transient attention scores (B*NH*S^2*2) + qkv/mlp temps
+      * chunked CE: one [B, chunk, V] f32 logits block (fwd + bwd)
+    """
+    p = cfg.num_params()
+    state = p * (4 + 4 + 8 + 2) / n_devices
+    h, L, nh = cfg.hidden_size, cfg.num_layers, cfg.num_heads
+    b = max(1, batch // n_devices)  # batch sharded over dp/fsdp
+    carry = L * b * seq * h * 2
+    scores = b * nh * seq * seq * 2
+    temps = 8 * b * seq * max(h, cfg.mlp_size) * 2
+    ce_chunk = 2 * b * min(512, seq) * cfg.vocab_size * 4
+    return (state + carry + scores + temps + ce_chunk) * 1.10
+
+
 def pick_config(args, n_devices: int, hbm_bytes: float):
     from ray_tpu.models import config as mcfg
     if args.preset == "debug":
@@ -47,15 +74,19 @@ def pick_config(args, n_devices: int, hbm_bytes: float):
     if args.preset != "auto":
         cfg = mcfg.PRESETS[args.preset]()
         return cfg, args.batch, args.seq or min(cfg.max_seq_len, 2048)
-    # auto: largest of our Llama-family bench configs whose train state fits.
-    # fp32 params + adam(mu,nu fp32) = 12 bytes/param, plus ~25% headroom for
-    # activations with remat.
-    for name in ("llama3-8b", "llama-1b", "gpt2-124m"):
-        cfg = mcfg.PRESETS[name]()
-        need = cfg.num_params() * 12 * 1.35
-        if need < hbm_bytes * n_devices:
-            seq = args.seq or (2048 if name != "gpt2-124m" else 1024)
-            return mcfg.PRESETS[name](max_seq_len=seq), args.batch, seq
+    # auto: largest Llama-family bench config (and largest batch <= requested)
+    # that fits the measured HBM under the memory model above.
+    for name in ("llama3-8b", "llama-1b", "llama-400m", "gpt2-124m"):
+        cfg_fn = mcfg.PRESETS[name]
+        seq = args.seq or (2048 if name != "gpt2-124m" else 1024)
+        # batch must stay divisible by the mesh's dp*fsdp extent (= n_devices
+        # here) or device_put on the batch sharding fails.
+        batch = max(args.batch, n_devices)
+        batch -= batch % n_devices
+        while batch >= n_devices:
+            if estimate_hbm_bytes(cfg_fn(), batch, seq, n_devices) < hbm_bytes:
+                return cfg_fn(max_seq_len=seq), batch, seq
+            batch = batch // 2 - (batch // 2) % n_devices
     return mcfg.tiny(), 8, 64
 
 

@@ -141,7 +141,7 @@ class ActorClass:
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=w.job_id,
-            name=o.get("name") or self.__name__,
+            name=self.__name__,
             fn_id=fn_id,
             args=args_blob,
             num_returns=1,

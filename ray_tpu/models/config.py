@@ -103,6 +103,15 @@ def llama_1b(max_seq_len: int = 2048) -> TransformerConfig:
         num_kv_heads=8, mlp_size=5632, max_seq_len=max_seq_len)
 
 
+def llama_400m(max_seq_len: int = 2048) -> TransformerConfig:
+    """~0.4B Llama-style model: fits a single 16 GB chip *with* f32 Adam state
+    and remat headroom (llama-1b's state alone is ~16 GB — see bench.py's
+    memory model). The single-chip bench config."""
+    return TransformerConfig(
+        vocab_size=32768, num_layers=12, hidden_size=1536, num_heads=12,
+        num_kv_heads=6, mlp_size=4096, max_seq_len=max_seq_len)
+
+
 def mixtral_8x7b(max_seq_len: int = 8192) -> TransformerConfig:
     return TransformerConfig(
         vocab_size=32000, num_layers=32, hidden_size=4096, num_heads=32,
@@ -124,6 +133,7 @@ PRESETS = {
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
     "llama-1b": llama_1b,
+    "llama-400m": llama_400m,
     "mixtral-8x7b": mixtral_8x7b,
     "tiny": tiny,
 }

@@ -99,10 +99,10 @@ def mha(q, k, v, causal: bool = True, logit_softcap: float = 0.0,
         use_flash = (jax.default_backend() == "tpu" and q.shape[1] >= 1024
                      and q.shape[-1] in (64, 128, 256) and logit_softcap == 0.0)
     if use_flash:
-        try:
-            from .flash_attention import flash_attention
-        except ImportError:
-            pass
-        else:
-            return flash_attention(q, k, v, causal=causal)
+        if logit_softcap > 0.0:
+            raise ValueError("flash_attention does not implement logit_softcap;"
+                             " use use_flash=False (or leave it None to"
+                             " auto-fall-back)")
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
     return attend(q, k, v, causal=causal, logit_softcap=logit_softcap)

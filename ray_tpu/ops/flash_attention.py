@@ -1,0 +1,223 @@
+"""Pallas TPU flash attention (forward kernel + memory-bounded backward).
+
+The reference has no TPU kernels at all (its attention lives in external torch
+models); this is greenfield TPU-first code (SURVEY §5.7, §7 stance).
+
+Design:
+* **Forward** is a Pallas kernel. Grid = (batch, q_heads, S/block_q); each
+  program streams K/V blocks for its (batch, kv_head) out of VMEM with a
+  `fori_loop`, folding them into the flash online-softmax accumulator
+  (running max `m`, denominator `l`, numerator `acc`) so the S×S score matrix
+  never exists — only a [block_q, block_kv] tile lives at a time.  Causal
+  programs stop the loop at their diagonal block: the lower-triangle work that
+  plain attention burns on masked logits is never issued to the MXU.
+* **GQA without materialization**: the kv-head index map is
+  ``h // (num_q_heads / num_kv_heads)`` so grouped-query K/V blocks are read
+  in place; the `repeat_kv` copy the plain path makes is skipped.
+* **Backward** recomputes attention blockwise from the saved (out, lse)
+  residuals — standard flash-attention recurrence — as a `lax.scan` over KV
+  blocks in plain JAX.  Peak memory O(S·block) like the forward; XLA fuses the
+  per-block matmuls onto the MXU.  (A Pallas backward kernel is a further
+  speedup, not a correctness need: training-step wall time is dominated by
+  the big MLP matmuls.)
+
+Numerics: logits and softmax statistics in f32 (MXU accumulates f32 via
+``preferred_element_type``); probabilities cast back to the input dtype for
+the PV matmul, matching ``attention.attend``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_kv: int,
+                seq_kv: int, causal: bool, scale: float):
+    """One (batch, head, q-block) program: stream KV blocks, online softmax."""
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[2]
+    d = q_ref.shape[3]
+    q = q_ref[0, 0]                                   # [block_q, d]
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    if causal:
+        # KV blocks strictly after this q-block's diagonal are fully masked:
+        # don't even loop over them.
+        num_kv = (qi * block_q + block_q + block_kv - 1) // block_kv
+    else:
+        num_kv = seq_kv // block_kv
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]     # [block_kv, d]
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bkv]
+        if causal:
+            k_pos = j * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_kv: int,
+               interpret: bool):
+    """q: [B, H, S, D], k/v: [B, KV, S, D] -> (out [B, H, S, D], lse [B, H, S])."""
+    b, h, s, d = q.shape
+    kv_heads = k.shape[1]
+    reps = h // kv_heads
+    scale = d ** -0.5
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, s)
+
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(_fwd_kernel, block_kv=block_kv, seq_kv=s,
+                               causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // reps, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // reps, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_blockwise(q, k, v, out, lse, g, causal: bool, block_kv: int):
+    """Flash backward, recompute-based, as a scan over KV blocks.
+
+    q/out/g: [B, H, S, D]; k/v: [B, KV, S, D]; lse: [B, H, S].
+    Returns (dq, dk, dv) with dk/dv in kv-head layout.
+    """
+    b, h, s, d = q.shape
+    kv_heads = k.shape[1]
+    reps = h // kv_heads
+    scale = d ** -0.5
+    block_kv = min(block_kv, s)
+    n_blocks = s // block_kv
+
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # D_i = rowsum(dO * O): the softmax-jacobian diagonal term.
+    delta = (gf * out.astype(jnp.float32)).sum(-1)              # [B, H, S]
+    q_pos = jnp.arange(s)
+
+    kb = jnp.moveaxis(k.reshape(b, kv_heads, n_blocks, block_kv, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, kv_heads, n_blocks, block_kv, d), 2, 0)
+
+    def per_block(j, kj, vj):
+        # kj/vj: [B, KV, block_kv, D] -> repeat to q heads.
+        kjh = jnp.repeat(kj, reps, axis=1) if reps > 1 else kj
+        vjh = jnp.repeat(vj, reps, axis=1) if reps > 1 else vj
+        sj = jnp.einsum("bhqd,bhkd->bhqk", qf, kjh.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = j * block_kv + jnp.arange(block_kv)
+            sj = jnp.where((q_pos[:, None] >= k_pos[None, :])[None, None],
+                           sj, NEG_INF)
+        p = jnp.exp(sj - lse[..., None])                        # [B,H,S,bkv]
+        dv_h = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vjh.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq_j = jnp.einsum("bhqk,bhkd->bhqd", ds, kjh.astype(jnp.float32))
+        # fold q-head grads back to kv heads (GQA)
+        dk_h = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        if reps > 1:
+            dv_h = dv_h.reshape(b, kv_heads, reps, block_kv, d).sum(2)
+            dk_h = dk_h.reshape(b, kv_heads, reps, block_kv, d).sum(2)
+        return dq_j, dk_h, dv_h
+
+    def scan_body(dq, xs):
+        j, kj, vj = xs
+        dq_j, dk_j, dv_j = per_block(j, kj, vj)
+        return dq + dq_j, (dk_j, dv_j)
+
+    scan_fn = jax.checkpoint(scan_body,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+    dq, (dkb, dvb) = jax.lax.scan(
+        scan_fn, jnp.zeros_like(qf), (jnp.arange(n_blocks), kb, vb))
+    dk = jnp.moveaxis(dkb, 0, 2).reshape(b, kv_heads, s, d)
+    dv = jnp.moveaxis(dvb, 0, 2).reshape(b, kv_heads, s, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_kv, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_kv, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_kv, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_kv, interpret, res, g):
+    q, k, v, out, lse = res
+    return _bwd_blockwise(q, k, v, out, lse, g, causal, block_kv)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_kv: int = 512,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention. q: [B, Sq, H, D], k/v: [B, Skv, KV, D] -> [B, Sq, H, D].
+
+    Layout matches ``attention.attend``; internally transposed to [B, H, S, D]
+    (the kernel wants the sequence on the sublane dim and D=64/128 on lanes).
+    Sequence lengths must be multiples of the block sizes (the model layer
+    guarantees power-of-two seq; dispatch falls back to plain otherwise).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    if sq % block_q or skv % block_kv or h % k.shape[2]:
+        from .attention import attend
+        return attend(q, k, v, causal=causal)
+    qt = q.swapaxes(1, 2)
+    kt = k.swapaxes(1, 2)
+    vt = v.swapaxes(1, 2)
+    out = _flash(qt, kt, vt, causal, block_q, block_kv, interpret)
+    return out.swapaxes(1, 2)
