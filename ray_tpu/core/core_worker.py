@@ -159,6 +159,20 @@ class PendingTask:
     arg_refs: List[ObjectRef] = field(default_factory=list)
 
 
+def _result_contained_refs(res: tuple) -> list:
+    """Contained-ref descriptors [(id_bytes, owner_addr), ...] of a result
+    tuple, if the producing worker attached them.
+
+    Result tuple shapes: ("inline", bytes[, contained]),
+    ("plasma", size, locations[, contained]), ("error", blob).
+    """
+    if res[0] == "inline" and len(res) >= 3:
+        return res[2]
+    if res[0] == "plasma" and len(res) >= 4:
+        return res[3]
+    return []
+
+
 class TaskManager:
     def __init__(self, worker: "CoreWorker"):
         self._w = worker
@@ -186,6 +200,17 @@ class TaskManager:
         for i, res in enumerate(results):
             oid = ObjectID.for_task_return(task_id, i)
             self._w.store_task_result(oid, res)
+            # Register borrows for ObjectRefs serialized inside the result NOW
+            # (at receipt), not when the user eventually deserializes them in
+            # ray.get: the producer's counts may hit zero right after it
+            # replies, and the escrow grace must only have to cover RPC
+            # latency — not user think-time (reference: reference_count.cc
+            # borrower bookkeeping; the round-1 grace-only scheme lost objects
+            # gotten later than ref_escrow_grace_s after production).
+            for idbin, owner in _result_contained_refs(res):
+                if owner and owner != self._w.address:
+                    self._w.register_contained_borrow(oid, ObjectID(idbin),
+                                                      owner)
         self.num_finished += 1
         if get_config().lineage_reconstruction_enabled and any(
                 r[0] == "plasma" for r in results):
@@ -410,6 +435,9 @@ class CoreWorker:
         self.worker_clients = ClientPool()
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self)
+        # result-object id -> [(contained oid, owner)] borrows registered at
+        # task-result receipt; released when the result object is freed.
+        self._contained_borrows: Dict[ObjectID, list] = {}
         self.task_manager = TaskManager(self)
         self.shm_reader = ShmReader()
         self.lease_pools: Dict[tuple, LeasePool] = {}
@@ -864,9 +892,18 @@ class CoreWorker:
 
         asyncio.run_coroutine_threadsafe(_notify(), loop)
 
+    def register_contained_borrow(self, result_oid: ObjectID, cid: ObjectID,
+                                  owner: str):
+        """A task result we own contains a ref owned elsewhere: hold a borrow
+        on it for as long as the result object itself is alive."""
+        self._contained_borrows.setdefault(result_oid, []).append((cid, owner))
+        self.reference_counter.add_local_ref(cid, owner)
+
     async def _free_owned(self, oid: ObjectID):
         if self.reference_counter.has_any_ref(oid):
             return
+        for cid, owner in self._contained_borrows.pop(oid, []):
+            self.reference_counter.remove_local_ref(cid, owner)
         rec = self.memory_store.get_if_exists(oid)
         self.memory_store.free(oid)
         if isinstance(rec, PlasmaRecord):
@@ -1075,9 +1112,14 @@ class CoreWorker:
         cfg = get_config()
         for v in values:
             so = serialization.serialize(v)
+            # Ship descriptors of any ObjectRefs inside the value so the
+            # caller can register its borrows at receipt (see
+            # TaskManager.complete) instead of at deserialize time.
+            contained = [(r.id.binary(), r.owner or self.address)
+                         for r in so.contained_refs]
             size = so.flat_size()
             if size <= cfg.max_direct_call_object_size or self.agent is None:
-                results.append(("inline", so.to_bytes()))
+                results.append(("inline", so.to_bytes(), contained))
             else:
                 oid = ObjectID.for_task_return(spec.task_id, len(results))
                 res = run_async(self.agent.call("store_create", object_id=oid,
@@ -1088,7 +1130,8 @@ class CoreWorker:
                 finally:
                     seg.close()
                 run_async(self.agent.call("store_seal", object_id=oid))
-                results.append(("plasma", size, [(self.node_id, self.agent_address)]))
+                results.append(("plasma", size,
+                                [(self.node_id, self.agent_address)], contained))
         return results
 
     def _execute_actor_creation(self, spec: TaskSpec):

@@ -42,12 +42,13 @@ from .scheduling import NodeView, pick_node
 class WorkerHandle:
     worker_id: str
     proc: Optional[asyncio.subprocess.Process]
-    state: str = "STARTING"          # STARTING | IDLE | LEASED | DEAD
+    state: str = "STARTING"          # STARTING | IDLE | LEASED | DRAINING | DEAD
     address: str = ""
     pid: int = 0
     lease_id: Optional[str] = None
     is_actor: bool = False
     actor_id: Optional[str] = None
+    probe_failures: int = 0          # consecutive failed idle-reaper probes
     blocked: bool = False
     idle_since: float = field(default_factory=time.monotonic)
     registered: "asyncio.Event" = field(default_factory=asyncio.Event)
@@ -182,13 +183,24 @@ class NodeAgent:
                     owned = await client.call("owned_object_count",
                                               _timeout=2.0)
                 except Exception:
-                    continue  # fail closed: don't kill what we can't probe
+                    # Fail closed on transient probe errors, but escalate: a
+                    # worker whose RPC channel is wedged for 3 consecutive
+                    # probes with no lease is dead weight — reap it.
+                    w.probe_failures = getattr(w, "probe_failures", 0) + 1
+                    if w.probe_failures < 3 or w.state != "IDLE":
+                        continue
+                    owned = 0
+                else:
+                    w.probe_failures = 0
                 if owned:
                     continue
                 # Re-check after the await: the worker may have been leased
                 # while the probe was in flight.
                 if w.state != "IDLE":
                     continue
+                # DRAINING before the async kill so the lease path cannot
+                # hand work to a dying worker mid-kill.
+                w.state = "DRAINING"
                 await self._kill_worker_proc(w)
                 n_idle -= 1
 

@@ -183,6 +183,37 @@ def _mlp_block(x, p, cfg: TransformerConfig):
 # Forward
 # ---------------------------------------------------------------------------
 
+def block_forward(x: jnp.ndarray, layer_params: Params, cfg: TransformerConfig,
+                  positions: jnp.ndarray,
+                  pctx: ParallelContext = ParallelContext()):
+    """One transformer block: x [B, S, H] -> (x, moe aux loss).  Shared by the
+    layer scan below and the pipeline-parallel stage loop
+    (parallel/pipeline.py)."""
+    attn_out = _attention_block(
+        _norm(x, layer_params["attn_norm"], cfg), layer_params["attn"],
+        cfg, positions, pctx)
+    x = x + attn_out
+    y = _norm(x, layer_params["mlp_norm"], cfg)
+    if cfg.num_experts > 1:
+        out, aux = moe_ops.moe_mlp(
+            y, layer_params["moe"]["router"], layer_params["moe"]["w_gate"],
+            layer_params["moe"]["w_in"], layer_params["moe"]["w_out"],
+            cfg.experts_per_token, cfg.expert_capacity_factor)
+    else:
+        out, aux = _mlp_block(y, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
+    return x + out, aux
+
+
+def embed_tokens(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
+                 compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Token (+ learned positional) embedding: [B, S] -> [B, S, H]."""
+    x = params["embed"]["tokens"][tokens].astype(compute_dtype)
+    if not cfg.use_rope:
+        s = tokens.shape[1]
+        x = x + params["embed"]["pos"][:s][None].astype(compute_dtype)
+    return x
+
+
 def apply_trunk(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
                 pctx: ParallelContext = ParallelContext(),
                 compute_dtype=jnp.bfloat16,
@@ -192,32 +223,14 @@ def apply_trunk(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
     The trunk stops before the LM head so losses can run the head blockwise
     (see ``chunked_cross_entropy``) without ever materializing [B, S, V]."""
     b, s = tokens.shape
-    x = params["embed"]["tokens"][tokens].astype(compute_dtype)
+    x = embed_tokens(params, tokens, cfg, compute_dtype)
     # Positions are global sequence positions; under jit with a sequence-sharded
     # batch XLA partitions this computation (only ring attention, which runs in
     # shard_map, handles per-shard offsets itself).
     positions = jnp.arange(s)
-    if not cfg.use_rope:
-        x = x + params["embed"]["pos"][:s][None].astype(compute_dtype)
-
-    def block(x, layer_params):
-        attn_out = _attention_block(
-            _norm(x, layer_params["attn_norm"], cfg), layer_params["attn"],
-            cfg, positions, pctx)
-        x = x + attn_out
-        y = _norm(x, layer_params["mlp_norm"], cfg)
-        if cfg.num_experts > 1:
-            out, aux = moe_ops.moe_mlp(
-                y, layer_params["moe"]["router"], layer_params["moe"]["w_gate"],
-                layer_params["moe"]["w_in"], layer_params["moe"]["w_out"],
-                cfg.experts_per_token, cfg.expert_capacity_factor)
-        else:
-            out, aux = _mlp_block(y, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
-        return x + out, aux
 
     def scan_body(x, layer_params):
-        x, aux = block(x, layer_params)
-        return x, aux
+        return block_forward(x, layer_params, cfg, positions, pctx)
 
     if remat:
         # Per-layer rematerialization: backward recomputes one block at a time,

@@ -1,9 +1,14 @@
-"""ray_tpu.parallel — mesh construction, sharding, and the pjit train step."""
+"""ray_tpu.parallel — mesh construction, sharding, the pjit train step, and
+GPipe pipeline parallelism."""
 
 from .mesh import AXIS_ORDER, MeshSpec, make_mesh, named_sharding
+from .pipeline import (init_pp_state, make_pp_train_step, merge_layers,
+                       partition_layers)
 from .train_step import (TrainState, init_sharded_state, make_eval_step,
                          make_optimizer, make_train_step, state_shardings)
 
 __all__ = ["MeshSpec", "make_mesh", "named_sharding", "AXIS_ORDER",
            "TrainState", "make_optimizer", "init_sharded_state",
-           "make_train_step", "make_eval_step", "state_shardings"]
+           "make_train_step", "make_eval_step", "state_shardings",
+           "init_pp_state", "make_pp_train_step", "partition_layers",
+           "merge_layers"]

@@ -1,0 +1,272 @@
+"""Pipeline parallelism: GPipe-style microbatching inside one jitted program.
+
+The reference has no pipeline parallelism in core (SURVEY §2.3 PP row —
+delegated to Alpa/DeepSpeed on top of Ray actors).  The TPU-native design runs
+the whole pipeline *inside* a single SPMD program under ``shard_map``:
+
+* the stacked layer params [L, ...] are reshaped to [P, L/P, ...] and the
+  stage dim is sharded over the ``pp`` mesh axis — each device holds one
+  stage's layers;
+* microbatches march through stages on a ``lax.scan`` over
+  ``M + P - 1`` ticks; each tick every stage runs its layers on its current
+  microbatch, then activations rotate one hop along the ``pp`` ring with
+  ``ppermute`` (ICI neighbor traffic, overlapping the next tick's compute);
+* stage 0 injects embedded microbatches, the last stage's outputs are
+  collected from the scan ys, and the loss (final norm + chunked CE) runs on
+  the last stage only — ``where``-masked, SPMD-uniform;
+* autodiff of the scan+ppermute gives the reverse pipeline schedule for
+  gradients; the replicated in-specs of embed/head params transpose into the
+  correct cross-stage psums.
+
+Composes with ``dp`` (batch sharding) in the same shard_map.  Bubble fraction
+is the GPipe (P-1)/(M+P-1); pick num_microbatches >= 4*P to amortize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import sharding as shard_rules
+from ..models import transformer
+from ..models.config import TransformerConfig
+from .mesh import named_sharding
+from .train_step import TrainState
+
+
+def partition_layers(params, num_stages: int):
+    """Reshape every stacked-layer leaf [L, ...] -> [P, L/P, ...]."""
+    def fix(x):
+        L = x.shape[0]
+        assert L % num_stages == 0, (L, num_stages)
+        return x.reshape(num_stages, L // num_stages, *x.shape[1:])
+    return {**params, "blocks": jax.tree.map(fix, params["blocks"])}
+
+
+def merge_layers(params):
+    """Inverse of partition_layers."""
+    def fix(x):
+        return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+    return {**params, "blocks": jax.tree.map(fix, params["blocks"])}
+
+
+def pipeline_param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec tree for stage-partitioned params: blocks get a leading
+    pp stage dim; embed/head/final-norm replicated (their grads psum across
+    stages through the shard_map in-spec transpose)."""
+    base = shard_rules.logical_param_specs(cfg)
+
+    def add_stage_dim(spec: P) -> P:
+        # original leading dim was the layer dim (None); keep per-layer dims'
+        # fsdp/tp sharding out of the shard_map path: inside shard_map only
+        # pp/dp are partitioned, so drop other axes here.
+        return P("pp", *[None] * len(spec))
+
+    blocks = jax.tree.map(add_stage_dim, base["blocks"],
+                          is_leaf=lambda x: isinstance(x, P))
+
+    def replicated(spec: P) -> P:
+        return P(*[None] * len(spec))
+
+    out = {k: (blocks if k == "blocks" else
+               jax.tree.map(replicated, v, is_leaf=lambda x: isinstance(x, P)))
+           for k, v in base.items()}
+    return out
+
+
+def _stage_apply(x, stage_params, cfg, positions, compute_dtype):
+    """Run this device's L/P layers on x [mb, S, H]."""
+    def body(x, layer_params):
+        x, aux = transformer.block_forward(x, layer_params, cfg, positions)
+        return x, aux
+
+    body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, aux = jax.lax.scan(body, x, stage_params)
+    return x, aux.sum()
+
+
+def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
+                     num_microbatches: int,
+                     compute_dtype=jnp.bfloat16,
+                     loss_chunk: Optional[int] = 0,
+                     pp_axis: str = "pp", dp_axes: Tuple[str, ...] = ("dp", "fsdp")):
+    """Returns loss(params_staged, batch) -> (loss, metrics), shard_mapped
+    over the pp (stages) and dp/fsdp (batch) mesh axes."""
+    M = num_microbatches
+    dp_axes = tuple(a for a in dp_axes if a in mesh.axis_names
+                    and mesh.shape[a] > 1) or None
+
+    pspec_tree = pipeline_param_specs(cfg)
+    batch_dim = dp_axes if dp_axes and len(dp_axes) > 1 else (
+        dp_axes[0] if dp_axes else None)
+    batch_spec = P(batch_dim)
+
+    def body(params, tokens, targets):
+        p_idx = jax.lax.axis_index(pp_axis)
+        n_stages = jax.lax.psum(1, pp_axis)
+        # Local view of the stage-sharded blocks has stage-dim extent 1.
+        stage = jax.tree.map(lambda x: x[0], params["blocks"])
+        b_local, s = tokens.shape
+        mb = b_local // M
+        positions = jnp.arange(s)
+
+        toks_mb = tokens.reshape(M, mb, s)
+        h = cfg.hidden_size
+
+        def tick(carry, t):
+            act = carry
+            # Inject microbatch t at stage 0 (all ranks compute the cheap
+            # embed; the where selects). Clamp t to a valid index for the
+            # trailing bubble ticks.
+            t_in = jnp.clip(t, 0, M - 1)
+            inject = transformer.embed_tokens(
+                params, jax.lax.dynamic_index_in_dim(toks_mb, t_in, 0,
+                                                     keepdims=False),
+                cfg, compute_dtype)
+            act = jnp.where((p_idx == 0) & (t < M), inject, act)
+            act, aux = _stage_apply(act, stage, cfg, positions,
+                                    compute_dtype)
+            # Rotate activations one hop forward along the pp ring; the wrap
+            # from the last stage back to 0 carries garbage that the next
+            # tick's stage-0 inject overwrites.
+            nxt = jax.lax.ppermute(
+                act, pp_axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return nxt, (act, aux)
+
+        init = jnp.zeros((mb, s, h), compute_dtype)
+        _, (outs, auxes) = jax.lax.scan(tick, init, jnp.arange(M + n_stages - 1))
+
+        # The last stage produced microbatch m's output at tick m + P - 1.
+        # n_stages is static on a concrete mesh: mesh.shape[pp_axis].
+        P_static = mesh.shape[pp_axis]
+        final = outs[P_static - 1: P_static - 1 + M]        # [M, mb, S, H]
+        final = final.reshape(M * mb, s, h)
+        x = transformer._norm(final, params["final_norm"], cfg)
+        w = transformer.lm_head_weight(params, cfg, x.dtype)
+        tgt = targets.reshape(M * mb, s)
+        chunk = loss_chunk
+        if chunk == 0:
+            chunk = 512 if s * cfg.vocab_size > 2 ** 25 else None
+        if chunk:
+            nll = transformer.chunked_cross_entropy(x, w, tgt, min(chunk, s))
+        else:
+            logits = (x @ w).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        local_loss = nll.mean()
+        # Only the last stage's loss is real; make it SPMD-uniform.
+        loss = jax.lax.psum(
+            jnp.where(p_idx == n_stages - 1, local_loss, 0.0), pp_axis)
+        if dp_axes:
+            loss = jax.lax.pmean(loss, dp_axes)
+        moe_aux = jax.lax.psum(auxes.sum(), pp_axis) / (M * n_stages)
+        if dp_axes:
+            moe_aux = jax.lax.pmean(moe_aux, dp_axes)
+        return loss, moe_aux
+
+    param_specs = jax.tree.map(lambda s: s, pspec_tree,
+                               is_leaf=lambda x: isinstance(x, P))
+
+    smapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, batch_spec, batch_spec),
+        out_specs=(P(), P()),
+        check_vma=False)
+
+    def loss_fn(params, batch):
+        if "targets" in batch:
+            tokens, targets = batch["tokens"], batch["targets"]
+        else:
+            tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+        loss, moe_aux = smapped(params, tokens, targets)
+        total = loss + 0.01 * moe_aux
+        return total, {"loss": loss, "moe_aux_loss": moe_aux,
+                       "tokens": tokens.size}
+
+    return loss_fn
+
+
+def init_pp_state(cfg: TransformerConfig, mesh: Mesh,
+                  optimizer: optax.GradientTransformation, seed: int = 0,
+                  param_dtype=jnp.float32) -> Tuple[TrainState, TrainState]:
+    """Initialize a stage-partitioned TrainState sharded over the mesh."""
+    num_stages = mesh.shape["pp"]
+
+    def init_fn():
+        params = transformer.init_params(jax.random.PRNGKey(seed), cfg,
+                                         dtype=param_dtype)
+        params = partition_layers(params, num_stages)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    pspecs = pipeline_param_specs(cfg)
+    param_sh = named_sharding(mesh, pspecs)
+    shapes = jax.eval_shape(init_fn)
+    from .train_step import state_shardings as _ss  # reuse opt-state recursion
+
+    # state_shardings builds from logical_param_specs; do the same recursion
+    # against the pipeline specs instead.
+    params_struct = jax.tree.structure(param_sh)
+
+    def shard_opt_state(node):
+        try:
+            if jax.tree.structure(node) == params_struct:
+                return param_sh
+        except Exception:
+            pass
+        if hasattr(node, "_fields"):
+            return type(node)(*(shard_opt_state(x) for x in node))
+        if isinstance(node, tuple):
+            return tuple(shard_opt_state(x) for x in node)
+        if isinstance(node, list):
+            return [shard_opt_state(x) for x in node]
+        if dataclasses.is_dataclass(node) and not isinstance(node, type):
+            return type(node)(**{f.name: shard_opt_state(getattr(node, f.name))
+                                 for f in dataclasses.fields(node)})
+        if isinstance(node, dict):
+            return {k: shard_opt_state(v) for k, v in node.items()}
+        return NamedSharding(mesh, P())
+
+    sh = TrainState(params=param_sh,
+                    opt_state=shard_opt_state(shapes.opt_state),
+                    step=NamedSharding(mesh, P()))
+    state = jax.jit(init_fn, out_shardings=sh)()
+    return state, sh
+
+
+def make_pp_train_step(cfg: TransformerConfig, mesh: Mesh,
+                       optimizer: optax.GradientTransformation,
+                       state_sh: TrainState, num_microbatches: int = 4,
+                       compute_dtype=jnp.bfloat16,
+                       loss_chunk: Optional[int] = 0) -> Callable:
+    """Jitted GPipe train step over a mesh with a pp axis (+ optional dp)."""
+    loss_fn = pipeline_loss_fn(cfg, mesh, num_microbatches, compute_dtype,
+                               loss_chunk)
+    batch_sh = NamedSharding(mesh, shard_rules.batch_spec())
+
+    def step_fn(state: TrainState, batch):
+        (total, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["total_loss"] = total
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    jitted = jax.jit(step_fn, in_shardings=(state_sh, None),
+                     out_shardings=(state_sh, None), donate_argnums=(0,))
+
+    def step(state, batch):
+        batch = {k: jax.device_put(v, batch_sh) for k, v in batch.items()}
+        return jitted(state, batch)
+
+    step._jitted = jitted
+    return step

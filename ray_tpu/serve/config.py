@@ -1,0 +1,50 @@
+"""Serve configs: deployment + autoscaling schemas.
+
+Reference: ``python/ray/serve/config.py`` (DeploymentConfig/AutoscalingConfig
+pydantic models) — re-expressed as plain dataclasses; and
+``python/ray/serve/_private/common.py`` status enums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-depth autoscaling (reference: _private/autoscaling_policy.py):
+    target ongoing requests per replica drives the replica count."""
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    # smoothing factor applied to the raw desired count
+    smoothing_factor: float = 1.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Any = None
+    autoscaling: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 10.0
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    route_prefix: Optional[str] = None  # default: f"/{name}"
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling is not None:
+            return max(self.autoscaling.min_replicas, 1)
+        return self.num_replicas
+
+
+# Deployment status values (reference: _private/common.py DeploymentStatus)
+DEPLOYING = "DEPLOYING"
+HEALTHY = "HEALTHY"
+UNHEALTHY = "UNHEALTHY"
+UPDATING = "UPDATING"
+DELETING = "DELETING"

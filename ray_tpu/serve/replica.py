@@ -1,0 +1,174 @@
+"""Replica actor: wraps the user callable, serves requests, reports health +
+queue depth, supports streaming and graceful drain.
+
+Reference: ``python/ray/serve/_private/replica.py`` (RayServeReplica).  Runs as
+an async actor with ``max_concurrency = max_concurrent_queries`` so requests
+interleave on the replica's event loop; ``num_ongoing`` is both the router's
+power-of-two-choices signal and the autoscaler's input.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class Request:
+    """Lightweight HTTP request container handed to deployments that take one
+    (the reference hands a starlette Request; same role)."""
+
+    __slots__ = ("method", "path", "query", "headers", "body")
+
+    def __init__(self, method: str = "POST", path: str = "/", query=None,
+                 headers=None, body: bytes = b""):
+        self.method = method
+        self.path = path
+        self.query = dict(query or {})
+        self.headers = dict(headers or {})
+        self.body = body
+
+    def json(self):
+        import json
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+
+class ReplicaActor:
+    """The actor class every replica runs (created by the controller)."""
+
+    def __init__(self, deployment_name: str, replica_id: str, app_blob: bytes,
+                 user_config: Any = None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        func_or_class, init_args, init_kwargs = cloudpickle.loads(app_blob)
+        if inspect.isclass(func_or_class):
+            self.callable = func_or_class(*init_args, **init_kwargs)
+            self._entry = None  # resolve per request (method or __call__)
+        else:
+            self.callable = None
+            self._fn = func_or_class
+        self.num_ongoing = 0
+        self.num_processed = 0
+        self._draining = False
+        self.started_at = time.time()
+        self._streams: Dict[str, list] = {}
+        self._stream_done: Dict[str, bool] = {}
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    # ------------------------------------------------------------- serving
+
+    def _resolve(self, method: Optional[str]):
+        if self.callable is None:
+            return self._fn
+        target = self.callable
+        if method:
+            return getattr(target, method)
+        if callable(target):
+            return target.__call__
+        raise AttributeError(f"{type(target)} is not callable; specify method")
+
+    async def handle_request(self, args: tuple, kwargs: dict,
+                             method: Optional[str] = None) -> Any:
+        if self._draining:
+            raise RuntimeError(f"replica {self.replica_id} is draining")
+        self.num_ongoing += 1
+        try:
+            fn = self._resolve(method)
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = await out
+            if inspect.isgenerator(out) or inspect.isasyncgen(out):
+                raise TypeError(
+                    "streaming responses go through handle_request_streaming")
+            return out
+        finally:
+            self.num_ongoing -= 1
+            self.num_processed += 1
+
+    async def handle_request_streaming(self, stream_id: str, args: tuple,
+                                       kwargs: dict,
+                                       method: Optional[str] = None) -> None:
+        """Run a (async) generator endpoint, buffering chunks for the caller
+        to drain via next_chunks() — streaming over the actor RPC plane."""
+        self.num_ongoing += 1
+        self._streams[stream_id] = []
+        self._stream_done[stream_id] = False
+        try:
+            fn = self._resolve(method)
+            out = fn(*args, **kwargs)
+            if inspect.isasyncgen(out):
+                async for chunk in out:
+                    self._streams[stream_id].append(chunk)
+            elif inspect.isgenerator(out):
+                for chunk in out:
+                    self._streams[stream_id].append(chunk)
+                    await asyncio.sleep(0)  # let pollers interleave
+            else:
+                if inspect.iscoroutine(out):
+                    out = await out
+                self._streams[stream_id].append(out)
+        finally:
+            self._stream_done[stream_id] = True
+            self.num_ongoing -= 1
+            self.num_processed += 1
+
+    async def next_chunks(self, stream_id: str, cursor: int) -> tuple:
+        """Poll a stream: returns (new_chunks, next_cursor, done)."""
+        for _ in range(200):  # long-poll up to ~2s per call
+            buf = self._streams.get(stream_id)
+            if buf is None:
+                raise KeyError(f"unknown stream {stream_id}")
+            if len(buf) > cursor:
+                chunks = buf[cursor:]
+                done = self._stream_done.get(stream_id, False)
+                nxt = cursor + len(chunks)
+                if done and nxt == len(buf):
+                    self._streams.pop(stream_id, None)
+                    self._stream_done.pop(stream_id, None)
+                return chunks, nxt, done
+            if self._stream_done.get(stream_id, False):
+                self._streams.pop(stream_id, None)
+                self._stream_done.pop(stream_id, None)
+                return [], cursor, True
+            await asyncio.sleep(0.01)
+        return [], cursor, False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _apply_user_config(self, user_config: Any):
+        target = self.callable if self.callable is not None else None
+        if target is not None and hasattr(target, "reconfigure"):
+            target.reconfigure(user_config)
+
+    async def reconfigure(self, user_config: Any) -> bool:
+        self._apply_user_config(user_config)
+        return True
+
+    async def health_check(self) -> Dict[str, Any]:
+        # User-defined health check hooks in when present (reference:
+        # replica.py check_health).
+        target = self.callable
+        if target is not None and hasattr(target, "check_health"):
+            res = target.check_health()
+            if inspect.iscoroutine(res):
+                await res
+        return {"ongoing": self.num_ongoing, "processed": self.num_processed,
+                "draining": self._draining}
+
+    async def queue_len(self) -> int:
+        return self.num_ongoing
+
+    async def drain(self, timeout_s: float = 10.0) -> bool:
+        """Stop accepting new requests; wait for ongoing ones to finish."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while self.num_ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return self.num_ongoing == 0

@@ -1,0 +1,192 @@
+"""Router + DeploymentHandle: replica selection and the calling surface.
+
+Reference: ``python/ray/serve/_private/router.py:1191`` (Router),
+``:328`` (PowerOfTwoChoicesReplicaScheduler), ``serve/handle.py:305``
+(RayServeHandle).  Scheduling is power-of-two-choices over (local in-flight
+count + last-known replica queue length): pick two random replicas, route to
+the less loaded.  Replica death triggers local eviction + a routing-table
+refresh; calls retry on another replica.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.common import ActorDiedError, TaskError
+
+CONTROLLER_NAME = "serve:controller"
+
+
+def _controller():
+    return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+class Router:
+    """Caches the controller's routing table; assigns requests to replicas."""
+
+    def __init__(self, refresh_interval_s: float = 0.5):
+        self.refresh_interval_s = refresh_interval_s
+        self._table: Dict[str, List[str]] = {}       # deployment -> replica names
+        self._handles: Dict[str, Any] = {}           # replica name -> handle
+        self._inflight: Dict[str, int] = {}          # replica name -> local count
+        self._last_refresh = 0.0
+        self._table_version = -1
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ table
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.refresh_interval_s:
+            return
+        ctrl = _controller()
+        version, table = ray_tpu.get(
+            ctrl.get_routing_table.remote(), timeout=30)
+        with self._lock:
+            self._last_refresh = now
+            if version != self._table_version:
+                self._table_version = version
+                self._table = table
+                live = {r for reps in table.values() for r in reps}
+                self._handles = {k: v for k, v in self._handles.items()
+                                 if k in live}
+
+    def _replica_handle(self, replica_name: str):
+        h = self._handles.get(replica_name)
+        if h is None:
+            h = ray_tpu.get_actor(replica_name)
+            self._handles[replica_name] = h
+        return h
+
+    def _evict(self, deployment: str, replica_name: str):
+        with self._lock:
+            if replica_name in self._table.get(deployment, []):
+                self._table[deployment].remove(replica_name)
+            self._handles.pop(replica_name, None)
+        try:
+            _controller().report_replica_failure.remote(deployment,
+                                                        replica_name)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- p2c selection
+
+    def choose_replica(self, deployment: str) -> str:
+        self._refresh()
+        replicas = self._table.get(deployment)
+        if not replicas:
+            self._refresh(force=True)
+            replicas = self._table.get(deployment)
+            if not replicas:
+                raise RuntimeError(f"no replicas for deployment "
+                                   f"{deployment!r} (not deployed or scaled "
+                                   f"to zero)")
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    # ------------------------------------------------------------- calling
+
+    def assign(self, deployment: str, args: tuple, kwargs: dict,
+               method: Optional[str] = None):
+        """Route one request; returns the result ObjectRef."""
+        last_err: Optional[Exception] = None
+        for _ in range(3):
+            name = self.choose_replica(deployment)
+            h = self._replica_handle(name)
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+            ref = h.handle_request.remote(args, kwargs, method)
+            self._attach_done(ref, name)
+            return ref
+        raise last_err or RuntimeError("routing failed")
+
+    def _attach_done(self, ref, name: str):
+        fut = ray_tpu.as_future(ref)
+
+        def _done(_):
+            self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+
+        fut.add_done_callback(_done)
+
+    def start_stream(self, deployment: str, args: tuple, kwargs: dict,
+                     method: Optional[str] = None) -> tuple:
+        """Kick off a streaming request; returns (replica_name, stream_id,
+        completion ref)."""
+        name = self.choose_replica(deployment)
+        h = self._replica_handle(name)
+        stream_id = uuid.uuid4().hex
+        ref = h.handle_request_streaming.remote(stream_id, args, kwargs, method)
+        return name, stream_id, ref
+
+
+_router: Optional[Router] = None
+_router_lock = threading.Lock()
+
+
+def get_router() -> Router:
+    global _router
+    with _router_lock:
+        if _router is None:
+            _router = Router()
+        return _router
+
+
+def reset_router():
+    global _router
+    with _router_lock:
+        _router = None
+
+
+class DeploymentHandle:
+    """Calling surface for a deployment (reference: serve/handle.py:305).
+
+    ``h.remote(...)`` returns an ObjectRef (``ray_tpu.get`` it);
+    ``h.method.remote(...)`` routes to a named method;
+    ``h.stream(...)`` yields chunks from a generator endpoint.
+    """
+
+    def __init__(self, deployment: str, method: Optional[str] = None):
+        self.deployment = deployment
+        self.method = method
+
+    def __getattr__(self, item: str) -> "DeploymentHandle":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return DeploymentHandle(self.deployment, item)
+
+    def remote(self, *args, **kwargs):
+        return get_router().assign(self.deployment, args, kwargs, self.method)
+
+    def stream(self, *args, **kwargs):
+        """Synchronous chunk iterator over a streaming endpoint."""
+        router = get_router()
+        name, stream_id, ref = router.start_stream(self.deployment, args,
+                                                   kwargs, self.method)
+        h = router._replica_handle(name)
+        cursor, done = 0, False
+        while not done:
+            chunks, cursor, done = ray_tpu.get(
+                h.next_chunks.remote(stream_id, cursor), timeout=60)
+            yield from chunks
+        # surface errors from the generator body
+        ray_tpu.get(ref, timeout=60)
+
+    async def stream_async(self, *args, **kwargs):
+        router = get_router()
+        name, stream_id, ref = router.start_stream(self.deployment, args,
+                                                   kwargs, self.method)
+        h = router._replica_handle(name)
+        cursor, done = 0, False
+        while not done:
+            chunks, cursor, done = await asyncio.wrap_future(
+                ray_tpu.as_future(h.next_chunks.remote(stream_id, cursor)))
+            for c in chunks:
+                yield c
+        await asyncio.wrap_future(ray_tpu.as_future(ref))

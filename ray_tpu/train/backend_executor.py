@@ -129,8 +129,18 @@ class BackendExecutor:
                 "mismatched session calls: some workers finished while "
                 "others are still reporting (all workers must call "
                 "train.report the same number of times)")
-        # register checkpoint (rank0's path; multi-host writers share the dir)
+        # register checkpoint (rank0's path). Multi-host sharded writers
+        # (jax_utils.save_pytree writes only addressable shards per host) are
+        # only correct when every rank reported the same shared-filesystem
+        # directory — divergent paths mean non-rank0 shards would be dropped.
         ckpt = None
+        reported = {p for _, _, p in results if p}
+        if len(reported) > 1:
+            import logging
+            logging.getLogger(__name__).warning(
+                "workers reported %d different checkpoint paths %s; using "
+                "rank0's. report(checkpoint=...) requires a shared storage "
+                "root across ranks", len(reported), sorted(reported)[:4])
         for kind, metrics, ckpt_path in results:
             if ckpt_path:
                 ckpt = Checkpoint(ckpt_path)

@@ -108,10 +108,13 @@ class TuneController:
         trial.metrics_history.append(metrics)
         trial.iteration = metrics.get("training_iteration", trial.iteration + 1)
         if ckpt_path:
+            trial.ckpt_seq += 1
             dest = os.path.join(trial.trial_dir,
-                                f"checkpoint_{trial.iteration:06d}")
+                                f"checkpoint_{trial.ckpt_seq:06d}")
             if os.path.abspath(ckpt_path) != os.path.abspath(dest):
-                shutil.copytree(ckpt_path, dest, dirs_exist_ok=True)
+                if os.path.exists(dest):  # stale leftovers must not mix in
+                    shutil.rmtree(dest)
+                shutil.copytree(ckpt_path, dest)
             trial.latest_checkpoint = dest
         self.searcher.on_trial_result(trial.trial_id, metrics)
         decision = self.scheduler.on_result(trial, metrics)

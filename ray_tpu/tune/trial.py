@@ -36,6 +36,10 @@ class Trial:
     iteration: int = 0
     rungs_passed: Set[int] = dataclasses.field(default_factory=set)
     restarts: int = 0
+    # Monotonic checkpoint counter: checkpoint dirs must not be keyed on
+    # training_iteration, which resets to 1 after a PBT perturb / failure
+    # restart and would merge fresh files into a stale directory.
+    ckpt_seq: int = 0
     _pending_ref: Any = None  # outstanding next_result ref (controller-owned)
 
     @property

@@ -95,7 +95,21 @@ class _GroupState:
         self.rounds: Dict[str, Dict[int, Any]] = {}
         self.results: Dict[str, Any] = {}
 
-    def contribute(self, op_id: str, rank: int, payload: Any) -> bool:
+    def contribute(self, op_id: str, rank: int, payload: Any,
+                   kind: str = "") -> bool:
+        # Divergent op sequences across ranks (rank A: barrier,allreduce;
+        # rank B: allreduce,...) must fail fast with a clear error, not hang
+        # all ranks until the timeout: the op kind is recorded per sequence
+        # number and any mismatch raises at the second contributor.
+        kinds = self.rounds.setdefault("\x00kinds", {})
+        seq = op_id.rsplit(":", 1)[-1]
+        if kind:
+            prev = kinds.get(seq)
+            if prev is not None and prev != kind:
+                raise RuntimeError(
+                    f"collective op sequence diverged: op #{seq} is "
+                    f"{prev!r} on another rank but {kind!r} on rank {rank}")
+            kinds[seq] = kind
         slot = self.rounds.setdefault(op_id, {})
         slot[rank] = payload
         return len(slot) == self.world
@@ -140,10 +154,12 @@ class CollectiveGroup:
         self.name = group_name
         self.world = world_size
         self.rank = rank
+        # Job-scoped (NOT detached): the state actor dies with the job instead
+        # of leaking one per run; destroy_collective_group() removes it early.
         state_cls = ray_tpu.remote(_GroupState)
         self.state = state_cls.options(
             name=f"_collective:{group_name}", get_if_exists=True,
-            lifetime="detached", num_cpus=0.1).remote(world_size)
+            num_cpus=0.1).remote(world_size)
         self._seq = 0
 
     def _op_id(self, kind: str) -> str:
@@ -154,7 +170,8 @@ class CollectiveGroup:
         """All ranks contribute; rank 0 reduces; everyone polls the result
         (which auto-gcs in the state actor after the last fetch)."""
         op = self._op_id(kind)
-        ray_tpu.get(self.state.contribute.remote(op, self.rank, payload))
+        ray_tpu.get(self.state.contribute.remote(op, self.rank, payload,
+                                                 kind=kind))
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
             if self.rank == 0:

@@ -170,3 +170,29 @@ def test_num_returns_options(ray_start_regular):
 
     r = pair.options(num_returns=2).remote()
     assert ray_tpu.get(list(r)) == [1, 2]
+
+
+def test_returned_ref_survives_escrow_grace():
+    """Regression (round-2 ADVICE): a ref serialized in a task result must
+    survive the owner's escrow grace even if the caller only deserializes it
+    long after the producing task finished — borrows are registered at result
+    receipt (TaskManager.complete), not at ray.get time."""
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"ref_escrow_grace_s": 0.3})
+    try:
+        @ray_tpu.remote
+        def produce():
+            inner = ray_tpu.put(np.arange(1000))
+            return {"ref": inner}
+
+        res = produce.remote()
+        # Wait for the task to finish WITHOUT deserializing its result, then
+        # sit past the grace window: the producer's own counts hit zero at
+        # task exit, and before the fix the owner freed the inner object here.
+        ray_tpu.wait([res], timeout=30)
+        time.sleep(1.5)
+        inner_val = ray_tpu.get(ray_tpu.get(res)["ref"])
+        np.testing.assert_array_equal(inner_val, np.arange(1000))
+    finally:
+        ray_tpu.shutdown()
