@@ -84,7 +84,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_kv: int,
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # TPU tiling wants the last two block dims (8, 128)-aligned; a [block_q]
+    # row vector is not.  Replicate the row stats across 8 sublanes and let
+    # the caller read lane 0.
+    lse_ref[0, 0] = jnp.broadcast_to((m + jnp.log(l))[:, 0][None, :],
+                                     (8, block_q))
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_kv: int,
@@ -110,15 +114,15 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_kv: int,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 8, s), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse[:, :, 0, :]
 
 
 def _bwd_blockwise(q, k, v, out, lse, g, causal: bool, block_kv: int):
