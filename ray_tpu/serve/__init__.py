@@ -1,0 +1,22 @@
+"""ray_tpu.serve — model serving on the actor substrate.
+
+Capabilities of Ray Serve (reference: ``python/ray/serve/``): deployments as
+reconciled replica actor sets, rolling updates, health-driven replacement,
+queue-depth autoscaling, power-of-two-choices routing, dynamic batching,
+streaming responses, and an HTTP ingress — plus a TPU-first continuous-
+batching LLM deployment (``ray_tpu.serve.llm``).
+"""
+
+from .api import (delete, get_deployment_handle, http_config, run, shutdown,
+                  start, status)
+from .batching import batch
+from .config import AutoscalingConfig, DeploymentConfig
+from .deployment import Deployment, deployment
+from .replica import Request
+from .router import DeploymentHandle
+
+__all__ = [
+    "deployment", "Deployment", "DeploymentConfig", "AutoscalingConfig",
+    "DeploymentHandle", "Request", "batch", "run", "start", "status",
+    "delete", "shutdown", "get_deployment_handle", "http_config",
+]

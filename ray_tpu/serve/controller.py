@@ -1,0 +1,433 @@
+"""ServeController: the reconciliation control loop.
+
+Reference: ``python/ray/serve/controller.py:82`` (ServeController actor) and
+``_private/deployment_state.py:1156`` (DeploymentState replica state machine).
+One detached named actor owns the desired state (deployments shipped by
+``serve.run``) and continuously reconciles the live replica set against it:
+
+* scale up: start replica actors until the target count of the target version
+  is running;
+* rolling update: when a deployment's code/config version changes, surge new
+  replicas first, then drain+stop outdated ones once enough new ones are
+  healthy (no request ever has zero healthy replicas to land on);
+* health: periodic ``health_check`` pings per replica; 3 consecutive failures
+  (or actor death) removes the replica, and the next reconcile pass replaces
+  it;
+* autoscaling: queue-depth driven (reference: _private/autoscaling_policy.py)
+  — desired = ceil(total ongoing / target_ongoing_requests) clamped to
+  [min, max], with upscale/downscale decision delays.
+
+Routers and proxies pull the routing table with a version tag and long-poll
+``wait_for_table_change`` (reference: _private/long_poll.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .config import (DEPLOYING, DELETING, HEALTHY, UNHEALTHY, UPDATING,
+                     DeploymentConfig)
+from .deployment import Deployment
+
+CONTROLLER_NAME = "serve:controller"
+
+# replica lifecycle states (reference: _private/common.py ReplicaState)
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+DRAINING = "DRAINING"
+
+HEALTH_FAILURE_THRESHOLD = 3
+
+
+class _Replica:
+    __slots__ = ("name", "handle", "version", "state", "failures",
+                 "started_at", "last_ongoing", "code_hash")
+
+    def __init__(self, name: str, handle, version: str,
+                 code_hash: Optional[str] = None):
+        self.name = name
+        self.handle = handle
+        self.version = version
+        self.state = STARTING
+        self.failures = 0
+        self.started_at = time.monotonic()
+        self.last_ongoing = 0
+        self.code_hash = code_hash
+
+
+class _DeploymentState:
+    def __init__(self, deployment: Deployment):
+        self.deployment = deployment
+        self.version = deployment.version()
+        self.app_blob = deployment.app_blob()
+        self.replicas: List[_Replica] = []
+        self.deleting = False
+        # autoscale bookkeeping
+        self.autoscale_target: Optional[int] = None
+        self._scale_pending_since: Optional[float] = None
+        self._scale_pending_dir = 0
+
+    @property
+    def config(self) -> DeploymentConfig:
+        return self.deployment.config
+
+    def target_count(self) -> int:
+        if self.deleting:
+            return 0
+        if self.config.autoscaling is not None:
+            if self.autoscale_target is None:
+                self.autoscale_target = self.config.initial_replicas()
+            return self.autoscale_target
+        return self.config.num_replicas
+
+    def running(self, version: Optional[str] = None) -> List[_Replica]:
+        return [r for r in self.replicas
+                if r.state == RUNNING
+                and (version is None or r.version == version)]
+
+    def status(self) -> str:
+        if self.deleting:
+            return DELETING
+        target = self.target_count()
+        current = self.running(self.version)
+        if len(current) >= target and all(
+                r.version == self.version for r in self.replicas
+                if r.state != DRAINING):
+            return HEALTHY
+        if any(r.version != self.version for r in self.replicas):
+            return UPDATING
+        if any(r.failures > 0 for r in self.replicas):
+            return UNHEALTHY if not current else DEPLOYING
+        return DEPLOYING
+
+
+class ServeController:
+    """The singleton control-loop actor (name: ``serve:controller``)."""
+
+    def __init__(self, reconcile_period_s: float = 0.25):
+        self.reconcile_period_s = reconcile_period_s
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._table_version = 0
+        self._table_event: Optional[asyncio.Event] = None
+        self._loop_task: Optional[asyncio.Task] = None
+        self._shutting_down = False
+        self._http_config: Optional[dict] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def startup(self) -> bool:
+        """Idempotent: spawn the reconcile loop on the actor's event loop."""
+        if self._loop_task is None or self._loop_task.done():
+            self._table_event = asyncio.Event()
+            self._loop_task = asyncio.get_event_loop().create_task(
+                self._reconcile_loop())
+        return True
+
+    async def graceful_shutdown(self) -> bool:
+        """Drain and stop every replica; used by serve.shutdown()."""
+        self._shutting_down = True
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+        for ds in self._deployments.values():
+            await asyncio.gather(
+                *[self._stop_replica(ds, r, graceful=True)
+                  for r in list(ds.replicas)],
+                return_exceptions=True)
+            ds.replicas.clear()
+        self._deployments.clear()
+        self._bump_table()
+        return True
+
+    # ------------------------------------------------------------- deploy
+
+    async def deploy(self, deployment: Deployment) -> str:
+        """Register/refresh a deployment; reconciliation does the rest.
+        Returns the target version."""
+        ds = self._deployments.get(deployment.name)
+        if ds is None:
+            self._deployments[deployment.name] = _DeploymentState(deployment)
+        else:
+            old_version = ds.version
+            ds.deployment = deployment
+            ds.version = deployment.version()
+            ds.app_blob = deployment.app_blob()
+            ds.deleting = False
+            if old_version != ds.version:
+                # user_config-only change: reconfigure in place, no restart
+                if self._only_user_config_changed(ds, old_version):
+                    await self._reconfigure_all(ds)
+        return self._deployments[deployment.name].version
+
+    def _only_user_config_changed(self, ds: _DeploymentState,
+                                  old_version: str) -> bool:
+        # Replicas of the old version whose code blob matches the new one can
+        # be reconfigured in place (reference: deployment_state lightweight
+        # config updates).  Compare code-only hash.
+        import hashlib
+        code_hash = hashlib.sha256(ds.app_blob).hexdigest()
+        return bool(ds.replicas) and all(r.code_hash == code_hash
+                                         for r in ds.replicas)
+
+    async def _reconfigure_all(self, ds: _DeploymentState):
+        cfg = ds.config
+        for r in ds.replicas:
+            try:
+                await self._aget(r.handle.reconfigure.remote(cfg.user_config))
+                r.version = ds.version
+            except Exception:
+                r.failures = HEALTH_FAILURE_THRESHOLD  # replace it
+
+    async def delete_deployment(self, name: str) -> bool:
+        ds = self._deployments.get(name)
+        if ds is None:
+            return False
+        ds.deleting = True
+        return True
+
+    # ------------------------------------------------------- table queries
+
+    def _bump_table(self):
+        self._table_version += 1
+        if self._table_event is not None:
+            self._table_event.set()
+            self._table_event = asyncio.Event()
+
+    async def get_routing_table(self):
+        """(version, {deployment -> [replica actor names]}) — RUNNING only."""
+        table = {name: [r.name for r in ds.running()]
+                 for name, ds in self._deployments.items() if not ds.deleting}
+        return self._table_version, table
+
+    async def wait_for_table_change(self, known_version: int,
+                                    timeout_s: float = 10.0):
+        """Long-poll: return as soon as the table moves past known_version
+        (reference: _private/long_poll.py LongPollHost)."""
+        if self._table_version != known_version:
+            return await self.get_routing_table()
+        ev = self._table_event
+        if ev is not None:
+            try:
+                await asyncio.wait_for(ev.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                pass
+        return await self.get_routing_table()
+
+    async def get_http_routes(self):
+        """{route_prefix -> deployment name} for the proxies."""
+        routes = {}
+        for name, ds in self._deployments.items():
+            if ds.deleting:
+                continue
+            prefix = ds.config.route_prefix
+            if prefix is None:
+                prefix = f"/{name}"
+            if prefix:
+                routes[prefix] = name
+        return self._table_version, routes
+
+    async def get_status(self):
+        out = {}
+        for name, ds in self._deployments.items():
+            out[name] = {
+                "status": ds.status(),
+                "version": ds.version,
+                "target_replicas": ds.target_count(),
+                "replicas": [
+                    {"name": r.name, "state": r.state, "version": r.version,
+                     "ongoing": r.last_ongoing}
+                    for r in ds.replicas],
+            }
+        return out
+
+    async def set_http_config(self, config: dict):
+        self._http_config = config
+        return True
+
+    async def get_http_config(self):
+        return self._http_config
+
+    # ------------------------------------------------- failure reporting
+
+    async def report_replica_failure(self, deployment: str, replica: str):
+        """Routers report dead replicas they hit; drop them immediately so the
+        table converges faster than the next health-check period."""
+        ds = self._deployments.get(deployment)
+        if ds is None:
+            return False
+        for r in list(ds.replicas):
+            if r.name == replica:
+                ds.replicas.remove(r)
+                self._bump_table()
+                await self._kill_replica(r)
+                return True
+        return False
+
+    # --------------------------------------------------------- reconcile
+
+    async def _reconcile_loop(self):
+        while not self._shutting_down:
+            try:
+                changed = False
+                for name in list(self._deployments):
+                    ds = self._deployments[name]
+                    changed |= await self._reconcile_one(ds)
+                    if ds.deleting and not ds.replicas:
+                        del self._deployments[name]
+                        changed = True
+                if changed:
+                    self._bump_table()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                import traceback
+                traceback.print_exc()
+            await asyncio.sleep(self.reconcile_period_s)
+
+    async def _reconcile_one(self, ds: _DeploymentState) -> bool:
+        changed = await self._probe_health(ds)
+        if ds.config.autoscaling is not None and not ds.deleting:
+            self._autoscale(ds)
+        target = ds.target_count()
+        current = [r for r in ds.replicas if r.version == ds.version
+                   and r.state in (STARTING, RUNNING)]
+        outdated = [r for r in ds.replicas if r.version != ds.version
+                    and r.state in (STARTING, RUNNING)]
+
+        # Scale up new-version replicas toward the target.
+        for _ in range(target - len(current)):
+            self._start_replica(ds)
+            changed = True
+
+        # Rolling update: once enough new-version replicas serve traffic,
+        # retire outdated ones (one batch per pass keeps it gradual).
+        if outdated and len(ds.running(ds.version)) >= min(
+                target, max(1, target - len(outdated) + 1)):
+            victim = outdated[0]
+            await self._stop_replica(ds, victim, graceful=True)
+            changed = True
+
+        # Scale down (autoscaling or lowered num_replicas / deletion).
+        excess = len(current) - target
+        for r in sorted(current, key=lambda r: -r.started_at)[:max(0, excess)]:
+            await self._stop_replica(ds, r, graceful=True)
+            changed = True
+        return changed
+
+    async def _probe_health(self, ds: _DeploymentState) -> bool:
+        """Ping replicas; promote STARTING->RUNNING, cull repeated failures."""
+        import ray_tpu
+        changed = False
+
+        async def ping(r: _Replica):
+            nonlocal changed
+            try:
+                res = await asyncio.wait_for(
+                    self._aget(r.handle.health_check.remote()),
+                    ds.config.health_check_timeout_s)
+                r.failures = 0
+                r.last_ongoing = int(res.get("ongoing", 0))
+                if r.state == STARTING:
+                    r.state = RUNNING
+                    changed = True
+            except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
+                r.failures = HEALTH_FAILURE_THRESHOLD  # dead: cull now
+            except Exception:
+                r.failures += 1
+
+        await asyncio.gather(*[ping(r) for r in list(ds.replicas)
+                               if r.state != DRAINING])
+        for r in list(ds.replicas):
+            if r.failures >= HEALTH_FAILURE_THRESHOLD:
+                ds.replicas.remove(r)
+                await self._kill_replica(r)
+                changed = True
+        return changed
+
+    # ------------------------------------------------------- autoscaling
+
+    def _autoscale(self, ds: _DeploymentState):
+        cfg = ds.config.autoscaling
+        running = ds.running()
+        if not running:
+            return
+        total_ongoing = sum(r.last_ongoing for r in running)
+        raw = total_ongoing / max(cfg.target_ongoing_requests, 1e-9)
+        desired = math.ceil(raw * cfg.smoothing_factor)
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        current = ds.autoscale_target or len(running)
+        if desired == current:
+            ds._scale_pending_since = None
+            ds._scale_pending_dir = 0
+            return
+        direction = 1 if desired > current else -1
+        now = time.monotonic()
+        if ds._scale_pending_dir != direction:
+            ds._scale_pending_dir = direction
+            ds._scale_pending_since = now
+        delay = (cfg.upscale_delay_s if direction > 0
+                 else cfg.downscale_delay_s)
+        if now - (ds._scale_pending_since or now) >= delay:
+            ds.autoscale_target = desired
+            ds._scale_pending_since = None
+            ds._scale_pending_dir = 0
+
+    # ------------------------------------------------- replica start/stop
+
+    def _start_replica(self, ds: _DeploymentState):
+        import hashlib
+
+        import ray_tpu
+        from .replica import ReplicaActor
+
+        name = f"serve:{ds.deployment.name}:{uuid.uuid4().hex[:8]}"
+        opts = dict(ds.config.ray_actor_options)
+        opts.setdefault("num_cpus", 1)
+        handle = ray_tpu.remote(ReplicaActor).options(
+            name=name, lifetime="detached",
+            max_concurrency=ds.config.max_concurrent_queries, **opts,
+        ).remote(ds.deployment.name, name, ds.app_blob,
+                 ds.config.user_config)
+        ds.replicas.append(_Replica(
+            name, handle, ds.version,
+            code_hash=hashlib.sha256(ds.app_blob).hexdigest()))
+
+    async def _stop_replica(self, ds: _DeploymentState, r: _Replica,
+                            graceful: bool):
+        if r in ds.replicas:
+            r.state = DRAINING
+        self._bump_table()
+        if graceful:
+            try:
+                await asyncio.wait_for(
+                    self._aget(r.handle.drain.remote(
+                        ds.config.graceful_shutdown_timeout_s)),
+                    ds.config.graceful_shutdown_timeout_s + 5)
+            except Exception:
+                pass
+        if r in ds.replicas:
+            ds.replicas.remove(r)
+        await self._kill_replica(r)
+
+    async def _kill_replica(self, r: _Replica):
+        import ray_tpu
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- util
+
+    @staticmethod
+    async def _aget(ref):
+        import ray_tpu
+        return await asyncio.wrap_future(ray_tpu.as_future(ref))
+
+
+def _replica_failure_is_dead(exc: BaseException) -> bool:
+    import ray_tpu
+    return isinstance(exc, (ray_tpu.ActorDiedError,
+                            ray_tpu.ActorUnavailableError))

@@ -1,0 +1,276 @@
+"""HTTP proxy: the ingress edge of Serve.
+
+Reference: ``python/ray/serve/_private/http_proxy.py:922`` (HTTPProxy /
+HTTPProxyActor).  The reference speaks ASGI through uvicorn; here the proxy is
+an async actor running an aiohttp server (aiohttp is in the base image;
+uvicorn/starlette are not).  Everything on the request path is ``await``-based
+— the actor's private event loop must never block on a synchronous
+``ray_tpu.get`` or concurrent requests would serialize.
+
+Routing: longest-prefix match on the controller's route table, then
+power-of-two-choices replica selection (local in-flight counts), then a direct
+actor call to the replica.  Streaming endpoints produce a chunked HTTP
+response driven by the replica's ``next_chunks`` long-poll.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .replica import Request
+
+PROXY_NAME = "serve:proxy"
+
+
+class AsyncRouter:
+    """Replica selection + table refresh with async-only control calls.
+
+    Same policy as ``router.Router`` (p2c over local in-flight counts) but
+    safe to use on an async actor's event loop; refreshes ride the
+    controller's long-poll so table changes propagate in ~one RTT.
+    """
+
+    def __init__(self):
+        self._table: Dict[str, List[str]] = {}
+        self._routes: Dict[str, str] = {}
+        self._handles: Dict[str, Any] = {}
+        self._inflight: Dict[str, int] = {}
+        self._version = -1
+        self._poller: Optional[asyncio.Task] = None
+
+    @staticmethod
+    async def _aget(ref):
+        import ray_tpu
+        return await asyncio.wrap_future(ray_tpu.as_future(ref))
+
+    def _controller(self):
+        import ray_tpu
+        from .controller import CONTROLLER_NAME
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    async def refresh(self, force: bool = False):
+        if self._version >= 0 and not force:
+            return
+        ctrl = self._controller()
+        self._version, self._table = await self._aget(
+            ctrl.get_routing_table.remote())
+        _, self._routes = await self._aget(ctrl.get_http_routes.remote())
+        live = {r for reps in self._table.values() for r in reps}
+        self._handles = {k: v for k, v in self._handles.items() if k in live}
+
+    def ensure_poller(self):
+        if self._poller is None or self._poller.done():
+            self._poller = asyncio.get_event_loop().create_task(
+                self._poll_loop())
+
+    async def _poll_loop(self):
+        ctrl = self._controller()
+        while True:
+            try:
+                self._version, self._table = await self._aget(
+                    ctrl.wait_for_table_change.remote(self._version, 10.0))
+                _, self._routes = await self._aget(
+                    ctrl.get_http_routes.remote())
+                live = {r for reps in self._table.values() for r in reps}
+                self._handles = {k: v for k, v in self._handles.items()
+                                 if k in live}
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                await asyncio.sleep(1.0)
+
+    def match_route(self, path: str) -> Optional[Tuple[str, str]]:
+        """Longest-prefix route match -> (deployment, route_prefix)."""
+        best = None
+        for prefix, dep in self._routes.items():
+            if path == prefix or path.startswith(
+                    prefix if prefix.endswith("/") else prefix + "/"):
+                if best is None or len(prefix) > len(best[1]):
+                    best = (dep, prefix)
+        return best
+
+    def _handle_for(self, name: str):
+        import ray_tpu
+        h = self._handles.get(name)
+        if h is None:
+            h = ray_tpu.get_actor(name)
+            self._handles[name] = h
+        return h
+
+    async def choose(self, deployment: str, wait_s: float = 5.0) -> str:
+        await self.refresh()
+        deadline = asyncio.get_event_loop().time() + wait_s
+        while True:
+            replicas = self._table.get(deployment)
+            if replicas:
+                break
+            if asyncio.get_event_loop().time() > deadline:
+                raise LookupError(
+                    f"no running replicas for deployment {deployment!r}")
+            await self.refresh(force=True)
+            await asyncio.sleep(0.1)
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        return (a if self._inflight.get(a, 0) <= self._inflight.get(b, 0)
+                else b)
+
+    async def call(self, deployment: str, args: tuple, kwargs: dict,
+                   method: Optional[str] = None) -> Any:
+        """Route + call + retry-on-dead/draining-replica."""
+        from .router import is_retryable_failure
+        last: Optional[BaseException] = None
+        for _ in range(5):
+            name = await self.choose(deployment)
+            try:
+                h = self._handle_for(name)
+                ref = h.handle_request.remote(args, kwargs, method)
+            except Exception as e:  # noqa: BLE001 — dead name
+                last = e
+                self._evict(deployment, name)
+                continue
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+            try:
+                return await self._aget(ref)
+            except BaseException as e:  # noqa: BLE001
+                if not is_retryable_failure(e):
+                    raise
+                last = e
+                self._evict(deployment, name)
+            finally:
+                self._inflight[name] = max(
+                    0, self._inflight.get(name, 1) - 1)
+        raise last  # type: ignore[misc]
+
+    def _evict(self, deployment: str, name: str):
+        if name in self._table.get(deployment, []):
+            self._table[deployment].remove(name)
+        self._handles.pop(name, None)
+        try:
+            self._controller().report_replica_failure.remote(deployment, name)
+        except Exception:
+            pass
+
+
+class HTTPProxyActor:
+    """Async actor hosting the aiohttp server (one per ingress node)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self.router = AsyncRouter()
+        self._runner = None
+        self._streaming_deployments: set = set()
+
+    async def ready(self) -> int:
+        """Start the server; returns the bound port."""
+        if self._runner is not None:
+            return self.port
+        from aiohttp import web
+        self.router.ensure_poller()
+        app = web.Application()
+        app.router.add_route("GET", "/-/healthz", self._healthz)
+        app.router.add_route("GET", "/-/routes", self._routes)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        srv = list(self._runner.sites)[0]._server  # bound socket
+        if self.port == 0:
+            self.port = srv.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _healthz(self, request):
+        from aiohttp import web
+        return web.Response(text="ok")
+
+    async def _routes(self, request):
+        from aiohttp import web
+        await self.router.refresh(force=True)
+        return web.json_response(self.router._routes)
+
+    async def _handle(self, request):
+        from aiohttp import web
+        await self.router.refresh()
+        match = self.router.match_route(request.path)
+        if match is None:
+            return web.Response(status=404,
+                                text=f"no deployment at {request.path}")
+        deployment, prefix = match
+        body = await request.read()
+        req = Request(method=request.method,
+                      path=request.path[len(prefix):] or "/",
+                      query=dict(request.query),
+                      headers=dict(request.headers),
+                      body=body)
+        try:
+            if deployment in self._streaming_deployments:
+                return await self._stream_response(request, deployment, req)
+            try:
+                result = await self.router.call(deployment, (req,), {})
+            except Exception as e:
+                # A generator endpoint rejects the unary path with a
+                # TypeError (TaskError-wrapped): remember it as streaming
+                # and re-route through the chunked path.
+                cause = getattr(e, "cause", e)
+                if isinstance(cause, TypeError) and "streaming" in str(cause):
+                    self._streaming_deployments.add(deployment)
+                    return await self._stream_response(request, deployment,
+                                                       req)
+                raise
+            return self._pack(result)
+        except LookupError as e:
+            return web.Response(status=503, text=str(e))
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=500, text=repr(e))
+
+    async def _stream_response(self, http_request, deployment: str,
+                               req: Request):
+        from aiohttp import web
+        name = await self.router.choose(deployment)
+        h = self.router._handle_for(name)
+        stream_id = uuid.uuid4().hex
+        done_ref = h.handle_request_streaming.remote(stream_id, (req,), {},
+                                                     None)
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "text/plain; charset=utf-8"
+        await resp.prepare(http_request)
+        cursor, done = 0, False
+        while not done:
+            chunks, cursor, done = await self.router._aget(
+                h.next_chunks.remote(stream_id, cursor))
+            for c in chunks:
+                await resp.write(self._chunk_bytes(c))
+        await self.router._aget(done_ref)  # surface generator errors
+        await resp.write_eof()
+        return resp
+
+    @staticmethod
+    def _chunk_bytes(c: Any) -> bytes:
+        if isinstance(c, bytes):
+            return c
+        if isinstance(c, str):
+            return c.encode()
+        return (json.dumps(c) + "\n").encode()
+
+    def _pack(self, result: Any):
+        from aiohttp import web
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, bytes):
+            return web.Response(body=result,
+                                content_type="application/octet-stream")
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)
+
+    async def drain(self) -> bool:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        return True

@@ -18,9 +18,29 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.core.common import ActorDiedError, TaskError
+from ray_tpu.core.common import (ActorDiedError, ActorUnavailableError,
+                                 TaskError)
 
 CONTROLLER_NAME = "serve:controller"
+
+
+def is_retryable_failure(e: BaseException) -> bool:
+    """A request may be transparently re-routed when the failure is about the
+    *replica*, not the request: the replica died, became unreachable, or
+    rejected the request because it is draining (rolling update / scale-down).
+    """
+    if isinstance(e, (ActorDiedError, ActorUnavailableError)):
+        return True
+    if isinstance(e, TaskError):
+        cause = e.cause
+        if isinstance(cause, (ActorDiedError, ActorUnavailableError)):
+            return True
+        if isinstance(cause, RuntimeError) and "draining" in str(cause):
+            return True
+        # the runtime may re-wrap death as a plain message
+        if "ActorDiedError" in str(e) or "draining" in str(e):
+            return True
+    return False
 
 
 def _controller():
@@ -96,22 +116,33 @@ class Router:
 
     def assign(self, deployment: str, args: tuple, kwargs: dict,
                method: Optional[str] = None):
-        """Route one request; returns the result ObjectRef."""
+        """Route one request; returns (replica_name, result ObjectRef).
+
+        A replica whose name no longer resolves (actor died and was
+        deregistered) is evicted and the request re-routed."""
         last_err: Optional[Exception] = None
-        for _ in range(3):
+        for _ in range(5):
             name = self.choose_replica(deployment)
-            h = self._replica_handle(name)
+            try:
+                h = self._replica_handle(name)
+                ref = h.handle_request.remote(args, kwargs, method)
+            except Exception as e:  # noqa: BLE001 — dead name, submit fail
+                last_err = e
+                self._evict(deployment, name)
+                continue
             self._inflight[name] = self._inflight.get(name, 0) + 1
-            ref = h.handle_request.remote(args, kwargs, method)
-            self._attach_done(ref, name)
-            return ref
+            self._attach_done(ref, deployment, name)
+            return name, ref
         raise last_err or RuntimeError("routing failed")
 
-    def _attach_done(self, ref, name: str):
+    def _attach_done(self, ref, deployment: str, name: str):
         fut = ray_tpu.as_future(ref)
 
-        def _done(_):
+        def _done(f):
             self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+            exc = f.exception()
+            if isinstance(exc, (ActorDiedError, ActorUnavailableError)):
+                self._evict(deployment, name)
 
         fut.add_done_callback(_done)
 
@@ -119,11 +150,19 @@ class Router:
                      method: Optional[str] = None) -> tuple:
         """Kick off a streaming request; returns (replica_name, stream_id,
         completion ref)."""
-        name = self.choose_replica(deployment)
-        h = self._replica_handle(name)
-        stream_id = uuid.uuid4().hex
-        ref = h.handle_request_streaming.remote(stream_id, args, kwargs, method)
-        return name, stream_id, ref
+        last: Optional[Exception] = None
+        for _ in range(5):
+            name = self.choose_replica(deployment)
+            stream_id = uuid.uuid4().hex
+            try:
+                h = self._replica_handle(name)
+                ref = h.handle_request_streaming.remote(stream_id, args,
+                                                        kwargs, method)
+                return name, stream_id, ref
+            except Exception as e:  # noqa: BLE001
+                last = e
+                self._evict(deployment, name)
+        raise last or RuntimeError("routing failed")
 
 
 _router: Optional[Router] = None
@@ -144,10 +183,48 @@ def reset_router():
         _router = None
 
 
+class DeploymentResponse:
+    """The result of ``handle.remote(...)`` (reference: serve/handle.py
+    DeploymentResponse).  Submission is eager; ``result()`` blocks and
+    transparently re-routes to another replica if the assigned one died
+    before/while executing (at-least-once on replica death)."""
+
+    def __init__(self, deployment: str, args: tuple, kwargs: dict,
+                 method: Optional[str]):
+        self.deployment = deployment
+        self._args = args
+        self._kwargs = kwargs
+        self._method = method
+        self._replica, self._ref = get_router().assign(
+            deployment, args, kwargs, method)
+
+    def result(self, timeout_s: float = 60.0):
+        deadline = time.monotonic() + timeout_s
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            try:
+                return ray_tpu.get(self._ref,
+                                   timeout=max(0.1, deadline -
+                                               time.monotonic()))
+            except BaseException as e:  # noqa: BLE001
+                if not is_retryable_failure(e):
+                    raise
+                last = e
+                get_router()._evict(self.deployment, self._replica)
+                self._replica, self._ref = get_router().assign(
+                    self.deployment, self._args, self._kwargs, self._method)
+        raise last or TimeoutError(
+            f"no result from {self.deployment} in {timeout_s}s")
+
+    def _to_object_ref(self):
+        """The underlying ObjectRef (no retry semantics)."""
+        return self._ref
+
+
 class DeploymentHandle:
     """Calling surface for a deployment (reference: serve/handle.py:305).
 
-    ``h.remote(...)`` returns an ObjectRef (``ray_tpu.get`` it);
+    ``h.remote(...)`` returns a DeploymentResponse (``.result()`` it);
     ``h.method.remote(...)`` routes to a named method;
     ``h.stream(...)`` yields chunks from a generator endpoint.
     """
@@ -161,8 +238,8 @@ class DeploymentHandle:
             raise AttributeError(item)
         return DeploymentHandle(self.deployment, item)
 
-    def remote(self, *args, **kwargs):
-        return get_router().assign(self.deployment, args, kwargs, self.method)
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return DeploymentResponse(self.deployment, args, kwargs, self.method)
 
     def stream(self, *args, **kwargs):
         """Synchronous chunk iterator over a streaming endpoint."""
