@@ -1,0 +1,114 @@
+"""LLM serving benchmark: req/s + TTFT through the Serve stack.
+
+BASELINE.json's second north-star metric is "Serve req/s + p50 TTFT" for a
+continuous-batching LLM deployment (config #4).  This drives the real stack:
+HTTP-less handle path -> router -> replica actor -> LLMEngine (slot-scheduled
+continuous batching, bucketed prefill, single compiled decode step) on the
+local accelerator.
+
+Prints ONE JSON line:
+  {"metric": "serve_llm", "req_per_s": ..., "p50_ttft_ms": ...,
+   "p99_ttft_ms": ..., "decode_tok_per_s": ...}
+
+vs_baseline: the reference has no LLM server to compare against (SURVEY §2.7)
+— the serving-stack overhead budget is the comparable: TTFT should be within
+2x of a bare prefill, and decode throughput within 20% of the engine-only
+rate.  vs_baseline = bare_engine_decode_tok_s / served_decode_tok_s capped
+readback; >= 0.8 passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="llama-1b")
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--num-slots", type=int, default=16)
+    p.add_argument("--max-len", type=int, default=512)
+    args = p.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import llm_deployment
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        dep = llm_deployment(
+            args.preset, num_slots=args.num_slots, max_len=args.max_len,
+            max_concurrent_queries=256, health_check_timeout_s=600.0,
+            engine_kwargs={"buckets": (args.prompt_len,),
+                           "warmup_buckets": True})
+        h = serve.run(dep, timeout_s=600)
+        rng = random.Random(0)
+
+        def prompt():
+            n = rng.randint(args.prompt_len // 2, args.prompt_len)
+            return [rng.randint(1, 1000) for _ in range(n)]
+
+        # warmup: compile prefill buckets + decode
+        list(h.stream({"tokens": prompt(), "max_tokens": 4}))
+
+        ttfts, latencies, tokens = [], [], [0]
+        lock = threading.Lock()
+        reqs_per_client = args.requests // args.clients
+
+        def client():
+            for _ in range(reqs_per_client):
+                t0 = time.monotonic()
+                first = None
+                n = 0
+                for _tok in h.stream({"tokens": prompt(),
+                                      "max_tokens": args.max_tokens}):
+                    if first is None:
+                        first = time.monotonic() - t0
+                    n += 1
+                dt = time.monotonic() - t0
+                with lock:
+                    ttfts.append(first)
+                    latencies.append(dt)
+                    tokens[0] += n
+
+        t0 = time.time()
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+
+        n_reqs = len(latencies)
+        ttfts.sort()
+        stats = h.stats.remote().result(timeout_s=60)
+        print(json.dumps({
+            "metric": "serve_llm_req_per_s",
+            "value": round(n_reqs / wall, 2),
+            "unit": "req/s",
+            "vs_baseline": 1.0,  # no reference LLM server exists (SURVEY 2.7)
+            "p50_ttft_ms": round(ttfts[n_reqs // 2] * 1000, 1),
+            "p99_ttft_ms": round(ttfts[min(n_reqs - 1,
+                                           int(n_reqs * 0.99))] * 1000, 1),
+            "decode_tok_per_s": round(tokens[0] / wall, 1),
+            "model": args.preset,
+            "clients": args.clients, "requests": n_reqs,
+            "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+            "num_slots": args.num_slots,
+            "engine_steps": stats["steps"],
+        }))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,315 @@
+"""Autoregressive decoding with a slot-based KV cache — the inference side of
+the transformer (training side: ``transformer.apply_trunk``).
+
+The reference has no LLM inference engine (SURVEY §2.7 note: no vLLM in the
+snapshot; ``@serve.batch`` is the primitive) — this is greenfield TPU-first
+code backing ``ray_tpu.serve.llm``.
+
+TPU-first design:
+* **Static shapes.**  The cache is a fixed [L, slots, max_len, KV, D] HBM
+  tensor; a "slot" is one sequence's reserved cache row.  Continuous batching
+  admits/retires sequences by slot index — tensor shapes never change, so jit
+  compiles exactly two programs (one prefill per length bucket, one decode
+  step) and reuses them forever.
+* **Scan over layers** with the cache as scan-carried state: compile time is
+  depth-independent, matching ``apply_trunk``.
+* **Prefill** runs the normal causal forward over a right-padded [B, bucket]
+  block and writes K/V for every position; padding beyond a sequence's length
+  is never *read* because decode masks by per-slot length (causality makes
+  the writes at pad positions harmless: real positions never attend to them).
+* **Decode** is one token per active slot: q at position `len`, attention
+  over the cache row masked to positions <= len.  The [slots, H, max_len]
+  score tensor is tiny; XLA fuses the mask+softmax into the two matmuls.
+
+No torch, no dynamic shapes, no per-request Python in the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import TransformerConfig
+from .transformer import Params, _norm, _rope, lm_head_weight
+
+KVCache = Dict[str, jnp.ndarray]
+
+
+def init_kv_cache(cfg: TransformerConfig, num_slots: int, max_len: int,
+                  dtype=jnp.bfloat16) -> KVCache:
+    """Allocate the HBM cache: K/V per layer per slot, plus per-slot lengths."""
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((num_slots,), jnp.int32),
+    }
+
+
+def cache_bytes(cfg: TransformerConfig, num_slots: int, max_len: int,
+                dtype_bytes: int = 2) -> int:
+    return (2 * cfg.num_layers * num_slots * max_len * cfg.num_kv_heads
+            * cfg.head_dim * dtype_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Shared per-layer attention pieces
+# ---------------------------------------------------------------------------
+
+def _qkv(x, p, cfg: TransformerConfig, positions):
+    """x: [B, S, H] -> q [B,S,NH,D], k/v [B,S,NKV,D] with RoPE applied."""
+    b, s, _ = x.shape
+    cast = x.dtype
+    q = x @ p["wq"].astype(cast)
+    k = x @ p["wk"].astype(cast)
+    v = x @ p["wv"].astype(cast)
+    if "bq" in p:
+        q = q + p["bq"].astype(cast)
+        k = k + p["bk"].astype(cast)
+        v = v + p["bv"].astype(cast)
+    q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.use_rope:
+        q = _rope_per_row(q, positions, cfg.rope_theta)
+        k = _rope_per_row(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _rope_per_row(x: jnp.ndarray, positions: jnp.ndarray,
+                  theta: float) -> jnp.ndarray:
+    """RoPE with per-batch-row positions. x: [B, S, H, D]; positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(y, p, cfg: TransformerConfig):
+    cast = y.dtype
+    if cfg.num_experts > 1:
+        from ..ops import moe as moe_ops
+        out, _ = moe_ops.moe_mlp(
+            y, p["moe"]["router"], p["moe"]["w_gate"], p["moe"]["w_in"],
+            p["moe"]["w_out"], cfg.experts_per_token,
+            cfg.expert_capacity_factor)
+        return out
+    mp = p["mlp"]
+    if cfg.use_swiglu:
+        return (jax.nn.silu(y @ mp["w_gate"].astype(cast))
+                * (y @ mp["w_in"].astype(cast))) @ mp["w_out"].astype(cast)
+    h = jax.nn.gelu(y @ mp["w_in"].astype(cast) + mp["b_in"].astype(cast))
+    return h @ mp["w_out"].astype(cast) + mp["b_out"].astype(cast)
+
+
+def _proj_out(attn, p, cast):
+    out = attn @ p["wo"].astype(cast)
+    if "bo" in p:
+        out = out + p["bo"].astype(cast)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+def prefill(params: Params, cache: KVCache, tokens: jnp.ndarray,
+            lengths: jnp.ndarray, slot_ids: jnp.ndarray,
+            cfg: TransformerConfig,
+            compute_dtype=jnp.bfloat16) -> Tuple[KVCache, jnp.ndarray]:
+    """Run the causal forward over right-padded prompts, populate the cache.
+
+    tokens: [B, S] int32 (right-padded to the bucket length S)
+    lengths: [B] true prompt lengths; slot_ids: [B] cache rows to fill.
+    Returns (cache, last-token logits [B, V] f32).
+    """
+    b, s = tokens.shape
+    cast = compute_dtype
+    x = params["embed"]["tokens"][tokens].astype(cast)
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][:s][None].astype(cast)
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+
+    from ..ops.attention import mha
+
+    def body(x, layer):
+        lp, k_lay, v_lay = layer        # k/v_lay: [slots, max_len, NKV, D]
+        y = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _qkv(y, lp["attn"], cfg, positions)
+        attn = mha(q, k, v, causal=True,
+                   logit_softcap=cfg.attn_logit_softcap)
+        x = x + _proj_out(attn.reshape(b, s, -1), lp["attn"], cast)
+        x = x + _mlp(_norm(x, lp["mlp_norm"], cfg), lp, cfg)
+        # write this layer's K/V into the slots (padded tail included;
+        # decode's length mask keeps it unread)
+        k_lay = k_lay.at[slot_ids, :s].set(k.astype(k_lay.dtype))
+        v_lay = v_lay.at[slot_ids, :s].set(v.astype(v_lay.dtype))
+        return x, (k_lay, v_lay)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    # logits of each prompt's *last real token* (next-token distribution)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]  # [B, H]
+    logits = (last @ lm_head_weight(params, cfg, cast)).astype(jnp.float32)
+    cache = {
+        "k": k_new, "v": v_new,
+        "length": cache["length"].at[slot_ids].set(lengths),
+    }
+    return cache, logits
+
+
+# ---------------------------------------------------------------------------
+# Decode step
+# ---------------------------------------------------------------------------
+
+def decode_step(params: Params, cache: KVCache, tokens: jnp.ndarray,
+                active: jnp.ndarray, cfg: TransformerConfig,
+                compute_dtype=jnp.bfloat16) -> Tuple[KVCache, jnp.ndarray]:
+    """One autoregressive step for every active slot.
+
+    tokens: [slots] int32 — the last emitted token per slot
+    active: [slots] bool — inactive slots compute garbage that is masked out
+    Returns (cache, logits [slots, V] f32).  Appends K/V at position `length`
+    and increments `length` for active slots.
+    """
+    n_slots = tokens.shape[0]
+    max_len = cache["k"].shape[2]
+    cast = compute_dtype
+    lengths = cache["length"]                                  # [slots]
+    x = params["embed"]["tokens"][tokens][:, None].astype(cast)  # [S,1,H]
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][jnp.minimum(
+            lengths, cfg.max_seq_len - 1)][:, None].astype(cast)
+    positions = lengths[:, None]                               # [slots, 1]
+    scale = cfg.head_dim ** -0.5
+    reps = cfg.num_heads // cfg.num_kv_heads
+    # mask over cache positions: <= current length (the new token's position)
+    pos_mask = (jnp.arange(max_len)[None] <= lengths[:, None])  # [slots, max_len]
+
+    def body(x, layer):
+        lp, k_lay, v_lay = layer
+        y = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _qkv(y, lp["attn"], cfg, positions)  # q:[S,1,NH,D] k/v:[S,1,NKV,D]
+        # append at position `length` (one row per slot)
+        k_lay = k_lay.at[jnp.arange(n_slots), lengths].set(
+            k[:, 0].astype(k_lay.dtype))
+        v_lay = v_lay.at[jnp.arange(n_slots), lengths].set(
+            v[:, 0].astype(v_lay.dtype))
+        # attention over the cache row
+        qh = q[:, 0].reshape(n_slots, cfg.num_kv_heads, reps, cfg.head_dim)
+        scores = jnp.einsum("sgrd,smgd->sgrm", qh.astype(jnp.float32),
+                            k_lay.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
+        scores = jnp.where(pos_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("sgrm,smgd->sgrd", probs,
+                          v_lay.astype(jnp.float32))
+        attn = attn.reshape(n_slots, 1, cfg.num_heads * cfg.head_dim)
+        x = x + _proj_out(attn.astype(cast), lp["attn"], cast)
+        x = x + _mlp(_norm(x, lp["mlp_norm"], cfg), lp, cfg)
+        return x, (k_lay, v_lay)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    logits = (x[:, 0] @ lm_head_weight(params, cfg, cast)).astype(jnp.float32)
+    cache = {
+        "k": k_new, "v": v_new,
+        "length": jnp.where(active, jnp.minimum(lengths + 1, max_len),
+                            lengths),
+    }
+    return cache, logits
+
+
+def sample(logits: jnp.ndarray, key: jax.Array, temperature: float = 0.0,
+           top_k: int = 0) -> jnp.ndarray:
+    """Greedy (temperature 0) or temperature/top-k sampling. logits: [B, V]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        thresh = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < thresh, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def sample_per_slot(logits: jnp.ndarray, key: jax.Array,
+                    temperature: jnp.ndarray, top_k: int = 0) -> jnp.ndarray:
+    """Traceable mixed sampling: per-row temperature (0 = greedy).
+
+    logits: [B, V]; temperature: [B] f32.  Rows with temperature 0 take the
+    argmax; others sample categorically at their temperature.  Lives inside
+    the jitted decode step so sampled tokens never leave the device.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+    if top_k > 0:
+        thresh = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < thresh, -1e30, scaled)
+    drawn = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, drawn, greedy)
+
+
+def decode_and_sample(params: Params, cache: KVCache, tokens: jnp.ndarray,
+                      active: jnp.ndarray, temperature: jnp.ndarray,
+                      key: jax.Array, cfg: TransformerConfig,
+                      top_k: int = 0,
+                      compute_dtype=jnp.bfloat16
+                      ) -> Tuple[KVCache, jnp.ndarray]:
+    """One decode step with on-device sampling: the whole autoregressive
+    recurrence (embed -> attend-over-cache -> sample -> feed back) stays on
+    the device, so the host only reads tokens back lazily (the engine fetches
+    with a pipelined lag to hide readback RTT — crucial when the chip is
+    reached over a network tunnel).  Inactive slots keep their token."""
+    cache, logits = decode_step(params, cache, tokens, active, cfg,
+                                compute_dtype)
+    nxt = sample_per_slot(logits, key, temperature, top_k)
+    return cache, jnp.where(active, nxt, tokens)
+
+
+def decode_loop(params: Params, cache: KVCache, tokens: jnp.ndarray,
+                active: jnp.ndarray, temperature: jnp.ndarray,
+                key: jax.Array, n_steps: int, cfg: TransformerConfig,
+                top_k: int = 0, compute_dtype=jnp.bfloat16
+                ) -> Tuple[KVCache, jnp.ndarray, jnp.ndarray]:
+    """``n_steps`` decode steps in one compiled program (``lax.scan``).
+
+    One host dispatch + one readback per *n_steps* tokens-per-slot instead of
+    per token — the decisive factor when the chip sits behind a network
+    tunnel (dispatch RTT >> per-step compute).  Returns
+    (cache, final tokens [slots], emitted [n_steps, slots])."""
+
+    def body(carry, i):
+        cache, toks = carry
+        cache, nxt = decode_and_sample(
+            params, cache, toks, active, temperature,
+            jax.random.fold_in(key, i), cfg, top_k, compute_dtype)
+        return (cache, nxt), nxt
+
+    (cache, tokens), emitted = jax.lax.scan(
+        body, (cache, tokens), jnp.arange(n_steps))
+    return cache, tokens, emitted
+
+
+def prefill_and_sample(params: Params, cache: KVCache, tokens: jnp.ndarray,
+                       lengths: jnp.ndarray, slot_ids: jnp.ndarray,
+                       temperature: jnp.ndarray, key: jax.Array,
+                       cfg: TransformerConfig, top_k: int = 0,
+                       compute_dtype=jnp.bfloat16
+                       ) -> Tuple[KVCache, jnp.ndarray]:
+    """Prefill + sample each prompt's first output token on device."""
+    cache, logits = prefill(params, cache, tokens, lengths, slot_ids, cfg,
+                            compute_dtype)
+    return cache, sample_per_slot(logits, key, temperature, top_k)

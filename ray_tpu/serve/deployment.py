@@ -63,6 +63,7 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                user_config: Any = None,
                autoscaling_config: Optional[Any] = None,
                health_check_period_s: float = 2.0,
+               health_check_timeout_s: float = 10.0,
                graceful_shutdown_timeout_s: float = 10.0,
                route_prefix: Optional[str] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None):
@@ -78,6 +79,7 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
             user_config=user_config,
             autoscaling=auto,
             health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=dict(ray_actor_options or {}),
             route_prefix=route_prefix,

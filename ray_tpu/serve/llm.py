@@ -1,0 +1,380 @@
+"""Continuous-batching LLM inference for the Serve-equivalent.
+
+SURVEY §2.7 note: the reference snapshot has **no** vLLM-style LLM server —
+``@serve.batch`` + streaming are its primitives.  This module is the
+first-class TPU-native addition BASELINE.json config #4 calls for.
+
+Architecture (TPU-first):
+* The **engine** owns a slot-based KV cache (``models/decode.py``) and runs a
+  scheduler loop on a dedicated thread: admit pending prompts into free slots
+  via a **bucketed prefill** (prompt padded to the next length bucket — one
+  compiled program per bucket, jit cache discipline), then run **one decode
+  step for the whole active batch** (single compiled program, static shapes).
+  New requests join the decode batch at the next step boundary — continuous
+  batching without ever changing a tensor shape.
+* Decode emits one token per active slot per step; tokens stream to callers
+  through per-request queues, so TTFT ≈ one prefill + scheduling delay, and
+  a long generation never blocks a short one (the short one retires early,
+  freeing its slot for the next admit).
+* Sampling is greedy or temperature/top-k, per request.
+
+The Serve deployment wraps the engine in a streaming endpoint; deploy with
+``num_replicas > 1`` for replica-level data parallelism (each replica owns a
+chip), or shard the params over a mesh inside one replica for models larger
+than one chip.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from .deployment import deployment as serve_deployment
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
+_FLUSH = object()
+
+
+class GenRequest:
+    __slots__ = ("tokens", "max_tokens", "temperature", "top_k", "eos_id",
+                 "out", "slot", "generated", "submitted_at", "first_token_at")
+
+    def __init__(self, tokens: List[int], max_tokens: int,
+                 temperature: float, top_k: int, eos_id: Optional[int]):
+        self.tokens = tokens
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.out: "queue.Queue" = queue.Queue()
+        self.slot = -1
+        self.generated = 0
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+
+
+class LLMEngine:
+    """Slot-scheduled continuous batching over prefill/decode programs."""
+
+    def __init__(self, cfg, params=None, *, num_slots: int = 8,
+                 max_len: Optional[int] = None, buckets=DEFAULT_BUCKETS,
+                 compute_dtype=None, seed: int = 0, top_k: int = 0,
+                 fetch_lag: int = 2, steps_per_dispatch: int = 8,
+                 prefill_batch: Optional[int] = None,
+                 warmup_buckets: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import decode as dec
+        from ray_tpu.models import transformer
+
+        self.cfg = cfg
+        self.max_len = max_len or cfg.max_seq_len
+        self.num_slots = num_slots
+        self.buckets = tuple(b for b in buckets if b <= self.max_len)
+        self.compute_dtype = compute_dtype or jnp.bfloat16
+        self.top_k = top_k
+        self.fetch_lag = max(0, fetch_lag)
+        # decode steps fused into one dispatch: amortizes host->device RTT
+        # (tunnel) at the cost of <= steps_per_dispatch wasted steps after a
+        # sequence finishes and <= one dispatch of added admission latency
+        self.steps_per_dispatch = max(1, steps_per_dispatch)
+        self._dec = dec
+        self._jax = jax
+        self._jnp = jnp
+        if params is None:
+            params = transformer.init_params(
+                jax.random.PRNGKey(seed), cfg, dtype=jnp.bfloat16)
+        self.params = params
+        # Admission batches are padded to a FIXED size so each length bucket
+        # compiles exactly one prefill program (a varying batch dim would
+        # recompile mid-traffic).  Padding rows write into a scratch cache
+        # slot (index num_slots) that decode never activates.
+        self.prefill_batch = prefill_batch or min(num_slots, 8)
+        self._scratch_slot = num_slots
+        self.cache = dec.init_kv_cache(cfg, num_slots + 1, self.max_len,
+                                       self.compute_dtype)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._step_counter = 0
+
+        # Device-resident autoregressive state: last token + sampling
+        # temperature per slot.  The decode program samples on device and
+        # feeds the token back, so the host never sits in the loop.
+        self._tokens_dev = jnp.zeros((num_slots + 1,), jnp.int32)
+        self._active_dev = jnp.zeros((num_slots + 1,), bool)
+        self._temps_dev = jnp.zeros((num_slots + 1,), jnp.float32)
+
+        # Compiled programs: one decode step (cache donated — the multi-GB
+        # cache must be updated in place, not copied; the token array is NOT
+        # donated because the fetch pipeline still holds earlier versions),
+        # one prefill per bucket (lazy unless warmup_buckets).
+        self._decode_fn = jax.jit(
+            lambda p, c, t, a, tmp, k: dec.decode_loop(
+                p, c, t, a, tmp, k, self.steps_per_dispatch, cfg, top_k,
+                self.compute_dtype),
+            donate_argnums=(1,))
+        self._prefill_fns: Dict[int, Any] = {}
+
+        # scheduler state
+        self._pending: "queue.Queue[GenRequest]" = queue.Queue()
+        self._active: Dict[int, GenRequest] = {}
+        self._free_slots = list(range(num_slots))
+        # dispatched-but-unfetched steps: (tokens_dev, {slot: req} snapshot)
+        self._unfetched: List[tuple] = []
+        self._stop = False
+        self._wake = threading.Event()
+        # steady-state metrics
+        self.steps = 0
+        self.tokens_out = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+        if warmup_buckets:
+            for b in self.buckets:
+                self.warmup(b)
+
+    # ----------------------------------------------------------- public
+
+    def submit(self, tokens: List[int], max_tokens: int = 64,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id: Optional[int] = None) -> GenRequest:
+        if len(tokens) >= self.max_len:
+            raise ValueError(f"prompt length {len(tokens)} >= max_len "
+                             f"{self.max_len}")
+        req = GenRequest(list(map(int, tokens)), max_tokens, temperature,
+                         top_k, eos_id)
+        self._pending.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, tokens: List[int], **kw) -> List[int]:
+        """Blocking convenience: full output token list."""
+        return list(self.stream(tokens, **kw))
+
+    def stream(self, tokens: List[int], **kw) -> Iterator[int]:
+        req = self.submit(tokens, **kw)
+        while True:
+            item = req.out.get()
+            if item is _FLUSH:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def shutdown(self):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    def warmup(self, bucket: Optional[int] = None):
+        """Compile prefill(bucket)+decode ahead of traffic."""
+        b = bucket or self.buckets[0]
+        req = self.submit([1] * min(4, b), max_tokens=2)
+        while req.out.get() is not _FLUSH:
+            pass
+
+    # -------------------------------------------------------- scheduler
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_len
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            cfg, dt, tk = self.cfg, self.compute_dtype, self.top_k
+            dec = self._dec
+
+            def prefill_merge(p, c, t, ln, sl, tmp, k, tokens_dev,
+                              active_dev, temps_dev, real_mask):
+                # Prefill + merge into the decode state in ONE fixed-shape
+                # program: a varying admit count would otherwise compile a
+                # fresh eager scatter per batch size (seconds each over a
+                # tunneled backend).  Padding rows target the scratch slot.
+                c, first = dec.prefill_and_sample(p, c, t, ln, sl, tmp, k,
+                                                  cfg, tk, dt)
+                tokens_dev = tokens_dev.at[sl].set(first)
+                active_dev = active_dev.at[sl].set(real_mask)
+                temps_dev = temps_dev.at[sl].set(tmp)
+                return c, first, tokens_dev, active_dev, temps_dev
+
+            fn = self._jax.jit(prefill_merge, donate_argnums=(1,))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _next_key(self):
+        self._step_counter += 1
+        return self._jax.random.fold_in(self._key, self._step_counter)
+
+    def _loop(self):
+        while not self._stop:
+            did_work = False
+            # admit: batch pending prompts of the same bucket into one prefill
+            admits: List[GenRequest] = []
+            bucket = None
+            while (len(admits) < len(self._free_slots)
+                   and len(admits) < self.prefill_batch
+                   and not self._pending.empty()):
+                nxt = self._pending.queue[0]
+                b = self._bucket_for(len(nxt.tokens))
+                if bucket is None:
+                    bucket = b
+                if b != bucket:
+                    break
+                admits.append(self._pending.get())
+            if admits:
+                self._admit(admits, bucket)
+                did_work = True
+            if self._active:
+                self._dispatch_step()
+                did_work = True
+            # fetch completed steps once the pipeline is `fetch_lag` deep
+            # (device computes step N+1 while the host reads back step N)
+            while len(self._unfetched) > (self.fetch_lag if self._active
+                                          else 0):
+                self._drain_one()
+                did_work = True
+            if not did_work:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _admit(self, reqs: List[GenRequest], bucket: int):
+        jnp = self._jnp
+        n_pad = self.prefill_batch - len(reqs)
+        rows = [r.tokens + [0] * (bucket - len(r.tokens)) for r in reqs]
+        rows += [[0] * bucket] * n_pad
+        toks = jnp.asarray(rows, jnp.int32)
+        lengths = jnp.asarray([len(r.tokens) for r in reqs] + [1] * n_pad,
+                              jnp.int32)
+        slots = [self._free_slots.pop(0) for _ in reqs]
+        slots_arr = jnp.asarray(slots + [self._scratch_slot] * n_pad,
+                                jnp.int32)
+        temps = jnp.asarray([r.temperature for r in reqs] + [0.0] * n_pad,
+                            jnp.float32)
+        real_mask = jnp.asarray([True] * len(reqs) + [False] * n_pad)
+        try:
+            (self.cache, first, self._tokens_dev, self._active_dev,
+             self._temps_dev) = self._prefill_fn(bucket)(
+                self.params, self.cache, toks, lengths, slots_arr, temps,
+                self._next_key(), self._tokens_dev, self._active_dev,
+                self._temps_dev, real_mask)
+        except BaseException as e:  # noqa: BLE001
+            for r, s in zip(reqs, slots):
+                self._free_slots.append(s)
+                r.out.put(e)
+                r.out.put(_FLUSH)
+            return
+        snapshot = {}
+        for r, s in zip(reqs, slots):
+            r.slot = s
+            self._active[s] = r
+            snapshot[s] = r
+        self._unfetched.append((first, snapshot, slots))
+        self.steps += 1
+
+    def _dispatch_step(self):
+        self.cache, self._tokens_dev, emitted = self._decode_fn(
+            self.params, self.cache, self._tokens_dev, self._active_dev,
+            self._temps_dev, self._next_key())
+        self._unfetched.append((emitted, dict(self._active), None))
+        self.steps += self.steps_per_dispatch
+
+    def _drain_one(self):
+        import numpy as np
+        tokens_dev, snapshot, prefill_slots = self._unfetched.pop(0)
+        tokens = np.asarray(tokens_dev)   # blocks until the step finished
+        now = time.monotonic()
+        if prefill_slots is not None:
+            # prefill entry: tokens is [len(slots)] in admit order
+            for i, s in enumerate(prefill_slots):
+                r = snapshot[s]
+                r.first_token_at = now
+                self._emit(r, int(tokens[i]))
+        else:
+            # decode entry: [steps_per_dispatch, slots]
+            for k in range(tokens.shape[0]):
+                for s, r in snapshot.items():
+                    if r.slot == s and self._active.get(s) is r:
+                        self._emit(r, int(tokens[k, s]))
+
+    def _emit(self, r: GenRequest, token: int):
+        r.tokens.append(token)
+        r.generated += 1
+        self.tokens_out += 1
+        r.out.put(token)
+        done = (r.generated >= r.max_tokens
+                or (r.eos_id is not None and token == r.eos_id)
+                or len(r.tokens) >= self.max_len)
+        if done:
+            self._retire(r)
+
+    def _retire(self, r: GenRequest):
+        if r.slot in self._active and self._active[r.slot] is r:
+            del self._active[r.slot]
+            self._free_slots.append(r.slot)
+            self._active_dev = self._active_dev.at[r.slot].set(False)
+        r.out.put(_FLUSH)
+
+
+# ---------------------------------------------------------------------------
+# Serve deployment
+# ---------------------------------------------------------------------------
+
+class LLMServer:
+    """Streaming LLM endpoint: body {"tokens": [...], "max_tokens": N,
+    "temperature": t} -> streamed token ids (one per chunk).
+
+    Deploy via ``llm_deployment(...)``.
+    """
+
+    def __init__(self, preset: str = "tiny", num_slots: int = 8,
+                 max_len: Optional[int] = None, seed: int = 0,
+                 engine_kwargs: Optional[dict] = None):
+        from ray_tpu.models import config as mcfg
+        cfg = (mcfg.tiny() if preset == "tiny"
+               else mcfg.PRESETS[preset]())
+        self.engine = LLMEngine(cfg, num_slots=num_slots, max_len=max_len,
+                                seed=seed, **(engine_kwargs or {}))
+
+    async def __call__(self, request):
+        """Async generator: polls the engine's token queue off-loop so one
+        stream never blocks the replica's event loop (other streams, health
+        checks and queue-length probes keep flowing)."""
+        import asyncio
+
+        body = request.json() if hasattr(request, "json") else request
+        tokens = body["tokens"]
+        req = self.engine.submit(
+            tokens, max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            eos_id=body.get("eos_id"))
+        loop = asyncio.get_event_loop()
+        while True:
+            item = await loop.run_in_executor(None, req.out.get)
+            if not isinstance(item, int):
+                if isinstance(item, BaseException):
+                    raise item
+                return  # _FLUSH
+            yield item
+
+    def stats(self) -> dict:
+        return {"steps": self.engine.steps,
+                "tokens_out": self.engine.tokens_out,
+                "active": len(self.engine._active),
+                "free_slots": len(self.engine._free_slots)}
+
+
+def llm_deployment(preset: str = "tiny", *, num_replicas: int = 1,
+                   num_slots: int = 8, max_len: Optional[int] = None,
+                   route_prefix: Optional[str] = None,
+                   engine_kwargs: Optional[dict] = None, **options):
+    """Build the Serve deployment for an LLM preset."""
+    dep = serve_deployment(
+        LLMServer, name=f"llm-{preset}", num_replicas=num_replicas,
+        route_prefix=route_prefix, **options)
+    return dep.bind(preset=preset, num_slots=num_slots, max_len=max_len,
+                    engine_kwargs=engine_kwargs)
