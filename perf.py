@@ -1,0 +1,176 @@
+"""Core microbenchmarks — the ``ray_perf.py`` equivalent.
+
+Reference harness: ``python/ray/_private/ray_perf.py``; reference numbers:
+BASELINE.md "Core microbenchmarks" (v2.6.3 release log, m4.16xlarge-class,
+64 cores).  This box is 1 core, so absolute numbers are not comparable 1:1 —
+the table tracks round-over-round movement of the pure-Python substrate and
+flags order-of-magnitude regressions vs the reference envelope.
+
+Run: ``python perf.py [--out PERF.json]`` — prints one JSON object with every
+metric, and a ``vs_baseline`` per metric where BASELINE.md has a row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+BASELINE = {
+    "tasks_sync": 1329.0,
+    "tasks_async": 10940.0,
+    "actor_calls_sync_1_1": 2528.0,
+    "actor_calls_async_1_1": 8233.0,
+    "actor_calls_async_n_n": 32688.0,
+    "async_actor_calls_sync_1_1": 1520.0,
+    "async_actor_calls_async_1_1": 2683.0,
+    "get_small": 6144.0,
+    "put_gbps": 18.4,
+    "wait_1k_refs": 5.1,
+    "pg_create_remove": 983.0,
+    "serve_noop_req_s": 630.0,
+}
+
+
+def timeit(fn, n: int, warmup: int = 1) -> float:
+    """ops/s of fn() called n times (fn itself may batch internally)."""
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="shrink/grow iteration counts")
+    p.add_argument("--serve", action="store_true",
+                   help="include the Serve noop benchmark (slower)")
+    args = p.parse_args()
+    S = args.scale
+
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    results = {}
+
+    @ray_tpu.remote
+    def noop(_x=None):
+        return None
+
+    @ray_tpu.remote
+    class Counter:
+        def ping(self):
+            return None
+
+    @ray_tpu.remote
+    class AsyncCounter:
+        async def ping(self):
+            return None
+
+    try:
+        # warm the worker pool
+        ray_tpu.get([noop.remote() for _ in range(8)])
+
+        n = int(200 * S)
+        results["tasks_sync"] = timeit(
+            lambda: [ray_tpu.get(noop.remote()) for _ in range(n)], n)
+
+        n = int(1000 * S)
+        results["tasks_async"] = timeit(
+            lambda: ray_tpu.get([noop.remote() for _ in range(n)]), n)
+
+        a = Counter.remote()
+        ray_tpu.get(a.ping.remote())
+        n = int(300 * S)
+        results["actor_calls_sync_1_1"] = timeit(
+            lambda: [ray_tpu.get(a.ping.remote()) for _ in range(n)], n)
+
+        n = int(2000 * S)
+        results["actor_calls_async_1_1"] = timeit(
+            lambda: ray_tpu.get([a.ping.remote() for _ in range(n)]), n)
+
+        actors = [Counter.remote() for _ in range(4)]
+        ray_tpu.get([x.ping.remote() for x in actors])
+        n = int(2000 * S)
+        results["actor_calls_async_n_n"] = timeit(
+            lambda: ray_tpu.get([actors[i % 4].ping.remote()
+                                 for i in range(n)]), n)
+
+        aa = AsyncCounter.remote()
+        ray_tpu.get(aa.ping.remote())
+        n = int(300 * S)
+        results["async_actor_calls_sync_1_1"] = timeit(
+            lambda: [ray_tpu.get(aa.ping.remote()) for _ in range(n)], n)
+        n = int(2000 * S)
+        results["async_actor_calls_async_1_1"] = timeit(
+            lambda: ray_tpu.get([aa.ping.remote() for _ in range(n)]), n)
+
+        small = ray_tpu.put(np.zeros(16))
+        n = int(2000 * S)
+        results["get_small"] = timeit(
+            lambda: [ray_tpu.get(small) for _ in range(n)], n)
+
+        big = np.zeros(64 * 1024 * 1024, np.uint8)  # 64 MB
+        n = max(int(8 * S), 2)
+
+        def put_big():
+            for _ in range(n):
+                ray_tpu.put(big)
+
+        ops = timeit(put_big, n)
+        results["put_gbps"] = ops * big.nbytes / 1e9
+
+        refs = [noop.remote() for _ in range(1000)]
+        ray_tpu.get(refs)
+        n = max(int(20 * S), 5)
+        results["wait_1k_refs"] = timeit(
+            lambda: [ray_tpu.wait(refs, num_returns=1000, timeout=10)
+                     for _ in range(n)], n)
+
+        n = max(int(20 * S), 5)
+
+        def pg_cycle():
+            for _ in range(n):
+                pg = ray_tpu.placement_group([{"CPU": 1}])
+                ray_tpu.get(pg.ready(), timeout=30)
+                ray_tpu.remove_placement_group(pg)
+
+        results["pg_create_remove"] = timeit(pg_cycle, n)
+
+        if args.serve:
+            from ray_tpu import serve
+
+            @serve.deployment(num_replicas=2)
+            def snoop(_x=None):
+                return b"ok"
+
+            h = serve.run(snoop)
+            for _ in range(20):
+                h.remote().result()
+            n = int(300 * S)
+            results["serve_noop_req_s"] = timeit(
+                lambda: [h.remote().result() for _ in range(n)], n)
+            serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    out = {"metric": "core_microbench", "unit": "ops/s",
+           "results": {k: round(v, 1) for k, v in results.items()},
+           "vs_baseline": {k: round(results[k] / BASELINE[k], 3)
+                           for k in results if k in BASELINE}}
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

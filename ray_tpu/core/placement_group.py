@@ -2,9 +2,11 @@
 ``placement_group()`` :146, strategies PACK/SPREAD/STRICT_PACK/STRICT_SPREAD :18-19).
 
 Bundles are reserved across node agents with 2-phase prepare/commit by the GCS PG
-manager.  For TPU pods, bundle packing is ICI-topology-aware: nodes carry
-``tpu_slice``/``ici_coord`` labels and STRICT_PACK keeps bundles ICI-contiguous
-(SURVEY §2.3 row "Placement/locality").
+manager.  For TPU pods, bundle packing is ICI-topology-aware (SURVEY §2.3 row
+"Placement/locality"): nodes carry ``tpu_slice``/``ici_coord`` labels;
+multi-node PACK spills onto same-slice nodes nearest in ICI hops, and
+STRICT_SPREAD selects the node set with minimal ICI diameter (a contiguous
+sub-torus when one is free) — see ``scheduling.pack_bundles``.
 """
 
 from __future__ import annotations

@@ -111,13 +111,44 @@ def pick_node(view: Dict[str, NodeView],
     return rng.choice(ranked[:k])
 
 
+def _ici_coord(n: NodeView) -> Optional[tuple]:
+    """Parse the node's ICI torus coordinate label ("x,y" / "x,y,z")."""
+    raw = (n.labels or {}).get("ici_coord")
+    if not raw:
+        return None
+    try:
+        return tuple(int(p) for p in str(raw).split(","))
+    except ValueError:
+        return None
+
+
+def _ici_distance(a: tuple, b: tuple) -> int:
+    """Manhattan hop distance between two ICI coordinates (a proxy for the
+    number of ICI links a collective must traverse)."""
+    return sum(abs(x - y) for x, y in zip(a, b))
+
+
+def _ici_span(coords: List[tuple]) -> int:
+    """Max pairwise hop distance — the diameter of a placement.  Contiguous
+    sub-tori minimize this, which is what keeps psum/all-gather on short ICI
+    paths instead of crossing the slice."""
+    return max((_ici_distance(a, b) for a in coords for b in coords),
+               default=0)
+
+
 def pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
                  strategy: str) -> Optional[List[str]]:
-    """Placement-group bundle packing (reference: bundle_scheduling_policy.h).
+    """Placement-group bundle packing (reference: bundle_scheduling_policy.h)
+    with the TPU extension SURVEY §2.3 calls for: nodes carrying
+    ``tpu_slice``/``ici_coord`` labels are packed ICI-contiguously.
 
-    Returns a node_id per bundle or None if infeasible.  STRICT_PACK puts every
-    bundle on one node; PACK prefers few nodes; SPREAD prefers distinct nodes;
-    STRICT_SPREAD requires distinct nodes.
+    Returns a node_id per bundle or None if infeasible.  STRICT_PACK puts
+    every bundle on one node; PACK prefers few nodes — and among multi-node
+    spills, same-slice nodes nearest (in ICI hops) to the nodes already
+    chosen; SPREAD prefers distinct nodes; STRICT_SPREAD requires distinct
+    nodes and, when the candidates have ICI coordinates, picks the seed whose
+    greedy nearest-neighbor set minimizes the placement's ICI diameter (a
+    contiguous sub-torus when one is free).
     """
     alive = {nid: NodeView(n.node_id, n.address, dict(n.total), dict(n.available),
                            n.labels, n.alive, n.queue_len)
@@ -148,13 +179,56 @@ def pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
             for k, v in saved.items():
                 alive[k].available = v
         return None
+    def ici_key(nid: str, placed: List[str]):
+        """(slice mismatch, ICI hops to the nearest already-placed node) —
+        zeros when topology labels are absent, so plain clusters keep the
+        original ordering."""
+        n = alive[nid]
+        placed_nodes = [alive[p] for p in dict.fromkeys(placed)]
+        if not placed_nodes:
+            return (0, 0)
+        slices = {(p.labels or {}).get("tpu_slice") for p in placed_nodes}
+        my_slice = (n.labels or {}).get("tpu_slice")
+        slice_penalty = 0 if (my_slice in slices or my_slice is None) else 1
+        c = _ici_coord(n)
+        pcoords = [pc for pc in (_ici_coord(p) for p in placed_nodes)
+                   if pc is not None]
+        hops = (min(_ici_distance(c, pc) for pc in pcoords)
+                if c is not None and pcoords else 0)
+        return (slice_penalty, hops)
+
     if strategy == "PACK":
         return try_place(lambda i, pl: sorted(
-            alive, key=lambda nid: (nid not in pl, alive[nid].utilization())))
+            alive, key=lambda nid: (nid not in pl, *ici_key(nid, pl),
+                                    alive[nid].utilization())))
     if strategy == "SPREAD":
         return try_place(lambda i, pl: sorted(
             alive, key=lambda nid: (pl.count(nid), alive[nid].utilization())))
     if strategy == "STRICT_SPREAD":
+        coords = {nid: _ici_coord(alive[nid]) for nid in alive}
+        if len(bundles) > 1 and sum(c is not None
+                                    for c in coords.values()) >= len(bundles):
+            # Topology-aware: greedy nearest-neighbor growth from every seed;
+            # keep the placement with the smallest ICI diameter.
+            best, best_span = None, None
+            for seed in alive:
+                if coords[seed] is None:
+                    continue
+                saved = {k: dict(v.available) for k, v in alive.items()}
+                order = sorted(
+                    (nid for nid in alive if coords[nid] is not None),
+                    key=lambda nid: (_ici_distance(coords[seed], coords[nid]),
+                                     alive[nid].utilization()))
+                p = try_place(lambda i, pl, order=order:
+                              [nid for nid in order if nid not in pl])
+                for k, v in saved.items():
+                    alive[k].available = v
+                if p is not None:
+                    span = _ici_span([coords[nid] for nid in p])
+                    if best_span is None or span < best_span:
+                        best, best_span = p, span
+            if best is not None:
+                return best
         return try_place(lambda i, pl: [nid for nid in sorted(
             alive, key=lambda n2: alive[n2].utilization()) if nid not in pl])
     raise ValueError(f"unknown placement strategy {strategy}")

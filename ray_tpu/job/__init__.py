@@ -1,0 +1,13 @@
+"""ray_tpu.job — job submission (reference: dashboard/modules/job).
+
+``JobManager`` (a named actor) accepts entrypoint commands, runs each one in
+a ``JobSupervisor`` actor as a subprocess with the cluster address injected,
+captures logs, and tracks status in the GCS KV — the reference's
+``job_manager.py:517`` flow without the dashboard dependency.
+"""
+
+from .manager import (JobInfo, JobManager, JobSubmissionClient, PENDING,
+                      RUNNING, STOPPED, SUCCEEDED, FAILED)
+
+__all__ = ["JobManager", "JobSubmissionClient", "JobInfo", "PENDING",
+           "RUNNING", "STOPPED", "SUCCEEDED", "FAILED"]

@@ -1,0 +1,294 @@
+"""Job manager + supervisor actors.
+
+Reference: ``dashboard/modules/job/job_manager.py:517`` (JobManager: per-job
+JobSupervisor actor; entrypoint as its subprocess; status + logs retrievable
+after the fact) and ``job_submission/JobSubmissionClient``.
+
+The supervisor runs the entrypoint with ``RAYTPU_GCS_ADDRESS`` exported, so a
+driver script that calls ``ray_tpu.init(address="auto")`` joins the same
+cluster.  ``working_dir`` support ships a tarball through the object store
+and unpacks it as the subprocess cwd (the seed of the reference's runtime-env
+packaging: ``_private/runtime_env/packaging.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+import tarfile
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+MANAGER_NAME = "_job_manager"
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = PENDING
+    submitted_at: float = field(default_factory=time.time)
+    finished_at: Optional[float] = None
+    exit_code: Optional[int] = None
+    message: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+    logs: str = ""  # cached at completion, before the supervisor is reaped
+
+
+class JobSupervisor:
+    """One actor per job: owns the entrypoint subprocess."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 env: Optional[Dict[str, str]] = None,
+                 working_dir_blob: Optional[bytes] = None,
+                 log_dir: Optional[str] = None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.env = dict(env or {})
+        self.working_dir_blob = working_dir_blob
+        self.log_dir = log_dir or os.path.join("/tmp/raytpu", "jobs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.log_path = os.path.join(self.log_dir, f"{job_id}.log")
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._exit_code: Optional[int] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> bool:
+        cwd = None
+        if self.working_dir_blob:
+            cwd = os.path.join(self.log_dir, f"{self.job_id}_workdir")
+            os.makedirs(cwd, exist_ok=True)
+            with tarfile.open(fileobj=io.BytesIO(self.working_dir_blob)) as tf:
+                tf.extractall(cwd, filter="data")
+        env = dict(os.environ)
+        env.update(self.env)
+        env["RAYTPU_JOB_ID"] = self.job_id
+        # the subprocess's ray_tpu.init(address="auto") finds the cluster
+        # through RAYTPU_GCS_ADDRESS, inherited from this worker
+        logf = open(self.log_path, "ab")
+        self._proc = await asyncio.create_subprocess_shell(
+            self.entrypoint, stdout=logf, stderr=logf, env=env, cwd=cwd)
+        self._task = asyncio.get_event_loop().create_task(self._wait())
+        return True
+
+    async def _wait(self):
+        self._exit_code = await self._proc.wait()
+
+    async def poll(self) -> Optional[int]:
+        return self._exit_code
+
+    async def stop(self) -> bool:
+        if self._proc is not None and self._exit_code is None:
+            try:
+                self._proc.terminate()
+                await asyncio.wait_for(self._proc.wait(), 5)
+            except Exception:
+                try:
+                    self._proc.kill()
+                except Exception:
+                    pass
+        return True
+
+    async def tail_logs(self, offset: int = 0,
+                        max_bytes: int = 1 << 20) -> tuple:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_bytes)
+            return data, offset + len(data)
+        except FileNotFoundError:
+            return b"", offset
+
+
+class JobManager:
+    """Singleton named actor: submit/track/stop jobs."""
+
+    def __init__(self):
+        self._jobs: Dict[str, JobInfo] = {}
+        self._supervisors: Dict[str, Any] = {}
+        self._monitor: Optional[asyncio.Task] = None
+
+    async def _ensure_monitor(self):
+        if self._monitor is None or self._monitor.done():
+            self._monitor = asyncio.get_event_loop().create_task(
+                self._monitor_loop())
+
+    async def submit(self, entrypoint: str, *,
+                     job_id: Optional[str] = None,
+                     env: Optional[Dict[str, str]] = None,
+                     working_dir_blob: Optional[bytes] = None,
+                     metadata: Optional[Dict[str, str]] = None) -> str:
+        import ray_tpu
+
+        job_id = job_id or f"raytpu-job-{uuid.uuid4().hex[:8]}"
+        if job_id in self._jobs:
+            raise ValueError(f"job {job_id} already exists")
+        info = JobInfo(job_id=job_id, entrypoint=entrypoint,
+                       metadata=dict(metadata or {}))
+        self._jobs[job_id] = info
+        sup = ray_tpu.remote(JobSupervisor).options(
+            name=f"_job_supervisor:{job_id}", num_cpus=0.1,
+            lifetime="detached").remote(
+            job_id, entrypoint, env=env, working_dir_blob=working_dir_blob)
+        self._supervisors[job_id] = sup
+        await asyncio.wrap_future(ray_tpu.as_future(sup.start.remote()))
+        info.status = RUNNING
+        await self._ensure_monitor()
+        return job_id
+
+    async def _monitor_loop(self):
+        import ray_tpu
+
+        while any(j.status == RUNNING for j in self._jobs.values()):
+            for job_id, info in list(self._jobs.items()):
+                if info.status != RUNNING:
+                    continue
+                sup = self._supervisors.get(job_id)
+                try:
+                    code = await asyncio.wrap_future(
+                        ray_tpu.as_future(sup.poll.remote()))
+                except Exception as e:  # supervisor died
+                    info.status = FAILED
+                    info.message = f"supervisor died: {e!r}"
+                    info.finished_at = time.time()
+                    continue
+                if code is not None:
+                    info.exit_code = code
+                    info.status = SUCCEEDED if code == 0 else FAILED
+                    info.finished_at = time.time()
+                    # cache the logs before reaping the supervisor: callers
+                    # ask for logs of finished jobs long after the actor
+                    # (and its worker) is gone
+                    try:
+                        info.logs = await self._fetch_logs(sup)
+                    except Exception:
+                        pass
+                    try:
+                        ray_tpu.kill(sup)
+                    except Exception:
+                        pass
+                    self._supervisors.pop(job_id, None)
+            await asyncio.sleep(0.5)
+
+    async def status(self, job_id: str) -> Dict[str, Any]:
+        info = self._jobs[job_id]
+        return {"job_id": info.job_id, "status": info.status,
+                "entrypoint": info.entrypoint,
+                "exit_code": info.exit_code, "message": info.message,
+                "submitted_at": info.submitted_at,
+                "finished_at": info.finished_at,
+                "metadata": info.metadata}
+
+    async def list_jobs(self) -> List[Dict[str, Any]]:
+        return [await self.status(j) for j in self._jobs]
+
+    async def stop_job(self, job_id: str) -> bool:
+        info = self._jobs[job_id]
+        sup = self._supervisors.get(job_id)
+        if sup is not None and info.status == RUNNING:
+            import ray_tpu
+            await asyncio.wrap_future(ray_tpu.as_future(sup.stop.remote()))
+            info.status = STOPPED
+            info.finished_at = time.time()
+        return True
+
+    async def _fetch_logs(self, sup, cap: int = 8 << 20) -> str:
+        import ray_tpu
+
+        chunks, offset = [], 0
+        while offset < cap:
+            data, offset = await asyncio.wrap_future(
+                ray_tpu.as_future(sup.tail_logs.remote(offset)))
+            if not data:
+                break
+            chunks.append(data)
+        return b"".join(chunks).decode(errors="replace")
+
+    async def get_logs(self, job_id: str) -> str:
+        info = self._jobs[job_id]
+        sup = self._supervisors.get(job_id)
+        if sup is None:
+            return info.logs
+        try:
+            return await self._fetch_logs(sup)
+        except Exception:
+            return info.logs
+
+
+def _pack_working_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for root, _dirs, files in os.walk(path):
+            for fn in files:
+                full = os.path.join(root, fn)
+                tf.add(full, arcname=os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+class JobSubmissionClient:
+    """Driver-side client (reference: ``dashboard/modules/job/sdk.py:40``)."""
+
+    def __init__(self):
+        import ray_tpu
+
+        try:
+            self._mgr = ray_tpu.get_actor(MANAGER_NAME)
+        except Exception:
+            self._mgr = ray_tpu.remote(JobManager).options(
+                name=MANAGER_NAME, lifetime="detached", num_cpus=0.1,
+                max_concurrency=100, get_if_exists=True).remote()
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   job_id: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        import ray_tpu
+
+        runtime_env = runtime_env or {}
+        blob = None
+        wd = runtime_env.get("working_dir")
+        if wd:
+            blob = _pack_working_dir(wd)
+        env = dict(runtime_env.get("env_vars") or {})
+        return ray_tpu.get(self._mgr.submit.remote(
+            entrypoint, job_id=job_id, env=env, working_dir_blob=blob,
+            metadata=metadata), timeout=60)
+
+    def get_job_status(self, job_id: str) -> str:
+        import ray_tpu
+        return ray_tpu.get(self._mgr.status.remote(job_id),
+                           timeout=30)["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        import ray_tpu
+        return ray_tpu.get(self._mgr.status.remote(job_id), timeout=30)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        import ray_tpu
+        return ray_tpu.get(self._mgr.list_jobs.remote(), timeout=30)
+
+    def stop_job(self, job_id: str) -> bool:
+        import ray_tpu
+        return ray_tpu.get(self._mgr.stop_job.remote(job_id), timeout=30)
+
+    def get_job_logs(self, job_id: str) -> str:
+        import ray_tpu
+        return ray_tpu.get(self._mgr.get_logs.remote(job_id), timeout=30)
+
+    def wait_until_finish(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.get_job_status(job_id)
+            if s in (SUCCEEDED, FAILED, STOPPED):
+                return s
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

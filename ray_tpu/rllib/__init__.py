@@ -1,0 +1,20 @@
+"""ray_tpu.rllib — reinforcement learning on the actor substrate.
+
+Reference surface (SURVEY §2.8): ``rllib/algorithms/algorithm.py:191``
+(Algorithm), ``core/learner/learner_group.py:61`` (LearnerGroup),
+``env/env_runner.py:9`` / ``evaluation/rollout_worker.py:159`` (EnvRunner).
+
+TPU-first re-architecture: rollouts run in EnvRunner *actors* (CPU-bound
+gymnasium stepping, policy inference in jax on the worker); the learner
+update is ONE jitted program — GAE, minibatch epochs and the PPO loss all
+inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
+(the reference's NCCL learner-group allreduce becomes a compiled psum).
+"""
+
+from .env_runner import EnvRunner
+from .learner import Learner, LearnerGroup
+from .models import ActorCriticMLP
+from .ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig", "EnvRunner", "Learner", "LearnerGroup",
+           "ActorCriticMLP"]

@@ -1,0 +1,97 @@
+"""EnvRunner: the rollout actor.
+
+Reference: ``rllib/env/env_runner.py:9`` (EnvRunner ABC) and
+``rllib/evaluation/rollout_worker.py:159`` — an actor that owns gymnasium
+envs, receives policy weights, and returns fixed-length sample batches.
+Stepping is Python/CPU; policy inference is jax on the worker (CPU devices —
+the big compiled update runs in the learner, not here)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    """Collects rollout fragments from N vectorized gymnasium envs."""
+
+    def __init__(self, env_name: str, model_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0,
+                 env_config: Optional[dict] = None):
+        import gymnasium as gym
+
+        from .models import ActorCriticMLP
+
+        self.envs = [gym.make(env_name, **(env_config or {}))
+                     for _ in range(num_envs)]
+        self.model = ActorCriticMLP(**model_spec)
+        self.num_envs = num_envs
+        self._seed = seed
+        self._rng_calls = 0
+        self.obs = np.stack([e.reset(seed=seed + i)[0]
+                             for i, e in enumerate(self.envs)])
+        self._ep_returns = np.zeros(num_envs)
+        self._done_returns: List[float] = []
+
+    def sample(self, params_blob: Dict[str, Any],
+               rollout_len: int = 128) -> Dict[str, np.ndarray]:
+        """Run `rollout_len` steps per env under the given weights; returns
+        the batch plus bootstrap values (learner computes GAE in-jit)."""
+        import jax
+        import jax.numpy as jnp
+
+        params = jax.tree_util.tree_map(jnp.asarray, params_blob)
+        apply = jax.jit(self.model.apply)
+        self._rng_calls += 1
+        key = jax.random.PRNGKey(
+            (self._seed << 20) ^ self._rng_calls)
+
+        T, N = rollout_len, self.num_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_shape = ((N,) if not self.model.continuous
+                     else (N, self.model.action_dim))
+        acts_buf = np.zeros((T,) + act_shape, np.float32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            pi_out, value = apply(params, jnp.asarray(self.obs, jnp.float32))
+            key, sub = jax.random.split(key)
+            action = self.model.sample_action(pi_out, sub)
+            logp = self.model.log_prob(pi_out, action)
+            action_np = np.asarray(action)
+            obs_buf[t] = self.obs
+            acts_buf[t] = action_np
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            for i, env in enumerate(self.envs):
+                a = action_np[i]
+                if not self.model.continuous:
+                    a = int(a)
+                nobs, rew, term, trunc, _ = env.step(a)
+                rew_buf[t, i] = rew
+                self._ep_returns[i] += rew
+                if term or trunc:
+                    done_buf[t, i] = 1.0
+                    self._done_returns.append(self._ep_returns[i])
+                    self._ep_returns[i] = 0.0
+                    nobs, _ = env.reset()
+                self.obs[i] = nobs
+        _, last_val = apply(params, jnp.asarray(self.obs, jnp.float32))
+        return {
+            "obs": obs_buf, "actions": acts_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_values": np.asarray(last_val, np.float32),
+        }
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._done_returns)
+        if clear:
+            self._done_returns.clear()
+        return out
+
+    def ping(self) -> bool:
+        return True

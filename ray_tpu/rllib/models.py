@@ -1,0 +1,94 @@
+"""RL policy/value networks as plain-pytree jax modules.
+
+Reference analogue: ``rllib/core/rl_module/rl_module.py`` (RLModule) — here a
+functional (params, obs) -> outputs design so the same apply() runs on an
+EnvRunner's CPU jax and inside the learner's compiled update.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+
+class ActorCriticMLP:
+    """Shared-nothing actor-critic MLP: policy logits (discrete) or
+    mean/log_std (continuous) + value head."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(64, 64),
+                 continuous: bool = False):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+        self.continuous = continuous
+
+    def init(self, key: jax.Array) -> Params:
+        sizes = (self.obs_dim,) + self.hidden
+        params: Params = {}
+        keys = jax.random.split(key, 2 * len(self.hidden) + 4)
+        ki = iter(keys)
+        for tower in ("pi", "vf"):
+            for i in range(len(self.hidden)):
+                fan_in = sizes[i]
+                params[f"{tower}_w{i}"] = jax.random.normal(
+                    next(ki), (sizes[i], sizes[i + 1])) * (2.0 / fan_in) ** 0.5
+                params[f"{tower}_b{i}"] = jnp.zeros((sizes[i + 1],))
+        out_dim = self.action_dim * (2 if self.continuous else 1)
+        params["pi_out_w"] = jax.random.normal(
+            next(ki), (self.hidden[-1], out_dim)) * 0.01
+        params["pi_out_b"] = jnp.zeros((out_dim,))
+        params["vf_out_w"] = jax.random.normal(
+            next(ki), (self.hidden[-1], 1)) * 1.0 / self.hidden[-1] ** 0.5
+        params["vf_out_b"] = jnp.zeros((1,))
+        return params
+
+    def _tower(self, params: Params, obs, tower: str):
+        x = obs
+        for i in range(len(self.hidden)):
+            x = jnp.tanh(x @ params[f"{tower}_w{i}"] + params[f"{tower}_b{i}"])
+        return x
+
+    def apply(self, params: Params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """obs [B, obs_dim] -> (pi_out [B, A or 2A], value [B])."""
+        pi = (self._tower(params, obs, "pi") @ params["pi_out_w"]
+              + params["pi_out_b"])
+        v = (self._tower(params, obs, "vf") @ params["vf_out_w"]
+             + params["vf_out_b"])[..., 0]
+        return pi, v
+
+    # ------------------------------------------------------ distributions
+
+    def dist(self, pi_out):
+        if self.continuous:
+            mean, log_std = jnp.split(pi_out, 2, axis=-1)
+            log_std = jnp.clip(log_std, -5.0, 2.0)
+            return ("gaussian", mean, log_std)
+        return ("categorical", pi_out, None)
+
+    def sample_action(self, pi_out, key):
+        kind, a, b = self.dist(pi_out)
+        if kind == "gaussian":
+            return a + jnp.exp(b) * jax.random.normal(key, a.shape)
+        return jax.random.categorical(key, a, axis=-1)
+
+    def log_prob(self, pi_out, action):
+        kind, a, b = self.dist(pi_out)
+        if kind == "gaussian":
+            var = jnp.exp(2 * b)
+            lp = -0.5 * (((action - a) ** 2) / var + 2 * b
+                         + jnp.log(2 * jnp.pi))
+            return lp.sum(-1)
+        logp = jax.nn.log_softmax(a, axis=-1)
+        return jnp.take_along_axis(
+            logp, action[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self, pi_out):
+        kind, a, b = self.dist(pi_out)
+        if kind == "gaussian":
+            return (b + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum(-1)
+        logp = jax.nn.log_softmax(a, axis=-1)
+        return -(jnp.exp(logp) * logp).sum(-1)

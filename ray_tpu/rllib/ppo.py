@@ -1,0 +1,173 @@
+"""PPO: config builder + algorithm driver.
+
+Reference: ``rllib/algorithms/ppo/ppo.py`` (PPOConfig/PPO) over
+``algorithms/algorithm.py:191`` (Algorithm.train loop).  The driver keeps the
+reference's shape — config builder, EnvRunner fan-out, learner update,
+weight broadcast — with the learner math compiled (learner.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class PPOConfig:
+    """Builder (reference: AlgorithmConfig fluent API)."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 1
+        self.rollout_len = 128
+        self.num_learners = 1
+        self.train: Dict[str, Any] = dict(
+            lr=3e-4, gamma=0.99, clip_param=0.2, vf_loss_coeff=0.5,
+            entropy_coeff=0.0, num_epochs=4, num_minibatches=4,
+            grad_clip=0.5)
+        self.model: Dict[str, Any] = dict(hidden=(64, 64))
+        self.seed = 0
+        self.worker_env: Optional[Dict[str, str]] = None
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = env_config or {}
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 1,
+                    rollout_fragment_length: int = 128):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def learners(self, num_learners: int = 1):
+        self.num_learners = num_learners
+        return self
+
+    def training(self, **kwargs):
+        model = kwargs.pop("model", None)
+        if model:
+            self.model.update(model)
+        for k, v in kwargs.items():
+            if k == "lambda_":
+                k = "lambda"
+            self.train[k] = v
+        return self
+
+    def debugging(self, seed: int = 0, worker_env: Optional[dict] = None):
+        self.seed = seed
+        self.worker_env = worker_env
+        return self
+
+    def build(self) -> "PPO":
+        if not self.env_name:
+            raise ValueError("call .environment(env_name) first")
+        return PPO(self)
+
+
+class PPO:
+    """The algorithm driver: rollout fan-out -> compiled update -> broadcast.
+
+    ``train()`` returns a result dict (reference: Algorithm.train's result
+    with episode_return_mean), so it drops straight into a Tune trainable.
+    """
+
+    def __init__(self, config: PPOConfig):
+        import gymnasium as gym
+
+        import ray_tpu
+
+        from .env_runner import EnvRunner as _ER
+        from .learner import LearnerGroup
+        from .models import ActorCriticMLP
+
+        self.config = config
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        continuous = not hasattr(probe.action_space, "n")
+        action_dim = (probe.action_space.shape[0] if continuous
+                      else int(probe.action_space.n))
+        probe.close()
+        self.model_spec = dict(obs_dim=obs_dim, action_dim=action_dim,
+                               hidden=tuple(config.model["hidden"]),
+                               continuous=continuous)
+        model = ActorCriticMLP(**self.model_spec)
+        self.learner_group = LearnerGroup(model, config.train,
+                                          num_learners=config.num_learners,
+                                          seed=config.seed)
+        runner_cls = ray_tpu.remote(_ER)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, self.model_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i,
+                env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sample on all runners, one compiled update."""
+        import ray_tpu
+
+        t0 = time.time()
+        weights = self.learner_group.get_weights()
+        weights_ref = ray_tpu.put(weights)
+        batches = ray_tpu.get(
+            [r.sample.remote(weights_ref, self.config.rollout_len)
+             for r in self.runners], timeout=600)
+        # concat along the env axis: [T, sum(B_i), ...]
+        rollout = {
+            k: np.concatenate([b[k] for b in batches],
+                              axis=0 if k == "last_values" else 1)
+            for k in batches[0]}
+        metrics = self.learner_group.update(rollout)
+        rets = [x for r in self.runners
+                for x in ray_tpu.get(r.episode_returns.remote(), timeout=60)]
+        self._recent_returns.extend(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        steps = (self.config.rollout_len * self.config.num_env_runners
+                 * self.config.num_envs_per_runner)
+        out = {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "episodes_this_iter": len(rets),
+            "num_env_steps_sampled": steps * self._iteration,
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+        return out
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    @staticmethod
+    def as_tune_trainable(config_builder):
+        """Wrap a PPOConfig-producing callable into a Tune trainable fn."""
+        def trainable(tune_config: Dict[str, Any]):
+            from ray_tpu import tune as rt_tune
+
+            cfg = config_builder(tune_config)
+            algo = cfg.build()
+            try:
+                while True:
+                    rt_tune.report(algo.train())
+            finally:
+                algo.stop()
+        return trainable

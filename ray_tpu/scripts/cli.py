@@ -1,0 +1,224 @@
+"""The ``raytpu`` CLI (reference: ``python/ray/scripts/scripts.py`` —
+``ray start`` :542, ``ray status`` :1963, ``ray submit`` :1550, plus the
+state-API ``ray list`` family).
+
+Invoke as ``python -m ray_tpu.scripts.cli <cmd>`` or via the ``raytpu``
+wrapper at the repo root.  argparse instead of click (not adding deps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ADDRESS_FILE = "/tmp/raytpu/head.json"
+
+
+def _read_head() -> dict:
+    try:
+        with open(ADDRESS_FILE) as f:
+            return json.load(f)
+    except OSError:
+        raise SystemExit("no running head found (raytpu start --head first?)")
+
+
+def _connect():
+    import ray_tpu
+
+    head = _read_head()
+    os.environ["RAYTPU_GCS_ADDRESS"] = head["gcs_address"]
+    ray_tpu.init(address="auto", ignore_reinit_error=True)
+    return ray_tpu
+
+
+# ------------------------------------------------------------------ start
+
+def cmd_start(args):
+    if args.head:
+        if os.path.exists(ADDRESS_FILE):
+            try:
+                head = json.load(open(ADDRESS_FILE))
+                os.kill(head["pid"], 0)
+                raise SystemExit(f"head already running (pid {head['pid']}); "
+                                 f"raytpu stop first")
+            except (OSError, KeyError, json.JSONDecodeError):
+                pass  # stale file
+        cmd = [sys.executable, "-m", "ray_tpu.core.head_main"]
+    else:
+        if not args.address:
+            raise SystemExit("--address required for non-head nodes")
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_main",
+               "--gcs-address", args.address]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        cmd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    if args.labels:
+        cmd += ["--labels", args.labels]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL,
+                            start_new_session=True)
+    line = proc.stdout.readline().decode()
+    if not line:
+        raise SystemExit("node process failed to start")
+    info = json.loads(line)
+    if args.head:
+        print(f"head started: gcs={info['gcs_address']} pid={proc.pid}")
+        print(f"join with: raytpu start --address={info['gcs_address']}")
+    else:
+        print(f"node started: {info['node_id'][:12]} pid={proc.pid}")
+
+
+def cmd_stop(_args):
+    head = _read_head()
+    try:
+        os.kill(head["pid"], signal.SIGTERM)
+        print(f"stopped head (pid {head['pid']})")
+    except OSError as e:
+        print(f"head pid {head['pid']}: {e}")
+    # node agents registered via `raytpu start --address` are independent
+    # processes; kill by module name
+    subprocess.run(["pkill", "-f", "ray_tpu.core.node_main"], check=False)
+    try:
+        os.unlink(ADDRESS_FILE)
+    except OSError:
+        pass
+
+
+# ----------------------------------------------------------------- status
+
+def cmd_status(_args):
+    rt = _connect()
+    nodes = rt.nodes()
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"{len(nodes)} node(s)")
+    for n in nodes:
+        print(f"  {n['NodeID'][:12]}  alive={n['Alive']}  {n['Resources']}")
+    print("resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):.1f}/{total[k]:.1f} available")
+
+
+def cmd_list(args):
+    rt = _connect()
+    from ray_tpu.util import state as state_api
+
+    kind = args.kind
+    fns = {"actors": state_api.list_actors, "tasks": state_api.list_tasks,
+           "nodes": state_api.list_nodes, "objects": state_api.list_objects,
+           "placement-groups": state_api.list_placement_groups}
+    if kind not in fns:
+        raise SystemExit(f"unknown kind {kind}; one of {sorted(fns)}")
+    rows = fns[kind]()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_timeline(args):
+    rt = _connect()
+    events = rt.timeline()
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"{len(events)} events -> {out}")
+
+
+# ------------------------------------------------------------------- jobs
+
+def cmd_submit(args):
+    _connect()
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    entry = list(args.entrypoint)
+    if entry and entry[0] == "--":  # argparse.REMAINDER keeps the separator
+        entry = entry[1:]
+    if not entry:
+        raise SystemExit("no entrypoint given (raytpu submit -- cmd ...)")
+    job_id = client.submit_job(entrypoint=" ".join(entry),
+                               runtime_env=runtime_env or None)
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        return
+    status = client.wait_until_finish(job_id, timeout=args.timeout)
+    print(client.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    if status != "SUCCEEDED":
+        sys.exit(1)
+
+
+def cmd_job(args):
+    _connect()
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.action == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+    elif args.action == "status":
+        print(json.dumps(client.get_job_info(args.job_id), indent=2,
+                         default=str))
+    elif args.action == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.action == "stop":
+        client.stop_job(args.job_id)
+        print(f"stopped {args.job_id}")
+
+
+# ------------------------------------------------------------------ main
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="raytpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a head or worker node daemon")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", default=None)
+    s.add_argument("--num-cpus", type=float, default=None)
+    s.add_argument("--num-tpus", type=float, default=None)
+    s.add_argument("--resources", default=None)
+    s.add_argument("--labels", default=None)
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("stop", help="stop local daemons")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("status", help="cluster nodes + resources")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("list", help="state API listings")
+    s.add_argument("kind")
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("timeline", help="export task timeline json")
+    s.add_argument("--output", default=None)
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("submit", help="submit a job (entrypoint after --)")
+    s.add_argument("--working-dir", default=None)
+    s.add_argument("--no-wait", action="store_true")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    s.set_defaults(fn=cmd_submit)
+
+    s = sub.add_parser("job", help="job list/status/logs/stop")
+    s.add_argument("action",
+                   choices=["list", "status", "logs", "stop"])
+    s.add_argument("job_id", nargs="?")
+    s.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,14 @@ class AutoscalingConfig:
     # smoothing factor applied to the raw desired count
     smoothing_factor: float = 1.0
 
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            # Scale-to-zero needs a pending-request signal at the controller
+            # (requests route directly to replicas here, so a zero-replica
+            # deployment could never wake up).  Reject rather than brick.
+            raise ValueError("min_replicas must be >= 1 (scale-to-zero is "
+                             "not supported: routing is direct-to-replica)")
+
 
 @dataclasses.dataclass
 class DeploymentConfig:

@@ -45,7 +45,7 @@ HEALTH_FAILURE_THRESHOLD = 3
 
 class _Replica:
     __slots__ = ("name", "handle", "version", "state", "failures",
-                 "started_at", "last_ongoing", "code_hash")
+                 "started_at", "last_ongoing", "code_hash", "last_probe")
 
     def __init__(self, name: str, handle, version: str,
                  code_hash: Optional[str] = None):
@@ -57,6 +57,7 @@ class _Replica:
         self.started_at = time.monotonic()
         self.last_ongoing = 0
         self.code_hash = code_hash
+        self.last_probe = 0.0
 
 
 class _DeploymentState:
@@ -116,6 +117,10 @@ class ServeController:
         self._loop_task: Optional[asyncio.Task] = None
         self._shutting_down = False
         self._http_config: Optional[dict] = None
+        # strong refs to in-flight drain_then_kill tasks: keeps them alive,
+        # and graceful_shutdown awaits them so detached replicas are never
+        # orphaned past controller death
+        self._drain_tasks: set = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -128,15 +133,20 @@ class ServeController:
         return True
 
     async def graceful_shutdown(self) -> bool:
-        """Drain and stop every replica; used by serve.shutdown()."""
+        """Drain and stop every replica; used by serve.shutdown().  Blocks
+        until every drain task finished — the caller kills this controller
+        right after, and an unfinished background drain would orphan the
+        detached replica actors."""
         self._shutting_down = True
         if self._loop_task is not None:
             self._loop_task.cancel()
         for ds in self._deployments.values():
-            await asyncio.gather(
-                *[self._stop_replica(ds, r, graceful=True)
-                  for r in list(ds.replicas)],
-                return_exceptions=True)
+            for r in list(ds.replicas):
+                await self._stop_replica(ds, r, graceful=True)
+        if self._drain_tasks:
+            await asyncio.gather(*list(self._drain_tasks),
+                                 return_exceptions=True)
+        for ds in self._deployments.values():
             ds.replicas.clear()
         self._deployments.clear()
         self._bump_table()
@@ -321,9 +331,17 @@ class ServeController:
         """Ping replicas; promote STARTING->RUNNING, cull repeated failures."""
         import ray_tpu
         changed = False
+        now = time.monotonic()
+        # STARTING replicas are probed every pass (fast promotion); RUNNING
+        # ones at the configured cadence — user check_health hooks can be
+        # expensive (reference honors health_check_period_s the same way)
+        due = [r for r in ds.replicas if r.state == STARTING
+               or (r.state == RUNNING
+                   and now - r.last_probe >= ds.config.health_check_period_s)]
 
         async def ping(r: _Replica):
             nonlocal changed
+            r.last_probe = now
             try:
                 res = await asyncio.wait_for(
                     self._aget(r.handle.health_check.remote()),
@@ -338,8 +356,7 @@ class ServeController:
             except Exception:
                 r.failures += 1
 
-        await asyncio.gather(*[ping(r) for r in list(ds.replicas)
-                               if r.state != DRAINING])
+        await asyncio.gather(*[ping(r) for r in due])
         for r in list(ds.replicas):
             if r.failures >= HEALTH_FAILURE_THRESHOLD:
                 ds.replicas.remove(r)
@@ -397,10 +414,22 @@ class ServeController:
 
     async def _stop_replica(self, ds: _DeploymentState, r: _Replica,
                             graceful: bool):
+        """Mark DRAINING (drops it from the routing table) and retire it.
+
+        The graceful drain (wait for in-flight requests + unclaimed stream
+        buffers) runs as a background task — awaiting it inline would stall
+        the reconcile loop for every other deployment for up to
+        graceful_shutdown_timeout_s per replica."""
         if r in ds.replicas:
             r.state = DRAINING
         self._bump_table()
-        if graceful:
+        if not graceful:
+            if r in ds.replicas:
+                ds.replicas.remove(r)
+            await self._kill_replica(r)
+            return
+
+        async def drain_then_kill():
             try:
                 await asyncio.wait_for(
                     self._aget(r.handle.drain.remote(
@@ -408,9 +437,13 @@ class ServeController:
                     ds.config.graceful_shutdown_timeout_s + 5)
             except Exception:
                 pass
-        if r in ds.replicas:
-            ds.replicas.remove(r)
-        await self._kill_replica(r)
+            if r in ds.replicas:
+                ds.replicas.remove(r)
+            await self._kill_replica(r)
+
+        task = asyncio.get_event_loop().create_task(drain_then_kill())
+        self._drain_tasks.add(task)
+        task.add_done_callback(self._drain_tasks.discard)
 
     async def _kill_replica(self, r: _Replica):
         import ray_tpu
@@ -425,9 +458,3 @@ class ServeController:
     async def _aget(ref):
         import ray_tpu
         return await asyncio.wrap_future(ray_tpu.as_future(ref))
-
-
-def _replica_failure_is_dead(exc: BaseException) -> bool:
-    import ray_tpu
-    return isinstance(exc, (ray_tpu.ActorDiedError,
-                            ray_tpu.ActorUnavailableError))

@@ -97,6 +97,8 @@ class ReplicaActor:
                                        method: Optional[str] = None) -> None:
         """Run a (async) generator endpoint, buffering chunks for the caller
         to drain via next_chunks() — streaming over the actor RPC plane."""
+        if self._draining:
+            raise RuntimeError(f"replica {self.replica_id} is draining")
         self.num_ongoing += 1
         self._streams[stream_id] = []
         self._stream_done[stream_id] = False
@@ -166,9 +168,13 @@ class ReplicaActor:
         return self.num_ongoing
 
     async def drain(self, timeout_s: float = 10.0) -> bool:
-        """Stop accepting new requests; wait for ongoing ones to finish."""
+        """Stop accepting new requests; wait for ongoing ones to finish AND
+        for buffered streaming chunks to be fully claimed — killing a replica
+        whose client is still polling next_chunks() would truncate the
+        stream mid-flight."""
         self._draining = True
         deadline = time.monotonic() + timeout_s
-        while self.num_ongoing > 0 and time.monotonic() < deadline:
+        while ((self.num_ongoing > 0 or self._streams)
+               and time.monotonic() < deadline):
             await asyncio.sleep(0.05)
-        return self.num_ongoing == 0
+        return self.num_ongoing == 0 and not self._streams

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import re
 import threading
 import time
 import uuid
@@ -24,21 +25,30 @@ from ray_tpu.core.common import (ActorDiedError, ActorUnavailableError,
 CONTROLLER_NAME = "serve:controller"
 
 
+_DRAIN_REJECT = re.compile(r"^replica \S+ is draining$")
+
+
 def is_retryable_failure(e: BaseException) -> bool:
     """A request may be transparently re-routed when the failure is about the
     *replica*, not the request: the replica died, became unreachable, or
     rejected the request because it is draining (rolling update / scale-down).
-    """
+
+    Matching is deliberately narrow — application exceptions that merely
+    *mention* draining or death must surface to the caller, not trigger a
+    silent re-execution."""
     if isinstance(e, (ActorDiedError, ActorUnavailableError)):
         return True
     if isinstance(e, TaskError):
         cause = e.cause
         if isinstance(cause, (ActorDiedError, ActorUnavailableError)):
             return True
-        if isinstance(cause, RuntimeError) and "draining" in str(cause):
+        # ReplicaActor's own drain rejection (replica.py raises exactly this)
+        if isinstance(cause, RuntimeError) and _DRAIN_REJECT.match(str(cause)):
             return True
-        # the runtime may re-wrap death as a plain message
-        if "ActorDiedError" in str(e) or "draining" in str(e):
+        # _strip_exc repackages unpicklable errors as
+        # RuntimeError("<TypeName>: <msg>") — recognize repackaged death
+        if isinstance(cause, RuntimeError) and str(cause).startswith(
+                ("ActorDiedError:", "ActorUnavailableError:")):
             return True
     return False
 

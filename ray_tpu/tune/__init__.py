@@ -6,9 +6,9 @@ PBT schedulers; per-trial checkpoints; experiment state snapshots.
 """
 
 from .search import (BasicVariantGenerator, Categorical, Domain, Float,
-                     GridSearch, Integer, Searcher, choice, grid_search,
-                     lograndint, loguniform, qloguniform, quniform, randint,
-                     randn, sample_from, uniform)
+                     GridSearch, Integer, Searcher, TPESearcher, choice,
+                     grid_search, lograndint, loguniform, qloguniform,
+                     quniform, randint, randn, sample_from, uniform)
 from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                          MedianStoppingRule, PopulationBasedTraining,
                          TrialScheduler)
@@ -22,7 +22,7 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TuneController", "Trial",
-    "Searcher", "BasicVariantGenerator", "uniform", "loguniform", "quniform",
+    "Searcher", "BasicVariantGenerator", "TPESearcher", "uniform", "loguniform", "quniform",
     "qloguniform", "randint", "lograndint", "choice", "sample_from", "randn",
     "grid_search", "Domain", "Float", "Integer", "Categorical", "GridSearch",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",

@@ -37,7 +37,9 @@ class TuneController:
                  max_failures_per_trial: int = 0,
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  worker_env: Optional[Dict[str, str]] = None,
-                 result_poll_timeout: float = 3600.0):
+                 result_poll_timeout: float = 3600.0,
+                 initial_trials: Optional[List[Trial]] = None,
+                 max_trials: Optional[int] = None):
         self.trainable = trainable
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler(metric, mode)
@@ -51,13 +53,19 @@ class TuneController:
         self.resources = resources_per_trial or {"CPU": 1}
         self.worker_env = worker_env
         self.poll_timeout = result_poll_timeout
-        self.trials: List[Trial] = []
+        self.trials: List[Trial] = list(initial_trials or [])
+        # Cap for open-ended searchers (TPE etc. always have a suggestion —
+        # num_samples is the budget; BasicVariant self-exhausts instead).
+        self.max_trials = max_trials
         self._exhausted = False
 
     # ------------------------------------------------------------- lifecycle
 
     def _next_trial(self) -> Optional[Trial]:
         if self._exhausted:
+            return None
+        if self.max_trials is not None and len(self.trials) >= self.max_trials:
+            self._exhausted = True
             return None
         t = Trial.new({}, self.experiment_dir)
         config = self.searcher.suggest(t.trial_id)
@@ -151,6 +159,14 @@ class TuneController:
         pending: Dict[Any, Trial] = {}
         while True:
             running = [t for t in self.trials if t.status == RUNNING]
+            # restored/restartable trials first (resume from checkpoint),
+            # then fresh suggestions from the searcher
+            waiting = [t for t in self.trials if t.status == PENDING
+                       and t.runner is None]
+            while waiting and len(running) < self.max_concurrent:
+                t = waiting.pop(0)
+                self._start_trial(t)
+                running.append(t)
             while len(running) < self.max_concurrent:
                 t = self._next_trial()
                 if t is None:
@@ -194,6 +210,11 @@ class TuneController:
     # ------------------------------------------------------------- state io
 
     def _save_state(self) -> None:
+        """Snapshot the experiment (reference:
+        ``tune/execution/experiment_state.py``): a JSON summary for humans
+        plus a pickled (trials, searcher) pair that ``Tuner.restore`` resumes
+        from — terminated trials keep their results, interrupted ones restart
+        from their latest checkpoint."""
         state = [{
             "trial_id": t.trial_id, "status": t.status, "config": repr(t.config),
             "last_result": {k: v for k, v in (t.last_result or {}).items()
@@ -206,5 +227,32 @@ class TuneController:
                                    "experiment_state.json"), "w") as f:
                 json.dump({"timestamp": time.time(), "trials": state}, f,
                           indent=2)
-        except OSError:
+            import cloudpickle
+            import dataclasses as dc
+            bare = [dc.replace(t, runner=None, _pending_ref=None)
+                    for t in self.trials]
+            blob = cloudpickle.dumps({"trials": bare,
+                                      "searcher": self.searcher,
+                                      "max_trials": self.max_trials})
+            tmp = os.path.join(self.experiment_dir, ".experiment_state.tmp")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(self.experiment_dir,
+                                         "experiment_state.pkl"))
+        except Exception:  # noqa: BLE001 — a snapshot failure (e.g. an
+            # unpicklable user searcher attribute) must not abort the run
             pass
+
+    @staticmethod
+    def load_state(experiment_dir: str):
+        """-> (trials, searcher, max_trials) from the last snapshot;
+        interrupted trials come back PENDING so the run loop restarts them
+        from checkpoints."""
+        import cloudpickle
+        with open(os.path.join(experiment_dir, "experiment_state.pkl"),
+                  "rb") as f:
+            state = cloudpickle.loads(f.read())
+        for t in state["trials"]:
+            if t.status == RUNNING:
+                t.status = PENDING
+        return state["trials"], state["searcher"], state.get("max_trials")

@@ -12,7 +12,7 @@ from __future__ import annotations
 import itertools
 import math
 import random
-from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class Domain:
@@ -183,17 +183,213 @@ class BasicVariantGenerator(Searcher):
         grids = _split_grid(space)
         paths = [p for p, _ in grids]
         combos = list(itertools.product(*[g.values for _, g in grids])) or [()]
-        self._variants: Iterator = iter([
+        # a plain list (not an iterator) so experiment snapshots can pickle
+        # the searcher mid-stream (tune resume)
+        self._variants: List[Dict] = [
             dict(zip(paths, combo))
             for _ in range(num_samples) for combo in combos
-        ])
+        ]
         self.total = num_samples * len(combos) + len(self._preset)
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         if self._preset:
             return self._preset.pop(0)
-        try:
-            assignment = next(self._variants)
-        except StopIteration:
+        if not self._variants:
             return None
-        return _instantiate(self.space, self.rng, assignment)
+        return _instantiate(self.space, self.rng, self._variants.pop(0))
+
+
+# ------------------------------------------------------------------- TPE
+
+def _flatten_domains(space: Dict[str, Any]):
+    """(path -> Domain) for every sampleable leaf (grid axes excluded)."""
+    out: Dict[Tuple[str, ...], Domain] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        elif isinstance(node, Domain):
+            out[path] = node
+
+    walk(space, ())
+    return out
+
+
+def _build_config(space, values: Dict[Tuple[str, ...], Any],
+                  rng: random.Random):
+    def build(node, path):
+        if isinstance(node, dict):
+            return {k: build(v, path + (k,)) for k, v in node.items()}
+        if isinstance(node, Domain):
+            return values.get(path, node.sample(rng))
+        if isinstance(node, GridSearch):
+            raise ValueError("grid_search is not supported by TPESearcher; "
+                             "use choice() or BasicVariantGenerator")
+        return node
+
+    return build(space, ())
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (model-based search).
+
+    Reference parity target: ``python/ray/tune/search/hyperopt`` wraps
+    hyperopt's TPE; this is a self-contained implementation of the same
+    algorithm (Bergstra et al., NeurIPS 2011) because external optimizer
+    packages are not in this image.
+
+    Per dimension: past observations are split into the best ``gamma``
+    fraction (l) and the rest (g); candidates are drawn from a Parzen mixture
+    over l (plus a uniform prior component) and ranked by the density ratio
+    l(x)/g(x).  Numeric domains work in transformed space (log where the
+    domain is log-scaled); categoricals use smoothed count ratios.
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: Optional[str] = None,
+                 mode: str = "max", *, n_startup: int = 10,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = space
+        self.domains = _flatten_domains(space)
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._live: Dict[str, Dict[Tuple[str, ...], Any]] = {}
+        self._latest: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[Tuple[Dict[Tuple[str, ...], Any], float]] = []
+
+    # -- domain helpers ---------------------------------------------------
+
+    @staticmethod
+    def _numeric(dom: Domain):
+        if isinstance(dom, Quantized):
+            inner = dom.inner
+            if isinstance(inner, (Float, Integer)):
+                return inner
+            return None
+        if isinstance(dom, (Float, Integer)):
+            return dom
+        return None
+
+    def _to_z(self, dom, x: float) -> float:
+        return math.log(x) if dom.log else float(x)
+
+    def _from_z(self, dom, z: float, outer: Domain):
+        lo, hi = self._z_bounds(dom)
+        z = min(max(z, lo), hi)
+        v = math.exp(z) if dom.log else z
+        # exp(log(hi)) can exceed hi by an ulp — clamp in value space too
+        v = min(max(v, dom.lower), dom.upper)
+        if isinstance(dom, Integer):
+            v = int(round(v))
+            v = min(max(v, dom.lower), dom.upper - 1)
+        if isinstance(outer, Quantized):
+            v = round(v / outer.q) * outer.q
+        return v
+
+    def _z_bounds(self, dom) -> Tuple[float, float]:
+        if dom.log:
+            return math.log(dom.lower), math.log(dom.upper)
+        return float(dom.lower), float(dom.upper)
+
+    # -- the estimator ----------------------------------------------------
+
+    def _suggest_dim(self, path, dom, good, bad):
+        num = self._numeric(dom)
+        if num is not None:
+            lo, hi = self._z_bounds(num)
+            span = max(hi - lo, 1e-12)
+            gz = [self._to_z(num, c[path]) for c in good if path in c]
+            bz = [self._to_z(num, c[path]) for c in bad if path in c]
+            if not gz:
+                return dom.sample(self.rng)
+
+            def bandwidth(pts):
+                # Scott's rule on the sample std (NOT the domain span — a
+                # span-scaled bandwidth exceeds the domain for small n and
+                # piles clamped candidates onto the boundaries)
+                n = len(pts)
+                if n < 2:
+                    return span * 0.25
+                mean = sum(pts) / n
+                std = (sum((p - mean) ** 2 for p in pts) / (n - 1)) ** 0.5
+                return min(max(std * 1.06 * n ** -0.2, span * 0.01), span)
+
+            bw_g = bandwidth(gz)
+            bw_b = bandwidth(bz) if bz else span
+
+            def density(z, pts, bw):
+                # Parzen mixture + uniform prior mass (keeps exploration alive)
+                p = 1.0 / span
+                for m in pts:
+                    p += math.exp(-0.5 * ((z - m) / bw) ** 2) / (
+                        bw * 2.5066282746310002)
+                return p / (len(pts) + 1)
+
+            best_z, best_score = None, -1.0
+            for _ in range(self.n_candidates):
+                # draw from the actual mixture l: uniform prior component
+                # with weight 1/(n+1), else a Parzen kernel — keeps
+                # exploration alive after the good set concentrates
+                if self.rng.random() < 1.0 / (len(gz) + 1):
+                    z = self.rng.uniform(lo, hi)
+                else:
+                    z = self.rng.gauss(self.rng.choice(gz), bw_g)
+                    z = min(max(z, lo), hi)
+                score = density(z, gz, bw_g) / density(z, bz, bw_b)
+                if score > best_score:
+                    best_z, best_score = z, score
+            return self._from_z(num, best_z, dom)
+        if isinstance(dom, Categorical):
+            cats = dom.categories
+            gcounts = {i: 1.0 for i in range(len(cats))}
+            bcounts = {i: 1.0 for i in range(len(cats))}
+            for c in good:
+                if path in c and c[path] in cats:
+                    gcounts[cats.index(c[path])] += 1
+            for c in bad:
+                if path in c and c[path] in cats:
+                    bcounts[cats.index(c[path])] += 1
+            gsum = sum(gcounts.values())
+            weights = [gcounts[i] / gsum for i in range(len(cats))]
+            # draw candidates from l, rank by l/g
+            best_i, best_score = None, -1.0
+            for _ in range(self.n_candidates):
+                i = self.rng.choices(range(len(cats)), weights)[0]
+                score = gcounts[i] / bcounts[i]
+                if score > best_score:
+                    best_i, best_score = i, score
+            return cats[best_i]
+        return dom.sample(self.rng)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._obs) < self.n_startup:
+            flat = {p: d.sample(self.rng) for p, d in self.domains.items()}
+        else:
+            obs = sorted(self._obs, key=lambda o: o[1], reverse=True)
+            n_good = max(1, int(math.ceil(self.gamma * len(obs))))
+            good = [c for c, _ in obs[:n_good]]
+            bad = [c for c, _ in obs[n_good:]] or good
+            flat = {p: self._suggest_dim(p, d, good, bad)
+                    for p, d in self.domains.items()}
+        self._live[trial_id] = flat
+        return _build_config(self.space, flat, self.rng)
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        self._latest[trial_id] = result
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        flat = self._live.pop(trial_id, None)
+        latest = self._latest.pop(trial_id, None)  # always pop: no leak
+        result = result or latest
+        if flat is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((flat, score))

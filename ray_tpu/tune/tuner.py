@@ -98,14 +98,42 @@ class Tuner:
         if isinstance(trainable, BaseTrainer):
             trainable = trainable.as_trainable()
         self.trainable = trainable
+        self._restored: Optional[tuple] = None  # (experiment_dir, trials, searcher)
+
+    @classmethod
+    def restore(cls, experiment_dir: str, trainable: Any,
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None,
+                resources_per_trial: Optional[Dict[str, float]] = None,
+                worker_env: Optional[Dict[str, str]] = None) -> "Tuner":
+        """Resume an interrupted experiment from its state snapshot
+        (reference: ``Tuner.restore`` / ``execution/experiment_state.py``).
+        Terminated trials keep their results; interrupted ones restart from
+        their latest checkpoint; the searcher resumes where it stopped."""
+        trials, searcher, max_trials = TuneController.load_state(
+            experiment_dir)
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config,
+                    resources_per_trial=resources_per_trial,
+                    worker_env=worker_env)
+        if tuner.tune_config.search_alg is None:
+            tuner.tune_config.search_alg = searcher
+        tuner._restored = (experiment_dir, trials, max_trials)
+        return tuner
 
     def fit(self) -> ResultGrid:
         cfg = self.tune_config
-        name = self.run_config.name or \
-            f"tune_{getattr(self.trainable, '__name__', 'exp')}_{int(time.time())}"
-        experiment_dir = os.path.join(
-            self.run_config.resolved_storage_path(), name)
-        os.makedirs(experiment_dir, exist_ok=True)
+        restored_max_trials = None
+        if self._restored is not None:
+            experiment_dir, initial_trials, restored_max_trials = \
+                self._restored
+        else:
+            initial_trials = None
+            name = self.run_config.name or \
+                f"tune_{getattr(self.trainable, '__name__', 'exp')}_{int(time.time())}"
+            experiment_dir = os.path.join(
+                self.run_config.resolved_storage_path(), name)
+            os.makedirs(experiment_dir, exist_ok=True)
         searcher = cfg.search_alg or BasicVariantGenerator(
             self.param_space, num_samples=cfg.num_samples, seed=cfg.seed)
         if searcher.metric is None:
@@ -118,6 +146,23 @@ class Tuner:
             max_failures_per_trial=(failure_cfg.max_failures
                                     if failure_cfg else 0),
             resources_per_trial=self.resources_per_trial,
-            worker_env=self.worker_env)
+            worker_env=self.worker_env,
+            initial_trials=initial_trials,
+            max_trials=self._resolve_max_trials(searcher,
+                                                restored_max_trials))
         trials = controller.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
+
+    def _resolve_max_trials(self, searcher,
+                            restored_max_trials: Optional[int]) -> Optional[int]:
+        """Open-ended searchers (TPE etc.) always have a suggestion, so
+        num_samples is their total trial budget; BasicVariantGenerator
+        self-exhausts and must NOT be capped (its num_samples means
+        grid-repeat count).  A restored run keeps its original budget unless
+        the caller overrides num_samples explicitly."""
+        if isinstance(searcher, BasicVariantGenerator):
+            return None
+        cfg = self.tune_config
+        if restored_max_trials is not None and cfg.num_samples == 1:
+            return restored_max_trials
+        return cfg.num_samples

@@ -1,82 +1,116 @@
-"""ActorPool — reference ``python/ray/util/actor_pool.py:13``: round-robin a
-pool of actors over submitted work with ordered/unordered result retrieval."""
+"""ActorPool: fan work out over a fixed set of actors.
+
+Same capability as the reference's ``ray.util.ActorPool`` (round-robin
+submission, ordered/unordered retrieval), built around a ticket ledger: every
+submission gets a monotonically increasing *ticket*; the ledger maps tickets
+to in-flight ObjectRefs and completed-but-unclaimed results.  Ordered
+retrieval walks tickets in submission order; unordered retrieval waits on
+whatever is in flight and claims the first completion.
+"""
 
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, List
+from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 import ray_tpu
 
 
+class _Ticket:
+    __slots__ = ("ref", "actor")
+
+    def __init__(self, ref, actor):
+        self.ref = ref
+        self.actor = actor
+
+
 class ActorPool:
-    def __init__(self, actors: List[Any]):
-        self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits = collections.deque()
+    """fn(actor, value) -> ObjectRef is the submission shape, matching the
+    reference API so call sites port unchanged."""
 
-    def submit(self, fn: Callable, value: Any) -> None:
-        if self._idle:
-            actor = self._idle.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+    def __init__(self, actors: Iterable[Any]):
+        self._ready = collections.deque(actors)   # actors with no task
+        self._backlog: collections.deque = collections.deque()
+        self._ledger: "collections.OrderedDict[int, _Ticket]" = \
+            collections.OrderedDict()             # ticket -> in-flight work
+        self._issue = 0                           # next ticket to issue
+        self._serve = 0                           # next ticket for get_next()
+
+    # ----------------------------------------------------------- submit
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        if not self._ready:
+            self._backlog.append((fn, value))
+            return
+        actor = self._ready.popleft()
+        self._ledger[self._issue] = _Ticket(fn(actor, value), actor)
+        self._issue += 1
+
+    def _recycle(self, actor) -> None:
+        """Actor finished its task: give it backlog work or park it."""
+        if self._backlog:
+            fn, value = self._backlog.popleft()
+            self._ledger[self._issue] = _Ticket(fn(actor, value), actor)
+            self._issue += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._ready.append(actor)
 
-    def _return_actor(self, actor) -> None:
-        self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.popleft())
+    # ---------------------------------------------------------- results
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor)
+        return bool(self._ledger)
 
-    def get_next(self, timeout: float = None) -> Any:
-        """Next result in submission order."""
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Result of the oldest unreturned submission."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        future = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        i, actor = self._future_to_actor.pop(future)
-        self._return_actor(actor)
-        return ray_tpu.get(future, timeout=timeout)
+        # skip tickets already served out of order by get_next_unordered()
+        while self._serve not in self._ledger and self._serve < self._issue:
+            self._serve += 1
+        ticket = self._serve
+        self._serve += 1
+        entry = self._ledger.pop(ticket)
+        self._recycle(entry.actor)
+        return ray_tpu.get(entry.ref, timeout=timeout)
 
-    def get_next_unordered(self, timeout: float = None) -> Any:
-        """Next result in completion order."""
-        if not self.has_next():
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Whichever outstanding result lands first."""
+        if not self._ledger:
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
-                                timeout=timeout)
-        if not ready:
+        by_ref = {t.ref: num for num, t in self._ledger.items()}
+        done, _ = ray_tpu.wait(list(by_ref), num_returns=1, timeout=timeout)
+        if not done:
             raise TimeoutError("get_next_unordered timed out")
-        future = ready[0]
-        i, actor = self._future_to_actor.pop(future)
-        self._index_to_future.pop(i, None)
-        self._return_actor(actor)
-        return ray_tpu.get(future)
+        ticket = by_ref[done[0]]
+        entry = self._ledger.pop(ticket)
+        self._recycle(entry.actor)
+        return ray_tpu.get(entry.ref)
 
-    def map(self, fn: Callable, values: List[Any]):
+    # -------------------------------------------------------------- map
+
+    def map(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        n = 0
         for v in values:
             self.submit(fn, v)
-        while self.has_next():
+            n += 1
+        for _ in range(n):
             yield self.get_next()
 
-    def map_unordered(self, fn: Callable, values: List[Any]):
+    def map_unordered(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        n = 0
         for v in values:
             self.submit(fn, v)
-        while self.has_next():
+            n += 1
+        for _ in range(n):
             yield self.get_next_unordered()
 
-    def has_free(self) -> bool:
-        return bool(self._idle)
+    # ------------------------------------------------------ pool mgmt
 
-    def pop_idle(self):
-        return self._idle.pop() if self._idle else None
+    def has_free(self) -> bool:
+        return bool(self._ready)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._ready.pop() if self._ready else None
 
     def push(self, actor) -> None:
-        self._return_actor(actor)
+        self._recycle(actor)

@@ -130,3 +130,78 @@ def test_pg_actor_uses_bundle_resources(ray_start_regular):
         return 1
     assert ray_tpu.get([f.remote() for _ in range(4)], timeout=30) == [1] * 4
     ray_tpu.remove_placement_group(pg)
+
+
+def _tpu_view(grid, busy=()):
+    """4x2 ICI grid of nodes, one slice; `busy` nodes have no free TPU."""
+    from ray_tpu.core.scheduling import NodeView
+
+    view = {}
+    for i, (x, y) in enumerate(grid):
+        nid = f"node{i}"
+        avail = {"CPU": 8.0, "TPU": 0.0 if nid in busy else 4.0}
+        view[nid] = NodeView(
+            node_id=nid, address=f"addr{i}",
+            total={"CPU": 8.0, "TPU": 4.0}, available=dict(avail),
+            labels={"tpu_slice": "slice-a", "ici_coord": f"{x},{y}"})
+    return view
+
+
+def test_ici_strict_spread_picks_contiguous_subtorus():
+    """STRICT_SPREAD over an ICI-labeled slice must choose a node set with
+    minimal ICI diameter, not arbitrary hosts (SURVEY §2.3 TPU placement)."""
+    from ray_tpu.core.scheduling import _ici_span, pack_bundles
+
+    grid = [(x, y) for x in range(4) for y in range(2)]
+    view = _tpu_view(grid)
+    placement = pack_bundles(view, [{"TPU": 4.0}] * 4, "STRICT_SPREAD")
+    assert placement is not None and len(set(placement)) == 4
+    coords = [tuple(map(int, view[n].labels["ici_coord"].split(",")))
+              for n in placement]
+    # a 2x2 block has diameter 2; any non-contiguous pick of 4 from 4x2 > 2
+    assert _ici_span(coords) == 2, f"non-contiguous placement: {coords}"
+
+
+def test_ici_strict_spread_avoids_busy_hole():
+    """With the corner 2x2 partly busy, the contiguous block must form
+    elsewhere rather than straddle the hole."""
+    from ray_tpu.core.scheduling import _ici_span, pack_bundles
+
+    grid = [(x, y) for x in range(4) for y in range(2)]
+    view = _tpu_view(grid, busy=("node0",))  # (0,0) has no TPU
+    placement = pack_bundles(view, [{"TPU": 4.0}] * 4, "STRICT_SPREAD")
+    assert placement is not None
+    assert "node0" not in placement
+    coords = [tuple(map(int, view[n].labels["ici_coord"].split(",")))
+              for n in placement]
+    assert _ici_span(coords) == 2, f"straddled the busy hole: {coords}"
+
+
+def test_ici_pack_spills_to_nearest_neighbor():
+    """PACK that overflows one node must spill to the ICI-nearest same-slice
+    node, not a random one."""
+    from ray_tpu.core.scheduling import pack_bundles
+
+    grid = [(x, y) for x in range(4) for y in range(2)]
+    view = _tpu_view(grid)
+    # 2 bundles of 3 TPU: no single node fits both (4 TPU each)
+    placement = pack_bundles(view, [{"TPU": 3.0}, {"TPU": 3.0}], "PACK")
+    assert placement is not None
+    a, b = placement
+    assert a != b
+    ca = tuple(map(int, view[a].labels["ici_coord"].split(",")))
+    cb = tuple(map(int, view[b].labels["ici_coord"].split(",")))
+    assert abs(ca[0] - cb[0]) + abs(ca[1] - cb[1]) == 1, (
+        f"spilled {ca}->{cb}, not adjacent")
+
+
+def test_pack_without_labels_unchanged():
+    """Plain clusters (no ICI labels) keep the original packing behavior."""
+    from ray_tpu.core.scheduling import NodeView, pack_bundles
+
+    view = {f"n{i}": NodeView(node_id=f"n{i}", address=f"a{i}",
+                              total={"CPU": 4.0}, available={"CPU": 4.0})
+            for i in range(3)}
+    assert pack_bundles(view, [{"CPU": 4.0}] * 2, "STRICT_SPREAD") is not None
+    assert pack_bundles(view, [{"CPU": 2.0}] * 2, "PACK") is not None
+    assert pack_bundles(view, [{"CPU": 8.0}], "PACK") is None

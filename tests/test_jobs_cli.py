@@ -1,0 +1,121 @@
+"""Job submission + CLI tests (reference: dashboard/modules/job/tests,
+python/ray/tests/test_cli.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_job_submission_end_to_end(ray_start_regular, tmp_path):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "entry.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        print("hello from job", os.environ.get("RAYTPU_JOB_ID"))
+        print("MARKER_OK")
+    """))
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        metadata={"who": "test"})
+    status = client.wait_until_finish(job_id, timeout=120)
+    assert status == "SUCCEEDED"
+    logs = client.get_job_logs(job_id)
+    assert "MARKER_OK" in logs
+    assert job_id in logs
+    infos = client.list_jobs()
+    assert any(j["job_id"] == job_id and j["metadata"]["who"] == "test"
+               for j in infos)
+
+
+def test_job_failure_and_stop(ray_start_regular, tmp_path):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finish(bad, timeout=60) == "FAILED"
+    assert client.get_job_info(bad)["exit_code"] == 3
+
+    slow = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    time.sleep(1)
+    client.stop_job(slow)
+    assert client.get_job_status(slow) == "STOPPED"
+
+
+def test_job_working_dir(ray_start_regular, tmp_path):
+    from ray_tpu.job import JobSubmissionClient
+
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "main.py").write_text("print(open('data.txt').read())")
+    (wd / "data.txt").write_text("WORKDIR_PAYLOAD")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} main.py",
+        runtime_env={"working_dir": str(wd)})
+    assert client.wait_until_finish(job_id, timeout=120) == "SUCCEEDED"
+    assert "WORKDIR_PAYLOAD" in client.get_job_logs(job_id)
+
+
+def test_job_driver_joins_cluster(ray_start_regular, tmp_path):
+    """The submitted entrypoint connects back to this cluster and runs a
+    task (the reference's driver-on-cluster contract)."""
+    from ray_tpu.job import JobSubmissionClient
+
+    script = tmp_path / "driver.py"
+    script.write_text(textwrap.dedent("""
+        import ray_tpu
+        ray_tpu.init(address="auto")
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        print("RESULT", ray_tpu.get(f.remote(21)))
+        ray_tpu.shutdown()
+    """))
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    assert client.wait_until_finish(job_id, timeout=180) == "SUCCEEDED"
+    assert "RESULT 42" in client.get_job_logs(job_id)
+
+
+@pytest.mark.slow
+def test_cli_start_status_submit_stop(tmp_path):
+    """Full daemon lifecycle through the CLI binary."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cli = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+
+    def run(*args, timeout=120):
+        return subprocess.run(cli + list(args), capture_output=True,
+                              text=True, env=env, cwd=REPO, timeout=timeout)
+
+    if os.path.exists("/tmp/raytpu/head.json"):
+        run("stop")
+    r = run("start", "--head", "--num-cpus", "4")
+    assert r.returncode == 0, r.stderr
+    assert "head started" in r.stdout
+    try:
+        r = run("status")
+        assert r.returncode == 0, r.stderr
+        assert "node(s)" in r.stdout
+        script = tmp_path / "ok.py"
+        script.write_text("print('CLI_JOB_OK')")
+        r = run("submit", "--", sys.executable, str(script))
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "CLI_JOB_OK" in r.stdout
+        assert "SUCCEEDED" in r.stdout
+    finally:
+        r = run("stop")
+        assert r.returncode == 0, r.stderr
+    assert not os.path.exists("/tmp/raytpu/head.json")

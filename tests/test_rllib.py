@@ -1,0 +1,147 @@
+"""RLlib-equivalent tests: PPO learner math, EnvRunner rollouts, and the
+CartPole learning test (reference: rllib/tuned_examples learning tests —
+train until a reward threshold as a CI regression gate)."""
+
+import numpy as np
+import pytest
+
+
+def test_gae_matches_reference_impl():
+    """GAE scan vs a hand-rolled python loop."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.learner import Learner
+    from ray_tpu.rllib.models import ActorCriticMLP
+
+    model = ActorCriticMLP(obs_dim=3, action_dim=2)
+    lrn = Learner(model, {"gamma": 0.9, "lambda": 0.8})
+    T, B = 6, 2
+    rng = np.random.RandomState(0)
+    rewards = rng.randn(T, B).astype(np.float32)
+    values = rng.randn(T, B).astype(np.float32)
+    dones = (rng.rand(T, B) < 0.2).astype(np.float32)
+    last = rng.randn(B).astype(np.float32)
+
+    got = np.asarray(lrn._gae(jnp.asarray(rewards), jnp.asarray(values),
+                              jnp.asarray(dones), jnp.asarray(last)))
+
+    want = np.zeros((T, B), np.float32)
+    for b in range(B):
+        adv_next, v_next = 0.0, last[b]
+        for t in reversed(range(T)):
+            nt = 1.0 - dones[t, b]
+            delta = rewards[t, b] + 0.9 * v_next * nt - values[t, b]
+            adv = delta + 0.9 * 0.8 * nt * adv_next
+            want[t, b] = adv
+            adv_next, v_next = adv, values[t, b]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_env_runner_rollout_shapes():
+    from ray_tpu.rllib.env_runner import EnvRunner
+
+    runner = EnvRunner("CartPole-v1",
+                       dict(obs_dim=4, action_dim=2, hidden=(16,)),
+                       num_envs=2, seed=0)
+    from ray_tpu.rllib.models import ActorCriticMLP
+    import jax
+
+    model = ActorCriticMLP(obs_dim=4, action_dim=2, hidden=(16,))
+    params = {k: np.asarray(v)
+              for k, v in model.init(jax.random.PRNGKey(0)).items()}
+    batch = runner.sample(params, rollout_len=16)
+    assert batch["obs"].shape == (16, 2, 4)
+    assert batch["actions"].shape == (16, 2)
+    assert batch["last_values"].shape == (2,)
+    assert batch["dones"].max() <= 1.0
+
+
+def test_learner_update_improves_objective():
+    """A few updates on a fixed synthetic advantage signal must move the
+    policy toward the advantaged action."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.learner import Learner
+    from ray_tpu.rllib.models import ActorCriticMLP
+
+    model = ActorCriticMLP(obs_dim=4, action_dim=2, hidden=(16,))
+    # gamma=lambda=0 makes advantage == reward - value: a pure per-step
+    # action-quality signal (discounting would smear reward over timesteps
+    # and normalization would wash the action signal out)
+    lrn = Learner(model, {"lr": 1e-2, "num_epochs": 2, "num_minibatches": 2,
+                          "entropy_coeff": 0.0, "gamma": 0.0, "lambda": 0.0})
+    rng = np.random.RandomState(1)
+    T, B = 32, 4
+    obs = rng.randn(T, B, 4).astype(np.float32)
+    actions = rng.randint(0, 2, (T, B)).astype(np.float32)
+    rollout = {
+        "obs": obs,
+        "actions": actions,
+        "logp": np.full((T, B), np.log(0.5), np.float32),
+        "values": np.zeros((T, B), np.float32),
+        "rewards": actions.copy(),  # action 1 rewarded, action 0 not
+        "dones": np.zeros((T, B), np.float32),
+        "last_values": np.zeros((B,), np.float32),
+    }
+    p0, _ = model.apply(lrn.params, jnp.asarray(obs.reshape(-1, 4)))
+    prob0 = float(jax.nn.softmax(p0, -1)[:, 1].mean())
+    for _ in range(3):
+        lrn.update(rollout)
+    p1, _ = model.apply(lrn.params, jnp.asarray(obs.reshape(-1, 4)))
+    prob1 = float(jax.nn.softmax(p1, -1)[:, 1].mean())
+    assert prob1 > prob0 + 0.05, f"policy did not move: {prob0} -> {prob1}"
+
+
+def test_learner_group_mesh_matches_single():
+    """dp=4 sharded update == single-device update (seeded)."""
+    from ray_tpu.rllib.learner import LearnerGroup
+    from ray_tpu.rllib.models import ActorCriticMLP
+
+    cfg = {"lr": 1e-3, "num_epochs": 1, "num_minibatches": 2}
+    rng = np.random.RandomState(2)
+    T, B = 16, 8
+    rollout = {
+        "obs": rng.randn(T, B, 4).astype(np.float32),
+        "actions": rng.randint(0, 2, (T, B)).astype(np.float32),
+        "logp": np.full((T, B), np.log(0.5), np.float32),
+        "values": rng.randn(T, B).astype(np.float32),
+        "rewards": rng.randn(T, B).astype(np.float32),
+        "dones": np.zeros((T, B), np.float32),
+        "last_values": np.zeros((B,), np.float32),
+    }
+    single = LearnerGroup(ActorCriticMLP(4, 2, (16,)), cfg, num_learners=1,
+                          seed=7)
+    sharded = LearnerGroup(ActorCriticMLP(4, 2, (16,)), cfg, num_learners=4,
+                           seed=7)
+    m1 = single.update(dict(rollout))
+    m4 = sharded.update(dict(rollout))
+    w1, w4 = single.get_weights(), sharded.get_weights()
+    for k in w1:
+        np.testing.assert_allclose(w1[k], w4[k], rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_ppo_learns_cartpole(ray_start_regular):
+    """The learning test: CartPole return must clear 100 within budget
+    (random policy: ~20).  Reference: rllib learning tests' reward gates."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=128)
+            .training(lr=1e-3, num_epochs=8, num_minibatches=4,
+                      entropy_coeff=0.01, model={"hidden": (64, 64)})
+            .debugging(seed=0)
+            .build())
+    best = 0.0
+    try:
+        for i in range(30):
+            res = algo.train()
+            best = max(best, res["episode_return_mean"])
+            if best >= 100.0:
+                break
+        assert best >= 100.0, f"PPO failed to learn CartPole: best {best}"
+    finally:
+        algo.stop()

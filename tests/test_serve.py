@@ -1,5 +1,5 @@
 """Serve-equivalent tests: deploy/call, batching, streaming, rolling update,
-replica death, autoscaling, HTTP proxy.
+replica death, autoscaling, HTTP proxy (reference: python/ray/serve/tests).
 
 Mirrors the reference's test strategy (``python/ray/serve/tests/``): each test
 drives the public API against a real single-node runtime.

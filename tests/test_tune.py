@@ -168,3 +168,115 @@ def test_tuner_over_trainer(ray_start_regular, tmp_path):
     ).fit()
     best = results.get_best_result()
     assert best.metrics["loss"] == pytest.approx(1.5)
+
+
+def test_tpe_searcher_beats_random_on_toy():
+    """TPE must concentrate samples near the optimum once past startup
+    (seeded, offline — no cluster needed)."""
+    from ray_tpu.tune import TPESearcher
+
+    space = {"x": tune.uniform(-1.0, 1.0), "y": tune.choice([0, 1, 2])}
+
+    def score(cfg):
+        # optimum at x=0.3, y=1 (small categorical coupling: per-dimension
+        # Parzen models are marginal, so a dominant cross-dim penalty would
+        # make the toy deceptive — a known TPE limitation, not a bug)
+        return -(cfg["x"] - 0.3) ** 2 - 0.1 * (cfg["y"] != 1)
+
+    tpe = TPESearcher(space, metric="obj", mode="max", n_startup=8, seed=0)
+    xs = []
+    best = -1e9
+    for i in range(40):
+        cfg = tpe.suggest(f"t{i}")
+        xs.append(cfg["x"])
+        val = score(cfg)
+        best = max(best, val)
+        tpe.on_trial_complete(f"t{i}", {"obj": val})
+    startup_err = sum(abs(x - 0.3) for x in xs[:8]) / 8
+    late_err = sum(abs(x - 0.3) for x in xs[-10:]) / 10
+    assert late_err < startup_err, (
+        f"no exploitation: late {late_err:.3f} vs startup {startup_err:.3f}")
+    assert best > -0.05, f"best {best} too far from optimum"
+    # random search with the same budget: expected best ~= -0.0025 only with
+    # luck; assert TPE used < half its samples far from the optimum
+    assert sum(1 for x in xs[8:] if abs(x - 0.3) < 0.25) > 16
+
+
+def test_tpe_log_and_int_domains():
+    from ray_tpu.tune import TPESearcher
+
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "layers": tune.randint(1, 16)}
+    import math
+
+    tpe = TPESearcher(space, metric="m", mode="min", n_startup=5, seed=1)
+    layer_picks = []
+    for i in range(25):
+        cfg = tpe.suggest(f"t{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert 1 <= cfg["layers"] < 16
+        assert isinstance(cfg["layers"], int)
+        layer_picks.append(cfg["layers"])
+        # optimum near lr=1e-3, layers=4
+        val = (math.log10(cfg["lr"]) + 3) ** 2 + (cfg["layers"] - 4) ** 2
+        tpe.on_trial_complete(f"t{i}", {"m": val})
+    # exploitation: late suggestions cluster nearer layers=4 than startup
+    late = layer_picks[-8:]
+    assert sum(abs(v - 4) for v in late) / 8 <= \
+        sum(abs(v - 4) for v in layer_picks[:5]) / 5 + 0.5
+
+
+def test_experiment_resume(ray_start_regular, tmp_path):
+    """Kill an experiment mid-flight; Tuner.restore must finish the
+    interrupted trials from their checkpoints and keep finished results."""
+    from ray_tpu.tune import TuneController
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = 0
+        if ckpt:
+            with open(os.path.join(ckpt.path, "it.txt")) as f:
+                start = int(f.read()) + 1
+        for i in range(start, 4):
+            d = os.path.join(tune.get_trial_dir(), f"_w{i}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "it.txt"), "w") as f:
+                f.write(str(i))
+            tune.report({"iter": i, "obj": config["x"] + i},
+                        checkpoint=Checkpoint(d))
+
+    exp_dir = str(tmp_path / "resume_exp")
+    os.makedirs(exp_dir, exist_ok=True)
+    searcher = BasicVariantGenerator({"x": tune.grid_search([10.0, 20.0])})
+    searcher.metric, searcher.mode = "obj", "max"
+
+    class StopAfterFirst(TuneController):
+        """Simulates a crash: stop the event loop after one trial finishes."""
+        def run(self):
+            try:
+                self._abort_after_one = True
+                return super().run()
+            except KeyboardInterrupt:
+                return self.trials
+
+        def _on_report(self, trial, metrics, ckpt):
+            super()._on_report(trial, metrics, ckpt)
+            done = [t for t in self.trials if t.status == "TERMINATED"]
+            if done and getattr(self, "_abort_after_one", False):
+                self._save_state()
+                raise KeyboardInterrupt
+
+    ctrl = StopAfterFirst(trainable, searcher, None, exp_dir,
+                          metric="obj", mode="max", max_concurrent=1)
+    trials = ctrl.run()
+    assert any(t.status == "TERMINATED" for t in trials)
+    assert os.path.exists(os.path.join(exp_dir, "experiment_state.pkl"))
+
+    # restore and finish
+    tuner = Tuner.restore(exp_dir, trainable,
+                          tune_config=TuneConfig(metric="obj", mode="max"))
+    results = tuner.fit()
+    assert len(results.trials) == 2
+    assert all(t.status == "TERMINATED" for t in results.trials)
+    best = results.get_best_result()
+    assert best.metrics["obj"] == pytest.approx(23.0)  # x=20 + iter 3

@@ -2,6 +2,8 @@
 (reference: python/ray/tests/test_actor_pool.py, test_queue.py,
 util/collective tests, test_state_api.py)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -97,7 +99,15 @@ def test_state_api(ray_start_regular):
         return 1
 
     ray_tpu.get(tiny_task.remote())
-    tasks = state.list_tasks()
+    # task events flush to the GCS on a 1s cadence (core_worker
+    # _flush_task_events_loop) — poll like the reference's state-API tests
+    # (wait_for_condition) instead of racing the buffer
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        if any(t.get("name") == "tiny_task" for t in tasks):
+            break
+        time.sleep(0.2)
     assert any(t.get("name") == "tiny_task" for t in tasks)
     summary = state.summarize_tasks()
     assert summary["total_tasks"] >= 1
