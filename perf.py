@@ -57,7 +57,10 @@ def main():
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=8)
+    # explicit store size: the put benchmark must measure shm write
+    # throughput, not LRU spill-to-disk (which the default capacity triggers
+    # at 8x64MB)
+    ray_tpu.init(num_cpus=8, object_store_memory=2 << 30)
     results = {}
 
     @ray_tpu.remote
@@ -139,12 +142,15 @@ def main():
         def pg_cycle():
             for _ in range(n):
                 pg = ray_tpu.placement_group([{"CPU": 1}])
-                ray_tpu.get(pg.ready(), timeout=30)
+                pg.ready(timeout=30)
                 ray_tpu.remove_placement_group(pg)
 
         results["pg_create_remove"] = timeit(pg_cycle, n)
 
         if args.serve:
+            # free the microbench actors' CPUs for the serve replicas
+            for actor in [a, aa, *actors]:
+                ray_tpu.kill(actor)
             from ray_tpu import serve
 
             @serve.deployment(num_replicas=2)

@@ -55,6 +55,7 @@ def init(address: Optional[str] = None,
          object_store_memory: int = 0,
          namespace: Optional[str] = None,
          ignore_reinit_error: bool = False,
+         log_to_driver: bool = True,
          _system_config: Optional[Dict[str, Any]] = None,
          worker_env: Optional[Dict[str, str]] = None) -> dict:
     """Start (or connect to) a cluster and attach this process as the driver."""
@@ -103,9 +104,53 @@ def init(address: Optional[str] = None,
                                         metadata={"namespace": namespace or "default"}))
     worker.job_id = JobID.from_hex(job_hex)
     _state.worker = worker
+    if log_to_driver:
+        _start_log_subscriber(worker)
     atexit.register(shutdown)
     return {"address": gcs_address, "session_dir": session_dir,
             "node_id": worker.node_id}
+
+
+def _start_log_subscriber(worker):
+    """Stream worker stdout/stderr to this driver (reference:
+    log_monitor.py:103 + worker.print_logs): a daemon thread long-polls the
+    GCS ``worker_logs`` topic and prefixes each line with its origin."""
+    import sys
+    import threading
+
+    from .rpc import RpcClient
+
+    def loop():
+        client = RpcClient(worker.gcs_address)
+        cursor = -1  # -1: start from "now" (first poll returns current seq)
+        try:
+            cursor, _ = run_async(client.call(
+                "pubsub_poll", topics=["worker_logs"], cursor=1 << 60,
+                timeout=0.01))
+        except Exception:
+            cursor = 0
+        while _state.worker is worker:
+            try:
+                cursor, events = run_async(
+                    client.call("pubsub_poll", topics=["worker_logs"],
+                                cursor=cursor, timeout=5.0),
+                    timeout=10.0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            for _seq, _topic, payload in events:
+                for entry in payload.get("batch", []):
+                    tag = f"({payload.get('node', '?')}:" \
+                          f"{entry.get('worker', '?')})"
+                    for line in entry.get("lines", []):
+                        print(f"{tag} {line}", file=sys.stderr)
+        try:
+            run_async(client.close(), timeout=2)
+        except Exception:
+            pass
+
+    threading.Thread(target=loop, daemon=True,
+                     name="log-subscriber").start()
 
 
 def _pick_agent(gcs_address: str) -> Optional[str]:

@@ -29,9 +29,11 @@ class Config:
     object_store_memory: int = 0
     #: Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    #: In-flight chunks per object pull (windowed parallel transfer).
+    object_transfer_parallelism: int = 4
     #: Max concurrent inbound object pulls admitted per node.
     object_pull_max_concurrency: int = 8
-    #: Spill directory ("" disables spilling).
+    #: Spill directory ("" = default under /tmp; "off" disables spilling).
     object_spilling_dir: str = ""
     #: Spill when store utilization exceeds this fraction.
     object_spilling_threshold: float = 0.8
@@ -81,6 +83,13 @@ class Config:
     # -- pubsub / syncer ---------------------------------------------------
     #: Resource-view gossip period (reference: RaySyncer, ray_syncer.h:86).
     resource_broadcast_period_s: float = 0.1
+
+    # -- OOM defense -------------------------------------------------------
+    #: Kill workers when node memory passes the threshold (reference:
+    #: memory_monitor.h:52 + worker_killing_policy.h:64 retriable-LIFO).
+    memory_monitor_enabled: bool = True
+    memory_monitor_interval_s: float = 1.0
+    memory_usage_threshold: float = 0.95
 
     # -- metrics -----------------------------------------------------------
     metrics_export_enabled: bool = True

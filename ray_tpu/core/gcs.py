@@ -118,6 +118,12 @@ class GcsServer:
         for ev in self._event_waiters:
             ev.set()
 
+    async def handle_publish(self, topic: str, payload: dict):
+        """Generic topic publish (reference: src/ray/pubsub Publisher) — used
+        by the log monitor, available to any client."""
+        self._publish(topic, payload)
+        return self._event_seq
+
     async def handle_pubsub_poll(self, topics: List[str], cursor: int,
                                  timeout: float = 30.0):
         def pending():

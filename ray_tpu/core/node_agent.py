@@ -51,6 +51,7 @@ class WorkerHandle:
     probe_failures: int = 0          # consecutive failed idle-reaper probes
     blocked: bool = False
     idle_since: float = field(default_factory=time.monotonic)
+    leased_at: float = 0.0           # last IDLE->LEASED transition
     registered: "asyncio.Event" = field(default_factory=asyncio.Event)
 
 
@@ -100,6 +101,9 @@ class NodeAgent:
     async def start(self):
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         await self.server.start()
+        if get_config().metrics_export_enabled:
+            # before registration: the endpoint port rides the node labels
+            await self._start_metrics_endpoint()
         self.gcs = RpcClient(self.gcs_address)
         res = await self.gcs.call("register_node", node_id=self.node_id.hex(),
                                   address=self.server.address,
@@ -107,6 +111,8 @@ class NodeAgent:
         self._apply_view(res["cluster_view"])
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._idle_reaper_loop()))
+        self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
+        self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         cfg = get_config()
         for _ in range(cfg.prestart_workers):
             asyncio.ensure_future(self._spawn_worker())
@@ -362,6 +368,7 @@ class NodeAgent:
         if w is None:
             w = await self._spawn_worker()
         w.state = "LEASED"
+        w.leased_at = time.monotonic()
         w.lease_id = lease_id
         try:
             await asyncio.wait_for(w.registered.wait(),
@@ -607,15 +614,33 @@ class NodeAgent:
                 client = self.agent_clients.get(addr)
                 try:
                     path = self.store.create(object_id, size)
-                    from .object_store import ShmSegment
                     seg = self.store._entries[object_id].segment
-                    off = 0
-                    while off < size:
-                        n = min(cfg.object_transfer_chunk_bytes, size - off)
-                        chunk = await client.call("read_chunk", object_id=object_id,
-                                                  offset=off, length=n)
-                        seg.view()[off:off + len(chunk)] = chunk
-                        off += len(chunk)
+                    # windowed parallel chunk pull (reference:
+                    # push_manager.h chunked parallel transfer) — overlaps
+                    # the RTTs instead of paying them serially
+                    chunk_n = cfg.object_transfer_chunk_bytes
+                    offsets = list(range(0, size, chunk_n))
+                    window = asyncio.Semaphore(
+                        max(1, cfg.object_transfer_parallelism))
+
+                    async def pull(off: int):
+                        async with window:
+                            n = min(chunk_n, size - off)
+                            chunk = await client.call(
+                                "read_chunk", object_id=object_id,
+                                offset=off, length=n)
+                            seg.view()[off:off + len(chunk)] = chunk
+
+                    pulls = [asyncio.ensure_future(pull(o)) for o in offsets]
+                    try:
+                        await asyncio.gather(*pulls)
+                    except BaseException:
+                        # stragglers must stop before store.free unmaps the
+                        # segment they write into
+                        for t in pulls:
+                            t.cancel()
+                        await asyncio.gather(*pulls, return_exceptions=True)
+                        raise
                     self.store.seal(object_id)
                     path, sz = self.store.get_path(object_id)
                     return {"path": path, "size": sz}
@@ -624,6 +649,162 @@ class NodeAgent:
                     self.store.free(object_id)
             raise RuntimeError(
                 f"failed to fetch {object_id} from {locations}: {last_err}")
+
+    # ------------------------------------------------------------ OOM defense
+
+    async def _memory_monitor_loop(self):
+        """Kill a worker before the kernel OOM-killer takes the whole node.
+
+        Reference: ``src/ray/common/memory_monitor.h:52`` + the raylet's
+        retriable-LIFO worker-killing policy (``worker_killing_policy.h:64``):
+        when node memory passes the threshold, kill the newest leased
+        (task-running) worker first — its task retries, and admission
+        backpressure (fewer workers) relieves the pressure.  Actors are
+        spared unless they are the only candidates (restarting an actor is
+        costlier than retrying a task)."""
+        cfg = get_config()
+        if not cfg.memory_monitor_enabled:
+            return
+        try:
+            import psutil
+        except ImportError:
+            return
+        while not self._shutting_down:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            try:
+                usage = psutil.virtual_memory().percent / 100.0
+                if usage < cfg.memory_usage_threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                victim.state = "DRAINING"
+                if victim.is_actor and victim.actor_id:
+                    # _kill_worker_proc releases leases but does not tell
+                    # the GCS — an unreported actor death would leave the
+                    # actor ALIVE forever and hang its callers
+                    try:
+                        await self.gcs.call(
+                            "report_actor_death", actor_id=victim.actor_id,
+                            reason="worker killed by memory monitor (OOM)")
+                    except Exception:
+                        pass
+                await self._kill_worker_proc(victim)
+                try:
+                    print(f"[memory-monitor] node memory {usage:.0%} >= "
+                          f"{cfg.memory_usage_threshold:.0%}: killed worker "
+                          f"{victim.worker_id[:12]} (retriable-LIFO)",
+                          flush=True)
+                except Exception:
+                    pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+    def _pick_oom_victim(self):
+        leased = [w for w in self.workers.values() if w.state == "LEASED"]
+        tasks = [w for w in leased if not w.is_actor]
+        pool = tasks or leased
+        if not pool:
+            return None
+        # LIFO by lease time: the newest lease loses the least progress
+        return max(pool, key=lambda w: w.leased_at)
+
+    # ---------------------------------------------------------- observability
+
+    async def handle_report_metrics(self, reporter: str, metrics: dict):
+        """Workers/drivers push their metric-registry snapshots here
+        (reference: stats export to the per-node agent, metric_exporter.h)."""
+        if not hasattr(self, "_metrics"):
+            self._metrics = {}
+        self._metrics[reporter] = metrics
+        return True
+
+    async def _start_metrics_endpoint(self):
+        """Prometheus text endpoint (reference: metrics_agent.py:375) —
+        aiohttp on a random port, advertised via the node's labels."""
+        try:
+            from aiohttp import web
+        except ImportError:
+            return
+
+        async def metrics_handler(_request):
+            from ray_tpu.util.metrics import render_prometheus
+            body = render_prometheus(getattr(self, "_metrics", {}))
+            body += self._runtime_metrics()
+            return web.Response(text=body,
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics_handler)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self._metrics_runner = runner
+        self.labels["metrics_port"] = str(port)
+
+    def _runtime_metrics(self) -> str:
+        """Built-in node gauges (reference: metric_defs.cc core metrics)."""
+        st = self.store.stats()
+        lines = [
+            "# TYPE raytpu_node_workers gauge",
+            f'raytpu_node_workers{{node="{self.node_id.hex()[:12]}"}} '
+            f"{len(self.workers)}",
+            "# TYPE raytpu_node_lease_queue_len gauge",
+            f'raytpu_node_lease_queue_len{{node="{self.node_id.hex()[:12]}"}} '
+            f"{len(self.lease_queue)}",
+            "# TYPE raytpu_object_store_bytes gauge",
+            f'raytpu_object_store_bytes{{node="{self.node_id.hex()[:12]}"}} '
+            f"{st.get('used', 0)}",
+        ]
+        for k, total in self.total.to_dict().items():
+            avail = self.available.to_dict().get(k, 0.0)
+            lines += [
+                f'raytpu_resource_available{{node="{self.node_id.hex()[:12]}",'
+                f'resource="{k}"}} {avail}',
+                f'raytpu_resource_total{{node="{self.node_id.hex()[:12]}",'
+                f'resource="{k}"}} {total}',
+            ]
+        return "\n".join(lines) + "\n"
+
+    async def _log_monitor_loop(self):
+        """Tail worker log files and publish new lines to the GCS pubsub
+        topic ``worker_logs`` (reference: _private/log_monitor.py:103 —
+        worker stdout/stderr shows up at the driver)."""
+        logdir = os.path.join(self.session_dir, "logs")
+        offsets: Dict[str, int] = {}
+        while not self._shutting_down:
+            await asyncio.sleep(0.5)
+            try:
+                batch = []
+                for fn in os.listdir(logdir):
+                    if not fn.startswith("worker-"):
+                        continue
+                    path = os.path.join(logdir, fn)
+                    off = offsets.get(fn, 0)
+                    size = os.path.getsize(path)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        data = f.read(min(size - off, 1 << 20))
+                    offsets[fn] = off + len(data)
+                    lines = data.decode(errors="replace").splitlines()
+                    if lines:
+                        batch.append({"worker": fn[len("worker-"):-4],
+                                      "lines": lines})
+                if batch and self.gcs:
+                    await self.gcs.call(
+                        "publish", topic="worker_logs",
+                        payload={"node": self.node_id.hex()[:12],
+                                 "batch": batch})
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
 
     # ----------------------------------------------------------------- misc
 

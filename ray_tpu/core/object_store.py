@@ -110,7 +110,14 @@ class NodeObjectStore:
         self._sealed_events: Dict[ObjectID, asyncio.Event] = {}
         self.num_creates = 0
         self.num_evictions = 0
-        self.spill_dir = cfg.object_spilling_dir or None
+        # Spill-on-evict is ON by default (reference: raylet spills rather
+        # than drop; local_object_manager.h:41) — an empty config value means
+        # "pick a default dir", not "disable".  Set it to "off" to disable.
+        if cfg.object_spilling_dir == "off":
+            self.spill_dir = None
+        else:
+            self.spill_dir = cfg.object_spilling_dir or os.path.join(
+                tempfile.gettempdir(), "raytpu", "spill")
 
     # -- creation ---------------------------------------------------------
 
@@ -151,8 +158,13 @@ class NodeObjectStore:
     # -- reads ------------------------------------------------------------
 
     def contains(self, object_id: ObjectID) -> bool:
+        """Locally retrievable: sealed in shm OR spilled to this node's disk
+        (get_path restores spilled entries transparently — without this,
+        fetch_object would declare a spilled-but-local object lost)."""
         e = self._entries.get(object_id)
-        return e is not None and e.sealed
+        if e is not None and e.sealed:
+            return True
+        return object_id in self._spilled
 
     async def wait_sealed(self, object_id: ObjectID, timeout: float | None = None) -> bool:
         e = self._entries.get(object_id)
@@ -274,6 +286,14 @@ class NodeObjectStore:
     def shutdown(self):
         for oid in list(self._entries):
             self.free(oid)
+        # spill files of still-referenced-but-evicted objects would otherwise
+        # outlive the session and accumulate under the shared default dir
+        for oid in list(self._spilled):
+            path = self._spilled.pop(oid)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,12 @@
+"""ray_tpu.dashboard — the control-plane REST API.
+
+Reference: ``dashboard/head.py:81`` + ``dashboard/modules/{node,actor,job,
+serve,healthz,state}`` (aiohttp REST the React UI and CLI consume).  The
+REST surface is implemented here over the GCS + state API; the web UI is out
+of scope (the reference's is ~25k LoC of TypeScript), but every endpoint
+returns plain JSON consumable by curl / the CLI / a future UI.
+"""
+
+from .head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,204 @@
+"""Dashboard head: aiohttp REST server over cluster state.
+
+Reference endpoints mirrored (dashboard/modules/*):
+  GET  /api/healthz            liveness (healthz module)
+  GET  /api/cluster            cluster summary (snapshot module)
+  GET  /api/nodes              node table + resources (node module)
+  GET  /api/actors             actor table (actor module)
+  GET  /api/tasks              task events (state module)
+  GET  /api/tasks/summarize    task state counts
+  GET  /api/objects            object table
+  GET  /api/placement_groups   PG table
+  GET  /api/jobs               submitted jobs (job module)
+  POST /api/jobs               submit a job {entrypoint, env?, metadata?}
+  GET  /api/jobs/{id}          job info
+  GET  /api/jobs/{id}/logs     job logs (text)
+  POST /api/jobs/{id}/stop     stop a job
+  GET  /api/serve              serve app status (serve module)
+  GET  /api/timeline           chrome://tracing export (timeline)
+
+Runs inside the driver (``start_dashboard()``) or as a standalone actor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+
+def _json(data: Any, status: int = 200):
+    from aiohttp import web
+    return web.json_response(data, status=status, dumps=lambda d: json.dumps(
+        d, default=str))
+
+
+async def _off(fn, *args):
+    """Run a blocking state/API call off the IO loop (the public APIs block
+    on RPC round-trips that are serviced by this same loop)."""
+    return await asyncio.get_event_loop().run_in_executor(None, fn, *args)
+
+
+class DashboardHead:
+    """The REST app; state comes from the public APIs so the dashboard can
+    never diverge from what users see programmatically."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._runner = None
+
+    # ---------------------------------------------------------- handlers
+
+    async def healthz(self, _req):
+        from aiohttp import web
+        return web.Response(text="success")
+
+    async def cluster(self, _req):
+        import ray_tpu
+
+        def snap():
+            return {
+                "nodes": len(ray_tpu.nodes()),
+                "resources_total": ray_tpu.cluster_resources(),
+                "resources_available": ray_tpu.available_resources(),
+            }
+
+        return _json(await _off(snap))
+
+    async def nodes(self, _req):
+        import ray_tpu
+        return _json(await _off(ray_tpu.nodes))
+
+    async def actors(self, req):
+        from ray_tpu.util import state
+        filters = self._filters(req)
+        return _json(await _off(lambda: state.list_actors(filters=filters)))
+
+    async def tasks(self, req):
+        from ray_tpu.util import state
+        filters = self._filters(req)
+        return _json(await _off(lambda: state.list_tasks(filters=filters)))
+
+    async def tasks_summarize(self, _req):
+        from ray_tpu.util import state
+        return _json(await _off(state.summarize_tasks))
+
+    async def objects(self, _req):
+        from ray_tpu.util import state
+        return _json(await _off(state.list_objects))
+
+    async def placement_groups(self, _req):
+        from ray_tpu.util import state
+        return _json(await _off(state.list_placement_groups))
+
+    async def jobs(self, _req):
+        from ray_tpu.job import JobSubmissionClient
+        return _json(await _off(lambda: JobSubmissionClient().list_jobs()))
+
+    async def submit_job(self, req):
+        from ray_tpu.job import JobSubmissionClient
+        body = await req.json()
+        job_id = await _off(lambda: JobSubmissionClient().submit_job(
+            entrypoint=body["entrypoint"],
+            runtime_env=body.get("runtime_env"),
+            metadata=body.get("metadata")))
+        return _json({"job_id": job_id})
+
+    async def job_info(self, req):
+        from ray_tpu.job import JobSubmissionClient
+        job_id = req.match_info["job_id"]
+        return _json(await _off(
+            lambda: JobSubmissionClient().get_job_info(job_id)))
+
+    async def job_logs(self, req):
+        from aiohttp import web
+        from ray_tpu.job import JobSubmissionClient
+        job_id = req.match_info["job_id"]
+        return web.Response(text=await _off(
+            lambda: JobSubmissionClient().get_job_logs(job_id)))
+
+    async def job_stop(self, req):
+        from ray_tpu.job import JobSubmissionClient
+        job_id = req.match_info["job_id"]
+        await _off(lambda: JobSubmissionClient().stop_job(job_id))
+        return _json({"stopped": True})
+
+    async def serve_status(self, _req):
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+        try:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            return _json({})
+        return _json(await _off(
+            lambda: ray_tpu.get(ctrl.get_status.remote(), timeout=30)))
+
+    async def timeline(self, _req):
+        from ray_tpu.util.tracing import chrome_trace
+        return _json(await _off(chrome_trace))
+
+    @staticmethod
+    def _filters(req) -> Optional[list]:
+        out = []
+        for k, v in req.query.items():
+            out.append((k, "=", v))
+        return out or None
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        r = app.router
+        r.add_get("/api/healthz", self.healthz)
+        r.add_get("/api/cluster", self.cluster)
+        r.add_get("/api/nodes", self.nodes)
+        r.add_get("/api/actors", self.actors)
+        r.add_get("/api/tasks", self.tasks)
+        r.add_get("/api/tasks/summarize", self.tasks_summarize)
+        r.add_get("/api/objects", self.objects)
+        r.add_get("/api/placement_groups", self.placement_groups)
+        r.add_get("/api/jobs", self.jobs)
+        r.add_post("/api/jobs", self.submit_job)
+        r.add_get("/api/jobs/{job_id}", self.job_info)
+        r.add_get("/api/jobs/{job_id}/logs", self.job_logs)
+        r.add_post("/api/jobs/{job_id}/stop", self.job_stop)
+        r.add_get("/api/serve", self.serve_status)
+        r.add_get("/api/timeline", self.timeline)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+_dashboard: Optional[DashboardHead] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the REST server on the driver's IO loop; returns the port."""
+    global _dashboard
+    from ray_tpu.core.rpc import run_async
+
+    if _dashboard is not None:
+        return _dashboard.port
+    _dashboard = DashboardHead(host, port)
+    return run_async(_dashboard.start())
+
+
+def stop_dashboard():
+    global _dashboard
+    from ray_tpu.core.rpc import run_async
+
+    if _dashboard is not None:
+        run_async(_dashboard.stop())
+        _dashboard = None

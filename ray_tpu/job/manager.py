@@ -199,6 +199,17 @@ class JobManager:
             await asyncio.wrap_future(ray_tpu.as_future(sup.stop.remote()))
             info.status = STOPPED
             info.finished_at = time.time()
+            # reap the detached supervisor like the monitor loop does, or a
+            # 0.1-CPU actor leaks per stopped job
+            try:
+                info.logs = await self._fetch_logs(sup)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(sup)
+            except Exception:
+                pass
+            self._supervisors.pop(job_id, None)
         return True
 
     async def _fetch_logs(self, sup, cap: int = 8 << 20) -> str:

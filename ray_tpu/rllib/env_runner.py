@@ -26,6 +26,11 @@ class EnvRunner:
         self.envs = [gym.make(env_name, **(env_config or {}))
                      for _ in range(num_envs)]
         self.model = ActorCriticMLP(**model_spec)
+        # compiled once: a fresh jit(self.model.apply) per sample() would
+        # retrace the policy on every rollout (bound methods never hit the
+        # jit cache)
+        import jax
+        self._apply = jax.jit(self.model.apply)
         self.num_envs = num_envs
         self._seed = seed
         self._rng_calls = 0
@@ -42,7 +47,7 @@ class EnvRunner:
         import jax.numpy as jnp
 
         params = jax.tree_util.tree_map(jnp.asarray, params_blob)
-        apply = jax.jit(self.model.apply)
+        apply = self._apply
         self._rng_calls += 1
         key = jax.random.PRNGKey(
             (self._seed << 20) ^ self._rng_calls)

@@ -122,12 +122,25 @@ def cmd_list(args):
 
 
 def cmd_timeline(args):
-    rt = _connect()
-    events = rt.timeline()
-    out = args.output or "timeline.json"
-    with open(out, "w") as f:
-        json.dump(events, f)
-    print(f"{len(events)} events -> {out}")
+    _connect()
+    from ray_tpu.util.tracing import export_chrome_trace
+
+    out = export_chrome_trace(args.output or "timeline.json")
+    print(f"chrome trace -> {out} (open in chrome://tracing or Perfetto)")
+
+
+def cmd_dashboard(args):
+    _connect()
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard(port=args.port)
+    print(f"dashboard REST at http://127.0.0.1:{port}/api "
+          f"(healthz/cluster/nodes/actors/tasks/jobs/serve/timeline)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
 
 
 # ------------------------------------------------------------------- jobs
@@ -199,9 +212,13 @@ def main(argv=None):
     s.add_argument("kind")
     s.set_defaults(fn=cmd_list)
 
-    s = sub.add_parser("timeline", help="export task timeline json")
+    s = sub.add_parser("timeline", help="export chrome-trace timeline json")
     s.add_argument("--output", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("dashboard", help="serve the REST dashboard")
+    s.add_argument("--port", type=int, default=8265)
+    s.set_defaults(fn=cmd_dashboard)
 
     s = sub.add_parser("submit", help="submit a job (entrypoint after --)")
     s.add_argument("--working-dir", default=None)

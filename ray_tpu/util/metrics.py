@@ -1,0 +1,222 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: ``python/ray/util/metrics.py`` (the Cython metric surface) and the
+export pipeline ``src/ray/stats`` -> per-node agent ->
+``_private/metrics_agent.py:375`` (Prometheus).  Here each process keeps a
+registry; a daemon thread pushes snapshots to its node agent, which serves
+the Prometheus text endpoint (``node_agent._render_prometheus``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_BOUNDARIES = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                      5.0, 10.0, 30.0, 60.0)
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_flusher_started = False
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name.replace("_", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(f"metric {name!r} already registered as "
+                                 f"{existing.kind}")
+            _registry[name] = self
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]):
+        if self._default_tags or tags:
+            merged = dict(self._default_tags)
+            merged.update(tags or {})
+            return merged
+        return None
+
+    def snapshot(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only go up")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"kind": self.kind, "help": self.description,
+                    "values": dict(self._values)}
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tags_key(self._merged(tags))] = float(value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"kind": self.kind, "help": self.description,
+                    "values": dict(self._values)}
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries=DEFAULT_BOUNDARIES,
+                 tag_keys=()):
+        self.boundaries = tuple(sorted(boundaries))
+        self._buckets: Dict[tuple, List[int]] = {}
+        self._sum: Dict[tuple, float] = {}
+        self._count: Dict[tuple, int] = {}
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + value
+            self._count[key] = self._count.get(key, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"kind": self.kind, "help": self.description,
+                    "boundaries": self.boundaries,
+                    "buckets": {k: list(v) for k, v in self._buckets.items()},
+                    "sum": dict(self._sum), "count": dict(self._count)}
+
+
+# ---------------------------------------------------------------- flushing
+
+def snapshot_registry() -> Dict[str, dict]:
+    with _registry_lock:
+        metrics = list(_registry.items())
+    return {name: m.snapshot() for name, m in metrics}
+
+
+def _flush_once() -> bool:
+    """Push this process's registry to its node agent (best effort)."""
+    try:
+        from ray_tpu.core.core_worker import global_worker_or_none
+        from ray_tpu.core.rpc import run_async
+
+        w = global_worker_or_none()
+        if w is None or w.agent is None:
+            return False
+        snap = snapshot_registry()
+        if not snap:
+            return True
+        run_async(w.agent.call(
+            "report_metrics",
+            reporter=f"{w.mode}-{w.worker_id.hex()[:12]}",
+            metrics=snap), timeout=5)
+        return True
+    except Exception:
+        return False
+
+
+def _ensure_flusher(period_s: float = 2.0):
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(period_s)
+            _flush_once()
+
+    threading.Thread(target=loop, daemon=True,
+                     name="metrics-flush").start()
+
+
+# ------------------------------------------------------------- rendering
+
+def render_prometheus(per_reporter: Dict[str, Dict[str, dict]]) -> str:
+    """{reporter -> {metric -> snapshot}} -> Prometheus exposition text."""
+    out: List[str] = []
+    seen_header = set()
+
+    def fmt_tags(key: tuple, extra: Dict[str, str]) -> str:
+        pairs = dict(key)
+        pairs.update(extra)
+        if not pairs:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(pairs.items()))
+        return "{" + inner + "}"
+
+    for reporter, metrics in sorted(per_reporter.items()):
+        for name, snap in sorted(metrics.items()):
+            if name not in seen_header:
+                seen_header.add(name)
+                if snap.get("help"):
+                    out.append(f"# HELP {name} {snap['help']}")
+                out.append(f"# TYPE {name} {snap['kind']}")
+            extra = {"reporter": reporter}
+            if snap["kind"] in ("counter", "gauge"):
+                for key, v in snap["values"].items():
+                    out.append(f"{name}{fmt_tags(key, extra)} {v}")
+            elif snap["kind"] == "histogram":
+                bounds = snap["boundaries"]
+                for key, buckets in snap["buckets"].items():
+                    acc = 0
+                    for i, b in enumerate(bounds):
+                        acc += buckets[i]
+                        out.append(
+                            f"{name}_bucket"
+                            f"{fmt_tags(key, {**extra, 'le': str(b)})} {acc}")
+                    acc += buckets[-1]
+                    out.append(
+                        f"{name}_bucket"
+                        f"{fmt_tags(key, {**extra, 'le': '+Inf'})} {acc}")
+                    out.append(f"{name}_sum{fmt_tags(key, extra)} "
+                               f"{snap['sum'][key]}")
+                    out.append(f"{name}_count{fmt_tags(key, extra)} "
+                               f"{snap['count'][key]}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,101 @@
+"""Tracing: span API + chrome://tracing export.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py`` (OpenTelemetry span
+wrapping — opentelemetry is lazy/optional there and absent in this image, so
+spans record into the task-event stream instead and export to the same
+places) and the ``ray timeline`` Chrome trace export (scripts.py).
+
+``chrome_trace()`` converts the GCS task-event history into the Chrome Trace
+Event Format (phase "X" complete events, one row per worker), loadable in
+chrome://tracing or Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes) -> Iterator[None]:
+    """User-code span: records begin/end into the task-event stream, so user
+    phases land in the same timeline as task state transitions."""
+    from ray_tpu.core.core_worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        if w is not None:
+            try:
+                w._task_events.append({
+                    "task_id": f"span-{name}-{int(t0 * 1e6)}",
+                    "name": name, "state": "SPAN",
+                    "job_id": w.job_id.hex() if w.job_id else "",
+                    "ts": t0, "dur": time.time() - t0,
+                    "actor_id": None,
+                    "attributes": attributes or None,
+                    "worker": w.worker_id.hex()[:12],
+                })
+            except Exception:
+                pass
+
+
+def _pid_for(ev: dict) -> str:
+    return ev.get("worker") or ev.get("node_id") or "driver"
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
+    """Task events -> Chrome Trace Event Format (reference: `ray timeline`).
+
+    RUNNING->FINISHED/FAILED pairs become complete ("X") slices; other state
+    transitions become instant ("i") events; SPAN records map directly.
+    """
+    if events is None:
+        import ray_tpu
+        events = ray_tpu.timeline()
+
+    out: List[dict] = []
+    running: Dict[str, dict] = {}
+    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        state = ev.get("state")
+        us = ev.get("ts", 0.0) * 1e6
+        base = {"pid": _pid_for(ev), "tid": _pid_for(ev),
+                "name": ev.get("name") or ev.get("task_id", "")[:12]}
+        if state == "SPAN":
+            out.append({**base, "ph": "X", "ts": us,
+                        "dur": ev.get("dur", 0.0) * 1e6,
+                        "cat": "span", "args": ev.get("attributes") or {}})
+        elif state == "RUNNING":
+            running[ev.get("task_id")] = ev
+        elif state in ("FINISHED", "FAILED"):
+            start = running.pop(ev.get("task_id"), None)
+            if start is not None:
+                out.append({**base, "ph": "X",
+                            "ts": start.get("ts", 0.0) * 1e6,
+                            "dur": max(us - start.get("ts", 0.0) * 1e6, 1.0),
+                            "cat": "task",
+                            "args": {"state": state,
+                                     "task_id": ev.get("task_id")}})
+            else:
+                out.append({**base, "ph": "i", "ts": us, "s": "t",
+                            "cat": "task", "args": {"state": state}})
+        else:
+            out.append({**base, "ph": "i", "ts": us, "s": "t",
+                        "cat": "task", "args": {"state": state}})
+    # still-open slices render as instants so nothing is silently dropped
+    for task_id, start in running.items():
+        out.append({"pid": _pid_for(start), "tid": _pid_for(start),
+                    "name": start.get("name", task_id[:12]), "ph": "i",
+                    "ts": start.get("ts", 0.0) * 1e6, "s": "t",
+                    "cat": "task", "args": {"state": "RUNNING"}})
+    return out
+
+
+def export_chrome_trace(path: str, events: Optional[List[dict]] = None):
+    import json
+    with open(path, "w") as f:
+        json.dump(chrome_trace(events), f)
+    return path

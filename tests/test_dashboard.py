@@ -1,0 +1,111 @@
+"""Dashboard REST + tracing + OOM-policy tests (reference:
+dashboard/modules tests, `ray timeline`, worker_killing_policy_test.cc)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_dashboard_rest_surface(ray_start_regular, tmp_path):
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard, head
+
+    port = start_dashboard()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        assert requests.get(f"{base}/healthz", timeout=10).text == "success"
+        cluster = requests.get(f"{base}/cluster", timeout=10).json()
+        assert cluster["nodes"] >= 1
+        assert "CPU" in cluster["resources_total"]
+
+        @ray_tpu.remote
+        class Dummy:
+            def ping(self):
+                return 1
+
+        a = Dummy.options(name="dash-actor").remote()
+        ray_tpu.get(a.ping.remote(), timeout=30)
+        actors = requests.get(f"{base}/actors", timeout=10).json()
+        assert any(x.get("name") == "dash-actor" for x in actors)
+
+        nodes = requests.get(f"{base}/nodes", timeout=10).json()
+        assert len(nodes) >= 1 and nodes[0]["Alive"]
+
+        # job submission through REST
+        r = requests.post(f"{base}/jobs", json={
+            "entrypoint": f"{sys.executable} -c 'print(\"REST_JOB_OK\")'"},
+            timeout=60)
+        job_id = r.json()["job_id"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            info = requests.get(f"{base}/jobs/{job_id}", timeout=10).json()
+            if info["status"] in ("SUCCEEDED", "FAILED"):
+                break
+            time.sleep(0.5)
+        assert info["status"] == "SUCCEEDED"
+        logs = requests.get(f"{base}/jobs/{job_id}/logs", timeout=10).text
+        assert "REST_JOB_OK" in logs
+
+        # timeline exports chrome-trace events
+        trace = requests.get(f"{base}/timeline", timeout=10).json()
+        assert isinstance(trace, list)
+    finally:
+        head.stop_dashboard()
+
+
+def test_chrome_trace_and_spans(ray_start_regular, tmp_path):
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    with tracing.span("user-phase", step=1):
+        ray_tpu.get(traced_task.remote(), timeout=30)
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        trace = tracing.chrome_trace()
+        slices = [e for e in trace if e["ph"] == "X"]
+        if any(e["name"] == "traced_task" for e in slices) and \
+                any(e["name"] == "user-phase" for e in slices):
+            break
+        time.sleep(0.3)
+    names = {e["name"] for e in trace if e["ph"] == "X"}
+    assert "traced_task" in names, names
+    assert "user-phase" in names, names
+    span_ev = next(e for e in trace if e["name"] == "user-phase")
+    assert span_ev["dur"] > 0
+    assert span_ev["args"] == {"step": 1}
+
+    out = tracing.export_chrome_trace(str(tmp_path / "trace.json"))
+    import json
+    assert json.load(open(out))
+
+
+def test_oom_victim_policy():
+    """Retriable-LIFO: newest leased task worker first; actors spared."""
+    from ray_tpu.core.node_agent import NodeAgent, WorkerHandle
+
+    agent = NodeAgent.__new__(NodeAgent)  # policy is pure over .workers
+    mk = lambda wid, state, actor, t: WorkerHandle(
+        worker_id=wid, proc=None, state=state, is_actor=actor)
+    agent.workers = {}
+    assert agent._pick_oom_victim() is None
+
+    w_old = mk("old-task", "LEASED", False, 1)
+    w_old.leased_at = 1.0
+    w_new = mk("new-task", "LEASED", False, 2)
+    w_new.leased_at = 2.0
+    w_actor = mk("actor", "LEASED", True, 3)
+    w_actor.leased_at = 3.0
+    w_idle = mk("idle", "IDLE", False, 4)
+    agent.workers = {w.worker_id: w
+                     for w in (w_old, w_new, w_actor, w_idle)}
+    assert agent._pick_oom_victim() is w_new  # newest TASK, not the actor
+    del agent.workers["new-task"], agent.workers["old-task"]
+    assert agent._pick_oom_victim() is w_actor  # actors only as last resort

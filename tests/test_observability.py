@@ -1,0 +1,96 @@
+"""Observability tests: user metrics -> Prometheus endpoint, worker log
+streaming to the driver, generic pubsub (reference: test_metrics_agent.py,
+log_monitor tests)."""
+
+import io
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_metrics_registry_and_render():
+    from ray_tpu.util import metrics as m
+
+    c = m.Counter("reqs_total", "requests", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    g = m.Gauge("queue_depth", "depth")
+    g.set(7)
+    h = m.Histogram("latency_s", "latency", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = m.snapshot_registry()
+    assert snap["reqs_total"]["values"][(("route", "/a"),)] == 3
+    text = m.render_prometheus({"w1": snap})
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{reporter="w1",route="/a"} 3' in text
+    assert 'queue_depth{reporter="w1"} 7' in text
+    assert 'latency_s_bucket{le="0.1",reporter="w1"} 1' in text
+    assert 'latency_s_bucket{le="+Inf",reporter="w1"} 3' in text
+    assert 'latency_s_count{reporter="w1"} 3' in text
+
+
+def test_metrics_flow_to_prometheus_endpoint(ray_start_regular):
+    import requests
+
+    @ray_tpu.remote
+    class Worker:
+        def work(self):
+            from ray_tpu.util.metrics import Counter
+            c = Counter("work_items", "processed")
+            c.inc(5)
+            from ray_tpu.util.metrics import _flush_once
+            assert _flush_once()
+            return True
+
+    w = Worker.remote()
+    assert ray_tpu.get(w.work.remote(), timeout=60)
+    # find the node's metrics endpoint from its labels
+    nodes = ray_tpu.nodes()
+    port = next(n["Labels"].get("metrics_port") for n in nodes
+                if n["Labels"].get("metrics_port"))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        body = requests.get(f"http://127.0.0.1:{port}/metrics",
+                            timeout=10).text
+        if "work_items" in body:
+            break
+        time.sleep(0.5)
+    assert "work_items" in body, body[:2000]
+    assert "raytpu_node_workers" in body
+    assert "raytpu_resource_total" in body
+
+
+def test_worker_logs_stream_to_driver(ray_start_regular, capfd):
+    @ray_tpu.remote
+    def chatty():
+        print("HELLO_FROM_WORKER_STDOUT")
+        print("WORKER_STDERR_LINE", file=sys.stderr)
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 15
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().err
+        if "HELLO_FROM_WORKER_STDOUT" in seen:
+            break
+        time.sleep(0.5)
+    assert "HELLO_FROM_WORKER_STDOUT" in seen
+    assert "WORKER_STDERR_LINE" in seen
+
+
+def test_generic_pubsub(ray_start_regular):
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+
+    w = global_worker()
+    seq = run_async(w.gcs.call("publish", topic="custom",
+                               payload={"x": 1}))
+    cursor, events = run_async(w.gcs.call(
+        "pubsub_poll", topics=["custom"], cursor=seq - 1, timeout=5.0))
+    assert any(p == {"x": 1} for _s, _t, p in events)
