@@ -1,0 +1,18 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference: ``python/ray/autoscaler/_private/autoscaler.py:166``
+(StandardAutoscaler), ``monitor.py`` (the polling process),
+``resource_demand_scheduler.py`` (bin-packing pending demands onto node
+types), ``node_provider.py:13`` (the cloud plugin ABC).
+
+TPU angle: node types carry ``tpu_slice``/``ici_coord`` labels so scaled-up
+nodes land in the topology-aware placement path (core/scheduling.py); a
+GCE/QR provider plugs in through the same NodeProvider ABC the local
+subprocess provider implements.
+"""
+
+from .autoscaler import AutoscalerConfig, NodeType, StandardAutoscaler
+from .providers import LocalNodeProvider, NodeProvider
+
+__all__ = ["StandardAutoscaler", "AutoscalerConfig", "NodeType",
+           "NodeProvider", "LocalNodeProvider"]

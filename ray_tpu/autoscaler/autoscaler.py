@@ -1,0 +1,232 @@
+"""StandardAutoscaler: poll load -> bin-pack pending demands -> scale.
+
+Reference: ``autoscaler/_private/autoscaler.py:166`` (StandardAutoscaler
+update loop), ``resource_demand_scheduler.py`` (demand bin-packing onto node
+types), ``monitor.py`` (the driving process).
+
+Scale-up: pending lease demands that no live node can satisfy are bin-packed
+onto prospective launches of the first feasible node type (first-fit
+decreasing over max_workers budgets; no cost model — node_types dict order
+is the preference order).
+Scale-down: provider nodes idle (no queued work, full availability) past
+``idle_timeout_s`` are drained + terminated, respecting ``min_workers``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .providers import LocalNodeProvider, NodeProvider
+
+
+@dataclasses.dataclass
+class NodeType:
+    resources: Dict[str, float]
+    max_workers: int = 8
+    min_workers: int = 0
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeType]
+    poll_interval_s: float = 1.0
+    idle_timeout_s: float = 30.0
+    upscaling_speed: int = 2   # max nodes launched per update
+
+
+class StandardAutoscaler:
+    """Runs in the driver (or a monitor process) against the GCS."""
+
+    def __init__(self, gcs_address: str, config: AutoscalerConfig,
+                 provider: Optional[NodeProvider] = None):
+        self.gcs_address = gcs_address
+        self.config = config
+        self.provider = provider or LocalNodeProvider(
+            gcs_address, {name: dataclasses.asdict(nt)
+                          for name, nt in config.node_types.items()})
+        self._owned: Dict[str, str] = {}       # provider id -> node type
+        self._launched_at: Dict[str, float] = {}
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # ------------------------------------------------------------- control
+
+    def start(self):
+        for name, nt in self.config.node_types.items():
+            for _ in range(nt.min_workers):
+                self._launch(name)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self, terminate_nodes: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if terminate_nodes and isinstance(self.provider, LocalNodeProvider):
+            self.provider.shutdown()
+
+    # ---------------------------------------------------------------- loop
+
+    def _loop(self):
+        from ray_tpu.core.rpc import RpcClient, run_async
+
+        client = RpcClient(self.gcs_address)
+        while not self._stop.is_set():
+            try:
+                load = run_async(client.call("get_load"), timeout=10)
+                self.update(load)
+            except Exception:
+                pass
+            self._stop.wait(self.config.poll_interval_s)
+        try:
+            run_async(client.close(), timeout=2)
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- update
+
+    def update(self, load: Dict):
+        """One reconcile pass over a load snapshot (pure given the snapshot;
+        the unit tests drive this directly like the reference's
+        StandardAutoscaler.update tests).
+
+        ``load`` is the GCS get_load payload: {"nodes": {...},
+        "pending_demands": [...]} — infeasible driver-side demands arrive in
+        pending_demands, node-queued ones in each node's queued_demands."""
+        nodes = load.get("nodes", load)
+        extra = load.get("pending_demands", []) if "nodes" in load else []
+        alive = {nid: n for nid, n in nodes.items() if n.get("alive")}
+        unmet = self._unmet_demands(alive, extra)
+        if unmet:
+            self._scale_up(unmet)
+        self._scale_down(alive)
+
+    def _unmet_demands(self, alive: Dict[str, dict],
+                       extra: List[Dict[str, float]]) -> List[Dict[str, float]]:
+        """Pending demand shapes no node can currently satisfy, minus what
+        free capacity could absorb (simulated placement like
+        resource_demand_scheduler)."""
+        free = {nid: dict(n["available"]) for nid, n in alive.items()}
+        demands = list(extra)
+        for n in alive.values():
+            for entry in n.get("queued_demands", []):
+                # agents report aggregated [shape, count] pairs; accept bare
+                # shapes too (driver pending-demand reports)
+                if isinstance(entry, (list, tuple)) and len(entry) == 2:
+                    shape, count = entry
+                    demands.extend([shape] * min(int(count), 100))
+                else:
+                    demands.append(entry)
+        unmet = []
+        for demand in demands:
+            placed = False
+            for nid, avail in free.items():
+                if all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items() if v > 0):
+                    for k, v in demand.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(demand)
+        return unmet
+
+    def _scale_up(self, unmet: List[Dict[str, float]]):
+        budget = self.config.upscaling_speed
+        counts = self._owned_counts()
+        # first-fit decreasing onto prospective launches: a planned node
+        # absorbs as many pending demands as fit before another is launched
+        # (reference: resource_demand_scheduler's simulated bin-packing)
+        prospective: List[Dict[str, float]] = []
+        for demand in unmet:
+            placed = False
+            for cap in prospective:
+                if all(cap.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items() if v > 0):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            if budget <= 0:
+                continue
+            for name, nt in self.config.node_types.items():
+                if counts.get(name, 0) >= nt.max_workers:
+                    continue
+                if all(nt.resources.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items() if v > 0):
+                    self._launch(name)
+                    counts[name] = counts.get(name, 0) + 1
+                    budget -= 1
+                    cap = dict(nt.resources)
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    prospective.append(cap)
+                    break
+
+    def _scale_down(self, alive: Dict[str, dict]):
+        if not isinstance(self.provider, LocalNodeProvider):
+            return
+        now = time.monotonic()
+        counts = self._owned_counts()
+        for pid in list(self._owned):
+            ntype = self._owned[pid]
+            raytpu_id = self.provider.raytpu_node_id(pid)
+            n = alive.get(raytpu_id)
+            if n is None:
+                # registered but not alive in the view: the node hung or the
+                # GCS declared it dead — a zombie process would otherwise
+                # hold a max_workers slot forever
+                launched = self._launched_at.get(pid, now)
+                if now - launched > 60.0:
+                    self._terminate(pid)
+                    counts[ntype] = max(0, counts.get(ntype, 1) - 1)
+                continue
+            busy = (n.get("queue_len", 0) > 0
+                    or any(n["available"].get(k, 0.0) + 1e-9 < v
+                           for k, v in n["total"].items()))
+            if busy:
+                self._idle_since.pop(pid, None)
+                continue
+            first_idle = self._idle_since.setdefault(pid, now)
+            nt = self.config.node_types[ntype]
+            if (now - first_idle >= self.config.idle_timeout_s
+                    and counts.get(ntype, 0) > nt.min_workers):
+                self._terminate(pid)
+                counts[ntype] -= 1
+
+    # ---------------------------------------------------------- primitives
+
+    def _owned_counts(self) -> Dict[str, int]:
+        live = set(self.provider.non_terminated_nodes())
+        self._owned = {pid: t for pid, t in self._owned.items()
+                       if pid in live or pid not in self._idle_since}
+        counts: Dict[str, int] = {}
+        for pid, t in self._owned.items():
+            if pid in live:
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _launch(self, node_type: str):
+        nt = self.config.node_types[node_type]
+        pid = self.provider.create_node(node_type, dict(nt.labels))
+        self._owned[pid] = node_type
+        self._launched_at[pid] = time.monotonic()
+        self.num_launches += 1
+
+    def _terminate(self, pid: str):
+        self.provider.terminate_node(pid)
+        self._owned.pop(pid, None)
+        self._idle_since.pop(pid, None)
+        self._launched_at.pop(pid, None)
+        self.num_terminations += 1

@@ -1,0 +1,119 @@
+"""Node providers: how the autoscaler actually gets machines.
+
+Reference: ``python/ray/autoscaler/node_provider.py:13`` (NodeProvider ABC)
+and ``_private/fake_multi_node/node_provider.py:237`` (FakeMultiNodeProvider
+— real node processes on one machine, used to test autoscaler logic without
+a cloud).  ``LocalNodeProvider`` is that fake-multi-node equivalent; a GCE
+TPU-pod provider implements the same three methods against the GCE/QR APIs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal cloud-plugin surface (create/terminate/list)."""
+
+    def create_node(self, node_type: str, labels: Dict[str, str]) -> str:
+        """Launch one node of `node_type`; returns a provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Real ``node_main`` subprocesses joining the GCS — autoscaling logic
+    runs against genuine nodes without a cloud account."""
+
+    def __init__(self, gcs_address: str, node_types: Dict[str, dict],
+                 session_dir: Optional[str] = None):
+        self.gcs_address = gcs_address
+        self.node_types = node_types
+        self.session_dir = session_dir or os.path.join(
+            "/tmp/raytpu", f"autoscaler-{os.getpid()}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._raytpu_node_ids: Dict[str, str] = {}
+
+    def create_node(self, node_type: str, labels: Dict[str, str]) -> str:
+        spec = self.node_types[node_type]
+        resources = dict(spec.get("resources", {}))
+        num_cpus = resources.pop("CPU", 1)
+        num_tpus = resources.pop("TPU", 0)
+        all_labels = dict(spec.get("labels", {}))
+        all_labels.update(labels)
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_main",
+               "--gcs-address", self.gcs_address,
+               "--num-cpus", str(num_cpus),
+               "--num-tpus", str(num_tpus),
+               "--resources", json.dumps(resources),
+               "--labels", json.dumps(all_labels),
+               "--session-dir", self.session_dir]
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        logf = open(os.path.join(self.session_dir, "logs",
+                                 f"scaled-{len(self._procs)}.log"), "ab")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=logf,
+                                env=env)
+        # register BEFORE waiting so a failed boot is still terminated, and
+        # bound the wait — an unreachable GCS must not wedge the autoscaler
+        pid = f"local-{uuid.uuid4().hex[:8]}"
+        self._procs[pid] = proc
+        line = self._read_line_with_timeout(proc, timeout_s=60.0)
+        if not line:
+            self.terminate_node(pid)
+            raise RuntimeError(f"node {node_type} failed to start "
+                              f"(no registration line within 60s)")
+        info = json.loads(line)
+        self._raytpu_node_ids[pid] = info["node_id"]
+        return pid
+
+    @staticmethod
+    def _read_line_with_timeout(proc, timeout_s: float) -> str:
+        import threading
+
+        box = {}
+
+        def read():
+            try:
+                box["line"] = proc.stdout.readline().decode()
+            except Exception:
+                box["line"] = ""
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        return box.get("line", "")
+
+    def terminate_node(self, provider_id: str) -> None:
+        proc = self._procs.pop(provider_id, None)
+        self._raytpu_node_ids.pop(provider_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [pid for pid, p in self._procs.items() if p.poll() is None]
+
+    def raytpu_node_id(self, provider_id: str) -> Optional[str]:
+        return self._raytpu_node_ids.get(provider_id)
+
+    def shutdown(self):
+        for pid in list(self._procs):
+            self.terminate_node(pid)

@@ -104,6 +104,12 @@ class ActorClass:
         ac._blob, ac._fn_id = self._blob, self._fn_id
         return ac
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node: the actor is created at dag.execute() time
+        (reference: dag/class_node.py)."""
+        from ..dag import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def _ensure_registered(self, worker) -> bytes:
         if self._blob is None:
             self._blob = serialization.dumps_function(self._cls)

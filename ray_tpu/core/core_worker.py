@@ -318,7 +318,19 @@ class LeasePool:
                     nid = pick_node(view, self.resources, self.strategy,
                                     local_node_id=self.w.node_id)
                     if nid is None:
-                        await asyncio.sleep(0.5)  # infeasible now; wait for nodes
+                        # Infeasible right now: surface the demand shape to
+                        # the GCS so the autoscaler can see it (reference:
+                        # infeasible tasks show up in cluster load) and wait
+                        # for nodes.
+                        try:
+                            await self.w.gcs.call(
+                                "report_pending_demand",
+                                reporter=self.w.address,
+                                shape=self.resources,
+                                count=max(len(self.queue), 1))
+                        except Exception:
+                            pass
+                        await asyncio.sleep(0.5)
                         if not self.queue:
                             return
                         continue

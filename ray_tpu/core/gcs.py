@@ -156,12 +156,17 @@ class GcsServer:
         return {"node_id": node_id, "cluster_view": self._view_payload()}
 
     async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
-                               queue_len: int = 0, store_stats: dict | None = None):
+                               queue_len: int = 0, store_stats: dict | None = None,
+                               queued_demands: List[Dict[str, float]] | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             return {"unknown": True}  # agent should re-register
         n.available = dict(available)
         n.queue_len = queue_len
+        # resource shapes queued behind this node's leases — the autoscaler's
+        # scale-up signal (reference: cluster load reported to the monitor,
+        # autoscaler/_private/load_metrics.py)
+        n.labels["_queued_demands"] = queued_demands or []
         if not n.alive:
             n.alive = True
             self._publish("nodes", {"event": "alive", "node_id": node_id,
@@ -174,6 +179,44 @@ class GcsServer:
     async def handle_drain_node(self, node_id: str):
         await self._mark_node_dead(node_id, reason="drained")
         return True
+
+    async def handle_report_pending_demand(self, reporter: str, shape: dict,
+                                           count: int = 1):
+        """Drivers/workers report demand shapes no live node can satisfy
+        (infeasible-task load; reference: load_metrics resource demand).
+        Entries expire after a few seconds of silence."""
+        if not hasattr(self, "_pending_demands"):
+            self._pending_demands = {}
+        key = (reporter, tuple(sorted(shape.items())))
+        self._pending_demands[key] = (dict(shape), count, time.monotonic())
+        return True
+
+    async def handle_get_load(self):
+        """Cluster load for the autoscaler: per-node resources + pending
+        demand shapes + infeasible driver demands (reference: the monitor's
+        GetAllResourceUsage poll)."""
+        now = time.monotonic()
+        pending = []
+        for key, (shape, count, ts) in list(
+                getattr(self, "_pending_demands", {}).items()):
+            if now - ts > 5.0:
+                self._pending_demands.pop(key, None)
+                continue
+            pending.extend([shape] * count)
+        return {
+            "nodes": {
+                nid: {
+                    "alive": n.alive,
+                    "total": n.total,
+                    "available": n.available,
+                    "queue_len": n.queue_len,
+                    "queued_demands": n.labels.get("_queued_demands", []),
+                    "labels": {k: v for k, v in n.labels.items()
+                               if not k.startswith("_")},
+                }
+                for nid, n in self.nodes.items()},
+            "pending_demands": pending,
+        }
 
     def _view_payload(self) -> Dict[str, dict]:
         return {nid: {"address": n.address, "total": n.total,

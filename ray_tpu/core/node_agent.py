@@ -135,6 +135,16 @@ class NodeAgent:
         await self.server.stop()
         self.store.shutdown()
 
+    def _aggregate_demands(self, max_shapes: int = 50):
+        """Queued lease demands as (shape, count) pairs — a wide fan-out must
+        not serialize thousands of identical dicts into every heartbeat
+        (reference: load reporting aggregates by shape)."""
+        counts: Dict[tuple, int] = {}
+        for r in self.lease_queue:
+            key = tuple(sorted(r.resources.items()))
+            counts[key] = counts.get(key, 0) + 1
+        return [[dict(k), c] for k, c in list(counts.items())[:max_shapes]]
+
     def _apply_view(self, payload: Dict[str, dict]):
         self.cluster_view = {
             nid: NodeView(nid, d["address"], d["total"], d["available"],
@@ -150,6 +160,7 @@ class NodeAgent:
                     "heartbeat", node_id=self.node_id.hex(),
                     available=self.available.to_dict(),
                     queue_len=len(self.lease_queue),
+                    queued_demands=self._aggregate_demands(),
                     store_stats=self.store.stats())
                 if res.get("unknown"):
                     res2 = await self.gcs.call(

@@ -65,6 +65,12 @@ class RemoteFunction:
         rf._blob, rf._fn_id = self._blob, self._fn_id
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node instead of immediate submission (reference:
+        dag/function_node.py)."""
+        from ..dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from .core_worker import global_worker
         w = global_worker()

@@ -1,0 +1,16 @@
+"""ray_tpu.workflow — durable workflow execution.
+
+Reference: ``python/ray/workflow/`` (``api.py:174`` run_async,
+``workflow_executor.py``, ``workflow_storage.py``): a DAG of steps executes
+with every step's result checkpointed to storage, so a crashed workflow
+resumes from the last completed step instead of rerunning finished work.
+
+Durability rides the GCS KV (namespace ``workflow``) — the same store that
+survives GCS restarts via the snapshot file (test_fault_tolerance.py).
+"""
+
+from .api import (get_output, get_status, list_all, resume, run, run_async,
+                  step)
+
+__all__ = ["step", "run", "run_async", "resume", "get_output", "get_status",
+           "list_all"]

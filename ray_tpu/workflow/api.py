@@ -1,0 +1,243 @@
+"""Workflow steps + the durable executor.
+
+Reference mapping:
+  @workflow.step / .bind      -> ``step()`` wraps a function into StepNodes
+  workflow.run / run_async    -> execute the DAG durably (api.py:174)
+  workflow.resume             -> re-run, skipping checkpointed steps
+  workflow_storage.py         -> GCS KV namespace "workflow"
+
+Each step runs as one task; its pickled result is committed to the KV under
+``{workflow_id}/{step_key}`` *before* the step is considered done.  A resumed
+run loads committed results instead of re-executing (exactly-once per step
+per workflow id, assuming deterministic step keys).
+
+Step keys are content-derived (function name + position in the DAG), so the
+same workflow definition resumes correctly across processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+NS = "workflow"
+
+
+class StepNode:
+    """One durable step; args may contain other StepNodes."""
+
+    def __init__(self, fn, args, kwargs, *, name: Optional[str] = None,
+                 max_retries: int = 3, num_cpus: float = 1.0):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or getattr(fn, "__name__", "step")
+        self.max_retries = max_retries
+        self.num_cpus = num_cpus
+
+    def _upstream(self) -> List["StepNode"]:
+        out = []
+
+        def scan(v):
+            if isinstance(v, StepNode):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    scan(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    scan(x)
+
+        for a in self.args:
+            scan(a)
+        for v in self.kwargs.values():
+            scan(v)
+        return out
+
+
+class _StepFactory:
+    def __init__(self, fn, **opts):
+        self.fn = fn
+        self.opts = opts
+
+    def bind(self, *args, **kwargs) -> StepNode:
+        return StepNode(self.fn, args, kwargs, **self.opts)
+
+    def options(self, **opts) -> "_StepFactory":
+        merged = dict(self.opts)
+        merged.update(opts)
+        return _StepFactory(self.fn, **merged)
+
+
+def step(_fn=None, **opts):
+    """``@workflow.step`` decorator (reference: the step surface)."""
+    def wrap(fn):
+        return _StepFactory(fn, **opts)
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# Storage (GCS KV)
+# ---------------------------------------------------------------------------
+
+def _kv():
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+
+    gcs = global_worker().gcs
+
+    class KV:
+        def put(self, key: str, value: bytes):
+            run_async(gcs.call("kv_put", ns=NS, key=key, value=value))
+
+        def get(self, key: str) -> Optional[bytes]:
+            return run_async(gcs.call("kv_get", ns=NS, key=key))
+
+        def keys(self, prefix: str = "") -> List[str]:
+            return run_async(gcs.call("kv_keys", ns=NS, prefix=prefix))
+
+    return KV()
+
+
+def _step_keys(root: StepNode):
+    """Deterministic content-position keys + topological order for the DAG.
+    One traversal serves both key derivation and execution so they can never
+    disagree (a divergence would corrupt resume)."""
+    order: List[StepNode] = []
+    seen = set()
+
+    def topo(n: StepNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for up in n._upstream():
+            topo(up)
+        order.append(n)
+
+    topo(root)
+    keys = {}
+    for i, n in enumerate(order):
+        h = hashlib.sha1(f"{i}:{n.name}".encode()).hexdigest()[:12]
+        keys[id(n)] = f"step-{i:03d}-{n.name}-{h}"
+    return keys, order
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+def _execute(workflow_id: str, root: StepNode) -> Any:
+    import ray_tpu
+
+    kv = _kv()
+    keys, order = _step_keys(root)
+    kv.put(f"{workflow_id}/__meta__", cloudpickle.dumps(
+        {"status": "RUNNING", "started_at": time.time()}))
+
+    memo: Dict[int, Any] = {}
+
+    def sub(v):
+        if isinstance(v, StepNode):
+            return memo[id(v)]
+        if isinstance(v, list):
+            return [sub(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(sub(x) for x in v)
+        if isinstance(v, dict):
+            return {k: sub(x) for k, x in v.items()}
+        return v
+
+    try:
+        for node in order:
+            key = f"{workflow_id}/{keys[id(node)]}"
+            committed = kv.get(key)
+            if committed is not None:
+                memo[id(node)] = cloudpickle.loads(committed)
+                continue
+            args = tuple(sub(a) for a in node.args)
+            kwargs = {k: sub(v) for k, v in node.kwargs.items()}
+            rf = ray_tpu.remote(node.fn) if not hasattr(
+                node.fn, "remote") else node.fn
+            ref = rf.options(num_cpus=node.num_cpus,
+                             max_retries=node.max_retries).remote(
+                *args, **kwargs)
+            result = ray_tpu.get(ref)
+            # durability point: the step is done only once this write lands
+            kv.put(key, cloudpickle.dumps(result))
+            memo[id(node)] = result
+    except BaseException as e:
+        kv.put(f"{workflow_id}/__meta__", cloudpickle.dumps(
+            {"status": "FAILED", "error": repr(e), "at": time.time()}))
+        raise
+    out = memo[id(root)]
+    kv.put(f"{workflow_id}/__meta__", cloudpickle.dumps(
+        {"status": "SUCCEEDED", "finished_at": time.time()}))
+    kv.put(f"{workflow_id}/__output__", cloudpickle.dumps(out))
+    return out
+
+
+def _new_workflow_id() -> str:
+    # a uuid component: millisecond timestamps collide under concurrent
+    # run_async calls and would cross-contaminate checkpoints
+    return f"workflow-{int(time.time() * 1000)}-{uuid.uuid4().hex[:8]}"
+
+
+def run(dag: StepNode, *, workflow_id: Optional[str] = None) -> Any:
+    """Execute durably, blocking (reference: workflow.run)."""
+    workflow_id = workflow_id or _new_workflow_id()
+    return _execute(workflow_id, dag)
+
+
+def run_async(dag: StepNode, *, workflow_id: Optional[str] = None):
+    """Execute in a background driver thread; returns (workflow_id, future)
+    (reference: api.py:174 run_async)."""
+    import concurrent.futures
+    import threading
+
+    workflow_id = workflow_id or _new_workflow_id()
+    fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+    def target():
+        try:
+            fut.set_result(_execute(workflow_id, dag))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True,
+                     name=f"workflow-{workflow_id}").start()
+    return workflow_id, fut
+
+
+def resume(workflow_id: str, dag: StepNode) -> Any:
+    """Re-run: committed steps load from storage, the rest execute.
+
+    The reference resumes from a stored DAG; here the caller re-supplies the
+    (deterministic) definition and storage supplies the progress — same
+    exactly-once-per-step guarantee, no code serialization in the KV."""
+    return _execute(workflow_id, dag)
+
+
+def get_status(workflow_id: str) -> Optional[dict]:
+    raw = _kv().get(f"{workflow_id}/__meta__")
+    return cloudpickle.loads(raw) if raw else None
+
+
+def get_output(workflow_id: str) -> Any:
+    raw = _kv().get(f"{workflow_id}/__output__")
+    if raw is None:
+        raise KeyError(f"workflow {workflow_id} has no committed output")
+    return cloudpickle.loads(raw)
+
+
+def list_all() -> List[str]:
+    ids = set()
+    for key in _kv().keys():
+        ids.add(key.split("/", 1)[0])
+    return sorted(ids)

@@ -1,0 +1,104 @@
+"""Autoscaler tests: demand bin-packing (unit, like the reference's
+StandardAutoscaler.update tests) and real scale-up/down with the local
+provider (reference: fake_multi_node tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalerConfig, NodeType,
+                                StandardAutoscaler)
+
+
+class FakeProvider:
+    def __init__(self):
+        self.nodes = {}
+        self.counter = 0
+
+    def create_node(self, node_type, labels):
+        self.counter += 1
+        pid = f"fake-{self.counter}"
+        self.nodes[pid] = node_type
+        return pid
+
+    def terminate_node(self, pid):
+        self.nodes.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+def _cfg(**kw):
+    return AutoscalerConfig(
+        node_types={"cpu4": NodeType(resources={"CPU": 4.0}, max_workers=3),
+                    "tpu": NodeType(resources={"CPU": 8.0, "TPU": 4.0},
+                                    max_workers=2)},
+        **kw)
+
+
+def test_scale_up_on_unmet_demand():
+    asc = StandardAutoscaler("unused:0", _cfg(), provider=FakeProvider())
+    load = {
+        "n1": {"alive": True, "total": {"CPU": 2.0},
+               "available": {"CPU": 0.0}, "queue_len": 2,
+               "queued_demands": [{"CPU": 2.0}, {"TPU": 4.0}]},
+    }
+    asc.update(load)
+    types = sorted(asc.provider.nodes.values())
+    assert types == ["cpu4", "tpu"], types
+
+
+def test_no_scale_up_when_free_capacity_absorbs():
+    asc = StandardAutoscaler("unused:0", _cfg(), provider=FakeProvider())
+    load = {
+        "n1": {"alive": True, "total": {"CPU": 8.0},
+               "available": {"CPU": 6.0}, "queue_len": 1,
+               "queued_demands": [{"CPU": 2.0}]},
+    }
+    asc.update(load)
+    assert asc.provider.nodes == {}
+
+
+def test_scale_up_respects_max_workers_and_speed():
+    asc = StandardAutoscaler("unused:0", _cfg(upscaling_speed=10),
+                             provider=FakeProvider())
+    demands = [{"TPU": 4.0}] * 5
+    load = {"n1": {"alive": True, "total": {}, "available": {},
+                   "queue_len": 5, "queued_demands": demands}}
+    asc.update(load)
+    # tpu type caps at max_workers=2 even with 5 pending TPU demands
+    assert sorted(asc.provider.nodes.values()).count("tpu") == 2
+
+
+@pytest.mark.slow
+def test_autoscaler_end_to_end_scale_up_and_down(ray_start_cluster):
+    """Queued TPU tasks trigger a real node launch; idle node drains."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    import ray_tpu
+    ray_tpu.init(address=cluster.address)
+
+    cfg = AutoscalerConfig(
+        node_types={"tpu_host": NodeType(resources={"CPU": 4.0, "TPU": 4.0})},
+        poll_interval_s=0.5, idle_timeout_s=3.0, upscaling_speed=1)
+    asc = StandardAutoscaler(cluster.address, cfg).start()
+    try:
+        @ray_tpu.remote
+        def needs_tpu():
+            return "got-tpu"
+
+        ref = needs_tpu.options(num_tpus=2).remote()
+        # the 2-CPU node can't run it; the autoscaler must add a tpu_host
+        assert ray_tpu.get(ref, timeout=120) == "got-tpu"
+        assert asc.num_launches >= 1
+        # idle: the scaled node terminates after idle_timeout
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if asc.num_terminations >= 1:
+                break
+            time.sleep(0.5)
+        assert asc.num_terminations >= 1, "idle node never scaled down"
+    finally:
+        asc.stop()
+        ray_tpu.shutdown()
