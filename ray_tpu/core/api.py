@@ -56,6 +56,7 @@ def init(address: Optional[str] = None,
          namespace: Optional[str] = None,
          ignore_reinit_error: bool = False,
          log_to_driver: bool = True,
+         runtime_env: Optional[Dict[str, Any]] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          worker_env: Optional[Dict[str, str]] = None) -> dict:
     """Start (or connect to) a cluster and attach this process as the driver."""
@@ -104,6 +105,13 @@ def init(address: Optional[str] = None,
                                         metadata={"namespace": namespace or "default"}))
     worker.job_id = JobID.from_hex(job_hex)
     _state.worker = worker
+    if runtime_env:
+        # ship py_modules/working_dir/env_vars to every worker of this job
+        # (reference: runtime_env packaging via the GCS)
+        from . import runtime_env as renv
+        renv.publish(
+            lambda *a, **kw: run_async(worker.gcs.call(*a, **kw)),
+            worker.job_id.hex(), runtime_env)
     if log_to_driver:
         _start_log_subscriber(worker)
     atexit.register(shutdown)

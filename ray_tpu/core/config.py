@@ -33,6 +33,9 @@ class Config:
     object_transfer_parallelism: int = 4
     #: Max concurrent inbound object pulls admitted per node.
     object_pull_max_concurrency: int = 8
+    #: Use the native C++ shm arena allocator for the store (falls back to
+    #: Python file-per-object when g++ is unavailable).
+    object_store_use_native_pool: bool = True
     #: Spill directory ("" = default under /tmp; "off" disables spilling).
     object_spilling_dir: str = ""
     #: Spill when store utilization exceeds this fraction.

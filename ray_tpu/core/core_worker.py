@@ -1075,7 +1075,18 @@ class CoreWorker:
         loop.call_soon_threadsafe(
             lambda: fut.set_result(results) if not fut.done() else None)
 
-    def _load_function(self, fn_id: bytes):
+    def _load_function(self, fn_id: bytes, job_id=None):
+        if job_id is not None:
+            # Materialize the job's runtime env (py_modules on sys.path, env
+            # vars) BEFORE the function runs — unconditionally, not on cache
+            # miss: fn_id is a content hash shared across jobs, so job B's
+            # env must apply even when job A already cached the function.
+            # ensure() is a set lookup after the first success.
+            from . import runtime_env
+            try:
+                runtime_env.ensure(self, job_id.hex())
+            except Exception:
+                pass
         fn = self.fn_cache.get(fn_id)
         if fn is None:
             blob = run_async(self.gcs.call("kv_get", ns="funcs", key=fn_id.hex()))
@@ -1104,7 +1115,7 @@ class CoreWorker:
             method = getattr(self.actor_instance, spec.actor_method)
             fn = method
         else:
-            fn = self._load_function(spec.fn_id)
+            fn = self._load_function(spec.fn_id, spec.job_id)
         args, kwargs = self._resolve_args(spec)
         token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,
                                    "actor_id": spec.actor_id, "name": spec.name})
@@ -1147,7 +1158,7 @@ class CoreWorker:
         return results
 
     def _execute_actor_creation(self, spec: TaskSpec):
-        cls = self._load_function(spec.fn_id)
+        cls = self._load_function(spec.fn_id, spec.job_id)
         args, kwargs = self._resolve_args(spec)
         from .runtime_context import _task_context
         token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,

@@ -41,13 +41,45 @@ class ObjectStoreFullError(Exception):
 # Shared-memory segments
 # ---------------------------------------------------------------------------
 
+class _PoolAttachCache:
+    """Per-process cache of mmaps of whole pool files.  A pool slice path is
+    ``{pool_path}#{offset}``; every attacher maps the pool once and indexes
+    by offset (the plasma client pattern: one fd per store, not per object)."""
+
+    def __init__(self):
+        self._maps: Dict[str, mmap.mmap] = {}
+
+    def view(self, pool_path: str, offset: int, size: int) -> memoryview:
+        mm = self._maps.get(pool_path)
+        if mm is None:
+            fd = os.open(pool_path, os.O_RDWR)
+            try:
+                mm = mmap.mmap(fd, os.path.getsize(pool_path))
+            finally:
+                os.close(fd)
+            self._maps[pool_path] = mm
+        return memoryview(mm)[offset:offset + size]
+
+
+_pool_attach = _PoolAttachCache()
+
+
 class ShmSegment:
-    """One mmap'd file; create-mode unlinks on free, attach-mode is read-only."""
+    """One mmap'd file; create-mode unlinks on free, attach-mode is read-only.
+
+    Attach-mode also understands pool-slice paths (``pool#offset``), mapping
+    the whole pool once per process via ``_pool_attach``."""
 
     def __init__(self, path: str, size: int, create: bool):
         self.path = path
         self.size = size
         self.created = create
+        self.mm = None
+        self._slice: Optional[memoryview] = None
+        if "#" in path and not create:
+            pool_path, off = path.rsplit("#", 1)
+            self._slice = _pool_attach.view(pool_path, int(off), size)
+            return
         flags = os.O_RDWR | (os.O_CREAT | os.O_EXCL if create else 0)
         fd = os.open(path, flags, 0o600)
         try:
@@ -58,19 +90,51 @@ class ShmSegment:
             os.close(fd)
 
     def view(self) -> memoryview:
+        if self._slice is not None:
+            return self._slice
         return memoryview(self.mm)
 
     def close(self):
+        if self.mm is None:
+            return  # pool slice: the attach cache owns the pool mapping
         try:
             self.mm.close()
         except (BufferError, ValueError):
             pass  # outstanding zero-copy views keep the map alive until GC
 
     def unlink(self):
+        if self.mm is None:
+            return  # pool slice: only the owner's allocator frees the range
         try:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
+
+
+class PoolSlice:
+    """Owner-side segment living inside the node's arena: close() is a no-op
+    (the pool owns the mapping); unlink() returns the range to the
+    allocator."""
+
+    __slots__ = ("pool", "offset", "size")
+
+    def __init__(self, pool, offset: int, size: int):
+        self.pool = pool
+        self.offset = offset
+        self.size = size
+
+    @property
+    def path(self) -> str:
+        return f"{self.pool.path}#{self.offset}"
+
+    def view(self) -> memoryview:
+        return self.pool.view(self.offset, self.size)
+
+    def close(self):
+        pass
+
+    def unlink(self):
+        self.pool.free(self.offset)
 
 
 def shm_path_for(store_name: str, object_id: ObjectID) -> str:
@@ -118,6 +182,18 @@ class NodeObjectStore:
         else:
             self.spill_dir = cfg.object_spilling_dir or os.path.join(
                 tempfile.gettempdir(), "raytpu", "spill")
+        # Native arena (C++ first-fit allocator over ONE shm mapping — the
+        # plasma design): per-object create cost drops from
+        # open+ftruncate+mmap+page-zero to an allocator call.  Falls back to
+        # file-per-object when the native lib can't build.
+        self.pool = None
+        if cfg.object_store_use_native_pool:
+            try:
+                from ray_tpu.native import ShmPool
+                self.pool = ShmPool(
+                    os.path.join(_SHM_DIR, f"raytpu-pool-{name}"), capacity)
+            except Exception:
+                self.pool = None
 
     # -- creation ---------------------------------------------------------
 
@@ -130,16 +206,35 @@ class NodeObjectStore:
                 f"object {object_id} ({size} B) exceeds store capacity {self.capacity} B")
         if self.used + size > self.capacity:
             self._evict(self.used + size - self.capacity)
-        path = shm_path_for(self.name, object_id)
-        try:
-            seg = ShmSegment(path, size, create=True)
-        except FileExistsError:
-            os.unlink(path)
-            seg = ShmSegment(path, size, create=True)
+        if self.pool is not None:
+            seg = self._pool_alloc(size)
+        else:
+            path = shm_path_for(self.name, object_id)
+            try:
+                seg = ShmSegment(path, size, create=True)
+            except FileExistsError:
+                os.unlink(path)
+                seg = ShmSegment(path, size, create=True)
         self._entries[object_id] = _Entry(segment=seg, size=size)
         self.used += size
         self.num_creates += 1
-        return path
+        return seg.path
+
+    def _pool_alloc(self, size: int) -> "PoolSlice":
+        off = self.pool.alloc(size)
+        if off < 0:
+            # allocator full (fragmentation can strand capacity even when
+            # self.used says otherwise): evict until the arena yields
+            self._evict(max(size, 1))
+            off = self.pool.alloc(size)
+            if off < 0:
+                self._evict(self.capacity // 4)
+                off = self.pool.alloc(size)
+        if off < 0:
+            raise ObjectStoreFullError(
+                f"store {self.name}: arena cannot place {size} B "
+                f"(used={self.pool.used}/{self.pool.capacity})")
+        return PoolSlice(self.pool, off, size)
 
     def create_and_write(self, object_id: ObjectID, data) -> str:
         path = self.create(object_id, len(data))
@@ -294,6 +389,9 @@ class NodeObjectStore:
                 os.unlink(path)
             except OSError:
                 pass
+        if self.pool is not None:
+            self.pool.close(unlink=True)
+            self.pool = None
 
 
 # ---------------------------------------------------------------------------
@@ -301,12 +399,24 @@ class NodeObjectStore:
 # ---------------------------------------------------------------------------
 
 class ShmReader:
-    """Attach-side cache of mapped segments for zero-copy reads."""
+    """Attach-side reads of store segments.
+
+    File-per-object segments are cached and returned zero-copy (an unlinked
+    file stays valid for existing mmaps, so eviction cannot invalidate a
+    reader's view).  Pool slices are **copied out**: the arena recycles
+    offsets immediately after eviction, so neither the `{pool}#{offset}`
+    path nor the mapping bytes are stable identities — a cached or zero-copy
+    view could silently alias a different object.  (The upgrade path is the
+    plasma client pin/release protocol; a copy per read is the correct-first
+    behavior.)"""
 
     def __init__(self):
         self._maps: Dict[str, ShmSegment] = {}
 
-    def read(self, path: str, size: int) -> memoryview:
+    def read(self, path: str, size: int):
+        if "#" in path:
+            pool_path, off = path.rsplit("#", 1)
+            return bytes(_pool_attach.view(pool_path, int(off), size))
         seg = self._maps.get(path)
         if seg is None:
             seg = ShmSegment(path, size, create=False)

@@ -1,0 +1,105 @@
+"""Runtime environments: shipping code + env vars to every worker.
+
+Reference: ``python/ray/_private/runtime_env/`` — the agent materializes
+per-job environments (working_dir/py_modules packaged through the GCS,
+``packaging.py``; agent ``runtime_env_agent.py:159``).  Scope here: the
+job-level environment — ``py_modules`` directories and ``env_vars`` packed
+at ``ray_tpu.init(runtime_env=...)`` into the GCS KV; every worker
+materializes them once per job before executing that job's first task, so
+multi-node deployments distribute real packages, not just cloudpickle
+closures.  (conda/pip env building is out of scope on a no-network image;
+the plug point is ``_materialize``.)
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tarfile
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+NS = "runtime_envs"
+
+
+def _pack_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for root, _dirs, files in os.walk(path):
+            for fn in files:
+                if fn.endswith((".pyc", ".so.tmp")) or "__pycache__" in root:
+                    continue
+                full = os.path.join(root, fn)
+                tf.add(full, arcname=os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
+    known = {"py_modules", "env_vars", "working_dir"}
+    unknown = set(runtime_env) - known
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
+                         f"(supported: {sorted(known)})")
+    return runtime_env
+
+
+def publish(gcs_call, job_id_hex: str, runtime_env: Dict[str, Any]):
+    """Driver side: pack + store the env under the job id (reference:
+    packaging.upload_package_if_needed)."""
+    validate(runtime_env)
+    blob: Dict[str, Any] = {"env_vars": dict(runtime_env.get("env_vars")
+                                             or {})}
+    mods = []
+    for path in runtime_env.get("py_modules") or []:
+        path = os.path.abspath(path)
+        if not os.path.isdir(path):
+            raise ValueError(f"py_modules entry is not a directory: {path}")
+        mods.append((os.path.basename(path), _pack_dir(path)))
+    blob["py_modules"] = mods
+    if runtime_env.get("working_dir"):
+        blob["working_dir"] = _pack_dir(runtime_env["working_dir"])
+    gcs_call("kv_put", ns=NS, key=job_id_hex, value=cloudpickle.dumps(blob))
+
+
+_materialized: set = set()
+
+
+def ensure(worker, job_id_hex: str):
+    """Worker side: materialize the job's env once (idempotent, cheap on the
+    hot path — one KV miss per job when no env exists).  The job is marked
+    materialized only AFTER success, so a transient GCS/extract failure
+    retries on the next task instead of silently disabling the env."""
+    if job_id_hex in _materialized:
+        return
+    from .rpc import run_async
+
+    raw = run_async(worker.gcs.call("kv_get", ns=NS, key=job_id_hex))
+    if raw is None:
+        _materialized.add(job_id_hex)
+        return
+    blob = cloudpickle.loads(raw)
+    base = os.path.join(worker.session_dir, "runtime_envs", job_id_hex)
+    for name, data in blob.get("py_modules", []):
+        dest = os.path.join(base, "py_modules", name)
+        if not os.path.isdir(dest):
+            os.makedirs(dest, exist_ok=True)
+            with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+                tf.extractall(dest, filter="data")
+        parent = os.path.dirname(dest)
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+    if blob.get("working_dir"):
+        dest = os.path.join(base, "working_dir")
+        if not os.path.isdir(dest):
+            os.makedirs(dest, exist_ok=True)
+            with tarfile.open(fileobj=io.BytesIO(
+                    blob["working_dir"])) as tf:
+                tf.extractall(dest, filter="data")
+        if dest not in sys.path:
+            sys.path.insert(0, dest)
+        os.chdir(dest)
+    for k, v in blob.get("env_vars", {}).items():
+        os.environ[k] = str(v)
+    _materialized.add(job_id_hex)

@@ -196,9 +196,11 @@ class JobManager:
         sup = self._supervisors.get(job_id)
         if sup is not None and info.status == RUNNING:
             import ray_tpu
-            await asyncio.wrap_future(ray_tpu.as_future(sup.stop.remote()))
+            # mark first: the monitor loop polls concurrently and would
+            # otherwise observe the SIGTERM exit code and record FAILED
             info.status = STOPPED
             info.finished_at = time.time()
+            await asyncio.wrap_future(ray_tpu.as_future(sup.stop.remote()))
             # reap the detached supervisor like the monitor loop does, or a
             # 0.1-CPU actor leaks per stopped job
             try:

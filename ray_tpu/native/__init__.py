@@ -1,0 +1,128 @@
+"""ray_tpu.native — C++ runtime components.
+
+The compute path is JAX/XLA/Pallas; the runtime around it goes native where
+the reference's does (SURVEY §2.1: the store/allocator layer is C++ plasma).
+Components build on first use with g++ (baked into the image; pybind11 is
+not, so the ABI is plain C consumed via ctypes).
+
+``shm_pool``: single-mmap arena allocator backing the object store — the
+plasma design (one mapping per node, objects are offsets) instead of the
+round-1 file-per-object layout (which paid open+ftruncate+mmap+page-zero on
+every put).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "shm_pool.cpp")
+
+
+def _build_lib() -> Optional[str]:
+    """Compile the .so next to the source (cached by mtime)."""
+    out = os.path.join(os.path.dirname(_SRC), "libshmpool.so")
+    try:
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(_SRC)):
+            return out
+        tmp = out + f".tmp{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except Exception:
+        return None
+
+
+def load_shm_pool() -> Optional[ctypes.CDLL]:
+    """The compiled allocator, or None (callers fall back to pure Python)."""
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        path = _build_lib()
+        if path is None:
+            _LIB_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _LIB_FAILED = True
+            return None
+        lib.rt_pool_create.restype = ctypes.c_void_p
+        lib.rt_pool_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_pool_alloc.restype = ctypes.c_int64
+        lib.rt_pool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rt_pool_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rt_pool_used.restype = ctypes.c_uint64
+        lib.rt_pool_used.argtypes = [ctypes.c_void_p]
+        lib.rt_pool_capacity.restype = ctypes.c_uint64
+        lib.rt_pool_capacity.argtypes = [ctypes.c_void_p]
+        lib.rt_pool_num_blocks.restype = ctypes.c_uint64
+        lib.rt_pool_num_blocks.argtypes = [ctypes.c_void_p]
+        lib.rt_pool_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _LIB = lib
+        return _LIB
+
+
+class ShmPool:
+    """Owner-side arena: allocate/free offsets in one shm mapping."""
+
+    def __init__(self, path: str, capacity: int):
+        lib = load_shm_pool()
+        if lib is None:
+            raise RuntimeError("native shm pool unavailable (no g++?)")
+        self._lib = lib
+        self.path = path
+        self._handle = lib.rt_pool_create(path.encode(), capacity)
+        if not self._handle:
+            raise OSError(f"failed to create shm pool at {path}")
+        import mmap as _mmap
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = _mmap.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+
+    def alloc(self, size: int) -> int:
+        """-> offset, or -1 when the arena is full (caller evicts)."""
+        return self._lib.rt_pool_alloc(self._handle, size)
+
+    def free(self, offset: int):
+        self._lib.rt_pool_free(self._handle, offset)
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return memoryview(self._mm)[offset:offset + size]
+
+    @property
+    def used(self) -> int:
+        return self._lib.rt_pool_used(self._handle)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.rt_pool_capacity(self._handle)
+
+    @property
+    def num_blocks(self) -> int:
+        return self._lib.rt_pool_num_blocks(self._handle)
+
+    def close(self, unlink: bool = True):
+        if self._handle:
+            try:
+                self._mm.close()
+            except Exception:
+                pass
+            self._lib.rt_pool_destroy(self._handle, 1 if unlink else 0)
+            self._handle = None

@@ -24,14 +24,47 @@ from typing import Any, Dict, List, Optional
 
 
 class Checkpoint:
-    """A directory of files; the unit of train/tune fault-tolerance."""
+    """A directory of files; the unit of train/tune fault-tolerance.
+
+    ``path`` may be a local directory or a pyarrow-filesystem URI
+    (``file://``, ``s3://``, ...; reference: _checkpoint.py:55 — a
+    Checkpoint is a directory + filesystem).  URI-backed checkpoints
+    materialize to a local temp dir on access."""
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        from .storage import is_uri
+
+        self._uri = path if is_uri(path) else None
+        self.path = path if self._uri else os.path.abspath(path)
+        self._local_cache: Optional[str] = None
+
+    @property
+    def uri(self) -> Optional[str]:
+        return self._uri
+
+    def _local_path(self) -> str:
+        """A local directory with the checkpoint contents."""
+        if self._uri is None:
+            return self.path
+        if self._local_cache is None:
+            from pyarrow import fs as pafs
+
+            from .storage import resolve
+
+            src_fs, src_path = resolve(self._uri)
+            dest = tempfile.mkdtemp(prefix="raytpu-ckpt-fetch-")
+            pafs.copy_files(src_path, dest, source_filesystem=src_fs,
+                            destination_filesystem=pafs.LocalFileSystem())
+            self._local_cache = dest
+        return self._local_cache
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        return cls(uri)
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
@@ -45,28 +78,39 @@ class Checkpoint:
 
     def to_dict(self) -> Dict[str, Any]:
         import pickle
-        with open(os.path.join(self.path, "_dict_checkpoint.pkl"), "rb") as f:
+        with open(os.path.join(self._local_path(),
+                               "_dict_checkpoint.pkl"), "rb") as f:
             return pickle.load(f)
 
     def to_directory(self, path: Optional[str] = None) -> str:
-        if path is None or os.path.abspath(path) == self.path:
-            return self.path
+        local = self._local_path()
+        if path is None or os.path.abspath(path) == local:
+            return local
         os.makedirs(path, exist_ok=True)
-        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        shutil.copytree(local, path, dirs_exist_ok=True)
         return path
 
     @contextmanager
     def as_directory(self):
-        yield self.path
+        yield self._local_path()
 
     def update_metadata(self, metadata: Dict[str, Any]) -> None:
         meta = self.get_metadata()
         meta.update(metadata)
-        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+        with open(os.path.join(self._local_path(),
+                               ".metadata.json"), "w") as f:
             json.dump(meta, f)
+        if self._uri is not None:
+            # the local fetch dir is a throwaway cache — push the update
+            # back to the URI filesystem or other readers never see it
+            from .storage import resolve
+
+            fs, p = resolve(self._uri)
+            with fs.open_output_stream(f"{p.rstrip('/')}/.metadata.json") as f:
+                f.write(json.dumps(meta).encode())
 
     def get_metadata(self) -> Dict[str, Any]:
-        p = os.path.join(self.path, ".metadata.json")
+        p = os.path.join(self._local_path(), ".metadata.json")
         if os.path.exists(p):
             with open(p) as f:
                 return json.load(f)
@@ -97,22 +141,44 @@ class _TrackedCheckpoint:
 
 
 class CheckpointManager:
-    """Registers reported checkpoints into the run dir, keeps top-k."""
+    """Registers reported checkpoints into the run dir, keeps top-k.
+
+    ``run_dir`` may be a local directory or a pyarrow-filesystem URI
+    (reference: StorageContext) — reported local checkpoints upload through
+    ``pyarrow.fs`` and are tracked as URI checkpoints."""
 
     def __init__(self, config: Optional[CheckpointConfig], run_dir: str):
+        from .storage import is_uri
+
         self.config = config or CheckpointConfig()
         self.run_dir = run_dir
+        self._remote = is_uri(run_dir)
         self.tracked: List[_TrackedCheckpoint] = []
         self._index = 0
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Checkpoint:
-        dest = os.path.join(self.run_dir, f"checkpoint_{self._index:06d}")
-        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-            os.makedirs(dest, exist_ok=True)
-            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
-        tracked = _TrackedCheckpoint(Checkpoint(dest), dict(metrics),
-                                     self._index)
+        name = f"checkpoint_{self._index:06d}"
+        if self._remote:
+            from pyarrow import fs as pafs
+
+            from .storage import resolve
+
+            dst_fs, root = resolve(self.run_dir)
+            dest_fs_path = f"{root.rstrip('/')}/{name}"
+            dst_fs.create_dir(dest_fs_path, recursive=True)
+            pafs.copy_files(checkpoint.to_directory(), dest_fs_path,
+                            source_filesystem=pafs.LocalFileSystem(),
+                            destination_filesystem=dst_fs)
+            scheme = self.run_dir.split("://", 1)[0]
+            registered = Checkpoint(f"{scheme}://{dest_fs_path}")
+        else:
+            dest = os.path.join(self.run_dir, name)
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                os.makedirs(dest, exist_ok=True)
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            registered = Checkpoint(dest)
+        tracked = _TrackedCheckpoint(registered, dict(metrics), self._index)
         self._index += 1
         self.tracked.append(tracked)
         self._enforce_retention()
@@ -142,7 +208,15 @@ class CheckpointManager:
         keep.add(id(latest))
         for t in list(self.tracked):
             if id(t) not in keep:
-                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+                if t.checkpoint.uri is not None:
+                    from .storage import resolve
+                    try:
+                        fs, p = resolve(t.checkpoint.uri)
+                        fs.delete_dir(p)
+                    except OSError:
+                        pass
+                else:
+                    shutil.rmtree(t.checkpoint.path, ignore_errors=True)
                 self.tracked.remove(t)
 
     @property

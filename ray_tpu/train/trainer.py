@@ -59,8 +59,17 @@ class BaseTrainer:
 
     def fit(self) -> Result:
         name = self.run_config.name or f"{type(self).__name__}_{int(time.time())}"
-        trial_dir = os.path.join(self.run_config.resolved_storage_path(), name)
-        os.makedirs(trial_dir, exist_ok=True)
+        storage_root = self.run_config.resolved_storage_path()
+        from .storage import is_uri
+        if is_uri(storage_root):
+            # checkpoints persist to the URI filesystem (StorageContext
+            # layout); per-worker scratch stays local
+            trial_dir = f"{storage_root.rstrip('/')}/{name}"
+            from .storage import StorageContext
+            StorageContext(storage_root, name)  # creates the experiment dir
+        else:
+            trial_dir = os.path.join(storage_root, name)
+            os.makedirs(trial_dir, exist_ok=True)
         failure_cfg = self.run_config.failure_config or FailureConfig()
         max_failures = failure_cfg.max_failures
         failures = 0

@@ -155,23 +155,44 @@ def _execute(workflow_id: str, root: StepNode) -> Any:
         return v
 
     try:
-        for node in order:
-            key = f"{workflow_id}/{keys[id(node)]}"
-            committed = kv.get(key)
-            if committed is not None:
-                memo[id(node)] = cloudpickle.loads(committed)
+        # Wave scheduler: every step whose upstreams are resolved submits
+        # immediately, so independent branches run in parallel; each result
+        # still commits to the KV before its dependents can consume it
+        # (per-step durability is unchanged).
+        remaining = list(order)
+        inflight: Dict[Any, Any] = {}  # ref -> node
+        while remaining or inflight:
+            progressed = True
+            while progressed:
+                progressed = False
+                for node in list(remaining):
+                    if any(id(u) not in memo for u in node._upstream()):
+                        continue
+                    remaining.remove(node)
+                    progressed = True
+                    committed = kv.get(f"{workflow_id}/{keys[id(node)]}")
+                    if committed is not None:
+                        memo[id(node)] = cloudpickle.loads(committed)
+                        continue
+                    args = tuple(sub(a) for a in node.args)
+                    kwargs = {k: sub(v) for k, v in node.kwargs.items()}
+                    rf = ray_tpu.remote(node.fn) if not hasattr(
+                        node.fn, "remote") else node.fn
+                    ref = rf.options(num_cpus=node.num_cpus,
+                                     max_retries=node.max_retries).remote(
+                        *args, **kwargs)
+                    inflight[ref] = node
+            if not inflight:
                 continue
-            args = tuple(sub(a) for a in node.args)
-            kwargs = {k: sub(v) for k, v in node.kwargs.items()}
-            rf = ray_tpu.remote(node.fn) if not hasattr(
-                node.fn, "remote") else node.fn
-            ref = rf.options(num_cpus=node.num_cpus,
-                             max_retries=node.max_retries).remote(
-                *args, **kwargs)
-            result = ray_tpu.get(ref)
-            # durability point: the step is done only once this write lands
-            kv.put(key, cloudpickle.dumps(result))
-            memo[id(node)] = result
+            ready, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                    timeout=3600)
+            for ref in ready:
+                node = inflight.pop(ref)
+                result = ray_tpu.get(ref)
+                # durability point: done only once this write lands
+                kv.put(f"{workflow_id}/{keys[id(node)]}",
+                       cloudpickle.dumps(result))
+                memo[id(node)] = result
     except BaseException as e:
         kv.put(f"{workflow_id}/__meta__", cloudpickle.dumps(
             {"status": "FAILED", "error": repr(e), "at": time.time()}))

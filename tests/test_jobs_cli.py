@@ -119,3 +119,33 @@ def test_cli_start_status_submit_stop(tmp_path):
         r = run("stop")
         assert r.returncode == 0, r.stderr
     assert not os.path.exists("/tmp/raytpu/head.json")
+
+
+def test_runtime_env_py_modules(tmp_path):
+    """init(runtime_env=py_modules) ships a real package to workers: tasks
+    import it even though it exists nowhere on the workers' sys.path
+    (reference: runtime_env packaging via GCS)."""
+    import ray_tpu
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+    pkg = tmp_path / "shipped_pkg"
+    (pkg / "shipped_pkg").mkdir(parents=True)
+    (pkg / "shipped_pkg" / "__init__.py").write_text(
+        "MAGIC = 'runtime-env-works'\n")
+
+    ray_tpu.init(num_cpus=2,
+                 runtime_env={"py_modules": [str(pkg / "shipped_pkg")],
+                              "env_vars": {"SHIPPED_FLAG": "yes"}},
+                 worker_env=dict(CPU_WORKER_ENV))
+    try:
+        @ray_tpu.remote
+        def use_pkg():
+            import os
+            import shipped_pkg
+            return shipped_pkg.MAGIC, os.environ.get("SHIPPED_FLAG")
+
+        magic, flag = ray_tpu.get(use_pkg.remote(), timeout=60)
+        assert magic == "runtime-env-works"
+        assert flag == "yes"
+    finally:
+        ray_tpu.shutdown()

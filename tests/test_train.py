@@ -185,3 +185,46 @@ def test_jax_trainer_single_worker_mesh(ray_start_regular, tmp_path):
     assert result.error is None
     assert result.metrics["step"] == 2
     assert result.metrics["loss"] > 0
+
+
+def test_checkpoint_storage_uri(ray_start_regular, tmp_path):
+    """storage_path as a pyarrow-filesystem URI: reported checkpoints upload
+    through pyarrow.fs and restore transparently (reference:
+    train/_internal/storage.py StorageContext)."""
+    import os
+
+    from ray_tpu import train as rt_train
+    from ray_tpu.train import (Checkpoint, CheckpointConfig,
+                               DataParallelTrainer, RunConfig, ScalingConfig)
+
+    storage_uri = f"file://{tmp_path}/bucket"
+
+    def loop(config):
+        import tempfile
+        ckpt = rt_train.get_checkpoint()
+        start = 0
+        if ckpt:
+            with ckpt.as_directory() as d:
+                start = int(open(os.path.join(d, "it.txt")).read()) + 1
+        for i in range(start, 3):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "it.txt"), "w") as f:
+                f.write(str(i))
+            rt_train.report({"iter": i}, checkpoint=Checkpoint(d))
+
+    trainer = DataParallelTrainer(
+        train_loop_per_worker=loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="uri_exp", storage_path=storage_uri,
+                             checkpoint_config=CheckpointConfig(num_to_keep=2)))
+    result = trainer.fit()
+    assert result.metrics["iter"] == 2
+    # the checkpoint lives on the URI filesystem and materializes locally
+    assert result.checkpoint is not None
+    assert result.checkpoint.uri is not None
+    with result.checkpoint.as_directory() as d:
+        assert open(os.path.join(d, "it.txt")).read() == "2"
+    # retention pruned to 2 on the target filesystem
+    ckpts = [p for p in os.listdir(str(tmp_path / "bucket" / "uri_exp"))
+             if p.startswith("checkpoint_")]
+    assert len(ckpts) == 2, ckpts
