@@ -59,12 +59,27 @@ def init(address: Optional[str] = None,
          runtime_env: Optional[Dict[str, Any]] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          worker_env: Optional[Dict[str, str]] = None) -> dict:
-    """Start (or connect to) a cluster and attach this process as the driver."""
+    """Start (or connect to) a cluster and attach this process as the driver.
+
+    ``address``: None/"local" boots an in-process GCS + node agent;
+    "auto" reads ``RAYTPU_GCS_ADDRESS``; "host:port" joins a running
+    cluster directly.  There is deliberately no separate ``ray://`` client
+    proxy (reference: ``python/ray/util/client``): that proxy exists because
+    the reference's driver embeds a heavyweight C++ CoreWorker that can't
+    run outside the cluster, whereas this driver is an ordinary RPC peer —
+    a remote process passes the GCS address and IS a fully-featured driver
+    (``raytpu submit`` covers the fire-and-forget case).
+    """
     if is_initialized():
         if ignore_reinit_error:
             return {"address": _state.gcs_address}
         raise RuntimeError("ray_tpu.init() called twice "
                            "(pass ignore_reinit_error=True to ignore)")
+    if runtime_env:
+        # validate BEFORE booting anything: raising after processes start
+        # would leave a half-initialized session with no atexit cleanup
+        from . import runtime_env as renv
+        renv.validate(runtime_env)
     if _system_config:
         set_config(Config.from_env(_system_config))
     session_dir = os.path.join(

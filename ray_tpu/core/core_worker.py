@@ -655,9 +655,27 @@ class CoreWorker:
         try:
             res = await self.agent.call("fetch_object", object_id=ref.id,
                                         size=record.size, locations=record.locations)
-            return self.shm_reader.read(res["path"], res["size"])
+            return await self._read_fetched(ref.id, res)
         except (RemoteError, ConnectionLost):
             return await self._try_reconstruct(ref, record)
+
+    async def _read_fetched(self, object_id: ObjectID, res: dict):
+        """Read a fetched object from the local store, re-validating arena
+        slices: the arena recycles offsets on eviction, so after copying the
+        bytes we confirm with the agent (whose loop serializes with
+        eviction) that the object still lives at that path; a recycled slot
+        re-fetches instead of returning another object's bytes."""
+        for _ in range(3):
+            data = self.shm_reader.read(res["path"], res["size"])
+            if "#" not in res["path"]:
+                return data  # file-backed: unlink semantics keep views safe
+            ok = await self.agent.call("store_verify", object_id=object_id,
+                                       path=res["path"])
+            if ok:
+                return data
+            res = await self.agent.call("fetch_object", object_id=object_id,
+                                        size=res["size"], locations=[])
+        raise ObjectLostError(object_id)
 
     async def _try_reconstruct(self, ref: ObjectRef, record: PlasmaRecord):
         """Lineage reconstruction (reference: object_recovery_manager.h:41)."""
@@ -673,7 +691,7 @@ class CoreWorker:
             if isinstance(rec, PlasmaRecord):
                 res = await self.agent.call("fetch_object", object_id=ref.id,
                                             size=rec.size, locations=rec.locations)
-                return self.shm_reader.read(res["path"], res["size"])
+                return await self._read_fetched(ref.id, res)
             raise ObjectLostError(ref.id)
         spec = self.task_manager.lineage.get(ref.id.task_id())
         if spec is None:
@@ -690,7 +708,7 @@ class CoreWorker:
         if isinstance(rec, PlasmaRecord):
             res = await self.agent.call("fetch_object", object_id=ref.id,
                                         size=rec.size, locations=rec.locations)
-            return self.shm_reader.read(res["path"], res["size"])
+            return await self._read_fetched(ref.id, res)
         if isinstance(rec, ErrorRecord):
             exc, tb = pickle.loads(rec.error)
             raise TaskError(exc, "reconstruction", tb)
@@ -1081,12 +1099,17 @@ class CoreWorker:
             # vars) BEFORE the function runs — unconditionally, not on cache
             # miss: fn_id is a content hash shared across jobs, so job B's
             # env must apply even when job A already cached the function.
-            # ensure() is a set lookup after the first success.
+            # ensure() is a set lookup after the first success.  Failures
+            # FAIL the task (it would otherwise run with a missing env and
+            # die with an unrelated-looking ImportError); the next attempt
+            # retries materialization.
             from . import runtime_env
             try:
                 runtime_env.ensure(self, job_id.hex())
-            except Exception:
-                pass
+            except Exception as e:
+                raise RuntimeError(
+                    f"runtime env materialization failed for job "
+                    f"{job_id.hex()[:12]}: {e!r}") from e
         fn = self.fn_cache.get(fn_id)
         if fn is None:
             blob = run_async(self.gcs.call("kv_get", ns="funcs", key=fn_id.hex()))

@@ -587,6 +587,19 @@ class NodeAgent:
         path, size = self.store.get_path(object_id)
         return {"path": path, "size": size}
 
+    async def handle_store_verify(self, object_id: ObjectID,
+                                  path: str) -> bool:
+        """Post-copy read validation for arena-backed objects: True iff the
+        object is still sealed AT this path.  Runs on the agent loop — the
+        same loop that evicts — so a True answer proves no evict+offset-reuse
+        interleaved with the caller's copy (the file-per-object store never
+        needed this: an unlinked file cannot alias a new object)."""
+        e = self.store._entries.get(object_id)
+        if e is not None and e.sealed and e.segment.path == path:
+            return True
+        # evicted-but-spilled (or restored elsewhere): not at `path` anymore
+        return False
+
     async def handle_store_free(self, object_ids: List[ObjectID]):
         for oid in object_ids:
             self.store.free(oid)

@@ -159,15 +159,19 @@ class RpcServer:
                 pass
 
     async def stop(self):
+        # Close live connections BEFORE wait_closed: since 3.12 wait_closed
+        # blocks until every connection handler returns, and long-poll
+        # clients (pubsub, heartbeats) would keep theirs open forever.
         if self._server:
             self._server.close()
-            try:
-                await self._server.wait_closed()
-            except Exception:
-                pass
         for w in list(self._conns):
             try:
                 w.close()
+            except Exception:
+                pass
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5)
             except Exception:
                 pass
 

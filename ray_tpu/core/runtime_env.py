@@ -64,20 +64,34 @@ def publish(gcs_call, job_id_hex: str, runtime_env: Dict[str, Any]):
 
 
 _materialized: set = set()
+#: per-job process-level mutations (env_vars, cwd) for re-application when a
+#: shared worker interleaves tasks of different jobs
+_applied_state: dict = {}
+_last_applied: Optional[str] = None
 
 
 def ensure(worker, job_id_hex: str):
     """Worker side: materialize the job's env once (idempotent, cheap on the
     hot path — one KV miss per job when no env exists).  The job is marked
     materialized only AFTER success, so a transient GCS/extract failure
-    retries on the next task instead of silently disabling the env."""
+    retries on the next task instead of silently disabling the env.
+
+    Workers are shared across jobs, so the process-wide pieces (env vars,
+    cwd) RE-apply whenever the executing job changes — sys.path additions
+    accumulate (harmless: packages are namespaced per job dir)."""
+    global _last_applied
     if job_id_hex in _materialized:
+        if _last_applied != job_id_hex:
+            _reapply(job_id_hex)
         return
     from .rpc import run_async
 
     raw = run_async(worker.gcs.call("kv_get", ns=NS, key=job_id_hex))
     if raw is None:
         _materialized.add(job_id_hex)
+        _applied_state[job_id_hex] = None
+        if _last_applied != job_id_hex:
+            _last_applied = job_id_hex
         return
     blob = cloudpickle.loads(raw)
     base = os.path.join(worker.session_dir, "runtime_envs", job_id_hex)
@@ -102,4 +116,22 @@ def ensure(worker, job_id_hex: str):
         os.chdir(dest)
     for k, v in blob.get("env_vars", {}).items():
         os.environ[k] = str(v)
+    _applied_state[job_id_hex] = {
+        "env_vars": dict(blob.get("env_vars", {})),
+        "cwd": (os.path.join(base, "working_dir")
+                if blob.get("working_dir") else None),
+    }
     _materialized.add(job_id_hex)
+    _last_applied = job_id_hex
+
+
+def _reapply(job_id_hex: str):
+    global _last_applied
+    state = _applied_state.get(job_id_hex)
+    _last_applied = job_id_hex
+    if not state:
+        return
+    for k, v in state["env_vars"].items():
+        os.environ[k] = str(v)
+    if state["cwd"] and os.path.isdir(state["cwd"]):
+        os.chdir(state["cwd"])

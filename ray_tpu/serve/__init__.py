@@ -10,6 +10,7 @@ batching LLM deployment (``ray_tpu.serve.llm``).
 from .api import (delete, get_deployment_handle, http_config, run, shutdown,
                   start, status)
 from .batching import batch
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .config import AutoscalingConfig, DeploymentConfig
 from .deployment import Deployment, deployment
 from .replica import Request
@@ -19,4 +20,5 @@ __all__ = [
     "deployment", "Deployment", "DeploymentConfig", "AutoscalingConfig",
     "DeploymentHandle", "Request", "batch", "run", "start", "status",
     "delete", "shutdown", "get_deployment_handle", "http_config",
+    "multiplexed", "get_multiplexed_model_id",
 ]

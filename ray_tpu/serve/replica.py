@@ -80,6 +80,9 @@ class ReplicaActor:
             raise RuntimeError(f"replica {self.replica_id} is draining")
         self.num_ongoing += 1
         try:
+            if args and isinstance(args[0], Request):
+                from .multiplex import _set_current_model_id
+                _set_current_model_id(args[0])
             fn = self._resolve(method)
             out = fn(*args, **kwargs)
             if inspect.iscoroutine(out):

@@ -97,20 +97,32 @@ class Checkpoint:
     def update_metadata(self, metadata: Dict[str, Any]) -> None:
         meta = self.get_metadata()
         meta.update(metadata)
-        with open(os.path.join(self._local_path(),
-                               ".metadata.json"), "w") as f:
-            json.dump(meta, f)
         if self._uri is not None:
-            # the local fetch dir is a throwaway cache — push the update
-            # back to the URI filesystem or other readers never see it
+            # touch only .metadata.json on the URI filesystem — materializing
+            # the whole (possibly multi-GB) checkpoint for one small file
+            # would be absurd, and a write into the throwaway fetch cache
+            # would be silently lost
             from .storage import resolve
 
             fs, p = resolve(self._uri)
             with fs.open_output_stream(f"{p.rstrip('/')}/.metadata.json") as f:
                 f.write(json.dumps(meta).encode())
+            return
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(meta, f)
 
     def get_metadata(self) -> Dict[str, Any]:
-        p = os.path.join(self._local_path(), ".metadata.json")
+        if self._uri is not None:
+            from .storage import resolve
+
+            fs, p = resolve(self._uri)
+            try:
+                with fs.open_input_stream(
+                        f"{p.rstrip('/')}/.metadata.json") as f:
+                    return json.loads(f.read().decode())
+            except (FileNotFoundError, OSError):
+                return {}
+        p = os.path.join(self.path, ".metadata.json")
         if os.path.exists(p):
             with open(p) as f:
                 return json.load(f)

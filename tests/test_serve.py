@@ -265,3 +265,32 @@ def test_delete_deployment(serve_clean):
     serve.run(gone)
     serve.delete("gone")
     assert "gone" not in serve.status()
+
+
+def test_multiplexed_model_loading(serve_clean):
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model:{model_id}"
+
+        async def __call__(self, model_id: str):
+            model = await self.get_model(model_id)
+            return model
+
+        def load_log(self):
+            return self.loads
+
+    h = serve.run(MultiModel)
+    assert h.remote("a").result(timeout_s=30) == "model:a"
+    assert h.remote("b").result(timeout_s=30) == "model:b"
+    assert h.remote("a").result(timeout_s=30) == "model:a"  # cached
+    assert h.load_log.remote().result(timeout_s=30) == ["a", "b"]
+    # third model evicts the LRU ("b" was used less recently than "a")
+    assert h.remote("c").result(timeout_s=30) == "model:c"
+    assert h.remote("b").result(timeout_s=30) == "model:b"  # re-load
+    assert h.load_log.remote().result(timeout_s=30) == ["a", "b", "c", "b"]
