@@ -14,9 +14,10 @@ from .block import Block, BlockAccessor, BlockMetadata
 from .context import DataContext
 from .dataset import Dataset, GroupedData
 from .datasource import (BinaryDatasource, BlocksDatasource, CSVDatasource,
-                         Datasource, ItemsDatasource, JSONDatasource,
-                         NumpyDatasource, ParquetDatasource, RangeDatasource,
-                         ReadTask, TextDatasource)
+                         Datasource, ImageDatasource, ItemsDatasource,
+                         JSONDatasource, NumpyDatasource, ParquetDatasource,
+                         RangeDatasource, ReadTask, SQLDatasource,
+                         TextDatasource, TFRecordDatasource)
 from .iterator import DataIterator
 
 
@@ -91,6 +92,23 @@ def read_text(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(TextDatasource(paths), parallelism=parallelism)
 
 
+def read_tfrecords(paths, *, parallelism: int = -1, raw: bool = False) -> Dataset:
+    return read_datasource(TFRecordDatasource(paths, raw=raw),
+                           parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, shard_queries=None,
+             parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_queries=shard_queries),
+        parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode=None, parallelism: int = -1) -> Dataset:
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
 __all__ = [
     "Dataset", "GroupedData", "DataContext", "DataIterator", "Datasource",
     "ReadTask", "Block", "BlockAccessor", "BlockMetadata",
@@ -98,4 +116,6 @@ __all__ = [
     "read_datasource", "range", "range_tensor", "from_items", "from_pandas",
     "from_arrow", "from_numpy", "from_huggingface", "read_parquet", "read_csv",
     "read_json", "read_numpy", "read_binary_files", "read_text",
+    "read_tfrecords", "read_sql", "read_images", "TFRecordDatasource",
+    "SQLDatasource", "ImageDatasource",
 ]

@@ -324,6 +324,28 @@ class Dataset:
     def write_numpy(self, path: str, **kw):
         return self._write(path, "npy", **kw)
 
+    def write_tfrecords(self, path: str, **kw):
+        return self._write(path, "tfrecords", **kw)
+
+    def write_sql(self, sql: str, connection_factory) -> int:
+        """Execute ``sql`` (an INSERT with ? placeholders) once per row;
+        returns rows written (reference: ``Dataset.write_sql``)."""
+        n = 0
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            for bundle in self._stream():
+                for ref, _ in bundle.blocks:
+                    acc = BlockAccessor.for_block(ray_get(ref))
+                    rows = [tuple(r.values()) if isinstance(r, dict) else (r,)
+                            for r in acc.iter_rows()]
+                    cur.executemany(sql, rows)  # one round trip per block
+                    n += len(rows)
+            conn.commit()
+        finally:
+            conn.close()
+        return n
+
     def __repr__(self):
         names = [op.name() for op in self._logical.chain()]
         return f"Dataset({' -> '.join(names)})"

@@ -1,0 +1,22 @@
+"""ray_tpu.experimental — counterparts of ``ray.experimental``.
+
+Reference surface: ``python/ray/experimental/`` — ``internal_kv`` (GCS KV
+access), distributed array helpers.  Kept deliberately small; stable pieces
+graduate into ``ray_tpu.util``.
+"""
+
+from .internal_kv import (
+    internal_kv_del,
+    internal_kv_exists,
+    internal_kv_get,
+    internal_kv_keys,
+    internal_kv_put,
+)
+
+__all__ = [
+    "internal_kv_get",
+    "internal_kv_put",
+    "internal_kv_del",
+    "internal_kv_exists",
+    "internal_kv_keys",
+]

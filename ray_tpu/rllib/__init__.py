@@ -11,10 +11,13 @@ inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 (the reference's NCCL learner-group allreduce becomes a compiled psum).
 """
 
+from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
 from .learner import Learner, LearnerGroup
 from .models import ActorCriticMLP
 from .ppo import PPO, PPOConfig
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
-__all__ = ["PPO", "PPOConfig", "EnvRunner", "Learner", "LearnerGroup",
-           "ActorCriticMLP"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "QNetwork", "EnvRunner",
+           "Learner", "LearnerGroup", "ActorCriticMLP", "ReplayBuffer",
+           "PrioritizedReplayBuffer"]

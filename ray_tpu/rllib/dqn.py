@@ -1,0 +1,337 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Reference: ``rllib/algorithms/dqn/`` (DQNConfig/DQN over
+``algorithms/algorithm.py:191``).  Double-DQN targets and n-step=1
+transitions; optional prioritized replay (``replay_buffer.py``).  TPU-first
+shape: the whole update — target computation, Huber loss, Adam, soft target
+sync — is one jitted program; the ring buffer stays on host and each
+sample() is a single device transfer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class QNetwork:
+    """MLP Q(s,·) head, same functional pytree style as ActorCriticMLP."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        sizes = (self.obs_dim,) + self.hidden + (self.action_dim,)
+        params = {}
+        keys = jax.random.split(key, len(sizes) - 1)
+        for i in range(len(sizes) - 1):
+            scale = (2.0 / sizes[i]) ** 0.5 if i < len(sizes) - 2 else 0.01
+            params[f"w{i}"] = jax.random.normal(
+                keys[i], (sizes[i], sizes[i + 1])) * scale
+            params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+        return params
+
+    def apply(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        n = len(self.hidden)
+        for i in range(n):
+            x = jnp.maximum(x @ params[f"w{i}"] + params[f"b{i}"], 0.0)
+        return x @ params[f"w{n}"] + params[f"b{n}"]
+
+
+class DQNRunner:
+    """Epsilon-greedy rollout actor producing replay transitions."""
+
+    def __init__(self, env_name: str, model_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0,
+                 env_config: Optional[dict] = None):
+        import gymnasium as gym
+        import jax
+
+        self.envs = [gym.make(env_name, **(env_config or {}))
+                     for _ in range(num_envs)]
+        self.model = QNetwork(**model_spec)
+        self._apply = jax.jit(self.model.apply)
+        self.num_envs = num_envs
+        self._rng = np.random.default_rng(seed)
+        self.obs = np.stack([e.reset(seed=seed + i)[0]
+                             for i, e in enumerate(self.envs)],
+                            dtype=np.float32)
+        self._ep_returns = np.zeros(num_envs)
+        self._done_returns: List[float] = []
+
+    def sample(self, params_blob, steps: int, epsilon: float
+               ) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        params = jax.tree_util.tree_map(jnp.asarray, params_blob)
+        N = self.num_envs
+        T = max(1, steps // N)
+        buf = {
+            "obs": np.zeros((T * N,) + self.obs.shape[1:], np.float32),
+            "actions": np.zeros((T * N,), np.int32),
+            "rewards": np.zeros((T * N,), np.float32),
+            "next_obs": np.zeros((T * N,) + self.obs.shape[1:], np.float32),
+            "dones": np.zeros((T * N,), np.float32),
+        }
+        k = 0
+        for _t in range(T):
+            q = np.asarray(self._apply(params, jnp.asarray(self.obs)))
+            greedy = q.argmax(axis=-1)
+            explore = self._rng.random(N) < epsilon
+            random_a = self._rng.integers(0, q.shape[-1], N)
+            actions = np.where(explore, random_a, greedy)
+            for i, env in enumerate(self.envs):
+                nobs, rew, term, trunc, _ = env.step(int(actions[i]))
+                buf["obs"][k] = self.obs[i]
+                buf["actions"][k] = actions[i]
+                buf["rewards"][k] = rew
+                buf["dones"][k] = float(term)  # truncation bootstraps
+                self._ep_returns[i] += rew
+                if term or trunc:
+                    self._done_returns.append(self._ep_returns[i])
+                    self._ep_returns[i] = 0.0
+                    nobs, _ = env.reset()
+                self.obs[i] = np.asarray(nobs, np.float32)
+                buf["next_obs"][k] = self.obs[i]
+                k += 1
+        return buf
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._done_returns)
+        if clear:
+            self._done_returns.clear()
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class DQNConfig:
+    """Builder (reference: DQNConfig fluent API)."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 1
+        self.rollout_steps = 256          # env steps sampled per iteration
+        self.train: Dict[str, Any] = dict(
+            lr=1e-3, gamma=0.99, batch_size=128, train_iters=8,
+            target_update_tau=0.01, double_q=True, huber_delta=1.0)
+        self.model: Dict[str, Any] = dict(hidden=(64, 64))
+        self.replay: Dict[str, Any] = dict(
+            capacity=50_000, prioritized=False, alpha=0.6, beta=0.4,
+            learn_starts=1_000)
+        self.exploration: Dict[str, Any] = dict(
+            epsilon_start=1.0, epsilon_end=0.05, epsilon_decay_steps=10_000)
+        self.seed = 0
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = env_config or {}
+        return self
+
+    def env_runners(self, num_env_runners: int = 1,
+                    num_envs_per_env_runner: int = 1,
+                    rollout_steps: int = 256):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_steps = rollout_steps
+        return self
+
+    def training(self, **kwargs):
+        model = kwargs.pop("model", None)
+        if model:
+            self.model.update(model)
+        replay = kwargs.pop("replay", None)
+        if replay:
+            self.replay.update(replay)
+        self.train.update(kwargs)
+        return self
+
+    def exploring(self, **kwargs):
+        self.exploration.update(kwargs)
+        return self
+
+    def debugging(self, seed: int = 0):
+        self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        if not self.env_name:
+            raise ValueError("call .environment(env_name) first")
+        return DQN(self)
+
+
+class DQN:
+    """Driver: epsilon-greedy sampling -> replay -> compiled double-DQN update."""
+
+    def __init__(self, config: DQNConfig):
+        import gymnasium as gym
+        import jax
+
+        import ray_tpu
+
+        from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+        self.config = config
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = int(probe.action_space.n)
+        probe.close()
+        self.model_spec = dict(obs_dim=obs_dim, action_dim=action_dim,
+                               hidden=tuple(config.model["hidden"]))
+        self.model = QNetwork(**self.model_spec)
+        self.params = self.model.init(jax.random.PRNGKey(config.seed))
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+
+        import optax
+        self.opt = optax.adam(config.train["lr"])
+        self.opt_state = self.opt.init(self.params)
+        self._update = self._build_update()
+
+        r = config.replay
+        if r.get("prioritized"):
+            self.buffer = PrioritizedReplayBuffer(
+                r["capacity"], alpha=r["alpha"], beta=r["beta"],
+                seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(r["capacity"], seed=config.seed)
+
+        runner_cls = ray_tpu.remote(DQNRunner)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, self.model_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i,
+                env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self._iteration = 0
+        self._env_steps = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config.train
+        gamma = cfg["gamma"]
+        tau = cfg["target_update_tau"]
+        double_q = cfg["double_q"]
+        delta = cfg["huber_delta"]
+        model = self.model
+
+        def loss_fn(params, target_params, batch):
+            q = model.apply(params, batch["obs"])
+            qa = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_t = model.apply(target_params, batch["next_obs"])
+            if double_q:
+                q_next_o = model.apply(params, batch["next_obs"])
+                next_a = q_next_o.argmax(axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, next_a[:, None], axis=-1)[:, 0]
+            else:
+                q_next = q_next_t.max(axis=-1)
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * q_next
+            td = qa - jax.lax.stop_gradient(target)
+            huber = jnp.where(jnp.abs(td) <= delta, 0.5 * td ** 2,
+                              delta * (jnp.abs(td) - 0.5 * delta))
+            w = batch.get("weights", jnp.ones_like(td))
+            return (w * huber).mean(), td
+
+        def update(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
+            target_params = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+            return params, target_params, opt_state, loss, td
+
+        return jax.jit(update)
+
+    def _epsilon(self) -> float:
+        e = self.config.exploration
+        frac = min(1.0, self._env_steps / max(1, e["epsilon_decay_steps"]))
+        return e["epsilon_start"] + frac * (e["epsilon_end"]
+                                            - e["epsilon_start"])
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        t0 = time.time()
+        cfg = self.config
+        eps = self._epsilon()
+        weights_ref = ray_tpu.put(
+            {k: np.asarray(v) for k, v in self.params.items()})
+        per_runner = max(1, cfg.rollout_steps // cfg.num_env_runners)
+        batches = ray_tpu.get(
+            [r.sample.remote(weights_ref, per_runner, eps)
+             for r in self.runners], timeout=600)
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += len(b["rewards"])
+
+        losses = []
+        if len(self.buffer) >= cfg.replay["learn_starts"]:
+            for _ in range(cfg.train["train_iters"]):
+                sample = self.buffer.sample(cfg.train["batch_size"])
+                batch = {
+                    "obs": jnp.asarray(sample["obs"]),
+                    "actions": jnp.asarray(sample["actions"]),
+                    "rewards": jnp.asarray(sample["rewards"]),
+                    "next_obs": jnp.asarray(sample["next_obs"]),
+                    "dones": jnp.asarray(sample["dones"]),
+                }
+                if "_weights" in sample:
+                    batch["weights"] = jnp.asarray(sample["_weights"])
+                (self.params, self.target_params, self.opt_state, loss,
+                 td) = self._update(self.params, self.target_params,
+                                    self.opt_state, batch)
+                self.buffer.update_priorities(sample["_indices"],
+                                              np.asarray(td))
+                losses.append(float(loss))
+
+        rets = [x for r in self.runners
+                for x in ray_tpu.get(r.episode_returns.remote(), timeout=60)]
+        self._recent_returns.extend(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "episodes_this_iter": len(rets),
+            "num_env_steps_sampled": self._env_steps,
+            "epsilon": eps,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "replay_size": len(self.buffer),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def get_weights(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}

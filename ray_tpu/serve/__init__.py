@@ -13,6 +13,7 @@ from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .config import AutoscalingConfig, DeploymentConfig
 from .deployment import Deployment, deployment
+from .graph import DAGDriver
 from .replica import Request
 from .router import DeploymentHandle
 
@@ -20,5 +21,5 @@ __all__ = [
     "deployment", "Deployment", "DeploymentConfig", "AutoscalingConfig",
     "DeploymentHandle", "Request", "batch", "run", "start", "status",
     "delete", "shutdown", "get_deployment_handle", "http_config",
-    "multiplexed", "get_multiplexed_model_id",
+    "multiplexed", "get_multiplexed_model_id", "DAGDriver",
 ]

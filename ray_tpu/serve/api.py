@@ -58,22 +58,39 @@ def run(target: Union[Deployment, Dict[str, Deployment]], *,
         _blocking: bool = True) -> DeploymentHandle:
     """Deploy and wait until healthy; returns a handle to the (first)
     deployment (reference: serve.run returns the app handle)."""
-    deployments = ([target] if isinstance(target, Deployment)
-                   else list(target.values()))
+    from .graph import collect_deployments, resolve_handles
+    # expand deployment graphs: nested Deployments in bound init args
+    # become DeploymentHandles; dependencies deploy first so the root
+    # never routes to a missing deployment (reference:
+    # deployment_graph_build.py).  Dict targets expand each value's graph.
+    roots = [target] if isinstance(target, Deployment) \
+        else list(target.values())
+    seen: Dict[str, Deployment] = {}
+    for r in roots:
+        for d in collect_deployments(r):
+            prev = seen.get(d.name)
+            if prev is not None and prev.version() != d.version():
+                raise ValueError(
+                    f"two different deployments named {d.name!r}; "
+                    "give them distinct name= options")
+            seen.setdefault(d.name, d)
+    deployments = [resolve_handles(d) for d in seen.values()]
+    root_name = roots[0].name if roots else None
     if not deployments:
         raise ValueError("nothing to deploy")
     if route_prefix != "/__auto__" and isinstance(target, Deployment):
-        cfg = deployments[0].config
         import dataclasses
-        deployments[0] = dataclasses.replace(
-            deployments[0], config=dataclasses.replace(
-                cfg, route_prefix=route_prefix))
+        deployments = [
+            dataclasses.replace(d, config=dataclasses.replace(
+                d.config, route_prefix=route_prefix))
+            if d.name == root_name else d
+            for d in deployments]
     ctrl = _get_controller(create=True, http=http)
     for d in deployments:
         ray_tpu.get(ctrl.deploy.remote(d), timeout=30)
     if _blocking:
         _wait_healthy(ctrl, [d.name for d in deployments], timeout_s)
-    return DeploymentHandle(deployments[0].name)
+    return DeploymentHandle(root_name)
 
 
 def _wait_healthy(ctrl, names, timeout_s: float):

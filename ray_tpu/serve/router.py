@@ -226,6 +226,30 @@ class DeploymentResponse:
         raise last or TimeoutError(
             f"no result from {self.deployment} in {timeout_s}s")
 
+    async def result_async(self, timeout_s: float = 60.0):
+        """Awaitable result() — for deployment-to-deployment calls inside
+        async replica code (blocking would starve the replica's loop).
+        Resolution is scheduled on the worker's RPC loop via ``as_future``
+        (the replica's actor loop must not touch loop-bound RPC state)."""
+        import asyncio
+        deadline = time.monotonic() + timeout_s
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            try:
+                fut = ray_tpu.as_future(self._ref)
+                return await asyncio.wait_for(
+                    asyncio.wrap_future(fut),
+                    max(0.1, deadline - time.monotonic()))
+            except BaseException as e:  # noqa: BLE001
+                if not is_retryable_failure(e):
+                    raise
+                last = e
+                get_router()._evict(self.deployment, self._replica)
+                self._replica, self._ref = get_router().assign(
+                    self.deployment, self._args, self._kwargs, self._method)
+        raise last or TimeoutError(
+            f"no result from {self.deployment} in {timeout_s}s")
+
     def _to_object_ref(self):
         """The underlying ObjectRef (no retry semantics)."""
         return self._ref

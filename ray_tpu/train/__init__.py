@@ -14,7 +14,8 @@ from .config import FailureConfig, RunConfig, ScalingConfig
 from .context import (TrainContext, get_checkpoint, get_context,
                       get_dataset_shard, report)
 from .result import Result
-from .backend import Backend, BackendConfig, JaxBackendConfig
+from .backend import (Backend, BackendConfig, JaxBackendConfig,
+                      TorchBackendConfig, prepare_torch_model)
 from .worker_group import WorkerGroup
 from .backend_executor import BackendExecutor, TrainingFailedError
 from .trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
@@ -24,7 +25,8 @@ __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "TrainContext", "get_context", "get_checkpoint",
     "get_dataset_shard", "report", "Result", "Backend", "BackendConfig",
-    "JaxBackendConfig", "WorkerGroup", "BackendExecutor",
+    "JaxBackendConfig", "TorchBackendConfig", "prepare_torch_model",
+    "WorkerGroup", "BackendExecutor",
     "TrainingFailedError", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "save_pytree", "load_pytree",
 ]

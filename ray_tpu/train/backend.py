@@ -95,3 +95,70 @@ class JaxBackend(Backend):
         ]
         import ray_tpu
         ray_tpu.get(futures, timeout=120)
+
+
+@dataclasses.dataclass
+class TorchBackendConfig(BackendConfig):
+    """Forms a ``torch.distributed`` process group across the worker actors.
+
+    Reference: ``python/ray/train/torch/config.py:63-160``
+    (``_setup_torch_process_group`` — TCP-store rendezvous, backend
+    nccl/gloo).  On TPU hosts torch is CPU-only, so the default backend is
+    gloo; this exists for data pipelines and models that train with torch
+    while the TPU path uses JaxBackend.
+    """
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _setup_torch_process_group(init_method: str, backend: str, rank: int,
+                               world_size: int, timeout_s: float) -> None:
+    import datetime
+    import torch.distributed as dist
+    dist.init_process_group(
+        backend=backend, init_method=init_method, rank=rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+
+
+def _teardown_torch_process_group() -> None:
+    import torch.distributed as dist
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group: "WorkerGroup") -> None:
+        cfg: TorchBackendConfig = self.config
+        n = len(worker_group)
+        coordinator = worker_group.execute_single(0, _pick_coordinator, 0)
+        init_method = f"tcp://{coordinator}"
+        futures = [
+            worker_group.execute_single_async(
+                i, _setup_torch_process_group, init_method, cfg.backend,
+                i, n, cfg.init_timeout_s)
+            for i in range(n)
+        ]
+        import ray_tpu
+        ray_tpu.get(futures, timeout=cfg.init_timeout_s + 30)
+
+    def on_shutdown(self, worker_group: "WorkerGroup") -> None:
+        try:
+            worker_group.execute(_teardown_torch_process_group)
+        except Exception:
+            pass
+
+
+def prepare_torch_model(model):
+    """Wrap a torch model in DistributedDataParallel when a process group is
+    up (reference: ``train/torch/train_loop_utils.py:263`` prepare_model)."""
+    import torch.distributed as dist
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model

@@ -17,6 +17,7 @@ class Result:
     error: Optional[BaseException] = None
     metrics_history: Optional[List[Dict[str, Any]]] = None
     best_checkpoints: Optional[List[Tuple[Checkpoint, Dict[str, Any]]]] = None
+    config: Optional[Dict[str, Any]] = None  # the trial's hyperparameters
 
     @property
     def metrics_dataframe(self):

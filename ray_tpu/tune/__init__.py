@@ -5,13 +5,14 @@ controller event loop → trials as actors; search spaces; ASHA / median /
 PBT schedulers; per-trial checkpoints; experiment state snapshots.
 """
 
-from .search import (BasicVariantGenerator, Categorical, Domain, Float,
-                     GridSearch, Integer, Searcher, TPESearcher, choice,
-                     grid_search, lograndint, loguniform, qloguniform,
-                     quniform, randint, randn, sample_from, uniform)
+from .search import (BasicVariantGenerator, BOHBSearcher, Categorical,
+                     Domain, Float, GridSearch, Integer, Searcher,
+                     TPESearcher, choice, grid_search, lograndint,
+                     loguniform, qloguniform, quniform, randint, randn,
+                     sample_from, uniform)
 from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
-                         MedianStoppingRule, PopulationBasedTraining,
-                         TrialScheduler)
+                         HyperBandScheduler, MedianStoppingRule,
+                         PopulationBasedTraining, TrialScheduler)
 from .session import (get_checkpoint, get_session, get_trial_dir,
                       get_trial_id, report, report_bridge)
 from .trial import Trial
@@ -26,7 +27,8 @@ __all__ = [
     "qloguniform", "randint", "lograndint", "choice", "sample_from", "randn",
     "grid_search", "Domain", "Float", "Integer", "Categorical", "GridSearch",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
-    "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "BOHBSearcher",
     "report", "get_checkpoint", "get_session", "get_trial_id",
     "get_trial_dir", "report_bridge",
 ]

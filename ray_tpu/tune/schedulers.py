@@ -81,6 +81,52 @@ class AsyncHyperBandScheduler(TrialScheduler):
         return CONTINUE
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand: multiple successive-halving brackets with different
+    exploration/exploitation trade-offs (Li et al., JMLR 2018; reference
+    ``python/ray/tune/schedulers/hyperband.py``).
+
+    Asynchronous variant: incoming trials are assigned round-robin to
+    brackets; bracket ``s`` starts its rungs at ``grace * eta^s`` so
+    aggressive brackets kill early and conservative ones let everything
+    run long.  Within a bracket the rung rule is ASHA's (top-1/eta
+    quantile continues) — the synchronous pause/resume machinery of the
+    original is deliberately traded for never idling a chip while a rung
+    waits to fill.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3.0):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        s_max = max(1, int(math.log(max_t) / math.log(reduction_factor)))
+        self.brackets: List[AsyncHyperBandScheduler] = [
+            AsyncHyperBandScheduler(
+                metric, mode, time_attr=time_attr, max_t=max_t,
+                grace_period=max(1, int(reduction_factor ** s)),
+                reduction_factor=reduction_factor)
+            for s in range(s_max)
+        ]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def on_result(self, trial, result):
+        for bracket in self.brackets:
+            # the controller patches metric/mode onto the outer scheduler
+            # after construction (controller fix-up for metric=None) —
+            # propagate so the brackets actually score
+            bracket.metric, bracket.mode = self.metric, self.mode
+        b = self._assignment.get(trial.trial_id)
+        if b is None:
+            b = self._assignment[trial.trial_id] = (
+                self._next % len(self.brackets))
+            self._next += 1
+        return self.brackets[b].on_result(trial, result)
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose best score so far is below the median of other
     trials' running averages at the same point in time."""

@@ -393,3 +393,58 @@ class TPESearcher(Searcher):
         if self.mode == "min":
             score = -score
         self._obs.append((flat, score))
+
+
+class BOHBSearcher(TPESearcher):
+    """Budget-aware model-based search (BOHB; Falkner et al., ICML 2018).
+
+    Reference parity target: ``python/ray/tune/search/bohb`` (TuneBOHB,
+    paired with HyperBandForBOHB).  Pair this with
+    :class:`~ray_tpu.tune.schedulers.HyperBandScheduler`: the scheduler
+    prunes at rungs while the searcher fits its TPE model ONLY on
+    observations from the highest budget (``time_attr`` value) that has
+    accumulated ``n_startup`` results — so cheap low-budget evaluations
+    guide early sampling but stop polluting the model once real evidence
+    at larger budgets exists.
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: Optional[str] = None,
+                 mode: str = "max", *, time_attr: str = "training_iteration",
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(space, metric, mode, n_startup=n_startup,
+                         gamma=gamma, n_candidates=n_candidates, seed=seed)
+        self.time_attr = time_attr
+        # budget -> [(flat_config, score)]
+        self._budget_obs: Dict[int, List[Tuple[Dict, float]]] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        super().on_trial_result(trial_id, result)
+        flat = self._live.get(trial_id)
+        score = result.get(self.metric)
+        budget = result.get(self.time_attr)
+        if flat is None or score is None or budget is None:
+            return
+        score = float(score)
+        if self.mode == "min":
+            score = -score
+        self._budget_obs.setdefault(int(budget), []).append((dict(flat),
+                                                             score))
+        # keep the base class's flat `_obs` tracking the model budget
+        self._obs = self._model_observations()
+
+    def _model_observations(self):
+        for budget in sorted(self._budget_obs, reverse=True):
+            obs = self._budget_obs[budget]
+            if len(obs) >= self.n_startup:
+                return obs
+        # no budget has enough data: pool everything (startup phase)
+        return [o for obs in self._budget_obs.values() for o in obs]
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        # per-budget results were already folded in on_trial_result; just
+        # release the live slot (do NOT double-append to _obs)
+        self._live.pop(trial_id, None)
+        self._latest.pop(trial_id, None)

@@ -40,7 +40,8 @@ class ResultGrid:
                    if t.latest_checkpoint else None,
                    path=os.path.join(t.experiment_dir, t.trial_id),
                    error=RuntimeError(t.error) if t.error else None,
-                   metrics_history=t.metrics_history)
+                   metrics_history=t.metrics_history,
+                   config=t.config)
             for t in trials
         ]
 

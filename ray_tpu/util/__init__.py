@@ -6,6 +6,7 @@ scheduling strategies (those live in ray_tpu.core), state API
 """
 
 from .actor_pool import ActorPool
+from .pubsub import Subscriber, publish
 from .queue import Empty, Full, Queue
 
-__all__ = ["ActorPool", "Queue", "Empty", "Full"]
+__all__ = ["ActorPool", "Queue", "Empty", "Full", "Subscriber", "publish"]

@@ -1,0 +1,44 @@
+"""joblib backend: run scikit-learn / joblib.Parallel work on the cluster.
+
+Reference: ``python/ray/util/joblib/`` — ``register_ray()`` installs a
+joblib parallel backend so ``with joblib.parallel_backend("ray_tpu"): ...``
+fans batches out as cluster tasks.  Built on the multiprocessing Pool shim
+(which itself rides the task substrate), mirroring how the reference backs
+its joblib backend with its Pool.
+"""
+
+from __future__ import annotations
+
+
+def register_ray_tpu():
+    """Register the ``ray_tpu`` joblib backend (requires joblib installed)."""
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    from .multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            from ..core import api
+            if not api.is_initialized():
+                api.init()
+            eff = int(api.cluster_resources().get("CPU", 1))
+            if n_jobs and n_jobs > 0:
+                eff = min(eff, n_jobs)
+            return max(1, eff)
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **memmappingpool_args):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,177 @@
+"""``multiprocessing.Pool``-compatible API over the task substrate.
+
+Reference: ``python/ray/util/multiprocessing/`` — a drop-in Pool whose
+workers are cluster actors, so ``Pool(...).map(f, xs)`` scales past one
+host with no code change.  This implementation runs each chunk as a remote
+task (stateless work needs no dedicated worker actors, and the lease pool
+already recycles processes), which keeps semantics identical while letting
+the scheduler spread chunks across every node.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+from ..core import api as _api
+from ..core.api import remote
+
+
+class AsyncResult:
+    """Matches ``multiprocessing.pool.AsyncResult``."""
+
+    def __init__(self, refs: List, single: bool, callback=None,
+                 error_callback=None):
+        self._refs = refs
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._done = False
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, timeout: Optional[float] = None):
+        if self._done:
+            return
+        try:
+            out: List[Any] = []
+            for chunk in _api.get(self._refs, timeout=timeout):
+                out.extend(chunk)
+            self._value = out[0] if self._single else out
+            if self._callback:
+                self._callback(self._value)
+        except BaseException as e:  # noqa: BLE001 — surfaced via get()
+            self._error = e
+            if self._error_callback:
+                self._error_callback(e)
+        self._done = True
+
+    def get(self, timeout: Optional[float] = None):
+        self._resolve(timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait(self, timeout: Optional[float] = None):
+        try:
+            _api.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+        except Exception:
+            pass
+
+    def ready(self) -> bool:
+        if self._done:
+            return True
+        _ready, rest = _api.wait(self._refs, num_returns=len(self._refs),
+                                 timeout=0)
+        return not rest
+
+    def successful(self) -> bool:
+        if not self._done:
+            raise ValueError("result is not ready")
+        return self._error is None
+
+
+def _chunks(seq: List, n: int):
+    for i in range(0, len(seq), n):
+        yield seq[i:i + n]
+
+
+class Pool:
+    """Process pool whose chunks run as cluster tasks."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if not _api.is_initialized():
+            _api.init()
+        self._processes = processes or int(
+            _api.cluster_resources().get("CPU", 1))
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    # every chunk re-runs the initializer: tasks may land on any pooled
+    # worker process, so per-process setup must be idempotent (documented
+    # reference behavior for non-actor execution)
+    def _runner(self):
+        initializer, initargs = self._initializer, self._initargs
+
+        @remote
+        def _run_chunk(fn, chunk, star):
+            if initializer is not None:
+                initializer(*initargs)
+            if star:
+                return [fn(*item) for item in chunk]
+            return [fn(item) for item in chunk]
+
+        return _run_chunk
+
+    def _submit(self, fn, items: List, chunksize: Optional[int], star: bool):
+        if self._closed:
+            raise ValueError("Pool not running")
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        run = self._runner()
+        return [run.remote(fn, c, star) for c in _chunks(items, chunksize)]
+
+    # ------------------------------------------------------------- apply/map
+
+    def apply(self, fn, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args: tuple = (), kwds: Optional[dict] = None,
+                    callback=None, error_callback=None):
+        kwds = kwds or {}
+        run = self._runner()
+        ref = run.remote(lambda _=None: fn(*args, **kwds), [None], False)
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
+
+    def map(self, fn, iterable: Iterable, chunksize: Optional[int] = None):
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable: Iterable,
+                  chunksize: Optional[int] = None, callback=None,
+                  error_callback=None):
+        refs = self._submit(fn, list(iterable), chunksize, star=False)
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
+
+    def starmap(self, fn, iterable: Iterable,
+                chunksize: Optional[int] = None):
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable: Iterable,
+                      chunksize: Optional[int] = None):
+        refs = self._submit(fn, list(iterable), chunksize, star=True)
+        return AsyncResult(refs, single=False)
+
+    def imap(self, fn, iterable: Iterable, chunksize: int = 1):
+        refs = self._submit(fn, list(iterable), chunksize, star=False)
+        for ref in refs:
+            yield from _api.get(ref)
+
+    def imap_unordered(self, fn, iterable: Iterable, chunksize: int = 1):
+        refs = self._submit(fn, list(iterable), chunksize, star=False)
+        pending = list(refs)
+        while pending:
+            done, pending = _api.wait(pending, num_returns=1)
+            yield from _api.get(done[0])
+
+    # ------------------------------------------------------------- lifecycle
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -1,0 +1,183 @@
+"""Ecosystem/utility surface: pubsub, internal_kv, multiprocessing Pool,
+joblib backend, new datasources (tfrecord/sql/image).
+
+Reference analogues: ``python/ray/tests/test_multiprocessing.py``,
+``test_joblib.py``, ``python/ray/data/tests/test_tfrecords.py`` /
+``test_sql.py``.
+"""
+
+import os
+import sqlite3
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def test_pubsub_roundtrip(ray_start_regular):
+    from ray_tpu.util.pubsub import Subscriber, publish
+
+    sub = Subscriber(["test_topic"])
+    got = []
+
+    def poller():
+        got.extend(sub.poll(timeout=10.0))
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.2)
+    publish("test_topic", {"hello": 1})
+    t.join(timeout=12)
+    assert got and got[0][0] == "test_topic" and got[0][1]["hello"] == 1
+    # messages on other topics are not delivered
+    publish("other_topic", {"x": 2})
+    publish("test_topic", {"hello": 2})
+    msgs = sub.poll(timeout=10.0)
+    assert [p["hello"] for _t, p in msgs] == [2]
+    sub.close()
+
+
+def test_pubsub_from_worker(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util.pubsub import Subscriber
+
+    sub = Subscriber("events")
+
+    @ray_tpu.remote
+    def announce(i):
+        from ray_tpu.util.pubsub import publish
+        publish("events", {"i": i})
+        return i
+
+    res = []
+    t = threading.Thread(target=lambda: res.extend(sub.poll(timeout=10)))
+    t.start()
+    time.sleep(0.2)
+    assert ray_tpu.get(announce.remote(7)) == 7
+    t.join(timeout=12)
+    assert res and res[0][1]["i"] == 7
+    sub.close()
+
+
+def test_internal_kv(ray_start_regular):
+    from ray_tpu.experimental import (internal_kv_del, internal_kv_exists,
+                                      internal_kv_get, internal_kv_keys,
+                                      internal_kv_put)
+
+    assert internal_kv_put("k1", b"v1")
+    assert internal_kv_get("k1") == b"v1"
+    assert internal_kv_exists("k1")
+    assert not internal_kv_exists("nope")
+    internal_kv_put("k2", "str-value")
+    assert internal_kv_get("k2") == b"str-value"
+    assert sorted(internal_kv_keys("k")) == ["k1", "k2"]
+    assert internal_kv_del("k1")
+    assert internal_kv_get("k1") is None
+    # no-overwrite mode
+    internal_kv_put("k3", b"a")
+    assert not internal_kv_put("k3", b"b", overwrite=False)
+    assert internal_kv_get("k3") == b"a"
+
+
+def _square(x):
+    return x * x
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_square, range(10)) == [x * x for x in range(10)]
+        assert pool.apply(_square, (7,)) == 49
+        r = pool.apply_async(_square, (8,))
+        assert r.get(timeout=30) == 64
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert sorted(pool.imap_unordered(_square, range(5))) == \
+            [0, 1, 4, 9, 16]
+        got = list(pool.imap(_square, range(5), chunksize=2))
+        assert got == [0, 1, 4, 9, 16]
+
+
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_square)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_tfrecords_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_items([{"x": i, "y": float(i) / 2, "s": f"row{i}".encode()}
+                           for i in range(20)])
+    ds.write_tfrecords(str(tmp_path / "out"))
+    back = rdata.read_tfrecords(str(tmp_path / "out"))
+    rows = sorted(back.take_all(), key=lambda r: r["x"])
+    assert len(rows) == 20
+    assert rows[3]["x"] == 3
+    assert abs(rows[3]["y"] - 1.5) < 1e-6
+    assert rows[3]["s"] == b"row3"
+
+
+def test_sql_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu import data as rdata
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pts (id INTEGER, val REAL)")
+    conn.executemany("INSERT INTO pts VALUES (?, ?)",
+                     [(i, i * 0.5) for i in range(10)])
+    conn.commit()
+    conn.close()
+
+    ds = rdata.read_sql("SELECT * FROM pts ORDER BY id",
+                        lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert len(rows) == 10 and rows[4]["id"] == 4
+
+    # write back into a second table
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE out (id INTEGER, val REAL)")
+    conn.commit()
+    conn.close()
+    n = ds.write_sql("INSERT INTO out VALUES (?, ?)",
+                     lambda: sqlite3.connect(db))
+    assert n == 10
+    conn = sqlite3.connect(db)
+    assert conn.execute("SELECT COUNT(*) FROM out").fetchone()[0] == 10
+    conn.close()
+
+
+def test_sql_sharded_read(ray_start_regular, tmp_path):
+    from ray_tpu import data as rdata
+
+    db = str(tmp_path / "s.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER)")
+    conn.executemany("INSERT INTO t VALUES (?)", [(i,) for i in range(100)])
+    conn.commit()
+    conn.close()
+    ds = rdata.read_sql(
+        "SELECT * FROM t", lambda: sqlite3.connect(db),
+        shard_queries=[f"SELECT * FROM t WHERE id % 4 = {k}"
+                       for k in range(4)])
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(100))
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from ray_tpu import data as rdata
+
+    for i in range(3):
+        arr = np.full((8, 8, 3), i * 40, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    ds = rdata.read_images(str(tmp_path))
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert rows[0]["image"].shape == (8, 8, 3)

@@ -1,0 +1,109 @@
+"""Deployment graph composition (reference: serve DAG/model-composition
+tests — ``python/ray/serve/tests/test_deployment_graph*.py``)."""
+
+import pytest
+
+from ray_tpu import serve
+
+
+def test_collect_deployments_order_and_dedup():
+    from ray_tpu.serve.graph import collect_deployments
+
+    @serve.deployment
+    class A:
+        pass
+
+    @serve.deployment
+    class B:
+        def __init__(self, a):
+            pass
+
+    @serve.deployment
+    class C:
+        def __init__(self, a, b):
+            pass
+
+    a = A.bind()
+    graph = C.bind(a, B.bind(a))
+    order = [d.name for d in collect_deployments(graph)]
+    assert order.index("A") < order.index("B") < order.index("C")
+    assert order.count("A") == 1  # shared dep deduped
+
+
+def test_conflicting_names_rejected():
+    from ray_tpu.serve.graph import collect_deployments
+
+    @serve.deployment(name="same")
+    class X:
+        def __init__(self, *deps):
+            pass
+
+    @serve.deployment(name="same")
+    class Y:
+        pass
+
+    with pytest.raises(ValueError, match="distinct name"):
+        collect_deployments(X.bind(Y.bind()))
+
+
+def test_graph_composition_e2e(ray_start_regular):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x: int) -> int:
+            return 2 * x
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, doubler, offset: int):
+            self.doubler = doubler      # a DeploymentHandle
+            self.offset = offset
+
+        async def __call__(self, x: int) -> int:
+            doubled = await self.doubler.remote(x).result_async()
+            return doubled + self.offset
+
+    app = Adder.bind(Doubler.bind(), 5)
+    h = serve.run(app)
+    try:
+        assert h.remote(10).result(timeout_s=30) == 25
+        assert h.remote(0).result(timeout_s=30) == 5
+    finally:
+        serve.shutdown()
+
+
+def test_graph_composition_dict_target(ray_start_regular):
+    @serve.deployment
+    class Inner:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        async def __call__(self, x):
+            return await self.inner.remote(x).result_async() * 10
+
+    serve.run({"app": Outer.bind(Inner.bind())})
+    try:
+        h = serve.get_deployment_handle("Outer")
+        assert h.remote(4).result(timeout_s=30) == 50
+    finally:
+        serve.shutdown()
+
+
+def test_dag_driver(ray_start_regular):
+    from ray_tpu.serve.graph import DAGDriver
+
+    @serve.deployment
+    class Upper:
+        def __call__(self, s: str) -> str:
+            return s.upper()
+
+    driver = DAGDriver.bind(Upper.bind())
+    h = serve.run(driver)
+    try:
+        assert h.remote("abc").result(timeout_s=30) == "ABC"
+    finally:
+        serve.shutdown()

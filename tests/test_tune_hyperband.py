@@ -1,0 +1,122 @@
+"""HyperBand scheduler + BOHB searcher (reference:
+``python/ray/tune/tests/test_trial_scheduler.py`` hyperband cases and
+``search/bohb`` behavior)."""
+
+import random
+
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import BOHBSearcher, HyperBandScheduler, TuneConfig, Tuner
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+
+class _FakeTrial:
+    def __init__(self, tid):
+        self.trial_id = tid
+        self.config = {}
+        self.rungs_passed = set()
+
+
+def test_hyperband_brackets_assign_round_robin():
+    sched = HyperBandScheduler(metric="m", mode="max", max_t=27,
+                               reduction_factor=3.0)
+    assert len(sched.brackets) >= 2
+    graces = [b.grace for b in sched.brackets]
+    assert graces == sorted(graces)  # bracket s starts at eta^s
+    t1, t2 = _FakeTrial("a"), _FakeTrial("b")
+    sched.on_result(t1, {"training_iteration": 1, "m": 1.0})
+    sched.on_result(t2, {"training_iteration": 1, "m": 1.0})
+    assert sched._assignment["a"] != sched._assignment["b"]
+
+
+def test_hyperband_metric_patched_late():
+    # the controller sets scheduler.metric after construction when the
+    # user gives metric via TuneConfig; brackets must pick it up
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3.0)
+    sched.metric, sched.mode = "m", "max"
+    for i in range(12):
+        t = _FakeTrial(f"p{i}")
+        sched._assignment[t.trial_id] = 0
+        sched.on_result(t, {"training_iteration": 1, "m": float(i)})
+    worst = _FakeTrial("worst")
+    sched._assignment["worst"] = 0
+    assert sched.on_result(
+        worst, {"training_iteration": 1, "m": -100.0}) == STOP
+
+
+def test_hyperband_prunes_bad_trials():
+    sched = HyperBandScheduler(metric="m", mode="max", max_t=9,
+                               reduction_factor=3.0)
+    # drive many trials through bracket 0 (grace=1): bad ones must stop
+    decisions = {}
+    for i in range(12):
+        t = _FakeTrial(f"t{i}")
+        sched._assignment[t.trial_id] = 0
+        score = float(i)  # later trials are better
+        d = sched.on_result(t, {"training_iteration": 1, "m": score})
+        decisions[i] = d
+    assert decisions[0] in (CONTINUE, STOP)
+    # with 12 seen, a new bottom-of-the-pack trial is pruned at the rung
+    worst = _FakeTrial("worst")
+    sched._assignment["worst"] = 0
+    assert sched.on_result(
+        worst, {"training_iteration": 1, "m": -100.0}) == STOP
+    # and max_t always stops
+    t = _FakeTrial("done")
+    sched._assignment["done"] = 0
+    assert sched.on_result(t, {"training_iteration": 9, "m": 1e9}) == STOP
+
+
+def test_bohb_model_uses_highest_budget():
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s = BOHBSearcher(space, metric="loss", mode="min", n_startup=3, seed=0)
+    rng = random.Random(0)
+    # feed low-budget results that mislead (good at x~0.9) and high-budget
+    # results that tell the truth (good at x~0.1)
+    for i in range(8):
+        tid = f"lo{i}"
+        cfg = s.suggest(tid)
+        x = cfg["x"]
+        s.on_trial_result(tid, {"training_iteration": 1,
+                                "loss": abs(x - 0.9)})
+        s.on_trial_complete(tid)
+    for i in range(8):
+        tid = f"hi{i}"
+        cfg = s.suggest(tid)
+        x = rng.random()
+        s._live[tid] = {("x",): x}
+        s.on_trial_result(tid, {"training_iteration": 9,
+                                "loss": abs(x - 0.1)})
+        s.on_trial_complete(tid)
+    # model should now be fit on budget-9 observations only
+    obs = s._model_observations()
+    assert all(o in s._budget_obs[9] for o in obs)
+    xs = [s.suggest(f"probe{i}")["x"] for i in range(12)]
+    # suggestions should lean toward the high-budget optimum (0.1), not 0.9
+    assert sum(1 for x in xs if x < 0.5) > sum(1 for x in xs if x >= 0.5)
+
+
+def test_bohb_with_hyperband_e2e(ray_start_regular, tmp_path):
+    def trainable(config):
+        x = config["x"]
+        for i in range(1, 10):
+            # converges toward the true quality of x over iterations
+            noise = (10 - i) * 0.05
+            tune.report({"score": -abs(x - 0.25) - noise,
+                         "training_iteration": i})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(
+            metric="score", mode="max", num_samples=10,
+            search_alg=BOHBSearcher({"x": tune.uniform(0.0, 1.0)},
+                                    metric="score", mode="max",
+                                    n_startup=4, seed=0),
+            scheduler=HyperBandScheduler(metric="score", mode="max",
+                                         max_t=9, reduction_factor=3.0),
+        ),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)),
+    ).fit()
+    best = results.get_best_result()
+    assert abs(best.config["x"] - 0.25) < 0.35
