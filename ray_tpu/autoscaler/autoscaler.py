@@ -54,6 +54,7 @@ class StandardAutoscaler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_launches = 0
+        self.num_failed_launches = 0
         self.num_terminations = 0
 
     # ------------------------------------------------------------- control
@@ -71,7 +72,7 @@ class StandardAutoscaler:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
-        if terminate_nodes and isinstance(self.provider, LocalNodeProvider):
+        if terminate_nodes and hasattr(self.provider, "shutdown"):
             self.provider.shutdown()
 
     # ---------------------------------------------------------------- loop
@@ -165,9 +166,10 @@ class StandardAutoscaler:
                     continue
                 if all(nt.resources.get(k, 0.0) + 1e-9 >= v
                        for k, v in demand.items() if v > 0):
-                    self._launch(name)
+                    budget -= 1  # a failed attempt still consumes budget
+                    if self._launch(name) is None:
+                        break  # demand stays unmet; retried next update
                     counts[name] = counts.get(name, 0) + 1
-                    budget -= 1
                     cap = dict(nt.resources)
                     for k, v in demand.items():
                         cap[k] = cap.get(k, 0.0) - v
@@ -175,7 +177,10 @@ class StandardAutoscaler:
                     break
 
     def _scale_down(self, alive: Dict[str, dict]):
-        if not isinstance(self.provider, LocalNodeProvider):
+        # Any provider that can map its ids to cluster node ids supports
+        # idle drain (LocalNodeProvider, wrapped/flaky providers; the GCE
+        # TPU provider reports None until its startup script registers).
+        if not hasattr(self.provider, "raytpu_node_id"):
             return
         now = time.monotonic()
         counts = self._owned_counts()
@@ -217,12 +222,20 @@ class StandardAutoscaler:
                 counts[t] = counts.get(t, 0) + 1
         return counts
 
-    def _launch(self, node_type: str):
+    def _launch(self, node_type: str) -> Optional[str]:
+        """Launch one node; a provider failure (quota, outage) is counted
+        and absorbed — the demand stays unmet and the next update retries
+        (reference: node_launcher.py catches and logs launch exceptions)."""
         nt = self.config.node_types[node_type]
-        pid = self.provider.create_node(node_type, dict(nt.labels))
+        try:
+            pid = self.provider.create_node(node_type, dict(nt.labels))
+        except Exception:
+            self.num_failed_launches += 1
+            return None
         self._owned[pid] = node_type
         self._launched_at[pid] = time.monotonic()
         self.num_launches += 1
+        return pid
 
     def _terminate(self, pid: str):
         self.provider.terminate_node(pid)

@@ -1,0 +1,65 @@
+"""Failure-injecting provider harness for autoscaler tests.
+
+Reference: ``python/ray/autoscaler/_private/fake_multi_node/node_provider.py:237``
+(FakeMultiNodeProvider) — the reference tests its autoscaler against a
+provider that can misbehave on command.  This wrapper delegates to any real
+provider (usually ``LocalNodeProvider``, which boots genuine node processes)
+and injects, per test knobs:
+
+* ``fail_first_n`` — the first N ``create_node`` calls raise (provider
+  outage / quota error); the autoscaler must retry on later ticks rather
+  than crash or leak demand.
+* ``launch_delay_s`` — every create blocks this long (slow cloud control
+  plane); tests assert the autoscaler neither double-launches nor counts a
+  slow launch as failed.
+* ``flaky_terminate`` — first terminate per node raises; the autoscaler
+  must converge anyway.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from .providers import NodeProvider
+
+
+class FlakyNodeProvider(NodeProvider):
+    def __init__(self, inner: NodeProvider, fail_first_n: int = 0,
+                 launch_delay_s: float = 0.0, flaky_terminate: bool = False):
+        self.inner = inner
+        self.fail_first_n = fail_first_n
+        self.launch_delay_s = launch_delay_s
+        self.flaky_terminate = flaky_terminate
+        self.create_attempts = 0
+        self.create_failures = 0
+        self._terminate_seen: Dict[str, bool] = {}
+
+    def create_node(self, node_type: str, labels: Dict[str, str]) -> str:
+        self.create_attempts += 1
+        if self.launch_delay_s:
+            time.sleep(self.launch_delay_s)
+        if self.create_attempts <= self.fail_first_n:
+            self.create_failures += 1
+            raise RuntimeError(
+                f"injected launch failure {self.create_attempts}"
+                f"/{self.fail_first_n}")
+        return self.inner.create_node(node_type, labels)
+
+    def terminate_node(self, provider_id: str) -> None:
+        if self.flaky_terminate and not self._terminate_seen.get(provider_id):
+            self._terminate_seen[provider_id] = True
+            raise RuntimeError("injected terminate failure")
+        self.inner.terminate_node(provider_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        return self.inner.non_terminated_nodes()
+
+    def raytpu_node_id(self, provider_id: str):
+        fn = getattr(self.inner, "raytpu_node_id", None)
+        return fn(provider_id) if fn else None
+
+    def shutdown(self):
+        fn = getattr(self.inner, "shutdown", None)
+        if fn:
+            fn()

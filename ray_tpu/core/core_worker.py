@@ -232,13 +232,16 @@ class TaskManager:
 
     def can_retry(self, task_id: TaskID) -> bool:
         pt = self.pending.get(task_id)
-        return pt is not None and pt.retries_left > 0
+        return pt is not None and pt.retries_left != 0
 
     def use_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
+        """Negative retries_left means retry forever (max_retries=-1, same
+        semantics as the reference's infinite task/actor retries)."""
         pt = self.pending.get(task_id)
-        if pt is None or pt.retries_left <= 0:
+        if pt is None or pt.retries_left == 0:
             return None
-        pt.retries_left -= 1
+        if pt.retries_left > 0:
+            pt.retries_left -= 1
         pt.spec.retry_count += 1
         return pt.spec
 
@@ -280,13 +283,41 @@ class LeasePool:
         self._pump()
 
     def _pump(self):
-        # Dispatch queued tasks to idle leased workers.
+        # Dispatch queued tasks to idle leased workers.  Multiple queued
+        # tasks ride one push RPC (up to max_tasks_in_flight_per_worker),
+        # split evenly across idle workers so batching never costs
+        # parallelism (reference: direct_task_transport.h:151 pipelining).
         idle = [lw for lw in self.leased.values() if not lw.busy]
+        max_batch = get_config().max_tasks_in_flight_per_worker
         while self.queue and idle:
+            # Split the queue over EXPECTED capacity (idle workers + leases
+            # still being granted), not just current idle workers: batching
+            # must never serialize onto one worker what in-flight leases
+            # would have parallelized (long tasks would lose whole-node
+            # parallelism; reference work-stealing solves the same hazard,
+            # direct_task_transport.h:151).
+            avail = len(idle) + self.requesting
+            share = min(max_batch,
+                        -(-len(self.queue) // max(1, avail)))  # ceil div
             lw = idle.pop()
-            spec = self.queue.popleft()
+            # A batch replies as a unit, so a task must never ride in the
+            # same batch as a task whose return it consumes — the consumer
+            # would block resolving the ref at the owner while the owner
+            # waits for this very batch's reply (deadlock).  Cross-batch
+            # dependencies are fine: the producer's batch replies first.
+            batch: List[TaskSpec] = []
+            produced: set = set()
+            while self.queue and len(batch) < share:
+                spec = self.queue[0]
+                pt = self.w.task_manager.pending.get(spec.task_id)
+                arg_ids = {r.id for r in pt.arg_refs} if pt else set()
+                if batch and not produced.isdisjoint(arg_ids):
+                    break
+                self.queue.popleft()
+                batch.append(spec)
+                produced.update(spec.return_ids())
             lw.busy = True
-            asyncio.ensure_future(self._run_on(lw, spec))
+            asyncio.ensure_future(self._run_on(lw, batch))
         # Request more leases only for demand not already covered by idle
         # leased workers or in-flight lease requests.
         deficit = len(self.queue) - len(idle) - self.requesting
@@ -363,20 +394,28 @@ class LeasePool:
             self.requesting -= 1
             self._pump()
 
-    async def _run_on(self, lw: LeasedWorker, spec: TaskSpec):
+    async def _run_on(self, lw: LeasedWorker, specs: List[TaskSpec]):
         client = self.w.worker_clients.get(lw.address)
-        self.w.task_event(spec, "RUNNING", node_id=lw.node_id)
+        for spec in specs:
+            self.w.task_event(spec, "RUNNING", node_id=lw.node_id)
         try:
-            results = await client.call("push_task", spec=spec, _timeout=86400.0)
+            if len(specs) == 1:
+                results_list = [await client.call("push_task", spec=specs[0],
+                                                  _timeout=86400.0)]
+            else:
+                results_list = await client.call("push_task_batch",
+                                                 specs=specs,
+                                                 _timeout=86400.0)
         except (ConnectionLost, RemoteError, OSError) as e:
-            await self._on_worker_failure(lw, spec, e)
+            await self._on_worker_failure(lw, specs, e)
             return
-        self.w.task_manager.complete(spec.task_id, results)
+        for spec, results in zip(specs, results_list):
+            self.w.task_manager.complete(spec.task_id, results)
         lw.busy = False
         lw.idle_since = time.monotonic()
         self._pump()
 
-    async def _on_worker_failure(self, lw: LeasedWorker, spec: TaskSpec,
+    async def _on_worker_failure(self, lw: LeasedWorker, specs: List[TaskSpec],
                                  err: Exception):
         self.leased.pop(lw.lease_id, None)
         try:
@@ -385,16 +424,20 @@ class LeasePool:
                              worker_id=lw.worker_id, worker_alive=False)
         except Exception:
             pass
-        retry_spec = self.w.task_manager.use_retry(spec.task_id)
-        if retry_spec is not None:
+        requeued = False
+        for spec in specs:
+            retry_spec = self.w.task_manager.use_retry(spec.task_id)
+            if retry_spec is not None:
+                self.queue.appendleft(retry_spec)
+                requeued = True
+            else:
+                self.w.task_manager.fail(
+                    spec.task_id,
+                    WorkerCrashedError(f"worker {lw.worker_id[:12]} died running "
+                                       f"{spec.name}: {err}"), "")
+        if requeued:
             await asyncio.sleep(get_config().task_retry_delay_s)
-            self.queue.appendleft(retry_spec)
             self._pump()
-        else:
-            self.w.task_manager.fail(
-                spec.task_id,
-                WorkerCrashedError(f"worker {lw.worker_id[:12]} died running "
-                                   f"{spec.name}: {err}"), "")
 
     async def _maybe_return(self, lw: LeasedWorker):
         try:
@@ -422,7 +465,13 @@ class ActorTarget:
     address: Optional[str] = None
     seq: int = 0
     state: str = "PENDING"
-    lock: "asyncio.Lock" = field(default_factory=asyncio.Lock)
+    # Submission-ordered outbox drained by a single pump coroutine per
+    # target: ordering comes from the pump being the only sender, and
+    # batching comes for free (reference: per-handle sequence numbers +
+    # client queueing in CoreWorkerDirectActorTaskSubmitter).
+    outbox: "collections.deque[TaskSpec]" = field(
+        default_factory=collections.deque)
+    pump_running: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -454,6 +503,13 @@ class CoreWorker:
         self.shm_reader = ShmReader()
         self.lease_pools: Dict[tuple, LeasePool] = {}
         self.actor_targets: Dict[str, ActorTarget] = {}
+        # Submission coalescing: bursts of .remote() calls from the user
+        # thread buffer here and drain in ONE loop callback, so the IO loop
+        # wakes once per burst (not per call) and lease pools see the whole
+        # burst at _pump time — which is what makes push batching effective.
+        self._submit_buffer: collections.deque = collections.deque()
+        self._submit_lock = threading.Lock()
+        self._submit_flush_scheduled = False
         self.fn_cache: Dict[bytes, Any] = {}
         self._view_cache: Tuple[float, Dict[str, NodeView]] = (0.0, {})
         self._task_events: List[dict] = []
@@ -565,7 +621,11 @@ class CoreWorker:
                 so.write_into(seg.view())
             finally:
                 seg.close()
-            await self.agent.call("store_seal", object_id=oid)
+            # One-way seal: saves a round trip per put.  Readers that race it
+            # park on wait_sealed at the agent (fetch_object), and this
+            # process's own later agent calls are ordered behind it on the
+            # same connection.
+            await self.agent.notify("store_seal", object_id=oid)
             self.memory_store.put(
                 oid, PlasmaRecord(size, [(self.node_id, self.agent_address)]))
 
@@ -587,6 +647,20 @@ class CoreWorker:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
+        # Fast path: every ref already resolved to an inline/error record in
+        # the local memory store — deserialize on the calling thread, no IO
+        # loop round trip, no block/unblock protocol (nothing waits).
+        records = []
+        for r in refs:
+            rec = self.memory_store.get_if_exists(r.id)
+            if rec is None or isinstance(rec, PlasmaRecord):
+                records = None
+                break
+            records.append(rec)
+        if records is not None:
+            values = [self._inline_record_to_value(r, rec)
+                      for r, rec in zip(refs, records)]
+            return values[0] if single else values
         self._on_block()
         try:
             values = run_async(self.get_async_many(refs, timeout),
@@ -594,6 +668,16 @@ class CoreWorker:
         finally:
             self._on_unblock()
         return values[0] if single else values
+
+    def _inline_record_to_value(self, ref: ObjectRef, record):
+        if isinstance(record, ErrorRecord):
+            exc, tb = pickle.loads(record.error)
+            if isinstance(exc, TaskError):
+                raise exc
+            raise TaskError(exc, ref.hex()[:12], tb) from None
+        if record == serialization.none_bytes():
+            return None
+        return serialization.loads(record)
 
     async def get_async_many(self, refs: List[ObjectRef],
                              timeout: Optional[float] = None) -> List[Any]:
@@ -633,17 +717,11 @@ class CoreWorker:
                 return ErrorRecord(rec[1])
 
     async def _record_to_value(self, ref: ObjectRef, record) -> Any:
-        if isinstance(record, ErrorRecord):
-            exc, tb = pickle.loads(record.error)
-            if isinstance(exc, TaskError):
-                raise exc
-            raise TaskError(exc, ref.hex()[:12], tb) from None
         if isinstance(record, PlasmaRecord):
             data = await self._fetch_plasma(ref, record)
             so = serialization.SerializedObject.from_buffer(data)
             return serialization.deserialize(so)
-        # inline bytes
-        return serialization.loads(record)
+        return self._inline_record_to_value(ref, record)
 
     async def _fetch_plasma(self, ref: ObjectRef, record: PlasmaRecord):
         if self.agent is None:
@@ -762,17 +840,53 @@ class CoreWorker:
     # ------------------------------------------------------------ submission
 
     def submit_task(self, spec: TaskSpec, arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+        """Fire-and-forget: bookkeeping happens on the calling thread (dict
+        ops under the GIL), dispatch hops to the IO loop without waiting for
+        it.  Blocking the caller on a cross-thread round trip per submission
+        capped async task throughput at ~1k/s (reference: task submission is
+        likewise a non-blocking enqueue, direct_task_transport.h:75)."""
         refs = [ObjectRef(oid, owner=self.address)
                 for oid in spec.return_ids()]
-        run_async(self._submit_async(spec, arg_refs))
-        return refs
-
-    async def _submit_async(self, spec: TaskSpec, arg_refs: List[ObjectRef]):
         self.task_manager.add_pending(spec, arg_refs)
         self.task_event(spec, "SUBMITTED")
-        self._submit_spec(spec)
+        self._enqueue_submit(("task", spec))
+        return refs
 
-    def _submit_spec(self, spec: TaskSpec):
+    def _enqueue_submit(self, item: tuple):
+        with self._submit_lock:
+            self._submit_buffer.append(item)
+            need_flush = not self._submit_flush_scheduled
+            self._submit_flush_scheduled = True
+        if need_flush:
+            get_loop().call_soon_threadsafe(self._flush_submits)
+
+    def _flush_submits(self):
+        with self._submit_lock:
+            items = list(self._submit_buffer)
+            self._submit_buffer.clear()
+            self._submit_flush_scheduled = False
+        pools: Dict[int, LeasePool] = {}
+        pumped_actors: Dict[str, ActorTarget] = {}
+        for kind, *rest in items:
+            if kind == "task":
+                (spec,) = rest
+                pool = self._pool_for(spec)
+                pool.queue.append(spec)
+                pools[id(pool)] = pool
+            else:  # actor call
+                actor_id, spec = rest
+                tgt = self.actor_targets.setdefault(actor_id,
+                                                    ActorTarget(actor_id))
+                tgt.outbox.append(spec)
+                pumped_actors[actor_id] = tgt
+        for pool in pools.values():
+            pool._pump()
+        for actor_id, tgt in pumped_actors.items():
+            if not tgt.pump_running:
+                tgt.pump_running = True
+                asyncio.ensure_future(self._actor_pump(actor_id, tgt))
+
+    def _pool_for(self, spec: TaskSpec) -> LeasePool:
         bundle = None
         strategy = spec.scheduling_strategy
         if isinstance(strategy, tuple) and strategy and strategy[0] == "_pg":
@@ -783,7 +897,10 @@ class CoreWorker:
         if pool is None:
             pool = LeasePool(self, key, spec.resources, strategy, bundle)
             self.lease_pools[key] = pool
-        pool.submit(spec)
+        return pool
+
+    def _submit_spec(self, spec: TaskSpec):
+        self._pool_for(spec).submit(spec)
 
     # -------------------------------------------------------------- actors
 
@@ -797,15 +914,38 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,
                           arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+        """Fire-and-forget like submit_task: enqueue into the target's
+        ordered outbox on the IO loop; the per-target pump batches and
+        sends."""
         refs = [ObjectRef(oid, owner=self.address) for oid in spec.return_ids()]
-        run_async(self._submit_actor_async(actor_id, spec, arg_refs))
-        return refs
-
-    async def _submit_actor_async(self, actor_id: str, spec: TaskSpec,
-                                  arg_refs: List[ObjectRef]):
         self.task_manager.add_pending(spec, arg_refs)
         self.task_event(spec, "SUBMITTED")
-        asyncio.ensure_future(self._run_actor_task(actor_id, spec))
+        self._enqueue_submit(("actor", actor_id, spec))
+        return refs
+
+    async def _actor_pump(self, actor_id: str, tgt: ActorTarget):
+        try:
+            while tgt.outbox:
+                batch: List[TaskSpec] = []
+                produced: set = set()
+                limit = get_config().actor_call_pipeline
+                # Same rule as LeasePool._pump: never batch a call with the
+                # producer of a ref it consumes (batch replies as a unit).
+                while tgt.outbox and len(batch) < limit:
+                    spec = tgt.outbox[0]
+                    pt = self.task_manager.pending.get(spec.task_id)
+                    arg_ids = {r.id for r in pt.arg_refs} if pt else set()
+                    if batch and not produced.isdisjoint(arg_ids):
+                        break
+                    tgt.outbox.popleft()
+                    batch.append(spec)
+                    produced.update(spec.return_ids())
+                await self._run_actor_batch(actor_id, tgt, batch)
+        finally:
+            tgt.pump_running = False
+            if tgt.outbox:  # raced with a late enqueue during unwinding
+                tgt.pump_running = True
+                asyncio.ensure_future(self._actor_pump(actor_id, tgt))
 
     async def _resolve_actor(self, actor_id: str, timeout: float = 120.0) -> ActorTarget:
         tgt = self.actor_targets.setdefault(actor_id, ActorTarget(actor_id))
@@ -823,54 +963,63 @@ class CoreWorker:
         tgt.state = "ALIVE"
         return tgt
 
-    async def _run_actor_task(self, actor_id: str, spec: TaskSpec):
-        retries = spec.max_retries  # = actor max_task_retries
-        while True:
-            # Hold the per-target lock across resolve + request *write* so that
-            # calls from this process hit the actor in submission order
-            # (reference: per-handle sequence numbers, actor_scheduling_queue.h:40).
-            tgt = self.actor_targets.setdefault(actor_id, ActorTarget(actor_id))
-            async with tgt.lock:
-                try:
-                    tgt = await self._resolve_actor(actor_id)
-                except ActorDiedError as e:
-                    self.task_manager.fail(spec.task_id, e)
-                    return
-                client = self.worker_clients.get(tgt.address)
-                spec.seq_no = tgt.seq = tgt.seq + 1
-                self.task_event(spec, "RUNNING")
-                try:
-                    fut = await client.call_start("actor_task", spec=spec)
-                except (ConnectionLost, OSError):
-                    fut = None
+    async def _run_actor_batch(self, actor_id: str, tgt: ActorTarget,
+                               specs: List[TaskSpec]):
+        """Send a submission-ordered batch of calls in ONE RPC and complete
+        each result.  The pump is the sole sender per target, so seq_nos and
+        delivery order are preserved without a lock (reference:
+        actor_scheduling_queue.h:40 sequencing)."""
+        while specs:
             try:
-                if fut is None:
-                    raise ConnectionLost("actor connection lost before send")
-                results = await asyncio.wait_for(fut, 86400.0)
-                self.task_manager.complete(spec.task_id, results)
+                tgt = await self._resolve_actor(actor_id)
+            except ActorDiedError as e:
+                for s in specs:
+                    self.task_manager.fail(s.task_id, e)
                 return
-            except ConnectionLost:
+            client = self.worker_clients.get(tgt.address)
+            for s in specs:
+                s.seq_no = tgt.seq = tgt.seq + 1
+                self.task_event(s, "RUNNING")
+            try:
+                if len(specs) == 1:
+                    results_list = [await client.call(
+                        "actor_task", spec=specs[0], _timeout=86400.0)]
+                else:
+                    results_list = await client.call(
+                        "actor_task_batch", specs=specs, _timeout=86400.0)
+            except (ConnectionLost, OSError):
                 tgt.state = "RESTARTING"
                 tgt.address = None
                 info = await self.gcs.call("get_actor_info", actor_id=actor_id)
                 if info is None or info["state"] == "DEAD":
-                    self.task_manager.fail(
-                        spec.task_id,
-                        ActorDiedError(actor_id, f"actor {actor_id[:12]} died"))
+                    err = ActorDiedError(actor_id,
+                                         f"actor {actor_id[:12]} died")
+                    for s in specs:
+                        self.task_manager.fail(s.task_id, err)
                     return
-                if retries == 0:
-                    self.task_manager.fail(
-                        spec.task_id,
-                        ActorDiedError(actor_id,
-                                       f"actor {actor_id[:12]} died while running "
-                                       f"{spec.name} (set max_task_retries to retry)"))
-                    return
-                if retries > 0:
-                    retries -= 1
+                retry = []
+                for s in specs:
+                    rs = self.task_manager.use_retry(s.task_id)
+                    if rs is not None:
+                        retry.append(rs)
+                    else:
+                        self.task_manager.fail(
+                            s.task_id,
+                            ActorDiedError(
+                                actor_id,
+                                f"actor {actor_id[:12]} died while running "
+                                f"{s.name} (set max_task_retries to retry)"))
+                specs = retry
                 await asyncio.sleep(0.1)
+                continue
             except RemoteError as e:
-                self.task_manager.fail(spec.task_id, e.cause, e.remote_traceback)
+                for s in specs:
+                    self.task_manager.fail(s.task_id, e.cause,
+                                           e.remote_traceback)
                 return
+            for s, results in zip(specs, results_list):
+                self.task_manager.complete(s.task_id, results)
+            return
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         return run_async(self.gcs.call("kill_actor", actor_id=actor_id,
@@ -1041,6 +1190,31 @@ class CoreWorker:
         self.exec_queue.put(("task", spec, fut, asyncio.get_event_loop()))
         return await fut
 
+    async def handle_push_task_batch(self, specs: List[TaskSpec]):
+        """Batched push: N tasks in one RPC, executed in order in ONE
+        main-thread stint, N result lists in one reply (the submitter-side
+        pipelining counterpart, direct_task_transport.h:151)."""
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self.exec_queue.put(("batch", specs, fut, loop))
+        return await fut
+
+    async def handle_actor_task_batch(self, specs: List[TaskSpec]):
+        """Batched ordered actor calls.  Async actors overlap the whole batch
+        on their private loop; threaded actors keep per-call dispatch so the
+        batch doesn't defeat max_concurrency."""
+        if self.actor_spec is not None and self.actor_spec.is_async_actor:
+            return list(await asyncio.gather(
+                *[self._run_async_actor_task(s) for s in specs]))
+        if (self.actor_spec is not None
+                and self.actor_spec.max_concurrency > 1):
+            return list(await asyncio.gather(
+                *[self.handle_actor_task(s) for s in specs]))
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self.exec_queue.put(("batch", specs, fut, loop))
+        return await fut
+
     async def handle_create_actor(self, spec: TaskSpec):
         fut = asyncio.get_event_loop().create_future()
         self.exec_queue.put(("create_actor", spec, fut, asyncio.get_event_loop()))
@@ -1074,22 +1248,31 @@ class CoreWorker:
             kind, spec, fut, loop = item
             if kind == "exit":
                 break
-            if (kind == "task" and self.actor_instance is not None
+            if kind == "batch":
+                self._execute_batch_and_reply(spec, fut, loop)
+            elif (kind == "task" and self.actor_instance is not None
                     and self.actor_spec.max_concurrency > 1):
                 self._actor_threadpool.submit(self._execute_and_reply, spec, fut, loop)
             else:
                 self._execute_and_reply(spec, fut, loop)
 
-    def _execute_and_reply(self, spec: TaskSpec, fut, loop):
+    def _execute_one(self, spec: TaskSpec) -> List[tuple]:
         try:
             if spec.is_actor_creation:
-                results = self._execute_actor_creation(spec)
-            else:
-                results = self._execute_task(spec)
+                return self._execute_actor_creation(spec)
+            return self._execute_task(spec)
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
-            results = [("error", pickle.dumps((_strip_exc(e), tb)))
-                       for _ in range(max(1, spec.num_returns))]
+            return [("error", pickle.dumps((_strip_exc(e), tb)))
+                    for _ in range(max(1, spec.num_returns))]
+
+    def _execute_and_reply(self, spec: TaskSpec, fut, loop):
+        results = self._execute_one(spec)
+        loop.call_soon_threadsafe(
+            lambda: fut.set_result(results) if not fut.done() else None)
+
+    def _execute_batch_and_reply(self, specs: List[TaskSpec], fut, loop):
+        results = [self._execute_one(s) for s in specs]
         loop.call_soon_threadsafe(
             lambda: fut.set_result(results) if not fut.done() else None)
 
@@ -1120,6 +1303,9 @@ class CoreWorker:
         return fn
 
     def _resolve_args(self, spec: TaskSpec):
+        from .remote_function import serialize_args
+        if spec.args == serialize_args((), {})[0]:  # canonical empty blob
+            return [], {}
         so = serialization.SerializedObject.from_buffer(spec.args)
         args, kwargs = serialization.deserialize(so)
 
@@ -1157,6 +1343,9 @@ class CoreWorker:
         results = []
         cfg = get_config()
         for v in values:
+            if v is None:  # ubiquitous for side-effect calls: skip the pickler
+                results.append(("inline", serialization.none_bytes(), []))
+                continue
             so = serialization.serialize(v)
             # Ship descriptors of any ObjectRefs inside the value so the
             # caller can register its borrows at receipt (see
@@ -1175,7 +1364,7 @@ class CoreWorker:
                     so.write_into(seg.view())
                 finally:
                     seg.close()
-                run_async(self.agent.call("store_seal", object_id=oid))
+                run_async(self.agent.notify("store_seal", object_id=oid))
                 results.append(("plasma", size,
                                 [(self.node_id, self.agent_address)], contained))
         return results

@@ -53,6 +53,7 @@ class GcsServer:
         self.actors: Dict[str, dict] = {}          # actor_id hex -> info
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor id hex
         self.pgs: Dict[str, dict] = {}
+        self._pg_events: Dict[str, asyncio.Event] = {}
         self.jobs: Dict[str, dict] = {}
         self.agent_clients = ClientPool()
         self.task_events: deque = deque(maxlen=get_config().task_events_max_buffer)
@@ -426,8 +427,14 @@ class GcsServer:
         self.pgs[pg_id] = {"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
                            "state": "PENDING", "name": name, "placement": None,
                            "lifetime": lifetime, "created_at": time.time()}
+        self._pg_events[pg_id] = asyncio.Event()
         asyncio.ensure_future(self._schedule_pg(pg_id))
         return pg_id
+
+    def _pg_settled(self, pg_id: str):
+        ev = self._pg_events.get(pg_id)
+        if ev is not None:
+            ev.set()
 
     async def _schedule_pg(self, pg_id: str):
         info = self.pgs.get(pg_id)
@@ -437,67 +444,88 @@ class GcsServer:
             placement = pack_bundles(self.nodes, info["bundles"], info["strategy"])
             if placement is not None:
                 # 2-phase: prepare on all nodes, then commit (reference:
-                # PrepareBundleResources/CommitBundleResources RPCs).
-                prepared: List[Tuple[str, int]] = []
-                ok = True
-                for i, nid in enumerate(placement):
+                # PrepareBundleResources/CommitBundleResources RPCs).  Both
+                # phases fan out concurrently — the RPCs are independent per
+                # bundle, so wall time is one round trip per phase, not one
+                # per bundle.
+                async def _prepare(i: int, nid: str) -> bool:
                     agent = self.agent_clients.get(self.nodes[nid].address)
                     try:
-                        good = await agent.call("prepare_bundle", pg_id=pg_id,
-                                                bundle_index=i,
-                                                resources=info["bundles"][i])
+                        return bool(await agent.call(
+                            "prepare_bundle", pg_id=pg_id, bundle_index=i,
+                            resources=info["bundles"][i]))
                     except Exception:
-                        good = False
-                    if not good:
-                        ok = False
-                        break
-                    prepared.append((nid, i))
-                if ok:
-                    for i, nid in enumerate(placement):
+                        return False
+
+                results = await asyncio.gather(
+                    *[_prepare(i, nid) for i, nid in enumerate(placement)])
+                prepared = [(nid, i) for i, (nid, good)
+                            in enumerate(zip(placement, results)) if good]
+                if all(results):
+                    async def _commit(i: int, nid: str):
                         agent = self.agent_clients.get(self.nodes[nid].address)
-                        await agent.call("commit_bundle", pg_id=pg_id, bundle_index=i)
+                        await agent.call("commit_bundle", pg_id=pg_id,
+                                         bundle_index=i)
+
+                    await asyncio.gather(
+                        *[_commit(i, nid) for i, nid in enumerate(placement)])
                     info.update(state="CREATED",
                                 placement=[(nid, self.nodes[nid].address)
                                            for nid in placement])
+                    self._pg_settled(pg_id)
                     self._publish("pgs", {"pg_id": pg_id, "state": "CREATED"})
                     return
-                for nid, i in prepared:  # rollback
+
+                async def _rollback(i: int, nid: str):
                     agent = self.agent_clients.get(self.nodes[nid].address)
                     try:
-                        await agent.call("return_bundle", pg_id=pg_id, bundle_index=i)
+                        await agent.call("return_bundle", pg_id=pg_id,
+                                         bundle_index=i)
                     except Exception:
                         pass
+
+                await asyncio.gather(*[_rollback(i, nid) for nid, i in prepared])
             if self.pgs.get(pg_id) is None:
                 return
             await asyncio.sleep(0.25)
         info["state"] = "INFEASIBLE"
+        self._pg_settled(pg_id)
 
     async def handle_get_placement_group(self, pg_id: str):
         return self.pgs.get(pg_id)
 
     async def handle_wait_placement_group(self, pg_id: str, timeout: float = 60.0):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            info = self.pgs.get(pg_id)
-            if info is None:
-                return None
-            if info["state"] in ("CREATED", "INFEASIBLE"):
-                return info
-            await asyncio.sleep(0.02)
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return None
+        if info["state"] in ("CREATED", "INFEASIBLE"):
+            return info
+        ev = self._pg_events.get(pg_id)
+        if ev is not None:
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
         return self.pgs.get(pg_id)
 
     async def handle_remove_placement_group(self, pg_id: str):
         info = self.pgs.pop(pg_id, None)
+        self._pg_settled(pg_id)
+        self._pg_events.pop(pg_id, None)
         if info is None:
             return False
         if info.get("placement"):
-            for i, (nid, addr) in enumerate(info["placement"]):
-                if nid in self.nodes:
-                    agent = self.agent_clients.get(addr)
-                    try:
-                        await agent.call("return_bundle", pg_id=pg_id, bundle_index=i)
-                    except Exception:
-                        pass
+            async def _return(i: int, addr: str):
+                try:
+                    await self.agent_clients.get(addr).call(
+                        "return_bundle", pg_id=pg_id, bundle_index=i)
+                except Exception:
+                    pass
+
+            await asyncio.gather(
+                *[_return(i, addr)
+                  for i, (nid, addr) in enumerate(info["placement"])
+                  if nid in self.nodes])
         self._publish("pgs", {"pg_id": pg_id, "state": "REMOVED"})
         return True
 

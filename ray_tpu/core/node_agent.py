@@ -626,6 +626,13 @@ class NodeAgent:
         if self.store.contains(object_id):
             path, sz = self.store.get_path(object_id)
             return {"path": path, "size": sz}
+        if object_id in self.store._entries:
+            # Created locally but not sealed yet: the writer's one-way seal
+            # (or its in-progress copy) is still in flight — park on it
+            # rather than treating a local object as remote.
+            if await self.store.wait_sealed(object_id, 30.0):
+                path, sz = self.store.get_path(object_id)
+                return {"path": path, "size": sz}
         async with self._pull_sem:
             if self.store.contains(object_id):
                 path, sz = self.store.get_path(object_id)

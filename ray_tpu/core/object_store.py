@@ -49,7 +49,8 @@ class _PoolAttachCache:
     def __init__(self):
         self._maps: Dict[str, mmap.mmap] = {}
 
-    def view(self, pool_path: str, offset: int, size: int) -> memoryview:
+    def view(self, pool_path: str, offset: int, size: int,
+             populate_write: bool = False) -> memoryview:
         mm = self._maps.get(pool_path)
         if mm is None:
             fd = os.open(pool_path, os.O_RDWR)
@@ -58,6 +59,18 @@ class _PoolAttachCache:
             finally:
                 os.close(fd)
             self._maps[pool_path] = mm
+        if populate_write and size >= (1 << 20) and \
+                hasattr(mmap, "MADV_POPULATE_WRITE"):
+            # Writers: establish writable PTEs for the slice in one syscall
+            # instead of ~size/4K minor faults during the memcpy (pages are
+            # already resident from the store's startup prefault).
+            page = mmap.PAGESIZE
+            start = (offset // page) * page
+            length = offset + size - start
+            try:
+                mm.madvise(mmap.MADV_POPULATE_WRITE, start, length)
+            except (OSError, ValueError):
+                pass
         return memoryview(mm)[offset:offset + size]
 
 
@@ -78,7 +91,10 @@ class ShmSegment:
         self._slice: Optional[memoryview] = None
         if "#" in path and not create:
             pool_path, off = path.rsplit("#", 1)
-            self._slice = _pool_attach.view(pool_path, int(off), size)
+            # Attach-for-write is the writer's path (puts / task returns):
+            # pre-populate the slice's PTEs so the copy runs at memcpy speed.
+            self._slice = _pool_attach.view(pool_path, int(off), size,
+                                            populate_write=True)
             return
         flags = os.O_RDWR | (os.O_CREAT | os.O_EXCL if create else 0)
         fd = os.open(path, flags, 0o600)
@@ -190,10 +206,47 @@ class NodeObjectStore:
         if cfg.object_store_use_native_pool:
             try:
                 from ray_tpu.native import ShmPool
+                # The path doubles as the attach-cache key in every client
+                # process, so it must be unique per store INSTANCE: a reused
+                # path would hand cached stale mmaps of a dead session's
+                # arena to long-lived clients.
+                uniq = os.urandom(4).hex()
                 self.pool = ShmPool(
-                    os.path.join(_SHM_DIR, f"raytpu-pool-{name}"), capacity)
+                    os.path.join(_SHM_DIR, f"raytpu-pool-{name}-{uniq}"),
+                    capacity)
             except Exception:
                 self.pool = None
+        if self.pool is not None and cfg.object_store_prefault and \
+                hasattr(mmap, "MADV_POPULATE_WRITE"):
+            # Fault the arena's tmpfs pages in once at startup (plasma
+            # pre-touches its arena the same way): steady-state creates then
+            # cost an allocator call, and writers copy into already-resident
+            # pages at memcpy speed instead of page-fault speed.  Runs in a
+            # background thread, CHUNKED: madvise holds the GIL for the
+            # syscall's duration, so one whole-arena call would freeze the
+            # agent loop (capacity defaults to 30% of RAM).  The low region
+            # is prefaulted first — first-fit allocation reuses it most.
+            import threading
+
+            def _prefault(path=self.pool.path,
+                          nbytes=min(capacity, 8 << 30)):
+                try:
+                    fd = os.open(path, os.O_RDWR)
+                    try:
+                        mm = mmap.mmap(fd, nbytes)
+                    finally:
+                        os.close(fd)
+                    step = 128 << 20
+                    for off in range(0, nbytes, step):
+                        mm.madvise(mmap.MADV_POPULATE_WRITE, off,
+                                   min(step, nbytes - off))
+                        time.sleep(0)  # yield the GIL between chunks
+                    mm.close()
+                except Exception:
+                    pass
+
+            threading.Thread(target=_prefault, name="store-prefault",
+                             daemon=True).start()
 
     # -- creation ---------------------------------------------------------
 

@@ -28,7 +28,18 @@ def _wrap_args(args, kwargs):
     return wargs, wkwargs
 
 
+_EMPTY_ARGS: Optional[bytes] = None
+
+
 def serialize_args(args, kwargs):
+    if not args and not kwargs:
+        # No-arg calls (pings, control-plane methods) skip the pickler: the
+        # canonical empty blob is byte-identical on every call, so the
+        # executor can match it and skip deserialization too.
+        global _EMPTY_ARGS
+        if _EMPTY_ARGS is None:
+            _EMPTY_ARGS = serialization.serialize(([], {})).to_bytes()
+        return _EMPTY_ARGS, []
     wargs, wkwargs = _wrap_args(args, kwargs)
     so = serialization.serialize((wargs, wkwargs))
     return so.to_bytes(), list(so.contained_refs)
@@ -40,6 +51,7 @@ class RemoteFunction:
         self._opts = dict(default_options or {})
         self._blob: Optional[bytes] = None
         self._fn_id: Optional[bytes] = None
+        self._captured_refs: list = []
         self._registered_in: set = set()
         self.__name__ = getattr(fn, "__name__", "anonymous")
 
@@ -47,7 +59,8 @@ class RemoteFunction:
 
     def _ensure_registered(self, worker) -> bytes:
         if self._blob is None:
-            self._blob = serialization.dumps_function(self._fn)
+            self._blob, self._captured_refs = \
+                serialization.dumps_function_with_refs(self._fn)
             self._fn_id = hashlib.sha1(self._blob).digest()[:16]
         key = id(worker)
         if key not in self._registered_in:
@@ -63,6 +76,7 @@ class RemoteFunction:
         merged.update(opts)
         rf = RemoteFunction(self._fn, merged)
         rf._blob, rf._fn_id = self._blob, self._fn_id
+        rf._captured_refs = self._captured_refs
         return rf
 
     def bind(self, *args, **kwargs):
@@ -87,6 +101,11 @@ class RemoteFunction:
         strategy = o.get("scheduling_strategy", "DEFAULT")
         strategy = resolve_pg_strategy(strategy)
         args_blob, arg_refs = serialize_args(args, kwargs)
+        # Closure-captured refs are data dependencies exactly like argument
+        # refs: they must be pinned until the task finishes, and the batch
+        # scheduler must not coalesce this task with their producers.
+        if self._captured_refs:
+            arg_refs = arg_refs + self._captured_refs
         num_returns = o.get("num_returns", 1)
         spec = TaskSpec(
             task_id=TaskID.from_random(),

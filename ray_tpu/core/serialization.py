@@ -15,10 +15,61 @@ tracking) exactly like the reference's ``SerializationContext`` does with
 from __future__ import annotations
 
 import io
+import os
 import pickle
+import sys
+import sysconfig
+import types
 from typing import Any, List, Tuple
 
 import cloudpickle
+from cloudpickle.cloudpickle import _dynamic_class_reduce
+
+# Roots under which a module is assumed importable on every worker: the
+# interpreter's stdlib + site-packages, and this package itself (workers get
+# the package root on PYTHONPATH — node_agent._spawn_worker).  Functions and
+# classes defined anywhere else (driver scripts, test files, notebook dirs)
+# are shipped BY VALUE, matching the reference's function-table export which
+# pickles the def itself rather than a module path
+# (python/ray/_private/function_manager.py export/fetch), so workers never
+# need the driver's cwd or sys.path to run ``Pool.map(module_fn)``.
+_PORTABLE_ROOTS = tuple(
+    os.path.abspath(p) + os.sep
+    for p in {
+        sysconfig.get_paths().get("stdlib", ""),
+        sysconfig.get_paths().get("platstdlib", ""),
+        sysconfig.get_paths().get("purelib", ""),
+        sysconfig.get_paths().get("platlib", ""),
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),  # ray_tpu/
+    }
+    if p
+)
+
+
+def _ship_by_value(obj) -> bool:
+    """True when ``obj``'s defining module may not be importable on workers."""
+    mod_name = getattr(obj, "__module__", None)
+    if mod_name is None or mod_name == "__main__":
+        return False  # cloudpickle already pickles __main__ defs by value
+    mod = sys.modules.get(mod_name)
+    if mod is None:
+        return False
+    mod_file = getattr(mod, "__file__", None)
+    if mod_file is None:
+        return False  # builtin / frozen — always importable
+    mod_file = os.path.abspath(mod_file)
+    return not mod_file.startswith(_PORTABLE_ROOTS)
+
+
+class _ByValuePickler(cloudpickle.CloudPickler):
+    """CloudPickler that forces by-value pickling for non-portable defs."""
+
+    def reducer_override(self, obj):
+        if isinstance(obj, types.FunctionType) and _ship_by_value(obj):
+            return self._dynamic_function_reduce(obj)
+        if isinstance(obj, type) and _ship_by_value(obj):
+            return _dynamic_class_reduce(obj)
+        return super().reducer_override(obj)
 
 
 class SerializedObject:
@@ -83,43 +134,49 @@ class SerializedObject:
         return cls(bytes(parts[0]), list(parts[1:]), [])
 
 
+class _RefPickler(_ByValuePickler):
+    """cloudpickle + ObjectRef interception: refs found inside the value are
+    collected into ``self.contained`` (for dependency/borrow tracking) and
+    replaced by persistent ids.  protocol 5 gives out-of-band buffer
+    extraction for numpy and friends."""
+
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self.contained: list = []
+
+    def persistent_id(self, obj):
+        from .object_ref import ObjectRef  # local import to break cycle
+        if isinstance(obj, ObjectRef):
+            self.contained.append(obj)
+            return ("rayref", obj.id.binary(), obj.owner)
+        return None
+
+
+class _RefUnpickler(pickle.Unpickler):
+    def persistent_load(self, pid):
+        tag, idbin, owner = pid
+        if tag != "rayref":
+            raise pickle.UnpicklingError(f"unknown persistent id {tag}")
+        from .ids import ObjectID
+        from .object_ref import ObjectRef
+        return ObjectRef(ObjectID(idbin), owner=owner)
+
+
 def serialize(value: Any) -> SerializedObject:
-    contained: list = []
     buffers: List[pickle.PickleBuffer] = []
 
-    def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+    def _collect(pb: pickle.PickleBuffer) -> bool:
         buffers.append(pb)
         return False  # out-of-band
 
-    # cloudpickle handles closures/lambdas/local classes; protocol 5 gives us
-    # out-of-band buffer extraction for numpy and friends.
-    from .object_ref import ObjectRef  # local import to break cycle
-
-    class _Pickler(cloudpickle.CloudPickler):
-        def persistent_id(self, obj):  # intercept ObjectRefs
-            if isinstance(obj, ObjectRef):
-                contained.append(obj)
-                return ("rayref", obj.id.binary(), obj.owner)
-            return None
-
     sio = io.BytesIO()
-    p = _Pickler(sio, protocol=5, buffer_callback=buffer_callback)
+    p = _RefPickler(sio, buffer_callback=_collect)
     p.dump(value)
-    return SerializedObject(sio.getvalue(), buffers, contained)
+    return SerializedObject(sio.getvalue(), buffers, p.contained)
 
 
 def deserialize(so: SerializedObject) -> Any:
-    from .object_ref import ObjectRef
-
-    class _Unpickler(pickle.Unpickler):
-        def persistent_load(self, pid):
-            tag, idbin, owner = pid
-            if tag != "rayref":
-                raise pickle.UnpicklingError(f"unknown persistent id {tag}")
-            from .ids import ObjectID
-            return ObjectRef(ObjectID(idbin), owner=owner)
-
-    return _Unpickler(io.BytesIO(so.inband), buffers=so.buffers).load()
+    return _RefUnpickler(io.BytesIO(so.inband), buffers=so.buffers).load()
 
 
 def dumps(value: Any) -> bytes:
@@ -131,9 +188,36 @@ def loads(data) -> Any:
     return deserialize(SerializedObject.from_buffer(data))
 
 
+_NONE_BYTES: bytes | None = None
+
+
+def none_bytes() -> bytes:
+    """Canonical flat serialization of ``None`` — the single most common task
+    result.  Producers emit this exact blob and consumers match it by bytes
+    equality, skipping a pickler round trip on both sides."""
+    global _NONE_BYTES
+    if _NONE_BYTES is None:
+        _NONE_BYTES = serialize(None).to_bytes()
+    return _NONE_BYTES
+
+
 def dumps_function(fn) -> bytes:
-    return cloudpickle.dumps(fn)
+    return dumps_function_with_refs(fn)[0]
+
+
+def dumps_function_with_refs(fn) -> Tuple[bytes, list]:
+    """Serialize a function/class AND report the ObjectRefs captured in its
+    closure/defaults.  Captured refs are real data dependencies — the
+    submitter must treat them like argument refs (pin them, and never batch
+    the consumer with the producer), or a closure-captured ref can deadlock
+    an intra-batch dependency."""
+    sio = io.BytesIO()
+    p = _RefPickler(sio, buffer_callback=None)
+    p.dump(fn)
+    return sio.getvalue(), p.contained
 
 
 def loads_function(data: bytes):
-    return pickle.loads(data)
+    # _RefUnpickler: function blobs may contain persistent-id'd ObjectRefs
+    # (closure captures) recorded by dumps_function_with_refs.
+    return _RefUnpickler(io.BytesIO(data)).load()

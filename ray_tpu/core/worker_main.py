@@ -39,6 +39,14 @@ def main():
                    session_dir=session_dir)
     w.worker_id = WorkerID.from_hex(worker_id)
     w.start()
+    # Populate the api-module state so context-dependent utilities (pubsub,
+    # util.state, runtime_context helpers) resolve the GCS address inside
+    # worker processes too, not just in drivers (reference: workers share the
+    # same ``ray._private.worker.global_worker`` context as drivers).
+    from . import api
+    api._state.worker = w
+    api._state.gcs_address = gcs_address
+    api._state.session_dir = session_dir
     res = run_async(w.agent.call("register_worker", worker_id=worker_id,
                                  address=w.address, pid=os.getpid()))
     if res.get("shutdown"):

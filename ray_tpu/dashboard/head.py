@@ -24,7 +24,11 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from typing import Any, Optional
+
+_STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "static")
 
 
 def _json(data: Any, status: int = 200):
@@ -138,6 +142,10 @@ class DashboardHead:
         from ray_tpu.util.tracing import chrome_trace
         return _json(await _off(chrome_trace))
 
+    async def index(self, _req):
+        from aiohttp import web
+        return web.FileResponse(os.path.join(_STATIC_DIR, "index.html"))
+
     @staticmethod
     def _filters(req) -> Optional[list]:
         out = []
@@ -167,6 +175,10 @@ class DashboardHead:
         r.add_post("/api/jobs/{job_id}/stop", self.job_stop)
         r.add_get("/api/serve", self.serve_status)
         r.add_get("/api/timeline", self.timeline)
+        # Web UI (reference: dashboard/client React SPA; here a no-build
+        # vanilla SPA served from package data over the same REST API).
+        r.add_get("/", self.index)
+        r.add_static("/static/", _STATIC_DIR)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

@@ -1,0 +1,187 @@
+/* Dashboard SPA: hash-routed pages over the REST API (reference:
+   dashboard/client/src — same pages, vanilla JS). Auto-refreshes the active
+   page every 5 s. */
+"use strict";
+
+const $ = (sel) => document.querySelector(sel);
+const MAIN = () => $("#main");
+
+async function api(path) {
+  const r = await fetch("/api/" + path);
+  if (!r.ok) throw new Error(path + " -> " + r.status);
+  const ct = r.headers.get("content-type") || "";
+  return ct.includes("json") ? r.json() : r.text();
+}
+
+function h(tag, attrs, ...kids) {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "onclick") el.onclick = v;
+    else el.setAttribute(k, v);
+  }
+  for (const kid of kids.flat()) {
+    el.append(kid instanceof Node ? kid : document.createTextNode(String(kid)));
+  }
+  return el;
+}
+
+function table(cols, rows) {
+  return h("table", {},
+    h("thead", {}, h("tr", {}, cols.map((c) => h("th", {}, c)))),
+    h("tbody", {}, rows.length
+      ? rows.map((r) => h("tr", {}, r.map((c) => h("td", { class: "mono" }, c))))
+      : [h("tr", {}, h("td", { colspan: cols.length, class: "muted" }, "none"))]));
+}
+
+function badge(text) {
+  const s = String(text || "").toUpperCase();
+  const cls = ["ALIVE", "RUNNING", "FINISHED", "CREATED", "SUCCEEDED", "HEALTHY"].includes(s)
+    ? "ok" : ["PENDING", "RESTARTING", "WAITING", "UPDATING"].includes(s)
+    ? "warn" : ["DEAD", "FAILED", "STOPPED", "INFEASIBLE", "UNHEALTHY"].includes(s)
+    ? "err" : "";
+  const el = h("span", { class: "badge " + cls }, s || "?");
+  return el;
+}
+
+function card(k, v) {
+  return h("div", { class: "card" }, h("div", { class: "k" }, k),
+    h("div", { class: "v" }, v));
+}
+
+function fmtRes(res) {
+  return Object.entries(res || {}).map(([k, v]) => `${k}:${Math.round(v * 100) / 100}`).join(" ");
+}
+
+const pages = {
+  async overview() {
+    const c = await api("cluster");
+    const sum = await api("tasks/summarize").catch(() => ({}));
+    const counts = sum.by_state || sum || {};
+    return h("div", {},
+      h("h2", {}, "Cluster overview"),
+      h("div", { class: "cards" },
+        card("Nodes", c.nodes),
+        card("CPUs", `${(c.resources_available || {}).CPU ?? "?"} / ${(c.resources_total || {}).CPU ?? "?"}`),
+        card("TPUs", `${(c.resources_available || {}).TPU ?? 0} / ${(c.resources_total || {}).TPU ?? 0}`)),
+      h("h2", {}, "Task states"),
+      table(["state", "count"], Object.entries(counts).map(([k, v]) => [k, v])));
+  },
+
+  async nodes() {
+    const nodes = await api("nodes");
+    return h("div", {}, h("h2", {}, "Nodes"),
+      table(["node id", "state", "address", "total", "available", "labels"],
+        nodes.map((n) => [
+          (n.node_id || "").slice(0, 12), badge(n.alive ? "ALIVE" : "DEAD"),
+          n.address || "", fmtRes(n.total), fmtRes(n.available),
+          JSON.stringify(n.labels || {})])));
+  },
+
+  async actors() {
+    const actors = await api("actors");
+    return h("div", {}, h("h2", {}, "Actors"),
+      table(["actor id", "class", "state", "name", "pid", "node"],
+        actors.map((a) => [
+          (a.actor_id || "").slice(0, 12), a.class_name || "", badge(a.state),
+          a.name || "", a.pid || "", (a.node_id || "").slice(0, 12)])));
+  },
+
+  async tasks() {
+    const tasks = await api("tasks");
+    const recent = tasks.slice(-200).reverse();
+    return h("div", {}, h("h2", {}, `Tasks (${tasks.length}, last 200 shown)`),
+      table(["task id", "name", "state", "node"],
+        recent.map((t) => [
+          (t.task_id || "").slice(0, 12), t.name || "", badge(t.state),
+          (t.node_id || "").slice(0, 12)])));
+  },
+
+  async pgs() {
+    const pgs = await api("placement_groups");
+    return h("div", {}, h("h2", {}, "Placement groups"),
+      table(["pg id", "state", "strategy", "bundles"],
+        pgs.map((p) => [
+          (p.placement_group_id || p.pg_id || "").slice(0, 12), badge(p.state),
+          p.strategy || "", JSON.stringify(p.bundles || [])])));
+  },
+
+  async jobs() {
+    const jobs = await api("jobs");
+    const rows = jobs.map((j) => [
+      h("a", { class: "plain", href: `#job/${j.job_id || j.submission_id}` },
+        (j.job_id || j.submission_id || "").slice(0, 18)),
+      badge(j.status || j.state), j.entrypoint || "",
+      j.start_time ? new Date(j.start_time * 1000).toLocaleTimeString() : ""]);
+    const entry = h("input", { type: "text", placeholder: "entrypoint, e.g. python -c \"print('hi')\"" });
+    const submit = h("button", {
+      onclick: async () => {
+        if (!entry.value) return;
+        await fetch("/api/jobs", { method: "POST",
+          headers: { "content-type": "application/json" },
+          body: JSON.stringify({ entrypoint: entry.value }) });
+        render();
+      } }, "Submit");
+    return h("div", {}, h("h2", {}, "Jobs"),
+      h("div", { class: "toolbar" }, entry, submit),
+      table(["job", "status", "entrypoint", "started"], rows));
+  },
+
+  async serve() {
+    const s = await api("serve");
+    const deployments = s.deployments || s.applications || {};
+    const rows = Object.entries(deployments).map(([name, d]) => [
+      name, badge(d.status || (d.replicas ? "HEALTHY" : "?")),
+      d.num_replicas ?? d.replicas ?? "", d.route_prefix || ""]);
+    return h("div", {}, h("h2", {}, "Serve"),
+      rows.length ? table(["deployment", "status", "replicas", "route"], rows)
+        : h("p", { class: "muted" }, "no serve apps running"));
+  },
+
+  async timeline() {
+    return h("div", {}, h("h2", {}, "Timeline"),
+      h("p", {}, "Chrome-trace export of task events. Load it in ",
+        h("span", { class: "mono" }, "chrome://tracing"), " or Perfetto."),
+      h("button", { onclick: async () => {
+        const data = await api("timeline");
+        const blob = new Blob([JSON.stringify(data)], { type: "application/json" });
+        const a = h("a", { href: URL.createObjectURL(blob), download: "timeline.json" });
+        a.click();
+      } }, "Download timeline.json"));
+  },
+};
+
+async function jobDetail(jobId) {
+  const info = await api(`jobs/${jobId}`).catch(() => ({}));
+  const logs = await api(`jobs/${jobId}/logs`).catch(() => "");
+  return h("div", {},
+    h("h2", {}, `Job ${jobId}`),
+    h("p", {}, badge(info.status || info.state), " ",
+      h("span", { class: "mono" }, info.entrypoint || "")),
+    h("button", { onclick: async () => {
+      await fetch(`/api/jobs/${jobId}/stop`, { method: "POST" });
+      render();
+    } }, "Stop job"),
+    h("h2", {}, "Logs"),
+    h("pre", { class: "logs" }, logs || "(empty)"));
+}
+
+let timer = null;
+async function render() {
+  const hash = (location.hash || "#overview").slice(1);
+  document.querySelectorAll("#nav a").forEach((a) =>
+    a.classList.toggle("active", a.getAttribute("href") === "#" + hash.split("/")[0]));
+  let view;
+  try {
+    if (hash.startsWith("job/")) view = await jobDetail(hash.slice(4));
+    else view = await (pages[hash] || pages.overview)();
+    $("#refresh-state").textContent = "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    view = h("p", { class: "muted" }, "error: " + e.message);
+  }
+  MAIN().replaceChildren(view);
+}
+
+window.addEventListener("hashchange", render);
+clearInterval(timer);
+timer = setInterval(render, 5000);
+render();

@@ -1,0 +1,345 @@
+"""Paged KV cache + prefix caching — vLLM-style block tables, TPU-first.
+
+The reference has no LLM inference engine (SURVEY §2.7: ``@serve.batch`` is
+the primitive); this extends ``models/decode.py``'s slot cache with paging so
+HBM scales with *actual* sequence lengths instead of ``slots x max_len``
+worst case, and identical prompt prefixes share cache pages.
+
+TPU-first shape choices:
+
+* The cache is one static HBM tensor ``[L, num_pages, page, NKV, D]``; a
+  sequence's cache is the pages its **block table** row points at
+  (``[slots, max_pages]`` int32).  Shapes never change -> jit compiles one
+  prefill per length bucket and one decode step, forever — the same
+  static-shape discipline as the dense cache.
+* Decode gathers each slot's pages with ``jnp.take`` (XLA lowers to dynamic
+  slices); attention reads the whole gathered row anyway, so the gather is
+  bandwidth-equivalent to the dense cache read.
+* Page allocation/refcounting/prefix hashing is **host-side Python** in the
+  engine (it is O(pages) per admit/retire, not per token) — the device
+  program never sees the free list, only the block table array.
+* Prefix caching: full pages of a prompt (page-aligned chunks) are keyed by
+  a rolling content hash; an admit that hits reuses those pages read-only
+  (refcount++) and prefills only the uncached suffix.  Decode always writes
+  to pages at index >= ceil-boundary of the reused prefix, which are
+  private by construction — no copy-on-write path is ever needed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import TransformerConfig
+from .transformer import Params, _norm, lm_head_weight
+
+from .decode import (_mlp, _proj_out, _qkv, sample_per_slot)
+
+PagedKVCache = Dict[str, jnp.ndarray]
+
+
+def init_paged_cache(cfg: TransformerConfig, num_pages: int, page_size: int,
+                     num_slots: int, max_pages_per_slot: int,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    """Allocate the paged HBM cache + block tables.
+
+    Page 0 is reserved as the null page (block tables point unused entries
+    at it); allocators hand out pages 1..num_pages-1.
+    """
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "block_table": jnp.zeros((num_slots, max_pages_per_slot), jnp.int32),
+        "length": jnp.zeros((num_slots,), jnp.int32),
+    }
+
+
+def paged_cache_bytes(cfg: TransformerConfig, num_pages: int, page_size: int,
+                      dtype_bytes: int = 2) -> int:
+    return (2 * cfg.num_layers * num_pages * page_size * cfg.num_kv_heads
+            * cfg.head_dim * dtype_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Device programs
+# ---------------------------------------------------------------------------
+
+def paged_prefill(params: Params, cache: PagedKVCache, tokens: jnp.ndarray,
+                  lengths: jnp.ndarray, slot_ids: jnp.ndarray,
+                  start_pos: jnp.ndarray, cfg: TransformerConfig,
+                  compute_dtype=jnp.bfloat16
+                  ) -> Tuple[PagedKVCache, jnp.ndarray]:
+    """Causal forward over right-padded prompt suffixes; K/V land in pages.
+
+    tokens:   [B, S] suffix tokens (positions start_pos .. start_pos+len)
+    lengths:  [B] true suffix lengths (<= S)
+    slot_ids: [B] slot whose block table routes the writes
+    start_pos:[B] absolute position of tokens[:, 0] (0 unless a cached
+              prefix was reused; reused pages are NOT written here)
+    Returns (cache, last-real-token logits [B, V] f32).
+
+    Attention inside the suffix is pure causal self-attention PLUS reads of
+    the reused prefix pages (positions < start_pos) via the block table.
+    """
+    b, s = tokens.shape
+    page = cache["k"].shape[2]
+    max_pages = cache["block_table"].shape[1]
+    cast = compute_dtype
+    x = params["embed"]["tokens"][tokens].astype(cast)
+    positions = start_pos[:, None] + jnp.arange(s)[None]        # [B, S]
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][
+            jnp.minimum(positions, cfg.max_seq_len - 1)].astype(cast)
+    bt = cache["block_table"][slot_ids]                          # [B, MP]
+    # scatter coordinates for every suffix position
+    page_idx = bt[jnp.arange(b)[:, None],
+                  jnp.minimum(positions // page, max_pages - 1)]  # [B, S]
+    page_off = positions % page                                  # [B, S]
+    scale = cfg.head_dim ** -0.5
+    reps = cfg.num_heads // cfg.num_kv_heads
+    kv_span = max_pages * page
+    # gathered-cache positions each query may read: absolute pos < q pos
+    abs_kv_pos = jnp.arange(kv_span)[None]                       # [1, MP*page]
+    valid_write = (jnp.arange(s)[None] < lengths[:, None])       # [B, S]
+
+    def body(x, layer):
+        lp, k_pages, v_pages = layer    # [P, page, NKV, D]
+        y = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _qkv(y, lp["attn"], cfg, positions)
+        # write suffix K/V into pages first, then attend over the gathered
+        # row (prefix pages + own suffix) with a causal mask on absolute
+        # positions — one code path covers both.
+        flat_pi = page_idx.reshape(-1)
+        flat_po = page_off.reshape(-1)
+        keep = valid_write.reshape(-1)
+        safe_pi = jnp.where(keep, flat_pi, 0)  # dump padding into null page
+        k_pages = k_pages.at[safe_pi, flat_po].set(
+            k.reshape(b * s, cfg.num_kv_heads, -1).astype(k_pages.dtype),
+            mode="drop")
+        v_pages = v_pages.at[safe_pi, flat_po].set(
+            v.reshape(b * s, cfg.num_kv_heads, -1).astype(v_pages.dtype),
+            mode="drop")
+        kg = jnp.take(k_pages, bt, axis=0)   # [B, MP, page, NKV, D]
+        vg = jnp.take(v_pages, bt, axis=0)
+        kg = kg.reshape(b, kv_span, cfg.num_kv_heads, cfg.head_dim)
+        vg = vg.reshape(b, kv_span, cfg.num_kv_heads, cfg.head_dim)
+        qh = q.reshape(b, s, cfg.num_kv_heads, reps, cfg.head_dim)
+        scores = jnp.einsum("bsgrd,bmgd->bgrsm", qh.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
+        # causal on ABSOLUTE positions: [B, S, span] -> [B, 1, 1, S, span]
+        causal = abs_kv_pos[:, None, :] <= positions[:, :, None]
+        scores = jnp.where(causal[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bgrsm,bmgd->bsgrd", probs, vg.astype(jnp.float32))
+        attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim)
+        x = x + _proj_out(attn.astype(cast), lp["attn"], cast)
+        x = x + _mlp(_norm(x, lp["mlp_norm"], cfg), lp, cfg)
+        return x, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+    logits = (last @ lm_head_weight(params, cfg, cast)).astype(jnp.float32)
+    new_len = start_pos + lengths
+    cache = {
+        "k": k_new, "v": v_new,
+        "block_table": cache["block_table"],
+        "length": cache["length"].at[slot_ids].set(new_len),
+    }
+    return cache, logits
+
+
+def paged_decode_step(params: Params, cache: PagedKVCache,
+                      tokens: jnp.ndarray, active: jnp.ndarray,
+                      cfg: TransformerConfig, compute_dtype=jnp.bfloat16
+                      ) -> Tuple[PagedKVCache, jnp.ndarray]:
+    """One token per active slot, attention over block-table pages."""
+    n_slots = tokens.shape[0]
+    page = cache["k"].shape[2]
+    max_pages = cache["block_table"].shape[1]
+    kv_span = max_pages * page
+    cast = compute_dtype
+    lengths = cache["length"]
+    bt = cache["block_table"]                                    # [S, MP]
+    x = params["embed"]["tokens"][tokens][:, None].astype(cast)
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][
+            jnp.minimum(lengths, cfg.max_seq_len - 1)][:, None].astype(cast)
+    positions = lengths[:, None]
+    scale = cfg.head_dim ** -0.5
+    reps = cfg.num_heads // cfg.num_kv_heads
+    write_page = bt[jnp.arange(n_slots),
+                    jnp.minimum(lengths // page, max_pages - 1)]  # [S]
+    write_off = lengths % page
+    pos_mask = (jnp.arange(kv_span)[None] <= lengths[:, None])   # [S, span]
+
+    def body(x, layer):
+        lp, k_pages, v_pages = layer
+        y = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _qkv(y, lp["attn"], cfg, positions)
+        safe_page = jnp.where(active, write_page, 0)
+        k_pages = k_pages.at[safe_page, write_off].set(
+            k[:, 0].astype(k_pages.dtype), mode="drop")
+        v_pages = v_pages.at[safe_page, write_off].set(
+            v[:, 0].astype(v_pages.dtype), mode="drop")
+        kg = jnp.take(k_pages, bt, axis=0).reshape(
+            n_slots, kv_span, cfg.num_kv_heads, cfg.head_dim)
+        vg = jnp.take(v_pages, bt, axis=0).reshape(
+            n_slots, kv_span, cfg.num_kv_heads, cfg.head_dim)
+        qh = q[:, 0].reshape(n_slots, cfg.num_kv_heads, reps, cfg.head_dim)
+        scores = jnp.einsum("sgrd,smgd->sgrm", qh.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
+        scores = jnp.where(pos_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("sgrm,smgd->sgrd", probs, vg.astype(jnp.float32))
+        attn = attn.reshape(n_slots, 1, cfg.num_heads * cfg.head_dim)
+        x = x + _proj_out(attn.astype(cast), lp["attn"], cast)
+        x = x + _mlp(_norm(x, lp["mlp_norm"], cfg), lp, cfg)
+        return x, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    logits = (x[:, 0] @ lm_head_weight(params, cfg, cast)).astype(jnp.float32)
+    cache = {
+        "k": k_new, "v": v_new,
+        "block_table": cache["block_table"],
+        "length": jnp.where(active, lengths + 1, lengths),
+    }
+    return cache, logits
+
+
+def paged_decode_loop(params: Params, cache: PagedKVCache,
+                      tokens: jnp.ndarray, active: jnp.ndarray,
+                      temperature: jnp.ndarray, key: jax.Array,
+                      n_steps: int, cfg: TransformerConfig, top_k: int = 0,
+                      compute_dtype=jnp.bfloat16
+                      ) -> Tuple[PagedKVCache, jnp.ndarray, jnp.ndarray]:
+    """``n_steps`` paged decode+sample steps in one compiled scan."""
+
+    def body(carry, i):
+        cache, toks = carry
+        cache, logits = paged_decode_step(params, cache, toks, active, cfg,
+                                          compute_dtype)
+        nxt = sample_per_slot(logits, jax.random.fold_in(key, i),
+                              temperature, top_k)
+        nxt = jnp.where(active, nxt, toks)
+        return (cache, nxt), nxt
+
+    (cache, tokens), emitted = jax.lax.scan(
+        body, (cache, tokens), jnp.arange(n_steps))
+    return cache, tokens, emitted
+
+
+# ---------------------------------------------------------------------------
+# Host-side page allocator + prefix cache
+# ---------------------------------------------------------------------------
+
+class PageAllocator:
+    """Free-list page allocator with refcounts (page 0 = reserved null page).
+
+    Prefix sharing gives pages refcount > 1; a page returns to the free list
+    when its count hits zero.  Pure host Python — called per admit/retire,
+    never per token."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._refs: Dict[int, int] = {}
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def incref(self, pages: Sequence[int]):
+        for p in pages:
+            self._refs[p] += 1
+
+    def release(self, pages: Sequence[int]):
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+
+
+class PrefixCache:
+    """Content-hash -> page mapping for full-page prompt prefixes.
+
+    A chunk key is the rolling hash of ALL tokens up to the end of that page
+    (so two prompts share page i only if they agree on every token before
+    it).  Eviction: a cached page with refcount 1 (cache-only) is reclaimed
+    lazily when the allocator runs dry."""
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        self.alloc = allocator
+        self.page = page_size
+        self._map: Dict[bytes, int] = {}        # chunk hash -> page id
+        self._lru: List[bytes] = []
+
+    @staticmethod
+    def _hash(tokens: Sequence[int]) -> bytes:
+        return hashlib.blake2b(
+            b"".join(int(t).to_bytes(4, "little") for t in tokens),
+            digest_size=16).digest()
+
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest reusable page-aligned prefix.  Returns (n_tokens_reused,
+        page_ids) with refcounts already taken."""
+        pages: List[int] = []
+        n_full = len(tokens) // self.page
+        reused = 0
+        for i in range(n_full):
+            key = self._hash(tokens[:(i + 1) * self.page])
+            pid = self._map.get(key)
+            if pid is None:
+                break
+            pages.append(pid)
+            reused += self.page
+        if pages:
+            self.alloc.incref(pages)
+        return reused, pages
+
+    def insert(self, tokens: Sequence[int], page_ids: Sequence[int]):
+        """Register freshly-filled full pages for future reuse.  The cache
+        holds one ref per registered page (released on eviction)."""
+        n_full = min(len(tokens) // self.page, len(page_ids))
+        for i in range(n_full):
+            key = self._hash(tokens[:(i + 1) * self.page])
+            if key in self._map:
+                continue
+            self._map[key] = page_ids[i]
+            self.alloc.incref([page_ids[i]])
+            self._lru.append(key)
+
+    def evict_some(self, n: int = 8) -> int:
+        """Drop up to n oldest cached chunks (returns pages whose only ref
+        was the cache)."""
+        dropped = 0
+        while self._lru and dropped < n:
+            key = self._lru.pop(0)
+            pid = self._map.pop(key, None)
+            if pid is not None:
+                self.alloc.release([pid])
+                dropped += 1
+        return dropped

@@ -69,23 +69,30 @@ class DQNRunner:
         self._ep_returns = np.zeros(num_envs)
         self._done_returns: List[float] = []
 
-    def sample(self, params_blob, steps: int, epsilon: float
-               ) -> Dict[str, np.ndarray]:
+    def sample(self, params_blob, steps: int, epsilon: float,
+               n_step: int = 1, gamma: float = 0.99) -> Dict[str, np.ndarray]:
+        """Roll out ``steps`` env steps, return n-step transitions.
+
+        Matches the reference's ``n_step`` support (rllib DQNConfig): each
+        transition carries the n-step discounted reward sum and a per-sample
+        ``discounts`` factor (gamma^k, zeroed at termination) so the learner's
+        bootstrap term is simply ``R + discounts * maxQ(next_obs)`` — no
+        special-casing of terminal vs truncated vs window-clipped samples.
+        """
         import jax
         import jax.numpy as jnp
 
         params = jax.tree_util.tree_map(jnp.asarray, params_blob)
         N = self.num_envs
         T = max(1, steps // N)
-        buf = {
-            "obs": np.zeros((T * N,) + self.obs.shape[1:], np.float32),
-            "actions": np.zeros((T * N,), np.int32),
-            "rewards": np.zeros((T * N,), np.float32),
-            "next_obs": np.zeros((T * N,) + self.obs.shape[1:], np.float32),
-            "dones": np.zeros((T * N,), np.float32),
-        }
-        k = 0
-        for _t in range(T):
+        shp = self.obs.shape[1:]
+        obs_t = np.zeros((T, N) + shp, np.float32)
+        act_t = np.zeros((T, N), np.int32)
+        rew_t = np.zeros((T, N), np.float32)
+        nobs_t = np.zeros((T, N) + shp, np.float32)
+        term_t = np.zeros((T, N), bool)
+        stop_t = np.zeros((T, N), bool)  # term OR trunc: n-step window ends
+        for t in range(T):
             q = np.asarray(self._apply(params, jnp.asarray(self.obs)))
             greedy = q.argmax(axis=-1)
             explore = self._rng.random(N) < epsilon
@@ -93,19 +100,48 @@ class DQNRunner:
             actions = np.where(explore, random_a, greedy)
             for i, env in enumerate(self.envs):
                 nobs, rew, term, trunc, _ = env.step(int(actions[i]))
-                buf["obs"][k] = self.obs[i]
-                buf["actions"][k] = actions[i]
-                buf["rewards"][k] = rew
-                buf["dones"][k] = float(term)  # truncation bootstraps
+                obs_t[t, i] = self.obs[i]
+                act_t[t, i] = actions[i]
+                rew_t[t, i] = rew
+                # The stored successor must be the ACTUAL next observation
+                # from env.step — at truncation the TD target still
+                # bootstraps from it, so record it before any reset
+                # replaces it with a fresh episode's initial obs.
+                nobs_t[t, i] = np.asarray(nobs, np.float32)
+                term_t[t, i] = term
+                stop_t[t, i] = term or trunc
                 self._ep_returns[i] += rew
                 if term or trunc:
                     self._done_returns.append(self._ep_returns[i])
                     self._ep_returns[i] = 0.0
                     nobs, _ = env.reset()
                 self.obs[i] = np.asarray(nobs, np.float32)
-                buf["next_obs"][k] = self.obs[i]
+        # n-step aggregation per env column (windows never cross episode
+        # boundaries; windows clipped by the rollout end bootstrap early
+        # with discount gamma^k, k < n).
+        out = {
+            "obs": obs_t.reshape((T * N,) + shp),
+            "actions": act_t.reshape(-1),
+            "rewards": np.zeros((T * N,), np.float32),
+            "next_obs": np.zeros((T * N,) + shp, np.float32),
+            "discounts": np.zeros((T * N,), np.float32),
+        }
+        k = 0
+        for t in range(T):
+            for i in range(N):
+                acc, g = 0.0, 1.0
+                j = t
+                while True:
+                    acc += g * rew_t[j, i]
+                    g *= gamma
+                    if stop_t[j, i] or j - t + 1 >= n_step or j + 1 >= T:
+                        break
+                    j += 1
+                out["rewards"][k] = acc
+                out["next_obs"][k] = nobs_t[j, i]
+                out["discounts"][k] = 0.0 if term_t[j, i] else g
                 k += 1
-        return buf
+        return out
 
     def episode_returns(self, clear: bool = True) -> List[float]:
         out = list(self._done_returns)
@@ -128,7 +164,8 @@ class DQNConfig:
         self.rollout_steps = 256          # env steps sampled per iteration
         self.train: Dict[str, Any] = dict(
             lr=1e-3, gamma=0.99, batch_size=128, train_iters=8,
-            target_update_tau=0.01, double_q=True, huber_delta=1.0)
+            target_update_tau=0.01, double_q=True, huber_delta=1.0,
+            n_step=1)
         self.model: Dict[str, Any] = dict(hidden=(64, 64))
         self.replay: Dict[str, Any] = dict(
             capacity=50_000, prioritized=False, alpha=0.6, beta=0.4,
@@ -226,7 +263,6 @@ class DQN:
         import jax.numpy as jnp
 
         cfg = self.config.train
-        gamma = cfg["gamma"]
         tau = cfg["target_update_tau"]
         double_q = cfg["double_q"]
         delta = cfg["huber_delta"]
@@ -244,7 +280,10 @@ class DQN:
                     q_next_t, next_a[:, None], axis=-1)[:, 0]
             else:
                 q_next = q_next_t.max(axis=-1)
-            target = batch["rewards"] + gamma * (1 - batch["dones"]) * q_next
+            # discounts = gamma^k with 0 at termination (computed by the
+            # runner's n-step aggregation), so one expression covers 1-step,
+            # n-step, terminal, and truncation-bootstrapped samples.
+            target = batch["rewards"] + batch["discounts"] * q_next
             td = qa - jax.lax.stop_gradient(target)
             huber = jnp.where(jnp.abs(td) <= delta, 0.5 * td ** 2,
                               delta * (jnp.abs(td) - 0.5 * delta))
@@ -281,7 +320,8 @@ class DQN:
             {k: np.asarray(v) for k, v in self.params.items()})
         per_runner = max(1, cfg.rollout_steps // cfg.num_env_runners)
         batches = ray_tpu.get(
-            [r.sample.remote(weights_ref, per_runner, eps)
+            [r.sample.remote(weights_ref, per_runner, eps,
+                             cfg.train["n_step"], cfg.train["gamma"])
              for r in self.runners], timeout=600)
         for b in batches:
             self.buffer.add(b)
@@ -296,7 +336,7 @@ class DQN:
                     "actions": jnp.asarray(sample["actions"]),
                     "rewards": jnp.asarray(sample["rewards"]),
                     "next_obs": jnp.asarray(sample["next_obs"]),
-                    "dones": jnp.asarray(sample["dones"]),
+                    "discounts": jnp.asarray(sample["discounts"]),
                 }
                 if "_weights" in sample:
                     batch["weights"] = jnp.asarray(sample["_weights"])

@@ -18,10 +18,14 @@ Architecture (TPU-first):
   freeing its slot for the next admit).
 * Sampling is greedy or temperature/top-k, per request.
 
-The Serve deployment wraps the engine in a streaming endpoint; deploy with
-``num_replicas > 1`` for replica-level data parallelism (each replica owns a
-chip), or shard the params over a mesh inside one replica for models larger
-than one chip.
+* **Paged KV cache** (``paged=True``): block-table pages instead of dense
+  ``slots x max_len`` rows (``models/paged_decode.py``) — HBM scales with
+  actual request lengths, and identical prompt prefixes share pages
+  (prefix caching with refcounts).
+* **In-replica tensor parallelism** (``tp=N``): params and KV heads are
+  sharded over an N-chip mesh with ``NamedSharding``; the same jitted
+  prefill/decode programs run SPMD (XLA inserts the collectives).  Deploy
+  with ``num_replicas > 1`` for replica-level data parallelism on top.
 """
 
 from __future__ import annotations
@@ -39,7 +43,8 @@ _FLUSH = object()
 
 class GenRequest:
     __slots__ = ("tokens", "max_tokens", "temperature", "top_k", "eos_id",
-                 "out", "slot", "generated", "submitted_at", "first_token_at")
+                 "out", "slot", "generated", "submitted_at", "first_token_at",
+                 "pages")
 
     def __init__(self, tokens: List[int], max_tokens: int,
                  temperature: float, top_k: int, eos_id: Optional[int]):
@@ -50,6 +55,7 @@ class GenRequest:
         self.eos_id = eos_id
         self.out: "queue.Queue" = queue.Queue()
         self.slot = -1
+        self.pages: List[int] = []
         self.generated = 0
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
@@ -63,7 +69,10 @@ class LLMEngine:
                  compute_dtype=None, seed: int = 0, top_k: int = 0,
                  fetch_lag: int = 2, steps_per_dispatch: int = 8,
                  prefill_batch: Optional[int] = None,
-                 warmup_buckets: bool = False):
+                 warmup_buckets: bool = False,
+                 paged: bool = False, page_size: int = 64,
+                 num_pages: Optional[int] = None, prefix_cache: bool = True,
+                 tp: int = 1):
         import jax
         import jax.numpy as jnp
 
@@ -94,8 +103,39 @@ class LLMEngine:
         # slot (index num_slots) that decode never activates.
         self.prefill_batch = prefill_batch or min(num_slots, 8)
         self._scratch_slot = num_slots
-        self.cache = dec.init_kv_cache(cfg, num_slots + 1, self.max_len,
-                                       self.compute_dtype)
+        self.paged = paged
+        if paged:
+            from ray_tpu.models import paged_decode as pdec
+            self._pdec = pdec
+            self.page_size = page_size
+            self.max_pages_per_slot = -(-self.max_len // page_size)
+            # default HBM budget = half the dense cache (the paged win)
+            self.num_pages = num_pages or max(
+                (num_slots + 1) * self.max_pages_per_slot // 2, 16)
+            self.cache = pdec.init_paged_cache(
+                cfg, self.num_pages, page_size, num_slots + 1,
+                self.max_pages_per_slot, self.compute_dtype)
+            self.allocator = pdec.PageAllocator(self.num_pages)
+            self.prefix = (pdec.PrefixCache(self.allocator, page_size)
+                           if prefix_cache else None)
+        else:
+            self.cache = dec.init_kv_cache(cfg, num_slots + 1, self.max_len,
+                                           self.compute_dtype)
+        # In-replica tensor parallelism: place params + cache with tp
+        # shardings; jit propagates them, XLA inserts the collectives.
+        self.tp = tp
+        self.mesh = None
+        if tp > 1:
+            if cfg.num_kv_heads % tp:
+                raise ValueError(f"tp={tp} must divide num_kv_heads="
+                                 f"{cfg.num_kv_heads}")
+            from jax.sharding import Mesh
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(f"tp={tp} but only {len(devs)} devices")
+            self.mesh = Mesh(devs[:tp], ("tp",))
+            self.params, self.cache = self._apply_tp_sharding(
+                self.params, self.cache)
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_counter = 0
 
@@ -110,11 +150,18 @@ class LLMEngine:
         # cache must be updated in place, not copied; the token array is NOT
         # donated because the fetch pipeline still holds earlier versions),
         # one prefill per bucket (lazy unless warmup_buckets).
-        self._decode_fn = jax.jit(
-            lambda p, c, t, a, tmp, k: dec.decode_loop(
-                p, c, t, a, tmp, k, self.steps_per_dispatch, cfg, top_k,
-                self.compute_dtype),
-            donate_argnums=(1,))
+        if paged:
+            self._decode_fn = jax.jit(
+                lambda p, c, t, a, tmp, k: self._pdec.paged_decode_loop(
+                    p, c, t, a, tmp, k, self.steps_per_dispatch, cfg, top_k,
+                    self.compute_dtype),
+                donate_argnums=(1,))
+        else:
+            self._decode_fn = jax.jit(
+                lambda p, c, t, a, tmp, k: dec.decode_loop(
+                    p, c, t, a, tmp, k, self.steps_per_dispatch, cfg, top_k,
+                    self.compute_dtype),
+                donate_argnums=(1,))
         self._prefill_fns: Dict[int, Any] = {}
 
         # scheduler state
@@ -175,6 +222,50 @@ class LLMEngine:
         while req.out.get() is not _FLUSH:
             pass
 
+    # -------------------------------------------------------- tp sharding
+
+    def _apply_tp_sharding(self, params, cache):
+        """Place params + cache on the tp mesh: attention/MLP weights split
+        megatron-style (column then row), KV heads split across chips,
+        small/control tensors replicated.  jit then runs the unchanged
+        programs SPMD (scaling-book recipe: annotate, let XLA do the rest)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        def spec_for(path: str, arr) -> "P":
+            dims = arr.ndim
+
+            def at(axis):  # PartitionSpec with 'tp' at `axis`
+                parts = [None] * dims
+                parts[axis] = "tp"
+                return P(*parts)
+
+            # stacked block params carry a leading L dim (scan over layers)
+            if "wq" in path or "wk" in path or "wv" in path \
+                    or "w_in" in path or "w_gate" in path:
+                return at(dims - 1)          # column parallel
+            if "wo" in path or "w_out" in path:
+                return at(dims - 2)          # row parallel
+            if "bq" in path or "bk" in path or "bv" in path \
+                    or "b_in" in path:
+                return at(dims - 1)
+            if path.endswith("/k") or path.endswith("/v"):
+                return at(3)                 # [L, P|S, len, NKV, D]
+            return P()                       # replicate
+
+        def place(tree):
+            flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+            placed = []
+            for keypath, leaf in flat:
+                path = "/".join(str(getattr(k, "key", k)) for k in keypath)
+                placed.append(jax.device_put(
+                    leaf, NamedSharding(mesh, spec_for("/" + path, leaf))))
+            return jax.tree_util.tree_unflatten(treedef, placed)
+
+        return place(params), place(cache)
+
     # -------------------------------------------------------- scheduler
 
     def _bucket_for(self, n: int) -> int:
@@ -202,7 +293,21 @@ class LLMEngine:
                 temps_dev = temps_dev.at[sl].set(tmp)
                 return c, first, tokens_dev, active_dev, temps_dev
 
-            fn = self._jax.jit(prefill_merge, donate_argnums=(1,))
+            def paged_prefill_merge(p, c, t, ln, sl, start, tmp, k,
+                                    tokens_dev, active_dev, temps_dev,
+                                    real_mask):
+                pdec = self._pdec
+                c, logits = pdec.paged_prefill(p, c, t, ln, sl, start, cfg,
+                                               dt)
+                first = pdec.sample_per_slot(logits, k, tmp, tk)
+                tokens_dev = tokens_dev.at[sl].set(first)
+                active_dev = active_dev.at[sl].set(real_mask)
+                temps_dev = temps_dev.at[sl].set(tmp)
+                return c, first, tokens_dev, active_dev, temps_dev
+
+            fn = self._jax.jit(
+                paged_prefill_merge if self.paged else prefill_merge,
+                donate_argnums=(1,))
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -243,6 +348,9 @@ class LLMEngine:
                 self._wake.clear()
 
     def _admit(self, reqs: List[GenRequest], bucket: int):
+        if self.paged:
+            self._admit_paged(reqs, bucket)
+            return
         jnp = self._jnp
         n_pad = self.prefill_batch - len(reqs)
         rows = [r.tokens + [0] * (bucket - len(r.tokens)) for r in reqs]
@@ -273,6 +381,97 @@ class LLMEngine:
             r.slot = s
             self._active[s] = r
             snapshot[s] = r
+        self._unfetched.append((first, snapshot, slots))
+        self.steps += 1
+
+    def _plan_pages(self, r: GenRequest):
+        """Reserve pages for one request: reuse cached prefix pages, allocate
+        private pages for the rest of prompt + generation budget.  Returns
+        (reused_tokens, page_row) or None when the arena is full."""
+        page = self.page_size
+        total = min(len(r.tokens) + r.max_tokens + 1, self.max_len)
+        reused, rpages = 0, []
+        if self.prefix is not None:
+            reused, rpages = self.prefix.match_prefix(r.tokens)
+            # always leave >= 1 prompt token for the prefill (logits needed)
+            max_reuse_pages = (len(r.tokens) - 1) // page
+            if len(rpages) > max_reuse_pages:
+                self.allocator.release(rpages[max_reuse_pages:])
+                rpages = rpages[:max_reuse_pages]
+                reused = max_reuse_pages * page
+        need = -(-total // page) - len(rpages)
+        private = self.allocator.alloc(need)
+        if private is None and self.prefix is not None:
+            self.prefix.evict_some(need * 2)
+            private = self.allocator.alloc(need)
+        if private is None:
+            self.allocator.release(rpages)
+            return None
+        return reused, rpages + private
+
+    def _admit_paged(self, reqs: List[GenRequest], bucket: int):
+        jnp = self._jnp
+        planned = []
+        for r in reqs:
+            plan = self._plan_pages(r)
+            if plan is None:
+                # arena full: requeue and stop admitting (backpressure)
+                self._pending.put(r)
+                continue
+            planned.append((r, plan))
+        if not planned:
+            return
+        # suffix bucket: longest uncached suffix, padded
+        sbucket = self._bucket_for(max(
+            len(r.tokens) - reused for r, (reused, _pages) in planned))
+        n_pad = self.prefill_batch - len(planned)
+        rows, lengths, starts, slots, temps = [], [], [], [], []
+        bt = self.cache["block_table"]
+        for r, (reused, pages) in planned:
+            suffix = r.tokens[reused:]
+            rows.append(suffix + [0] * (sbucket - len(suffix)))
+            lengths.append(len(suffix))
+            starts.append(reused)
+            s = self._free_slots.pop(0)
+            slots.append(s)
+            temps.append(r.temperature)
+            r.pages = pages
+            row = pages + [0] * (self.max_pages_per_slot - len(pages))
+            bt = bt.at[s].set(jnp.asarray(row[:self.max_pages_per_slot],
+                                          jnp.int32))
+        rows += [[0] * sbucket] * n_pad
+        lengths += [1] * n_pad
+        starts += [0] * n_pad
+        temps += [0.0] * n_pad
+        self.cache["block_table"] = bt
+        slots_arr = jnp.asarray(slots + [self._scratch_slot] * n_pad,
+                                jnp.int32)
+        real_mask = jnp.asarray([True] * len(planned) + [False] * n_pad)
+        try:
+            (self.cache, first, self._tokens_dev, self._active_dev,
+             self._temps_dev) = self._prefill_fn(sbucket)(
+                self.params, self.cache, jnp.asarray(rows, jnp.int32),
+                jnp.asarray(lengths, jnp.int32), slots_arr,
+                jnp.asarray(starts, jnp.int32),
+                jnp.asarray(temps, jnp.float32), self._next_key(),
+                self._tokens_dev, self._active_dev, self._temps_dev,
+                real_mask)
+        except BaseException as e:  # noqa: BLE001
+            for (r, (_reused, pages)), s in zip(planned, slots):
+                self._free_slots.append(s)
+                self.allocator.release(pages)
+                r.out.put(e)
+                r.out.put(_FLUSH)
+            return
+        snapshot = {}
+        for (r, (_reused, _pages)), s in zip(planned, slots):
+            r.slot = s
+            self._active[s] = r
+            snapshot[s] = r
+            if self.prefix is not None:
+                # register this prompt's full pages for future reuse
+                self.prefix.insert(r.tokens,
+                                   r.pages[:len(r.tokens) // self.page_size])
         self._unfetched.append((first, snapshot, slots))
         self.steps += 1
 
@@ -317,6 +516,14 @@ class LLMEngine:
             del self._active[r.slot]
             self._free_slots.append(r.slot)
             self._active_dev = self._active_dev.at[r.slot].set(False)
+            if self.paged and r.pages:
+                # refcounted: shared prefix pages survive on the prefix
+                # cache's refs; private pages return to the free list.
+                # In-flight decode steps may still write into released
+                # pages, but every such position is re-written by its next
+                # owner's prefill/decode before it becomes readable.
+                self.allocator.release(r.pages)
+                r.pages = []
         r.out.put(_FLUSH)
 
 

@@ -11,43 +11,73 @@ the scheduler spread chunks across every node.
 from __future__ import annotations
 
 import itertools
+import multiprocessing as _stdlib_mp
+import threading
 from typing import Any, Callable, Iterable, List, Optional
 
 from ..core import api as _api
 from ..core.api import remote
+from ..core.common import GetTimeoutError
 
 
 class AsyncResult:
-    """Matches ``multiprocessing.pool.AsyncResult``."""
+    """Matches ``multiprocessing.pool.AsyncResult``.
+
+    Callbacks fire asynchronously from a background thread the moment the
+    result lands — stdlib Pool semantics, and what joblib's retrieval loop
+    depends on (it waits for the callback before ever calling ``get``).
+    A ``get(timeout)`` that times out raises ``multiprocessing.TimeoutError``
+    without latching: a later ``get`` with a longer timeout can still succeed.
+    """
 
     def __init__(self, refs: List, single: bool, callback=None,
                  error_callback=None):
         self._refs = refs
         self._single = single
-        self._callback = callback
-        self._error_callback = error_callback
-        self._done = False
+        self._done = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
+        self._callback = callback
+        self._error_callback = error_callback
+        if callback is not None or error_callback is not None:
+            threading.Thread(target=self._resolve, name="mp-asyncresult",
+                             daemon=True).start()
+
+    def _finish(self, value=None, error: Optional[BaseException] = None):
+        if self._done.is_set():
+            return
+        self._value, self._error = value, error
+        self._done.set()
+        try:
+            if error is None and self._callback is not None:
+                self._callback(value)
+            elif error is not None and self._error_callback is not None:
+                self._error_callback(error)
+        except Exception:
+            pass  # stdlib Pool also swallows callback errors
 
     def _resolve(self, timeout: Optional[float] = None):
-        if self._done:
+        if self._done.is_set():
             return
         try:
             out: List[Any] = []
             for chunk in _api.get(self._refs, timeout=timeout):
                 out.extend(chunk)
-            self._value = out[0] if self._single else out
-            if self._callback:
-                self._callback(self._value)
+            self._finish(value=out[0] if self._single else out)
+        except GetTimeoutError:
+            # Timed out fetching, not failed: leave state unlatched so a
+            # retried get() with a longer timeout can still resolve.
+            raise _stdlib_mp.TimeoutError()
         except BaseException as e:  # noqa: BLE001 — surfaced via get()
-            self._error = e
-            if self._error_callback:
-                self._error_callback(e)
-        self._done = True
+            self._finish(error=e)
 
     def get(self, timeout: Optional[float] = None):
-        self._resolve(timeout)
+        if self._callback is not None or self._error_callback is not None:
+            # A background thread owns resolution; wait for it.
+            if not self._done.wait(timeout):
+                raise _stdlib_mp.TimeoutError()
+        else:
+            self._resolve(timeout)
         if self._error is not None:
             raise self._error
         return self._value
@@ -59,14 +89,14 @@ class AsyncResult:
             pass
 
     def ready(self) -> bool:
-        if self._done:
+        if self._done.is_set():
             return True
         _ready, rest = _api.wait(self._refs, num_returns=len(self._refs),
                                  timeout=0)
         return not rest
 
     def successful(self) -> bool:
-        if not self._done:
+        if not self._done.is_set():
             raise ValueError("result is not ready")
         return self._error is None
 

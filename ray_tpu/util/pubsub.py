@@ -56,13 +56,23 @@ class Subscriber:
         self.topics = [topics] if isinstance(topics, str) else list(topics)
         self._client = RpcClient(_gcs_address(gcs_address))
         self._closed = False
-        try:
-            # Poll with an impossible cursor to learn the current seq ("now").
-            self._cursor, _ = run_async(self._client.call(
-                "pubsub_poll", topics=self.topics,
-                cursor=_FAR_FUTURE_CURSOR, timeout=0.01))
-        except Exception:
-            self._cursor = 0
+        # Poll with an impossible cursor to learn the current seq ("now").
+        # The probe must not silently fall back to cursor 0 — that would
+        # replay retained history, violating the documented start-at-now
+        # semantics — so retry once and then surface the failure.
+        last_err = None
+        for _ in range(2):
+            try:
+                self._cursor, _ = run_async(self._client.call(
+                    "pubsub_poll", topics=self.topics,
+                    cursor=_FAR_FUTURE_CURSOR, timeout=0.01))
+                break
+            except Exception as e:
+                last_err = e
+                time.sleep(0.2)
+        else:
+            raise RuntimeError(
+                f"pubsub cursor probe failed (GCS unreachable?): {last_err}")
 
     def poll(self, timeout: float = 30.0) -> List[Tuple[str, Any]]:
         deadline = time.monotonic() + timeout

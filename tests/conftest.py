@@ -15,7 +15,62 @@ from ray_tpu.utils.testing import CPU_WORKER_ENV, force_cpu_devices
 # TPU-terminal sitecustomize hooks that pin jax_platforms to the TPU).
 force_cpu_devices(8)
 
+import signal  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Per-test timeout (reference: pytest.ini's 180 s pytest-timeout default).
+# pytest-timeout isn't in this image, so a SIGALRM in the main thread stands
+# in: a wedged test raises instead of hanging the whole suite forever.
+_TEST_TIMEOUT_S = int(os.environ.get("RAYTPU_TEST_TIMEOUT_S", "180"))
+
+
+def _alarm_guard(item, phase_timeout):
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded {phase_timeout}s per-phase timeout "
+            f"(conftest SIGALRM)")
+
+    prev = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(phase_timeout)
+    return prev
+
+
+def _item_timeout(item) -> int:
+    m = item.get_closest_marker("timeout")
+    return int(m.args[0]) if m else _TEST_TIMEOUT_S
+
+
+# Guard all three phases — cluster boot/shutdown happens in fixture
+# setup/teardown, which can wedge just as hard as the test body.
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    prev = _alarm_guard(item, _item_timeout(item))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    prev = _alarm_guard(item, _item_timeout(item))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    prev = _alarm_guard(item, _item_timeout(item))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
 
 
 @pytest.fixture

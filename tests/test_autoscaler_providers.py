@@ -1,0 +1,168 @@
+"""Autoscaler provider tests: GCE TPU queued-resources provider (fake
+transport) and launch-failure/latency injection (reference:
+``python/ray/tests/test_autoscaler.py`` with FakeMultiNodeProvider /
+MockProvider)."""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import AutoscalerConfig, NodeType, StandardAutoscaler
+from ray_tpu.autoscaler.fake_provider import FlakyNodeProvider
+from ray_tpu.autoscaler.gcp import GCETpuNodeProvider
+from ray_tpu.autoscaler.providers import NodeProvider
+
+
+class FakeTpuApi:
+    """In-memory tpu.googleapis.com: QRs progress WAITING -> ACTIVE after
+    `delay_polls` GETs; supports injected create failures."""
+
+    def __init__(self, delay_polls=1, fail_creates=0):
+        self.qrs = {}
+        self.polls = {}
+        self.delay_polls = delay_polls
+        self.fail_creates = fail_creates
+        self.calls = []
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url))
+        if method == "POST" and "queuedResources" in url:
+            if self.fail_creates > 0:
+                self.fail_creates -= 1
+                raise RuntimeError("injected: RESOURCE_EXHAUSTED")
+            qr_id = url.split("queuedResourceId=")[1]
+            name = url.split("?")[0].replace(
+                "https://tpu.googleapis.com/v2/", "") + "/" + qr_id
+            self.qrs[name] = "WAITING_FOR_RESOURCES"
+            self.polls[name] = 0
+            return {"name": name}
+        if method == "GET":
+            name = url.replace("https://tpu.googleapis.com/v2/", "")
+            if name not in self.qrs:
+                return {"state": {"state": "SUSPENDED"}}
+            self.polls[name] += 1
+            if self.polls[name] > self.delay_polls:
+                self.qrs[name] = "ACTIVE"
+            return {"state": {"state": self.qrs[name]}}
+        if method == "DELETE":
+            name = url.replace("https://tpu.googleapis.com/v2/", "")
+            self.qrs.pop(name, None)
+            return {}
+        raise AssertionError(f"unexpected {method} {url}")
+
+
+def _tpu_provider(api):
+    return GCETpuNodeProvider(
+        gcs_address="127.0.0.1:1", project="proj", zone="us-central2-b",
+        poll_interval_s=0.01, transport=api,
+        node_types={"v5e_8": {
+            "resources": {"CPU": 8, "TPU": 8},
+            "accelerator_type": "v5litepod-8",
+            "runtime_version": "tpu-vm-base",
+            "labels": {"tpu_slice": "v5e-8"},
+        }})
+
+
+def test_gcp_qr_lifecycle():
+    api = FakeTpuApi(delay_polls=2)
+    p = _tpu_provider(api)
+    pid = p.create_node("v5e_8", {"cluster": "c1"})
+    # queued (not yet ACTIVE) capacity still counts as non-terminated —
+    # the autoscaler must not double-launch while the QR waits
+    assert p.non_terminated_nodes() == [pid]
+    assert p.wait_active(pid, timeout_s=5)
+    assert p.non_terminated_nodes() == [pid]
+    p.terminate_node(pid)
+    assert p.non_terminated_nodes() == []
+    # both the node and the QR got DELETE calls
+    deletes = [u for m, u in api.calls if m == "DELETE"]
+    assert any("/nodes/" in u for u in deletes)
+    assert any("/queuedResources/" in u for u in deletes)
+
+
+def test_gcp_qr_request_shape():
+    api = FakeTpuApi()
+    p = _tpu_provider(api)
+    p.create_node("v5e_8", {})
+    method, url = api.calls[0]
+    assert method == "POST"
+    assert "projects/proj/locations/us-central2-b/queuedResources" in url
+
+
+def test_gcp_create_failure_surfaces():
+    api = FakeTpuApi(fail_creates=1)
+    p = _tpu_provider(api)
+    with pytest.raises(RuntimeError):
+        p.create_node("v5e_8", {})
+    assert p.non_terminated_nodes() == []
+
+
+class _RecordingProvider(NodeProvider):
+    """Pure in-memory provider for driving StandardAutoscaler.update."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.n = 0
+
+    def create_node(self, node_type, labels):
+        self.n += 1
+        pid = f"n{self.n}"
+        self.nodes[pid] = node_type
+        return pid
+
+    def terminate_node(self, pid):
+        self.nodes.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+def _load_with_pending(n_shapes):
+    return {"nodes": {}, "pending_demands": [{"CPU": 1}] * n_shapes}
+
+
+def test_autoscaler_survives_launch_failures():
+    inner = _RecordingProvider()
+    flaky = FlakyNodeProvider(inner, fail_first_n=2)
+    cfg = AutoscalerConfig(
+        node_types={"cpu": NodeType(resources={"CPU": 4}, max_workers=4)},
+        upscaling_speed=1)
+    a = StandardAutoscaler("127.0.0.1:1", cfg, provider=flaky)
+    # two updates fail at the provider; the third succeeds
+    a.update(_load_with_pending(1))
+    assert a.num_launches == 0 and a.num_failed_launches == 1
+    a.update(_load_with_pending(1))
+    assert a.num_launches == 0 and a.num_failed_launches == 2
+    a.update(_load_with_pending(1))
+    assert a.num_launches == 1
+    assert inner.non_terminated_nodes() == ["n1"]
+
+
+def test_autoscaler_slow_launch_no_double_request():
+    inner = _RecordingProvider()
+    slow = FlakyNodeProvider(inner, launch_delay_s=0.2)
+    cfg = AutoscalerConfig(
+        node_types={"cpu": NodeType(resources={"CPU": 4}, max_workers=4)},
+        upscaling_speed=4)
+    a = StandardAutoscaler("127.0.0.1:1", cfg, provider=slow)
+    t0 = time.monotonic()
+    # one demand shape -> exactly one (slow) launch, even with budget 4
+    a.update(_load_with_pending(1))
+    assert time.monotonic() - t0 >= 0.2
+    assert a.num_launches == 1 and slow.create_attempts == 1
+
+
+def test_autoscaler_tpu_slice_node_type():
+    """A TPU-shaped demand selects the TPU node type, not the CPU type."""
+    inner = _RecordingProvider()
+    cfg = AutoscalerConfig(node_types={
+        "cpu": NodeType(resources={"CPU": 8}, max_workers=4),
+        "v5e_8": NodeType(resources={"CPU": 8, "TPU": 8}, max_workers=2,
+                          labels={"tpu_slice": "v5e-8"}),
+    })
+    a = StandardAutoscaler("127.0.0.1:1", cfg, provider=inner)
+    a.update({"nodes": {}, "pending_demands": [{"TPU": 8}]})
+    assert inner.nodes == {"n1": "v5e_8"}
+    # max_workers caps TPU slices
+    a.update({"nodes": {}, "pending_demands": [{"TPU": 8}] * 5})
+    assert list(inner.nodes.values()).count("v5e_8") <= 2

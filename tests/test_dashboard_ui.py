@@ -1,0 +1,36 @@
+"""Dashboard web UI smoke: the SPA is served and its API calls resolve
+(reference: dashboard/client — capability check without a browser)."""
+
+import json
+import urllib.request
+
+import pytest
+
+pytest.importorskip("aiohttp")
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def test_dashboard_serves_spa(ray_start_regular):
+    from ray_tpu.dashboard.head import start_dashboard, stop_dashboard
+    port = start_dashboard(port=0)
+    try:
+        status, body = _get(port, "/")
+        assert status == 200 and "ray_tpu dashboard" in body
+        # static assets referenced by the page exist
+        for asset in ("/static/app.js", "/static/style.css"):
+            status, content = _get(port, asset)
+            assert status == 200 and len(content) > 100
+        # every page's backing endpoint answers with JSON
+        for ep in ("/api/cluster", "/api/nodes", "/api/actors", "/api/tasks",
+                   "/api/placement_groups", "/api/jobs", "/api/serve",
+                   "/api/tasks/summarize"):
+            status, body = _get(port, ep)
+            assert status == 200, ep
+            json.loads(body)
+    finally:
+        stop_dashboard()

@@ -52,6 +52,7 @@ def test_sum_tree_prefix_find():
     assert t.find(9.9) == 3
 
 
+@pytest.mark.timeout(420)  # 90 train iters can outrun the 180 s default
 def test_dqn_learns_cartpole(ray_start_regular):
     pytest.importorskip("gymnasium")
     from ray_tpu.rllib.dqn import DQNConfig
@@ -61,15 +62,15 @@ def test_dqn_learns_cartpole(ray_start_regular):
             .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
                          rollout_steps=400)
             .training(lr=1e-3, batch_size=64, train_iters=16,
-                      target_update_tau=0.05,
-                      replay=dict(capacity=20_000, learn_starts=400))
+                      target_update_tau=0.005, n_step=3,
+                      replay=dict(capacity=50_000, learn_starts=1_000))
             .exploring(epsilon_start=1.0, epsilon_end=0.05,
-                       epsilon_decay_steps=4_000)
+                       epsilon_decay_steps=10_000)
             .debugging(seed=0)
             .build())
     try:
         best = -np.inf
-        for _ in range(30):
+        for _ in range(90):
             result = algo.train()
             best = max(best, result["episode_return_mean"])
             if best >= 60.0:
