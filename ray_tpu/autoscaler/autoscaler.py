@@ -187,6 +187,23 @@ class StandardAutoscaler:
         for pid in list(self._owned):
             ntype = self._owned[pid]
             raytpu_id = self.provider.raytpu_node_id(pid)
+            if raytpu_id is None:
+                # Cloud providers learn the mapping when the node registers
+                # with its `raytpu-provider-id` label (set at create_node).
+                for nid, n in alive.items():
+                    if (n.get("labels") or {}).get(
+                            "raytpu-provider-id") == pid:
+                        rec = getattr(self.provider,
+                                      "record_node_registration", None)
+                        if rec is not None:
+                            rec(pid, nid)
+                        raytpu_id = nid
+                        break
+            if raytpu_id is None:
+                # Not registered yet (e.g. a queued TPU slice still
+                # provisioning — can legitimately take hours): neither
+                # idle-drain nor zombie cleanup applies.
+                continue
             n = alive.get(raytpu_id)
             if n is None:
                 # registered but not alive in the view: the node hung or the

@@ -104,6 +104,10 @@ class GCETpuNodeProvider(NodeProvider):
         all_labels = dict(spec.get("labels", {}))
         all_labels.update(labels)
         all_labels["raytpu-cluster"] = self.cluster_name
+        # The provider id rides into the node's cluster labels via the boot
+        # script; the autoscaler matches it back to map provider id ->
+        # cluster node id (enables idle drain + zombie cleanup).
+        all_labels["raytpu-provider-id"] = pid
         # The boot script joins the slice to the cluster exactly like a
         # manually-started worker node (raytpu start --address=GCS).
         startup = ("#! /bin/bash\n"
@@ -182,6 +186,19 @@ class GCETpuNodeProvider(NodeProvider):
             else:
                 self._nodes.pop(pid, None)
         return live
+
+    def raytpu_node_id(self, provider_id: str) -> Optional[str]:
+        """Cluster node id for a provisioned slice, or None while the QR is
+        still queued/provisioning.  The mapping arrives when the slice's
+        startup script registers with the GCS and reports the provider id
+        label back (``register_provider_node``); until then the autoscaler
+        must not treat the node as a zombie."""
+        return self._nodes.get(provider_id, {}).get("raytpu_node_id")
+
+    def record_node_registration(self, provider_id: str, raytpu_node_id: str):
+        info = self._nodes.get(provider_id)
+        if info is not None:
+            info["raytpu_node_id"] = raytpu_node_id
 
     def shutdown(self):
         for pid in list(self._nodes):

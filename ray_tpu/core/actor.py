@@ -16,7 +16,8 @@ from . import serialization
 from .common import TaskSpec
 from .ids import ActorID, TaskID
 from .object_ref import ObjectRef
-from .remote_function import resolve_pg_strategy, serialize_args
+from .remote_function import (_current_trace_ctx, resolve_pg_strategy,
+                              serialize_args)
 from .rpc import run_async
 
 
@@ -74,6 +75,7 @@ class ActorHandle:
             actor_id=ActorID.from_hex(self._actor_id),
             actor_method=method,
             max_retries=self._max_task_retries,
+            trace_ctx=_current_trace_ctx(),
         )
         refs = w.submit_actor_task(self._actor_id, spec, arg_refs)
         if num_returns == 0:

@@ -79,14 +79,23 @@ class TaskSpec:
     is_actor_task: bool = False
     actor_method: Optional[str] = None
     seq_no: int = 0
+    #: propagated trace context (trace_id, parent_span_id) — reference:
+    #: util/tracing/tracing_helper.py serialized span context in the spec
+    trace_ctx: Optional[tuple] = None
     # bookkeeping
     submitted_at: float = field(default_factory=time.time)
 
     def scheduling_key(self) -> tuple:
         """Tasks with the same key can reuse the same leased worker
-        (reference: SchedulingKey in direct_task_transport.h:151)."""
+        (reference: SchedulingKey in direct_task_transport.h:151).  The
+        runtime env is part of worker identity: a pip env means a dedicated
+        interpreter, so different envs must never share a lease pool."""
+        env_key = None
+        if self.runtime_env:
+            env_key = repr(sorted(
+                (k, repr(v)) for k, v in self.runtime_env.items()))
         return (self.fn_id, tuple(sorted(self.resources.items())),
-                repr(self.scheduling_strategy), self.runtime_env is None)
+                repr(self.scheduling_strategy), env_key)
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
@@ -116,6 +125,12 @@ class TaskError(RayTpuError):
         self.remote_traceback = remote_tb
         super().__init__(f"task {task_name!r} failed: {type(cause).__name__}: {cause}"
                          + (f"\n--- remote traceback ---\n{remote_tb}" if remote_tb else ""))
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """The task's runtime environment could not be built (e.g. pip install
+    failed) — deterministic, so the task fails instead of retrying
+    (reference: ray.exceptions.RuntimeEnvSetupError)."""
 
 
 class WorkerCrashedError(RayTpuError):

@@ -66,6 +66,11 @@ class Config:
     #: results / actor replies) can be registered by the receiver before the
     #: owner evaluates "no references left".
     ref_escrow_grace_s: float = 10.0
+    #: How long an owner honors a producer's escrow hold on a contained ref
+    #: before assuming the consumer died (the hold is normally released
+    #: explicitly the moment the consumer registers its borrow — this expiry
+    #: only bounds the leak window when a consumer crashes mid-handoff).
+    escrow_hold_expiry_s: float = 60.0
     #: Max workers a node agent will spawn beyond configured CPU count for
     #: blocked-on-get tasks.
     max_extra_workers: int = 2

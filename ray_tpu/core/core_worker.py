@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import itertools
 import os
 import pickle
 import queue as _queue
@@ -207,10 +208,17 @@ class TaskManager:
             # latency — not user think-time (reference: reference_count.cc
             # borrower bookkeeping; the round-1 grace-only scheme lost objects
             # gotten later than ref_escrow_grace_s after production).
-            for idbin, owner in _result_contained_refs(res):
+            for desc in _result_contained_refs(res):
+                idbin, owner = desc[0], desc[1]
+                hold_id = desc[2] if len(desc) > 2 else None
                 if owner and owner != self._w.address:
                     self._w.register_contained_borrow(oid, ObjectID(idbin),
-                                                      owner)
+                                                      owner, hold_id)
+                elif hold_id:
+                    # Our own object round-tripped through the result: the
+                    # producer's hold sits with US — drop it (the ref's
+                    # local count keeps the object alive from here).
+                    self._w.release_local_hold(ObjectID(idbin), hold_id)
         self.num_finished += 1
         if get_config().lineage_reconstruction_enabled and any(
                 r[0] == "plasma" for r in results):
@@ -268,12 +276,14 @@ class LeasePool:
     MAX_LEASES = 64
 
     def __init__(self, worker: "CoreWorker", key: tuple, resources: Dict[str, float],
-                 strategy, bundle: Optional[Tuple[str, int]]):
+                 strategy, bundle: Optional[Tuple[str, int]],
+                 runtime_env: Optional[dict] = None):
         self.w = worker
         self.key = key
         self.resources = resources or {"CPU": 1.0}
         self.strategy = strategy
         self.bundle = bundle
+        self.runtime_env = runtime_env
         self.queue: collections.deque[TaskSpec] = collections.deque()
         self.leased: Dict[str, LeasedWorker] = {}
         self.requesting = 0
@@ -295,27 +305,16 @@ class LeasePool:
             # must never serialize onto one worker what in-flight leases
             # would have parallelized (long tasks would lose whole-node
             # parallelism; reference work-stealing solves the same hazard,
-            # direct_task_transport.h:151).
+            # direct_task_transport.h:151).  Intra-batch dependencies are
+            # fine: each task's result is STREAMED back as it completes
+            # (handle_push_task_batch), so a consumer later in the batch
+            # resolves its producer without waiting for the batch reply.
             avail = len(idle) + self.requesting
             share = min(max_batch,
                         -(-len(self.queue) // max(1, avail)))  # ceil div
             lw = idle.pop()
-            # A batch replies as a unit, so a task must never ride in the
-            # same batch as a task whose return it consumes — the consumer
-            # would block resolving the ref at the owner while the owner
-            # waits for this very batch's reply (deadlock).  Cross-batch
-            # dependencies are fine: the producer's batch replies first.
-            batch: List[TaskSpec] = []
-            produced: set = set()
-            while self.queue and len(batch) < share:
-                spec = self.queue[0]
-                pt = self.w.task_manager.pending.get(spec.task_id)
-                arg_ids = {r.id for r in pt.arg_refs} if pt else set()
-                if batch and not produced.isdisjoint(arg_ids):
-                    break
-                self.queue.popleft()
-                batch.append(spec)
-                produced.update(spec.return_ids())
+            batch = [self.queue.popleft()
+                     for _ in range(min(share, len(self.queue)))]
             lw.busy = True
             asyncio.ensure_future(self._run_on(lw, batch))
         # Request more leases only for demand not already covered by idle
@@ -371,11 +370,30 @@ class LeasePool:
                     grant = await agent.call("request_worker_lease",
                                              resources=self.resources,
                                              bundle=self.bundle,
+                                             runtime_env=self.runtime_env,
                                              allow_spillback=(hops < 4),
                                              _timeout=3600.0)
                 except (ConnectionLost, OSError):
                     target_addr = None
                     await asyncio.sleep(0.2)
+                    continue
+                except RemoteError as e:
+                    from .common import RuntimeEnvSetupError
+                    if isinstance(e.cause, RuntimeEnvSetupError):
+                        # Deterministic: the pool's pip env cannot be built;
+                        # every queued task shares it — fail them all with
+                        # the real error instead of retrying pip forever
+                        # while ray.get hangs (reference:
+                        # RuntimeEnvSetupError fails the task).
+                        while self.queue:
+                            spec = self.queue.popleft()
+                            self.w.task_manager.fail(spec.task_id, e.cause,
+                                                     e.remote_traceback)
+                        return
+                    # transient agent-side failure (register timeout etc.):
+                    # back off and retry the lease
+                    target_addr = None
+                    await asyncio.sleep(0.5)
                     continue
                 if "worker_address" in grant:
                     lw = LeasedWorker(grant["worker_address"], grant["worker_id"],
@@ -410,7 +428,8 @@ class LeasePool:
             await self._on_worker_failure(lw, specs, e)
             return
         for spec, results in zip(specs, results_list):
-            self.w.task_manager.complete(spec.task_id, results)
+            if results != "__streamed__":  # else completed via push already
+                self.w.task_manager.complete(spec.task_id, results)
         lw.busy = False
         lw.idle_since = time.monotonic()
         self._pump()
@@ -493,12 +512,24 @@ class CoreWorker:
         self.gcs: Optional[RpcClient] = None
         self.agent: Optional[RpcClient] = None
         self.agent_clients = ClientPool()
-        self.worker_clients = ClientPool()
+        # Worker peers stream per-task results as pushes on the batch
+        # connection (see handle_push_task_batch): route them straight into
+        # the task manager so a consumer elsewhere in the same batch can
+        # resolve its dependency without waiting for the batch reply.
+        self.worker_clients = ClientPool(push_handler=self._on_peer_push)
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self)
         # result-object id -> [(contained oid, owner)] borrows registered at
         # task-result receipt; released when the result object is freed.
         self._contained_borrows: Dict[ObjectID, list] = {}
+        # Owner-side escrow holds: oid -> {hold_id: expiry_deadline}.  Placed
+        # by producers shipping our refs inside results, released by the
+        # consumers that register the borrow (WaitForRefRemoved-equivalent).
+        self._escrow_holds: Dict[ObjectID, Dict[str, float]] = {}
+        self._hold_seq = itertools.count()
+        # In-flight ADD borrower notes awaiting owner acks (see
+        # flush_borrower_notes).
+        self._pending_notes: set = set()
         self.task_manager = TaskManager(self)
         self.shm_reader = ShmReader()
         self.lease_pools: Dict[tuple, LeasePool] = {}
@@ -567,11 +598,18 @@ class CoreWorker:
     def task_event(self, spec: TaskSpec, state: str, **extra):
         if not get_config().task_events_enabled:
             return
-        self._task_events.append({
+        ev = {
             "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
             "job_id": spec.job_id.hex(), "ts": time.time(),
             "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-            **extra})
+            **extra}
+        if spec.trace_ctx:
+            # the task's slice joins the submitter's trace: its own span id
+            # derives from the task id so parent/child arrows line up
+            ev.setdefault("trace_id", spec.trace_ctx[0])
+            ev.setdefault("parent_id", spec.trace_ctx[1])
+            ev.setdefault("span_id", spec.task_id.hex()[:12])
+        self._task_events.append(ev)
 
     async def _flush_task_events_loop(self):
         while not self._shutdown:
@@ -732,7 +770,9 @@ class CoreWorker:
                                      length=record.size)
         try:
             res = await self.agent.call("fetch_object", object_id=ref.id,
-                                        size=record.size, locations=record.locations)
+                                        size=record.size,
+                                        locations=record.locations,
+                                        owner=ref.owner or self.address)
             return await self._read_fetched(ref.id, res)
         except (RemoteError, ConnectionLost):
             return await self._try_reconstruct(ref, record)
@@ -895,7 +935,8 @@ class CoreWorker:
         key = spec.scheduling_key() + ((bundle,) if bundle else ())
         pool = self.lease_pools.get(key)
         if pool is None:
-            pool = LeasePool(self, key, spec.resources, strategy, bundle)
+            pool = LeasePool(self, key, spec.resources, strategy, bundle,
+                             spec.runtime_env)
             self.lease_pools[key] = pool
         return pool
 
@@ -927,19 +968,11 @@ class CoreWorker:
         try:
             while tgt.outbox:
                 batch: List[TaskSpec] = []
-                produced: set = set()
                 limit = get_config().actor_call_pipeline
-                # Same rule as LeasePool._pump: never batch a call with the
-                # producer of a ref it consumes (batch replies as a unit).
+                # Intra-batch dependencies are safe: per-call results are
+                # streamed back as they land (handle_actor_task_batch).
                 while tgt.outbox and len(batch) < limit:
-                    spec = tgt.outbox[0]
-                    pt = self.task_manager.pending.get(spec.task_id)
-                    arg_ids = {r.id for r in pt.arg_refs} if pt else set()
-                    if batch and not produced.isdisjoint(arg_ids):
-                        break
-                    tgt.outbox.popleft()
-                    batch.append(spec)
-                    produced.update(spec.return_ids())
+                    batch.append(tgt.outbox.popleft())
                 await self._run_actor_batch(actor_id, tgt, batch)
         finally:
             tgt.pump_running = False
@@ -1018,7 +1051,8 @@ class CoreWorker:
                                            e.remote_traceback)
                 return
             for s, results in zip(specs, results_list):
-                self.task_manager.complete(s.task_id, results)
+                if results != "__streamed__":  # else completed via push
+                    self.task_manager.complete(s.task_id, results)
             return
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
@@ -1030,14 +1064,15 @@ class CoreWorker:
     def on_ref_count_zero(self, oid: ObjectID, owner: str):
         """All owner-side counts (local/submitted/borrowers) hit zero.
 
-        The free is delayed by a short escrow grace and the counts re-checked:
-        when a ref is in flight between processes (serialized into a task
-        result / actor reply), the sender's count can hit zero before the
-        receiver's add_borrower_note lands at the owner.  The reference closes
-        this window with full borrower-list bookkeeping
-        (``reference_count.cc`` WaitForRefRemoved); the grace window covers the
-        same hand-off race because receivers note borrows immediately on
-        deserialization.
+        The free happens immediately UNLESS an escrow hold is registered:
+        when a producer serializes this ref into a task result, it places an
+        acked hold with us BEFORE replying (``_package_returns``), and the
+        consumer releases it AFTER registering its borrow
+        (``register_contained_borrow``) — so the in-flight hand-off window is
+        covered by explicit protocol, not a timing grace (the reference's
+        WaitForRefRemoved bookkeeping, ``reference_count.cc``).  Hold expiry
+        (``escrow_hold_expiry_s``) only bounds the leak when a consumer dies
+        mid-handoff.
         """
         if self._shutdown:
             return
@@ -1045,15 +1080,48 @@ class CoreWorker:
             loop = get_loop()
         except Exception:
             return
+        asyncio.run_coroutine_threadsafe(self._free_owned(oid), loop)
 
-        async def _delayed_free():
-            await asyncio.sleep(get_config().ref_escrow_grace_s)
-            await self._free_owned(oid)  # re-checks has_any_ref
+    async def handle_add_object_location(self, object_id: ObjectID,
+                                         node_id: str, address: str):
+        """A node finished pulling our object: record it as a source so later
+        pullers fan out over all holders (tree-shaped broadcast; reference:
+        ownership-based object directory location updates)."""
+        rec = self.memory_store.get_if_exists(object_id)
+        if isinstance(rec, PlasmaRecord):
+            loc = (node_id, address)
+            if loc not in rec.locations:
+                rec.locations.append(loc)
+        return True
 
-        asyncio.run_coroutine_threadsafe(_delayed_free(), loop)
+    async def handle_escrow_hold(self, object_id: ObjectID, hold_id: str):
+        """A producer is about to ship a result containing our object: keep
+        it alive until the consumer's release (or expiry)."""
+        self._escrow_holds.setdefault(object_id, {})[hold_id] = (
+            time.monotonic() + get_config().escrow_hold_expiry_s)
+        return True
+
+    def release_local_hold(self, object_id: ObjectID, hold_id: str):
+        try:
+            loop = get_loop()
+        except Exception:
+            return
+        asyncio.run_coroutine_threadsafe(
+            self.handle_escrow_release(object_id, hold_id), loop)
+
+    async def handle_escrow_release(self, object_id: ObjectID, hold_id: str):
+        holds = self._escrow_holds.get(object_id)
+        if holds is not None:
+            holds.pop(hold_id, None)
+            if not holds:
+                self._escrow_holds.pop(object_id, None)
+        await self._free_owned(object_id)  # no-op while refs/holds remain
 
     def send_borrower_note(self, oid: ObjectID, owner: str, add: bool):
-        """Borrower-side: tell the owner we hold / released a copy of its object."""
+        """Borrower-side: tell the owner we hold / released a copy of its
+        object.  ADD notes are acked calls tracked in _pending_notes so
+        task execution can flush them before its results ship (see
+        flush_borrower_notes); REMOVE notes stay fire-and-forget."""
         if self._shutdown:
             return
         try:
@@ -1063,24 +1131,73 @@ class CoreWorker:
 
         async def _notify():
             try:
-                await self.worker_clients.get(owner).notify(
-                    "add_borrower_note" if add else "remove_borrower_note",
-                    object_id=oid)
+                if add:
+                    await self.worker_clients.get(owner).call(
+                        "add_borrower_note", object_id=oid, _timeout=30.0)
+                else:
+                    await self.worker_clients.get(owner).notify(
+                        "remove_borrower_note", object_id=oid)
             except Exception:
                 pass
 
-        asyncio.run_coroutine_threadsafe(_notify(), loop)
+        fut = asyncio.run_coroutine_threadsafe(_notify(), loop)
+        if add:
+            self._pending_notes.add(fut)
+            fut.add_done_callback(self._pending_notes.discard)
+
+    def flush_borrower_notes(self, timeout: float = 10.0):
+        """Block until every in-flight ADD borrower note is ACKED by its
+        owner.  Called at the end of task execution, BEFORE results ship:
+        the submitter releases its argument pins the moment it processes
+        our results, so the owner must already know about any borrows this
+        task registered — otherwise a ref kept by an actor/task could be
+        freed in the note-vs-result race (reference: reference_count.cc
+        WaitForRefRemoved ordering)."""
+        import concurrent.futures
+        pending = list(self._pending_notes)
+        if pending:
+            concurrent.futures.wait(pending, timeout=timeout)
 
     def register_contained_borrow(self, result_oid: ObjectID, cid: ObjectID,
-                                  owner: str):
+                                  owner: str, hold_id: Optional[str] = None):
         """A task result we own contains a ref owned elsewhere: hold a borrow
-        on it for as long as the result object itself is alive."""
+        on it for as long as the result object itself is alive, then release
+        the producer's escrow hold — ordered AFTER our borrower note on the
+        same connection, so the owner always learns of the borrow before the
+        hold drops."""
         self._contained_borrows.setdefault(result_oid, []).append((cid, owner))
         self.reference_counter.add_local_ref(cid, owner)
+        if hold_id and owner and owner != self.address:
+            try:
+                loop = get_loop()
+            except Exception:
+                return
+
+            async def _release():
+                try:
+                    await self.worker_clients.get(owner).notify(
+                        "escrow_release", object_id=cid, hold_id=hold_id)
+                except Exception:
+                    pass  # expiry reclaims
+
+            asyncio.run_coroutine_threadsafe(_release(), loop)
 
     async def _free_owned(self, oid: ObjectID):
         if self.reference_counter.has_any_ref(oid):
             return
+        holds = self._escrow_holds.get(oid)
+        if holds:
+            now = time.monotonic()
+            live = {h: d for h, d in holds.items() if d > now}
+            if live:
+                self._escrow_holds[oid] = live
+                # consumer-death safety valve: retry at the earliest expiry
+                delay = max(0.05, min(live.values()) - now)
+                loop = asyncio.get_event_loop()
+                loop.call_later(delay, lambda: asyncio.ensure_future(
+                    self._free_owned(oid)))
+                return
+            self._escrow_holds.pop(oid, None)
         for cid, owner in self._contained_borrows.pop(oid, []):
             self.reference_counter.remove_local_ref(cid, owner)
         rec = self.memory_store.get_if_exists(oid)
@@ -1134,6 +1251,10 @@ class CoreWorker:
             pass
 
     # =========================================================== RPC handlers
+
+    async def handle_dump_stacks(self) -> str:
+        from ray_tpu.util.debug import dump_all_stacks
+        return dump_all_stacks()
 
     async def handle_ping(self):
         return "pong"
@@ -1190,30 +1311,77 @@ class CoreWorker:
         self.exec_queue.put(("task", spec, fut, asyncio.get_event_loop()))
         return await fut
 
-    async def handle_push_task_batch(self, specs: List[TaskSpec]):
-        """Batched push: N tasks in one RPC, executed in order in ONE
-        main-thread stint, N result lists in one reply (the submitter-side
-        pipelining counterpart, direct_task_transport.h:151)."""
-        loop = asyncio.get_event_loop()
-        fut = loop.create_future()
-        self.exec_queue.put(("batch", specs, fut, loop))
-        return await fut
+    def _make_result_streamer(self, writer, task_id: TaskID):
+        """Done-callback that pushes one task's results to the submitter the
+        moment it finishes (req_id -1 frame on the batch connection).  This
+        is what makes batching deadlock-free: a consumer later in the batch
+        (or holding the producer's ref indirectly) can resolve it at the
+        owner without waiting for the whole batch to reply."""
+        from .rpc import _encode
 
-    async def handle_actor_task_batch(self, specs: List[TaskSpec]):
-        """Batched ordered actor calls.  Async actors overlap the whole batch
-        on their private loop; threaded actors keep per-call dispatch so the
-        batch doesn't defeat max_concurrency."""
-        if self.actor_spec is not None and self.actor_spec.is_async_actor:
-            return list(await asyncio.gather(
-                *[self._run_async_actor_task(s) for s in specs]))
-        if (self.actor_spec is not None
-                and self.actor_spec.max_concurrency > 1):
-            return list(await asyncio.gather(
-                *[self.handle_actor_task(s) for s in specs]))
+        def _cb(fut):
+            try:
+                writer.write(_encode((-1, "task_result",
+                                      {"task_id": task_id,
+                                       "results": fut.result()})))
+            except Exception:
+                pass  # connection gone: the batch reply path handles it
+
+        return _cb
+
+    def _on_peer_push(self, topic: str, payload: dict):
+        if topic == "task_result":
+            self.task_manager.complete(payload["task_id"],
+                                       payload["results"])
+
+    async def handle_push_task_batch(self, specs: List[TaskSpec],
+                                     _writer=None):
+        """Batched push: N tasks in one RPC, executed in submission order on
+        the main thread, each result STREAMED back as it lands, one final
+        reply as the completion barrier (reference counterpart:
+        direct_task_transport.h:151 pipelining)."""
         loop = asyncio.get_event_loop()
-        fut = loop.create_future()
-        self.exec_queue.put(("batch", specs, fut, loop))
-        return await fut
+        futs = []
+        for spec in specs:
+            fut = loop.create_future()
+            if _writer is not None:
+                fut.add_done_callback(
+                    self._make_result_streamer(_writer, spec.task_id))
+            self.exec_queue.put(("task", spec, fut, loop))
+            futs.append(fut)
+        results = await asyncio.gather(*futs)
+        if _writer is not None:
+            # Results already streamed (and processed in-order before this
+            # reply); don't pickle them all a second time.
+            return ["__streamed__"] * len(results)
+        return results
+
+    handle_push_task_batch.rpc_pass_writer = True
+
+    async def handle_actor_task_batch(self, specs: List[TaskSpec],
+                                      _writer=None):
+        """Batched ordered actor calls with the same per-call result
+        streaming.  Async actors overlap the whole batch on their private
+        loop; threaded actors keep per-call dispatch so the batch doesn't
+        defeat max_concurrency."""
+        loop = asyncio.get_event_loop()
+        futs = []
+        for spec in specs:
+            if self.actor_spec is not None and self.actor_spec.is_async_actor:
+                fut = asyncio.ensure_future(self._run_async_actor_task(spec))
+            else:
+                fut = loop.create_future()
+                self.exec_queue.put(("task", spec, fut, loop))
+            if _writer is not None:
+                fut.add_done_callback(
+                    self._make_result_streamer(_writer, spec.task_id))
+            futs.append(fut)
+        results = list(await asyncio.gather(*futs))
+        if _writer is not None:
+            return ["__streamed__"] * len(results)
+        return results
+
+    handle_actor_task_batch.rpc_pass_writer = True
 
     async def handle_create_actor(self, spec: TaskSpec):
         fut = asyncio.get_event_loop().create_future()
@@ -1248,9 +1416,7 @@ class CoreWorker:
             kind, spec, fut, loop = item
             if kind == "exit":
                 break
-            if kind == "batch":
-                self._execute_batch_and_reply(spec, fut, loop)
-            elif (kind == "task" and self.actor_instance is not None
+            if (kind == "task" and self.actor_instance is not None
                     and self.actor_spec.max_concurrency > 1):
                 self._actor_threadpool.submit(self._execute_and_reply, spec, fut, loop)
             else:
@@ -1268,11 +1434,6 @@ class CoreWorker:
 
     def _execute_and_reply(self, spec: TaskSpec, fut, loop):
         results = self._execute_one(spec)
-        loop.call_soon_threadsafe(
-            lambda: fut.set_result(results) if not fut.done() else None)
-
-    def _execute_batch_and_reply(self, specs: List[TaskSpec], fut, loop):
-        results = [self._execute_one(s) for s in specs]
         loop.call_soon_threadsafe(
             lambda: fut.set_result(results) if not fut.done() else None)
 
@@ -1328,11 +1489,24 @@ class CoreWorker:
         args, kwargs = self._resolve_args(spec)
         token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,
                                    "actor_id": spec.actor_id, "name": spec.name})
+        # Execution joins the submitter's trace: spans opened by the task and
+        # any remote calls it makes chain under the task's span id.
+        from ray_tpu.util import tracing as _tracing
+        trace_id = (spec.trace_ctx[0] if spec.trace_ctx
+                    else spec.task_id.hex()[:12])
+        trace_token = _tracing.set_context((trace_id,
+                                            spec.task_id.hex()[:12]))
         try:
             out = fn(*args, **kwargs)
         finally:
+            _tracing.reset_context(trace_token)
             _task_context.reset(token)
-        return self._package_returns(spec, out)
+        results = self._package_returns(spec, out)
+        # Borrow notes for refs this task deserialized (and may retain, e.g.
+        # actor state) must be ACKED before the results ship — the submitter
+        # drops its argument pins as soon as it processes them.
+        self.flush_borrower_notes()
+        return results
 
     def _package_returns(self, spec: TaskSpec, out) -> List[tuple]:
         n = spec.num_returns
@@ -1349,9 +1523,30 @@ class CoreWorker:
             so = serialization.serialize(v)
             # Ship descriptors of any ObjectRefs inside the value so the
             # caller can register its borrows at receipt (see
-            # TaskManager.complete) instead of at deserialize time.
-            contained = [(r.id.binary(), r.owner or self.address)
-                         for r in so.contained_refs]
+            # TaskManager.complete).  For refs owned ELSEWHERE, place an
+            # ACKED escrow hold with the owner before this result ships:
+            # our own counts may hit zero right after the reply, and the
+            # hold keeps the object alive until the consumer registers its
+            # borrow and releases (no timing window; reference:
+            # reference_count.cc WaitForRefRemoved).
+            contained = []
+            for r in so.contained_refs:
+                r_owner = r.owner or self.address
+                hold_id = f"{self.worker_id.hex()[:12]}:{next(self._hold_seq)}"
+                if r_owner == self.address:
+                    # We own it: hold locally — our last local ref may die
+                    # the moment this function returns, and the consumer's
+                    # borrow note is still in flight.
+                    self._escrow_holds.setdefault(r.id, {})[hold_id] = (
+                        time.monotonic()
+                        + get_config().escrow_hold_expiry_s)
+                else:
+                    try:
+                        run_async(self.worker_clients.get(r_owner).call(
+                            "escrow_hold", object_id=r.id, hold_id=hold_id))
+                    except Exception:
+                        hold_id = None  # owner gone: nothing to protect
+                contained.append((r.id.binary(), r_owner, hold_id))
             size = so.flat_size()
             if size <= cfg.max_direct_call_object_size or self.agent is None:
                 results.append(("inline", so.to_bytes(), contained))
@@ -1397,14 +1592,18 @@ class CoreWorker:
         Arg resolution and result packaging must happen on the actor loop's
         thread too — they block on IO-loop round-trips (run_async), which would
         deadlock if done here on the IO loop thread itself."""
-        method = getattr(self.actor_instance, spec.actor_method)
 
         async def runner():
+            # getattr inside the per-spec error scope: a missing method must
+            # fail only ITS call, not every call batched with it.
+            method = getattr(self.actor_instance, spec.actor_method)
             args, kwargs = self._resolve_args(spec)
             res = method(*args, **kwargs)
             if asyncio.iscoroutine(res):
                 res = await res
-            return self._package_returns(spec, res)
+            results = self._package_returns(spec, res)
+            self.flush_borrower_notes()  # see _execute_task
+            return results
 
         cfut = asyncio.run_coroutine_threadsafe(runner(), self._actor_async_loop)
         try:

@@ -53,6 +53,9 @@ class WorkerHandle:
     idle_since: float = field(default_factory=time.monotonic)
     leased_at: float = 0.0           # last IDLE->LEASED transition
     registered: "asyncio.Event" = field(default_factory=asyncio.Event)
+    #: pip-env identity: workers run the env's venv interpreter and are only
+    #: leased to tasks with the same hash (None = the plain interpreter)
+    env_hash: Optional[str] = None
 
 
 @dataclass
@@ -93,6 +96,7 @@ class NodeAgent:
         self.worker_env = dict(worker_env or {})
         self._bg: List[asyncio.Task] = []
         self._pull_sem = asyncio.Semaphore(get_config().object_pull_max_concurrency)
+        self._inflight_pulls: Dict[ObjectID, "asyncio.Future"] = {}
         self._lease_counter = 0
         self._shutting_down = False
 
@@ -223,7 +227,23 @@ class NodeAgent:
 
     # ----------------------------------------------------------- worker pool
 
-    async def _spawn_worker(self, is_actor: bool = False) -> WorkerHandle:
+    async def _spawn_worker(self, is_actor: bool = False,
+                            runtime_env: Optional[dict] = None
+                            ) -> WorkerHandle:
+        from .runtime_env import materialize_pip_env, pip_env_hash
+        env_hash = pip_env_hash(runtime_env)
+        python_exe = sys.executable
+        if env_hash is not None:
+            # Build (or reuse) the env's venv off-loop — pip takes seconds —
+            # and launch the worker under its interpreter so the task sees
+            # the env's package versions, isolated from every other env
+            # (reference: _private/runtime_env/pip.py + worker startup).
+            from .common import RuntimeEnvSetupError
+            try:
+                python_exe = await asyncio.get_event_loop().run_in_executor(
+                    None, materialize_pip_env, self.session_dir, runtime_env)
+            except Exception as e:
+                raise RuntimeEnvSetupError(str(e)) from e
         worker_id = WorkerID.from_random().hex()
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -242,10 +262,10 @@ class NodeAgent:
         log = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
         logf = open(log, "ab", buffering=0)
         proc = await asyncio.create_subprocess_exec(
-            sys.executable, "-m", "ray_tpu.core.worker_main",
+            python_exe, "-m", "ray_tpu.core.worker_main",
             stdout=logf, stderr=logf, env=env)
         w = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid,
-                         is_actor=is_actor)
+                         is_actor=is_actor, env_hash=env_hash)
         self.workers[worker_id] = w
         asyncio.ensure_future(self._monitor_worker(w))
         return w
@@ -367,6 +387,7 @@ class NodeAgent:
         return None
 
     async def _grant_lease(self, resources, bundle, runtime_env) -> dict:
+        from .runtime_env import pip_env_hash
         pool = self._resource_pool_for(bundle)
         pool.acquire(resources)
         lease_id = self._next_lease_id()
@@ -375,9 +396,16 @@ class NodeAgent:
         else:
             self._lease_resources[lease_id] = {}
             self._bundle_of_lease[lease_id] = (tuple(bundle), dict(resources))
-        w = self._pop_idle_worker()
+        env_hash = pip_env_hash(runtime_env)
+        w = self._pop_idle_worker(env_hash)
         if w is None:
-            w = await self._spawn_worker()
+            try:
+                w = await self._spawn_worker(runtime_env=runtime_env)
+            except Exception:
+                # env materialization / spawn failed: the acquired resources
+                # must go back or the node bleeds capacity on every retry
+                self._release_lease_resources(lease_id)
+                raise
         w.state = "LEASED"
         w.leased_at = time.monotonic()
         w.lease_id = lease_id
@@ -406,10 +434,11 @@ class NodeAgent:
             self.available.release(self._lease_resources.get(lease_id, {}))
         self._lease_resources.pop(lease_id, None)
 
-    def _pop_idle_worker(self) -> Optional[WorkerHandle]:
+    def _pop_idle_worker(self, env_hash: Optional[str] = None
+                         ) -> Optional[WorkerHandle]:
         best = None
         for w in self.workers.values():
-            if w.state == "IDLE":
+            if w.state == "IDLE" and w.env_hash == env_hash:
                 if best is None or w.idle_since > best.idle_since:
                     best = w  # MRU: keep caches warm
         return best
@@ -491,6 +520,22 @@ class NodeAgent:
                         req.future.set_result(spill)
                     continue
             i += 1
+
+    async def handle_node_stacks(self) -> Dict[str, str]:
+        """Stack dumps of every registered worker on this node plus the
+        agent itself (reference: dashboard/modules/reporter stack traces)."""
+        from ray_tpu.util.debug import dump_all_stacks
+        out: Dict[str, str] = {}
+        out["agent"] = dump_all_stacks()
+        for w in list(self.workers.values()):
+            if not w.address:
+                continue
+            try:
+                out[f"worker-{w.worker_id[:12]}"] = await self.worker_clients \
+                    .get(w.address).call("dump_stacks", _timeout=5.0)
+            except Exception as e:  # noqa: BLE001
+                out[f"worker-{w.worker_id[:12]}"] = f"<unavailable: {e}>"
+        return out
 
     async def handle_kill_worker(self, worker_id: str, reason: str = ""):
         w = self.workers.get(worker_id)
@@ -619,10 +664,16 @@ class NodeAgent:
         return self.store.read_chunk(object_id, offset, length)
 
     async def handle_fetch_object(self, object_id: ObjectID, size: int,
-                                  locations: List[Tuple[str, str]]):
+                                  locations: List[Tuple[str, str]],
+                                  owner: Optional[str] = None):
         """Ensure `object_id` is in the local store, pulling from a remote node
         if needed. Returns {path, size} (reference: PullManager admission-
-        controlled prioritized pulls)."""
+        controlled prioritized pulls + PushManager chunked transfer).
+
+        Broadcast shape: the source location is picked at RANDOM from the
+        owner's list, and a completed pull REPORTS this node back to the
+        owner — so an N-node broadcast fans out over a doubling set of
+        sources (tree propagation) instead of hammering the origin."""
         if self.store.contains(object_id):
             path, sz = self.store.get_path(object_id)
             return {"path": path, "size": sz}
@@ -633,15 +684,40 @@ class NodeAgent:
             if await self.store.wait_sealed(object_id, 30.0):
                 path, sz = self.store.get_path(object_id)
                 return {"path": path, "size": sz}
+        # Dedup concurrent pulls of the same object: followers await the
+        # leader's transfer instead of pulling a second copy.
+        inflight = self._inflight_pulls.get(object_id)
+        if inflight is not None:
+            return dict(await asyncio.shield(inflight))
+        fut = asyncio.get_event_loop().create_future()
+        self._inflight_pulls[object_id] = fut
+        try:
+            res = await self._pull_object(object_id, size, locations, owner)
+            if not fut.done():
+                fut.set_result(res)
+            return res
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+            fut.exception()  # mark retrieved for followers that never await
+            raise
+        finally:
+            self._inflight_pulls.pop(object_id, None)
+
+    async def _pull_object(self, object_id: ObjectID, size: int,
+                           locations: List[Tuple[str, str]],
+                           owner: Optional[str]):
+        import random
         async with self._pull_sem:
             if self.store.contains(object_id):
                 path, sz = self.store.get_path(object_id)
                 return {"path": path, "size": sz}
             cfg = get_config()
             last_err: Optional[Exception] = None
-            for node_id, addr in locations:
-                if addr == self.server.address:
-                    continue
+            candidates = [(nid, addr) for nid, addr in locations
+                          if addr != self.server.address]
+            random.shuffle(candidates)
+            for node_id, addr in candidates:
                 client = self.agent_clients.get(addr)
                 try:
                     path = self.store.create(object_id, size)
@@ -673,6 +749,15 @@ class NodeAgent:
                         await asyncio.gather(*pulls, return_exceptions=True)
                         raise
                     self.store.seal(object_id)
+                    if owner:
+                        # register as a new source for later pullers
+                        try:
+                            await self.worker_clients.get(owner).notify(
+                                "add_object_location", object_id=object_id,
+                                node_id=self.node_id.hex(),
+                                address=self.server.address)
+                        except Exception:
+                            pass
                     path, sz = self.store.get_path(object_id)
                     return {"path": path, "size": sz}
                 except Exception as e:  # noqa: BLE001 — try next location

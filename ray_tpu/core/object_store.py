@@ -216,42 +216,52 @@ class NodeObjectStore:
                     capacity)
             except Exception:
                 self.pool = None
-        if self.pool is not None and cfg.object_store_prefault and \
-                hasattr(mmap, "MADV_POPULATE_WRITE"):
-            # Fault the arena's tmpfs pages in once at startup (plasma
-            # pre-touches its arena the same way): steady-state creates then
-            # cost an allocator call, and writers copy into already-resident
-            # pages at memcpy speed instead of page-fault speed.  Runs in a
-            # background thread, CHUNKED: madvise holds the GIL for the
-            # syscall's duration, so one whole-arena call would freeze the
-            # agent loop (capacity defaults to 30% of RAM).  The low region
-            # is prefaulted first — first-fit allocation reuses it most.
-            import threading
+        # Arena prefault is LAZY: triggered by the first create(), so a
+        # cluster that never touches plasma doesn't eagerly commit gigabytes
+        # of tmpfs RAM (see _maybe_start_prefault).
+        self._prefault_started = not (
+            self.pool is not None and cfg.object_store_prefault
+            and hasattr(mmap, "MADV_POPULATE_WRITE"))
 
-            def _prefault(path=self.pool.path,
-                          nbytes=min(capacity, 8 << 30)):
+    def _maybe_start_prefault(self):
+        """Fault the arena's tmpfs pages in once, on first use (plasma
+        pre-touches its arena the same way): steady-state creates then cost
+        an allocator call, and writers copy into already-resident pages at
+        memcpy speed instead of page-fault speed.  Runs in a background
+        thread, CHUNKED: madvise holds the GIL for the syscall's duration,
+        so one whole-arena call would freeze the agent loop (capacity
+        defaults to 30% of RAM).  The low region is prefaulted first —
+        first-fit allocation reuses it most."""
+        if self._prefault_started:
+            return
+        self._prefault_started = True
+        import threading
+
+        def _prefault(path=self.pool.path,
+                      nbytes=min(self.capacity, 8 << 30)):
+            try:
+                fd = os.open(path, os.O_RDWR)
                 try:
-                    fd = os.open(path, os.O_RDWR)
-                    try:
-                        mm = mmap.mmap(fd, nbytes)
-                    finally:
-                        os.close(fd)
-                    step = 128 << 20
-                    for off in range(0, nbytes, step):
-                        mm.madvise(mmap.MADV_POPULATE_WRITE, off,
-                                   min(step, nbytes - off))
-                        time.sleep(0)  # yield the GIL between chunks
-                    mm.close()
-                except Exception:
-                    pass
+                    mm = mmap.mmap(fd, nbytes)
+                finally:
+                    os.close(fd)
+                step = 128 << 20
+                for off in range(0, nbytes, step):
+                    mm.madvise(mmap.MADV_POPULATE_WRITE, off,
+                               min(step, nbytes - off))
+                    time.sleep(0)  # yield the GIL between chunks
+                mm.close()
+            except Exception:
+                pass
 
-            threading.Thread(target=_prefault, name="store-prefault",
-                             daemon=True).start()
+        threading.Thread(target=_prefault, name="store-prefault",
+                         daemon=True).start()
 
     # -- creation ---------------------------------------------------------
 
     def create(self, object_id: ObjectID, size: int) -> str:
         """Allocate a segment; returns the shm path the writer should mmap."""
+        self._maybe_start_prefault()
         if object_id in self._entries:
             return self._entries[object_id].segment.path
         if size > self.capacity:

@@ -28,6 +28,11 @@ def _wrap_args(args, kwargs):
     return wargs, wkwargs
 
 
+def _current_trace_ctx():
+    from ray_tpu.util import tracing
+    return tracing.current_context()
+
+
 _EMPTY_ARGS: Optional[bytes] = None
 
 
@@ -100,6 +105,9 @@ class RemoteFunction:
             resources["memory"] = float(o["memory"])
         strategy = o.get("scheduling_strategy", "DEFAULT")
         strategy = resolve_pg_strategy(strategy)
+        if o.get("runtime_env"):
+            from . import runtime_env as _renv
+            _renv.validate(o["runtime_env"])
         args_blob, arg_refs = serialize_args(args, kwargs)
         # Closure-captured refs are data dependencies exactly like argument
         # refs: they must be pinned until the task finishes, and the batch
@@ -120,6 +128,7 @@ class RemoteFunction:
             max_retries=o.get("max_retries", get_config().default_task_max_retries),
             retry_exceptions=bool(o.get("retry_exceptions", False)),
             runtime_env=o.get("runtime_env"),
+            trace_ctx=_current_trace_ctx(),
         )
         refs = w.submit_task(spec, arg_refs)
         if num_returns == 0:

@@ -85,6 +85,12 @@ class RemoteError(RpcError):
         self.cause = cause
         self.remote_traceback = tb
 
+    def __reduce__(self):
+        # Default exception reduce would replay __init__ with the formatted
+        # message only (TypeError on unpickle) — rebuild from the real parts
+        # so a RemoteError inside a shipped task-error blob round-trips.
+        return (RemoteError, (self.cause, self.remote_traceback))
+
 
 class RpcServer:
     """Dispatches ``(req_id, method, kwargs)`` to ``handler.handle_<method>`` coroutines."""
@@ -101,7 +107,11 @@ class RpcServer:
         return f"{self.host}:{self.port}"
 
     async def start(self):
-        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        # 16 MB stream buffer: the default 64 KB limit makes readexactly of
+        # multi-MB frames (object chunks) crawl through hundreds of tiny
+        # transport reads with pause/resume churn.
+        self._server = await asyncio.start_server(self._on_conn, self.host,
+                                                  self.port, limit=16 << 20)
         self.port = self._server.sockets[0].getsockname()[1]
         return self
 
@@ -137,6 +147,11 @@ class RpcServer:
     async def _dispatch(self, writer, req_id, method, kwargs):
         try:
             fn = getattr(self.handler, "handle_" + method)
+            if getattr(fn, "rpc_pass_writer", False):
+                # Handler streams interim server->client pushes on this
+                # connection (req_id -1 frames; the client routes them to
+                # its on_push handler) before the final reply.
+                kwargs["_writer"] = writer
             result = await fn(**kwargs)
             ok = True
         except BaseException as e:  # noqa: BLE001 — errors must travel back
@@ -209,7 +224,8 @@ class RpcClient:
                 return
             cfg = get_config()
             self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self._host, self._port),
+                asyncio.open_connection(self._host, self._port,
+                                        limit=16 << 20),
                 timeout=cfg.rpc_connect_timeout_s)
             asyncio.ensure_future(self._read_loop(self._reader))
 
@@ -282,15 +298,21 @@ class RpcClient:
 
 
 class ClientPool:
-    """Cache of RpcClients keyed by address (reference: rpc client pools)."""
+    """Cache of RpcClients keyed by address (reference: rpc client pools).
 
-    def __init__(self):
+    ``push_handler(topic, payload)``, when given, is installed on every
+    client so server-initiated pushes (streamed task results) are routed."""
+
+    def __init__(self, push_handler: Callable[[str, dict], None] | None = None):
         self._clients: Dict[str, RpcClient] = {}
+        self._push_handler = push_handler
 
     def get(self, address: str) -> RpcClient:
         c = self._clients.get(address)
         if c is None or c._closed:
             c = RpcClient(address)
+            if self._push_handler is not None:
+                c.on_push(self._push_handler)
             self._clients[address] = c
         return c
 

@@ -37,12 +37,87 @@ def _pack_dir(path: str) -> bytes:
 
 
 def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
-    known = {"py_modules", "env_vars", "working_dir"}
+    known = {"py_modules", "env_vars", "working_dir", "pip", "pip_args"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
                          f"(supported: {sorted(known)})")
     return runtime_env
+
+
+# ---------------------------------------------------------------------------
+# pip/venv isolation (reference: _private/runtime_env/pip.py + uri_cache.py)
+# ---------------------------------------------------------------------------
+
+def pip_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Cache key for a pip environment, or None when the env needs no
+    dedicated interpreter.  Workers are pooled per hash: tasks with the same
+    pip spec share venv workers; different specs never share a process."""
+    if not runtime_env or not runtime_env.get("pip"):
+        return None
+    import hashlib
+    spec = (sorted(runtime_env["pip"]),
+            list(runtime_env.get("pip_args") or []))
+    return hashlib.sha1(repr(spec).encode()).hexdigest()[:16]
+
+
+_venv_locks: Dict[str, Any] = {}
+_venv_guard = None
+
+
+def materialize_pip_env(session_dir: str, runtime_env: Dict[str, Any]) -> str:
+    """Build (or reuse) the venv for a pip runtime env; returns its python.
+
+    Node-local URI cache: one venv per spec hash under
+    ``{session_dir}/envs/{hash}`` with a ``.ready`` marker — concurrent
+    requests for the same hash build once (per-hash lock).  The venv sees
+    system site-packages (jax/numpy stay importable); pip installs overlay
+    them (reference: pip.py creates the same system-site virtualenv).
+    Runs in a worker thread — venv + pip take seconds."""
+    import subprocess
+    import sys
+    import threading
+    import venv as venv_mod
+
+    global _venv_guard
+    if _venv_guard is None:
+        _venv_guard = threading.Lock()
+    h = pip_env_hash(runtime_env)
+    env_dir = os.path.join(session_dir, "envs", h)
+    python = os.path.join(env_dir, "bin", "python")
+    marker = os.path.join(env_dir, ".ready")
+    with _venv_guard:
+        lock = _venv_locks.setdefault(h, threading.Lock())
+    with lock:
+        if os.path.exists(marker):
+            return python
+        venv_mod.create(env_dir, system_site_packages=True, with_pip=False,
+                        clear=True)
+        # The building interpreter may itself be a venv, whose packages
+        # system_site_packages does NOT expose (it points at the BASE
+        # prefix).  A .pth appends this process's site-packages so jax/
+        # numpy/cloudpickle stay importable; the env's own site-packages
+        # comes first on sys.path, so pip installs below shadow them.
+        import glob
+        import site
+        sp = glob.glob(os.path.join(env_dir, "lib", "python*",
+                                    "site-packages"))[0]
+        with open(os.path.join(sp, "_parent_sites.pth"), "w") as f:
+            f.write("\n".join(site.getsitepackages()))
+        # Install with the PARENT's pip targeting the env interpreter —
+        # avoids a slow ensurepip bootstrap per env.
+        cmd = [sys.executable, "-m", "pip", "--python", python, "install",
+               "--quiet", "--disable-pip-version-check"]
+        cmd += list(runtime_env.get("pip_args") or [])
+        cmd += list(runtime_env["pip"])
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip install failed for runtime env {h}: {proc.stderr[-2000:]}")
+        with open(marker, "w") as f:
+            f.write("ok")
+        return python
 
 
 def publish(gcs_call, job_id_hex: str, runtime_env: Dict[str, Any]):

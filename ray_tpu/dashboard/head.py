@@ -138,9 +138,42 @@ class DashboardHead:
         return _json(await _off(
             lambda: ray_tpu.get(ctrl.get_status.remote(), timeout=30)))
 
+    async def serve_deploy(self, req):
+        """Declarative deploy over REST (reference:
+        dashboard/modules/serve — PUT /api/serve/applications)."""
+        from ray_tpu.serve import schema as serve_schema
+        config = await req.json()
+        names = await _off(
+            lambda: serve_schema.deploy_config(config, blocking=False))
+        return _json({"deployed": names})
+
     async def timeline(self, _req):
         from ray_tpu.util.tracing import chrome_trace
         return _json(await _off(chrome_trace))
+
+    async def stacks(self, _req):
+        """Cluster-wide thread stacks (reference: dashboard reporter's
+        py-spy endpoint; here via each node agent's node_stacks)."""
+        import ray_tpu
+        from ray_tpu.core.rpc import RpcClient, run_async
+
+        def collect():
+            out = {}
+            for n in ray_tpu.nodes():
+                addr = n.get("AgentAddress")
+                if not (n.get("Alive") and addr):
+                    continue
+                try:
+                    client = RpcClient(addr)
+                    out[n["NodeID"][:12]] = run_async(
+                        client.call("node_stacks", _timeout=15.0),
+                        timeout=20)
+                    run_async(client.close(), timeout=2)
+                except Exception as e:  # noqa: BLE001
+                    out[n["NodeID"][:12]] = {"error": str(e)}
+            return out
+
+        return _json(await _off(collect))
 
     async def index(self, _req):
         from aiohttp import web
@@ -174,6 +207,8 @@ class DashboardHead:
         r.add_get("/api/jobs/{job_id}/logs", self.job_logs)
         r.add_post("/api/jobs/{job_id}/stop", self.job_stop)
         r.add_get("/api/serve", self.serve_status)
+        r.add_post("/api/serve/deploy", self.serve_deploy)
+        r.add_get("/api/stacks", self.stacks)
         r.add_get("/api/timeline", self.timeline)
         # Web UI (reference: dashboard/client React SPA; here a no-build
         # vanilla SPA served from package data over the same REST API).

@@ -72,9 +72,9 @@ const pages = {
     return h("div", {}, h("h2", {}, "Nodes"),
       table(["node id", "state", "address", "total", "available", "labels"],
         nodes.map((n) => [
-          (n.node_id || "").slice(0, 12), badge(n.alive ? "ALIVE" : "DEAD"),
-          n.address || "", fmtRes(n.total), fmtRes(n.available),
-          JSON.stringify(n.labels || {})])));
+          (n.NodeID || "").slice(0, 12), badge(n.Alive ? "ALIVE" : "DEAD"),
+          n.AgentAddress || "", fmtRes(n.Resources), fmtRes(n.Available),
+          JSON.stringify(n.Labels || {})])));
   },
 
   async actors() {

@@ -11,13 +11,16 @@ inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 (the reference's NCCL learner-group allreduce becomes a compiled psum).
 """
 
+from .conv import ActorCriticConv
 from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
 from .learner import Learner, LearnerGroup
-from .models import ActorCriticMLP
+from .models import ActorCriticMLP, build_model
 from .ppo import PPO, PPOConfig
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from .sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "QNetwork", "EnvRunner",
-           "Learner", "LearnerGroup", "ActorCriticMLP", "ReplayBuffer",
-           "PrioritizedReplayBuffer"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+           "QNetwork", "EnvRunner", "Learner", "LearnerGroup",
+           "ActorCriticMLP", "ActorCriticConv", "build_model",
+           "ReplayBuffer", "PrioritizedReplayBuffer"]

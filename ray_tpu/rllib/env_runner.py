@@ -21,11 +21,11 @@ class EnvRunner:
                  env_config: Optional[dict] = None):
         import gymnasium as gym
 
-        from .models import ActorCriticMLP
+        from .models import build_model
 
         self.envs = [gym.make(env_name, **(env_config or {}))
                      for _ in range(num_envs)]
-        self.model = ActorCriticMLP(**model_spec)
+        self.model = build_model(model_spec)
         # compiled once: a fresh jit(self.model.apply) per sample() would
         # retrace the policy on every rollout (bound methods never hit the
         # jit cache)

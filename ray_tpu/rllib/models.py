@@ -15,6 +15,16 @@ import jax.numpy as jnp
 Params = Dict[str, jnp.ndarray]
 
 
+def build_model(spec: Dict):
+    """Model factory (reference: rllib's catalog): a spec with
+    ``obs_shape`` builds the conv net (pixel obs); ``obs_dim`` builds the
+    MLP.  Specs are plain dicts so they ship to EnvRunner actors."""
+    if "obs_shape" in spec:
+        from .conv import ActorCriticConv
+        return ActorCriticConv(**spec)
+    return ActorCriticMLP(**spec)
+
+
 class ActorCriticMLP:
     """Shared-nothing actor-critic MLP: policy logits (discrete) or
     mean/log_std (continuous) + value head."""

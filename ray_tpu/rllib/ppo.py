@@ -84,19 +84,32 @@ class PPO:
 
         from .env_runner import EnvRunner as _ER
         from .learner import LearnerGroup
-        from .models import ActorCriticMLP
+        from .models import build_model
 
         self.config = config
         probe = gym.make(config.env_name, **config.env_config)
-        obs_dim = int(np.prod(probe.observation_space.shape))
+        obs_shape = probe.observation_space.shape
         continuous = not hasattr(probe.action_space, "n")
         action_dim = (probe.action_space.shape[0] if continuous
                       else int(probe.action_space.n))
         probe.close()
-        self.model_spec = dict(obs_dim=obs_dim, action_dim=action_dim,
-                               hidden=tuple(config.model["hidden"]),
-                               continuous=continuous)
-        model = ActorCriticMLP(**self.model_spec)
+        if config.model.get("conv") or len(obs_shape) == 3:
+            # pixel obs: Nature-CNN torso (Atari-class envs); filters /
+            # torso width overridable for small test grids
+            self.model_spec = dict(obs_shape=tuple(obs_shape),
+                                   action_dim=action_dim,
+                                   continuous=continuous)
+            if config.model.get("filters"):
+                self.model_spec["filters"] = tuple(
+                    tuple(f) for f in config.model["filters"])
+            if config.model.get("conv_hidden"):
+                self.model_spec["hidden"] = int(config.model["conv_hidden"])
+        else:
+            self.model_spec = dict(obs_dim=int(np.prod(obs_shape)),
+                                   action_dim=action_dim,
+                                   hidden=tuple(config.model["hidden"]),
+                                   continuous=continuous)
+        model = build_model(self.model_spec)
         self.learner_group = LearnerGroup(model, config.train,
                                           num_learners=config.num_learners,
                                           seed=config.seed)

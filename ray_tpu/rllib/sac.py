@@ -1,0 +1,440 @@
+"""SAC: off-policy maximum-entropy actor-critic for continuous control.
+
+Reference: ``rllib/algorithms/sac/`` (SACConfig/SAC over
+``algorithms/algorithm.py:191``).  Twin Q networks with target smoothing,
+a tanh-squashed Gaussian policy, and automatic entropy-temperature tuning
+(the three standard SAC components).  TPU-first shape: the whole update —
+twin-critic targets, actor reparameterized gradient, alpha step, soft
+target sync — is ONE jitted program; rollouts ride the same remote-runner
+pattern as DQN with replay on the driver.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+class SquashedGaussianPolicy:
+    """MLP -> (mean, log_std) -> tanh-squashed action in [-1, 1]^A."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(256, 256)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        sizes = (self.obs_dim,) + self.hidden + (2 * self.action_dim,)
+        params = {}
+        keys = jax.random.split(key, len(sizes))
+        for i in range(len(sizes) - 1):
+            scale = (2.0 / sizes[i]) ** 0.5 if i < len(sizes) - 2 else 0.01
+            params[f"w{i}"] = jax.random.normal(
+                keys[i], (sizes[i], sizes[i + 1])) * scale
+            params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+        return params
+
+    def forward(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        n = len(self.hidden)
+        for i in range(n):
+            x = jnp.maximum(x @ params[f"w{i}"] + params[f"b{i}"], 0.0)
+        out = x @ params[f"w{n}"] + params[f"b{n}"]
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample(self, params, obs, key):
+        """Reparameterized squashed sample -> (action, log_prob)."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = self.forward(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        # log prob with tanh change-of-variables (numerically stable form)
+        lp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+        lp -= (2 * (jnp.log(2.0) - pre - jax.nn.softplus(-2 * pre))).sum(-1)
+        return act, lp
+
+
+class QNetworkSA:
+    """Q(s, a) MLP (concatenated input)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(256, 256)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        sizes = (self.obs_dim + self.action_dim,) + self.hidden + (1,)
+        params = {}
+        keys = jax.random.split(key, len(sizes))
+        for i in range(len(sizes) - 1):
+            scale = (2.0 / sizes[i]) ** 0.5
+            params[f"w{i}"] = jax.random.normal(
+                keys[i], (sizes[i], sizes[i + 1])) * scale
+            params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+        return params
+
+    def apply(self, params, obs, act):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs, act], axis=-1)
+        n = len(self.hidden)
+        for i in range(n):
+            x = jnp.maximum(x @ params[f"w{i}"] + params[f"b{i}"], 0.0)
+        return (x @ params[f"w{n}"] + params[f"b{n}"])[..., 0]
+
+
+class SACRunner:
+    """Rollout actor: squashed-Gaussian exploration, env-scaled actions."""
+
+    def __init__(self, env_name: str, spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0,
+                 env_config: Optional[dict] = None):
+        import gymnasium as gym
+        import jax
+
+        self.envs = [gym.make(env_name, **(env_config or {}))
+                     for _ in range(num_envs)]
+        self.policy = SquashedGaussianPolicy(**spec)
+        self._sample = jax.jit(self.policy.sample)
+        self.num_envs = num_envs
+        space = self.envs[0].action_space
+        self.act_low = np.asarray(space.low, np.float32)
+        self.act_high = np.asarray(space.high, np.float32)
+        self._seed = seed
+        self._calls = 0
+        self.obs = np.stack([e.reset(seed=seed + i)[0]
+                             for i, e in enumerate(self.envs)],
+                            dtype=np.float32)
+        self._ep_returns = np.zeros(num_envs)
+        self._done_returns: List[float] = []
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return self.act_low + (a + 1.0) * 0.5 * (self.act_high - self.act_low)
+
+    def sample(self, params_blob, steps: int, random_actions: bool = False
+               ) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        params = jax.tree_util.tree_map(jnp.asarray, params_blob)
+        N = self.num_envs
+        T = max(1, steps // N)
+        A = self.policy.action_dim
+        rng = np.random.default_rng(self._seed * 7919 + self._calls)
+        self._calls += 1
+        buf = {
+            "obs": np.zeros((T * N,) + self.obs.shape[1:], np.float32),
+            "actions": np.zeros((T * N, A), np.float32),
+            "rewards": np.zeros((T * N,), np.float32),
+            "next_obs": np.zeros((T * N,) + self.obs.shape[1:], np.float32),
+            "dones": np.zeros((T * N,), np.float32),
+        }
+        k = 0
+        for t in range(T):
+            if random_actions:  # warmup: uniform in the squashed range
+                acts = rng.uniform(-1, 1, (N, A)).astype(np.float32)
+            else:
+                key = jax.random.PRNGKey((self._seed << 18) ^ self._calls
+                                         ^ (t << 1))
+                acts, _ = self._sample(params, jnp.asarray(self.obs), key)
+                acts = np.asarray(acts)
+            for i, env in enumerate(self.envs):
+                nobs, rew, term, trunc, _ = env.step(self._scale(acts[i]))
+                buf["obs"][k] = self.obs[i]
+                buf["actions"][k] = acts[i]
+                buf["rewards"][k] = rew
+                buf["next_obs"][k] = np.asarray(nobs, np.float32)
+                buf["dones"][k] = float(term)
+                self._ep_returns[i] += rew
+                if term or trunc:
+                    self._done_returns.append(self._ep_returns[i])
+                    self._ep_returns[i] = 0.0
+                    nobs, _ = env.reset()
+                self.obs[i] = np.asarray(nobs, np.float32)
+                k += 1
+        return buf
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._done_returns)
+        if clear:
+            self._done_returns.clear()
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class SACConfig:
+    """Builder (reference: SACConfig fluent API)."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 1
+        self.rollout_steps = 256
+        self.train: Dict[str, Any] = dict(
+            actor_lr=3e-4, critic_lr=3e-4, alpha_lr=3e-4, gamma=0.99,
+            tau=0.005, batch_size=256, train_iters=8,
+            target_entropy=None, init_alpha=0.1)
+        self.model: Dict[str, Any] = dict(hidden=(256, 256))
+        self.replay: Dict[str, Any] = dict(capacity=100_000,
+                                           learn_starts=1_000,
+                                           random_warmup=True)
+        self.seed = 0
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = env_config or {}
+        return self
+
+    def env_runners(self, num_env_runners: int = 1,
+                    num_envs_per_env_runner: int = 1,
+                    rollout_steps: int = 256):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_steps = rollout_steps
+        return self
+
+    def training(self, **kwargs):
+        model = kwargs.pop("model", None)
+        if model:
+            self.model.update(model)
+        replay = kwargs.pop("replay", None)
+        if replay:
+            self.replay.update(replay)
+        self.train.update(kwargs)
+        return self
+
+    def debugging(self, seed: int = 0):
+        self.seed = seed
+        return self
+
+    def build(self) -> "SAC":
+        if not self.env_name:
+            raise ValueError("call .environment(env_name) first")
+        return SAC(self)
+
+
+class SAC:
+    """Driver: stochastic rollouts -> replay -> one compiled SAC update."""
+
+    def __init__(self, config: SACConfig):
+        import gymnasium as gym
+        import jax
+
+        import ray_tpu
+
+        from .replay_buffer import ReplayBuffer
+
+        self.config = config
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = int(np.prod(probe.action_space.shape))
+        probe.close()
+        hidden = tuple(config.model["hidden"])
+        self.spec = dict(obs_dim=obs_dim, action_dim=action_dim,
+                         hidden=hidden)
+        self.policy = SquashedGaussianPolicy(**self.spec)
+        self.q1 = QNetworkSA(obs_dim, action_dim, hidden)
+        self.q2 = QNetworkSA(obs_dim, action_dim, hidden)
+        k = jax.random.split(jax.random.PRNGKey(config.seed), 3)
+        import jax.numpy as jnp
+
+        import optax
+        self.state = {
+            "pi": self.policy.init(k[0]),
+            "q1": self.q1.init(k[1]),
+            "q2": self.q2.init(k[2]),
+            "log_alpha": jnp.asarray(
+                np.log(config.train["init_alpha"]), jnp.float32),
+        }
+        self.state["q1_t"] = jax.tree_util.tree_map(lambda x: x,
+                                                    self.state["q1"])
+        self.state["q2_t"] = jax.tree_util.tree_map(lambda x: x,
+                                                    self.state["q2"])
+        t = config.train
+        self.opt = {
+            "pi": optax.adam(t["actor_lr"]),
+            "q": optax.adam(t["critic_lr"]),
+            "alpha": optax.adam(t["alpha_lr"]),
+        }
+        self.opt_state = {
+            "pi": self.opt["pi"].init(self.state["pi"]),
+            "q": self.opt["q"].init((self.state["q1"], self.state["q2"])),
+            "alpha": self.opt["alpha"].init(self.state["log_alpha"]),
+        }
+        self.target_entropy = (t["target_entropy"]
+                               if t["target_entropy"] is not None
+                               else -float(action_dim))
+        self._update = self._build_update()
+        self.buffer = ReplayBuffer(config.replay["capacity"],
+                                   seed=config.seed)
+        runner_cls = ray_tpu.remote(SACRunner)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, self.spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i,
+                env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self._iteration = 0
+        self._env_steps = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config.train
+        gamma, tau = cfg["gamma"], cfg["tau"]
+        policy, q1, q2 = self.policy, self.q1, self.q2
+        target_entropy = self.target_entropy
+        opt = self.opt
+
+        def update(state, opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(state["log_alpha"])
+
+            # --- critics
+            next_a, next_lp = policy.sample(state["pi"], batch["next_obs"],
+                                            k1)
+            q_next = jnp.minimum(
+                q1.apply(state["q1_t"], batch["next_obs"], next_a),
+                q2.apply(state["q2_t"], batch["next_obs"], next_a))
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                q_next - alpha * next_lp)
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(qs):
+                p1, p2 = qs
+                e1 = q1.apply(p1, batch["obs"], batch["actions"]) - target
+                e2 = q2.apply(p2, batch["obs"], batch["actions"]) - target
+                return (e1 ** 2).mean() + (e2 ** 2).mean()
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                (state["q1"], state["q2"]))
+            cup, q_opt = opt["q"].update(cgrads, opt_state["q"],
+                                         (state["q1"], state["q2"]))
+            new_q1, new_q2 = jax.tree_util.tree_map(
+                lambda p, u: p + u, (state["q1"], state["q2"]), cup)
+
+            # --- actor (reparameterized)
+            def actor_loss(pi_params):
+                a, lp = policy.sample(pi_params, batch["obs"], k2)
+                q = jnp.minimum(q1.apply(new_q1, batch["obs"], a),
+                                q2.apply(new_q2, batch["obs"], a))
+                return (alpha * lp - q).mean(), lp
+
+            (aloss, lp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state["pi"])
+            aup, pi_opt = opt["pi"].update(agrads, opt_state["pi"],
+                                           state["pi"])
+            new_pi = jax.tree_util.tree_map(lambda p, u: p + u,
+                                            state["pi"], aup)
+
+            # --- temperature
+            def alpha_loss(log_alpha):
+                return -(jnp.exp(log_alpha)
+                         * jax.lax.stop_gradient(lp + target_entropy)).mean()
+
+            _, algrad = jax.value_and_grad(alpha_loss)(state["log_alpha"])
+            alup, al_opt = opt["alpha"].update(algrad, opt_state["alpha"],
+                                               state["log_alpha"])
+            new_log_alpha = state["log_alpha"] + alup
+
+            new_state = {
+                "pi": new_pi, "q1": new_q1, "q2": new_q2,
+                "log_alpha": new_log_alpha,
+                "q1_t": jax.tree_util.tree_map(
+                    lambda t_, p: (1 - tau) * t_ + tau * p,
+                    state["q1_t"], new_q1),
+                "q2_t": jax.tree_util.tree_map(
+                    lambda t_, p: (1 - tau) * t_ + tau * p,
+                    state["q2_t"], new_q2),
+            }
+            new_opt = {"pi": pi_opt, "q": q_opt, "alpha": al_opt}
+            return new_state, new_opt, closs, aloss, alpha
+
+        import jax
+        return jax.jit(update)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        t0 = time.time()
+        cfg = self.config
+        warm = (cfg.replay.get("random_warmup", True)
+                and self._env_steps < cfg.replay["learn_starts"])
+        weights_ref = ray_tpu.put(jax.tree_util.tree_map(
+            np.asarray, self.state["pi"]))
+        per_runner = max(1, cfg.rollout_steps // cfg.num_env_runners)
+        batches = ray_tpu.get(
+            [r.sample.remote(weights_ref, per_runner, warm)
+             for r in self.runners], timeout=600)
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += len(b["rewards"])
+
+        closs = aloss = alpha_v = float("nan")
+        if len(self.buffer) >= cfg.replay["learn_starts"]:
+            for j in range(cfg.train["train_iters"]):
+                s = self.buffer.sample(cfg.train["batch_size"])
+                batch = {k: jnp.asarray(v) for k, v in s.items()
+                         if not k.startswith("_")}
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(cfg.seed), self._iteration * 131 + j)
+                (self.state, self.opt_state, closs, aloss,
+                 alpha_v) = self._update(self.state, self.opt_state, batch,
+                                         key)
+            closs, aloss, alpha_v = (float(closs), float(aloss),
+                                     float(alpha_v))
+
+        rets = [x for r in self.runners
+                for x in ray_tpu.get(r.episode_returns.remote(), timeout=60)]
+        self._recent_returns.extend(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "episodes_this_iter": len(rets),
+            "num_env_steps_sampled": self._env_steps,
+            "critic_loss": closs, "actor_loss": aloss, "alpha": alpha_v,
+            "replay_size": len(self.buffer),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def get_weights(self):
+        import jax
+        return jax.tree_util.tree_map(np.asarray, self.state["pi"])

@@ -187,6 +187,40 @@ def cmd_job(args):
         print(f"stopped {args.job_id}")
 
 
+def cmd_serve(args):
+    """Declarative serve flows (reference: serve/scripts.py
+    `serve deploy/run/status/shutdown`)."""
+    _connect()
+    from ray_tpu.serve import schema as serve_schema
+
+    if args.action == "deploy":
+        if not args.target:
+            raise SystemExit("usage: raytpu serve deploy CONFIG.yaml")
+        cfg = serve_schema.load_config(args.target)
+        names = serve_schema.deploy_config(cfg, blocking=not args.no_wait)
+        print(f"deployed: {', '.join(names)}")
+    elif args.action == "run":
+        if not args.target:
+            raise SystemExit("usage: raytpu serve run module:app")
+        from ray_tpu import serve as serve_api
+        app = serve_schema.build_application({"import_path": args.target})
+        serve_api.run(app, route_prefix=args.route_prefix or "/__auto__")
+        print(f"running {app.name} (ctrl-c to exit)")
+        try:
+            import time as _t
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    elif args.action == "status":
+        print(json.dumps(serve_schema.status_summary(), indent=2,
+                         default=str))
+    elif args.action == "shutdown":
+        from ray_tpu import serve as serve_api
+        serve_api.shutdown()
+        print("serve shut down")
+
+
 # ------------------------------------------------------------------ main
 
 def main(argv=None):
@@ -232,6 +266,15 @@ def main(argv=None):
                    choices=["list", "status", "logs", "stop"])
     s.add_argument("job_id", nargs="?")
     s.set_defaults(fn=cmd_job)
+
+    s = sub.add_parser("serve", help="declarative serve deploy/run/status")
+    s.add_argument("action",
+                   choices=["deploy", "run", "status", "shutdown"])
+    s.add_argument("target", nargs="?",
+                   help="config file (deploy) or module:app (run)")
+    s.add_argument("--route-prefix", default=None)
+    s.add_argument("--no-wait", action="store_true")
+    s.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     args.fn(args)

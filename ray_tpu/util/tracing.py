@@ -13,21 +13,54 @@ chrome://tracing or Perfetto.
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import os
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+#: Active trace context (trace_id, span_id) — flows through TaskSpec into
+#: remote execution (reference: tracing_helper.py's propagated span context),
+#: so a driver span, the tasks it submits, and THEIR nested submissions all
+#: share one trace id and chain parent ids.
+_ctx: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("raytpu_trace_ctx", default=None)
+
+
+def new_id() -> str:
+    return os.urandom(6).hex()
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    return _ctx.get()
+
+
+def set_context(ctx: Optional[Tuple[str, str]]):
+    """Install (trace_id, span_id) as the active context; returns the reset
+    token (used by the worker around task execution)."""
+    return _ctx.set(ctx)
+
+
+def reset_context(token):
+    _ctx.reset(token)
 
 
 @contextlib.contextmanager
 def span(name: str, **attributes) -> Iterator[None]:
     """User-code span: records begin/end into the task-event stream, so user
-    phases land in the same timeline as task state transitions."""
+    phases land in the same timeline as task state transitions.  Nested
+    spans and remote calls made inside chain to it via the context var."""
     from ray_tpu.core.core_worker import global_worker_or_none
 
     w = global_worker_or_none()
+    parent = _ctx.get()
+    trace_id = parent[0] if parent else new_id()
+    span_id = new_id()
+    token = _ctx.set((trace_id, span_id))
     t0 = time.time()
     try:
         yield
     finally:
+        _ctx.reset(token)
         if w is not None:
             try:
                 w._task_events.append({
@@ -38,6 +71,8 @@ def span(name: str, **attributes) -> Iterator[None]:
                     "actor_id": None,
                     "attributes": attributes or None,
                     "worker": w.worker_id.hex()[:12],
+                    "trace_id": trace_id, "span_id": span_id,
+                    "parent_id": parent[1] if parent else None,
                 })
             except Exception:
                 pass
@@ -45,6 +80,19 @@ def span(name: str, **attributes) -> Iterator[None]:
 
 def _pid_for(ev: dict) -> str:
     return ev.get("worker") or ev.get("node_id") or "driver"
+
+
+def _flow_events(out: List[dict], base: dict, ts_us: float, ev: dict):
+    """Chrome flow arrows: a slice with a span_id STARTS a flow under that
+    id; a slice with a parent_id FINISHES the parent's flow — the viewer
+    draws arrows from parent spans to the work they caused, across
+    processes."""
+    if ev.get("span_id"):
+        out.append({**base, "ph": "s", "cat": "flow", "ts": ts_us + 1,
+                    "id": ev["span_id"]})
+    if ev.get("parent_id"):
+        out.append({**base, "ph": "f", "bp": "e", "cat": "flow",
+                    "ts": ts_us + 1, "id": ev["parent_id"]})
 
 
 def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
@@ -64,21 +112,32 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
         us = ev.get("ts", 0.0) * 1e6
         base = {"pid": _pid_for(ev), "tid": _pid_for(ev),
                 "name": ev.get("name") or ev.get("task_id", "")[:12]}
+        trace_args = {k: ev[k] for k in ("trace_id", "span_id", "parent_id")
+                      if ev.get(k)}
         if state == "SPAN":
             out.append({**base, "ph": "X", "ts": us,
                         "dur": ev.get("dur", 0.0) * 1e6,
-                        "cat": "span", "args": ev.get("attributes") or {}})
+                        "cat": "span",
+                        "args": {**(ev.get("attributes") or {}),
+                                 **trace_args}})
+            _flow_events(out, base, us, ev)
         elif state == "RUNNING":
             running[ev.get("task_id")] = ev
         elif state in ("FINISHED", "FAILED"):
             start = running.pop(ev.get("task_id"), None)
             if start is not None:
+                start_us = start.get("ts", 0.0) * 1e6
                 out.append({**base, "ph": "X",
-                            "ts": start.get("ts", 0.0) * 1e6,
-                            "dur": max(us - start.get("ts", 0.0) * 1e6, 1.0),
+                            "ts": start_us,
+                            "dur": max(us - start_us, 1.0),
                             "cat": "task",
                             "args": {"state": state,
-                                     "task_id": ev.get("task_id")}})
+                                     "task_id": ev.get("task_id"),
+                                     **trace_args,
+                                     **{k: start[k] for k in
+                                        ("trace_id", "span_id", "parent_id")
+                                        if start.get(k)}}})
+                _flow_events(out, base, start_us, {**ev, **start})
             else:
                 out.append({**base, "ph": "i", "ts": us, "s": "t",
                             "cat": "task", "args": {"state": state}})

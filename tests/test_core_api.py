@@ -172,6 +172,33 @@ def test_num_returns_options(ray_start_regular):
     assert ray_tpu.get(list(r)) == [1, 2]
 
 
+def test_intra_batch_dependencies(ray_start_regular):
+    """Tasks batched onto one worker may depend on each other — directly,
+    through a closure capture, or through a ref hidden inside a put object.
+    Per-task result streaming (handle_push_task_batch) must keep all three
+    deadlock-free."""
+    @ray_tpu.remote
+    def produce():
+        return 7
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    # direct: consumer's arg is the producer's return, submitted back-to-back
+    r1 = produce.remote()
+    r2 = add.remote(r1, 1)
+    # indirect: the dependency rides inside a plain put() object
+    box = ray_tpu.put({"hidden": r1})
+
+    @ray_tpu.remote
+    def open_box(b):
+        return ray_tpu.get(b["hidden"]) + 100
+
+    r3 = open_box.remote(box)
+    assert ray_tpu.get([r2, r3], timeout=60) == [8, 107]
+
+
 def test_returned_ref_survives_escrow_grace():
     """Regression (round-2 ADVICE): a ref serialized in a task result must
     survive the owner's escrow grace even if the caller only deserializes it

@@ -78,9 +78,12 @@ def test_chrome_trace_and_spans(ray_start_regular, tmp_path):
     names = {e["name"] for e in trace if e["ph"] == "X"}
     assert "traced_task" in names, names
     assert "user-phase" in names, names
-    span_ev = next(e for e in trace if e["name"] == "user-phase")
+    span_ev = next(e for e in trace
+                   if e["name"] == "user-phase" and e["ph"] == "X")
     assert span_ev["dur"] > 0
-    assert span_ev["args"] == {"step": 1}
+    # user attributes survive; trace/span ids ride along for flow arrows
+    assert span_ev["args"]["step"] == 1
+    assert span_ev["args"].get("trace_id") and span_ev["args"].get("span_id")
 
     out = tracing.export_chrome_trace(str(tmp_path / "trace.json"))
     import json

@@ -1,0 +1,102 @@
+"""Escrow-hold protocol: ref hand-offs survive arbitrarily delayed borrower
+notes (reference: reference_count.cc WaitForRefRemoved bookkeeping — no
+timing grace)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.core_worker import CoreWorker
+from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+
+def test_delayed_borrow_note_no_premature_free(monkeypatch):
+    """Adversarial: the consumer's borrow registration (and with it the
+    escrow release) is delayed 3 s — far beyond the old 0.2 s grace below.
+    The producer's acked hold must keep the object alive regardless."""
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"ref_escrow_grace_s": 0.2})
+    try:
+        orig = CoreWorker.register_contained_borrow
+
+        def delayed(self, result_oid, cid, owner, hold_id=None):
+            threading.Timer(3.0, orig,
+                            args=(self, result_oid, cid, owner,
+                                  hold_id)).start()
+
+        monkeypatch.setattr(CoreWorker, "register_contained_borrow", delayed)
+
+        @ray_tpu.remote
+        def produce():
+            inner = ray_tpu.put(np.arange(500))
+            return {"ref": inner}  # worker-owned ref handed to the driver
+
+        res = produce.remote()
+        ray_tpu.wait([res], timeout=30)
+        # The producing worker's own counts hit zero right after the reply;
+        # without the hold the owner frees here (grace is only 0.2 s).
+        time.sleep(1.5)
+        val = ray_tpu.get(ray_tpu.get(res)["ref"], timeout=30)
+        np.testing.assert_array_equal(val, np.arange(500))
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_retained_arg_ref_survives_owner_release():
+    """A ref passed as an ARGUMENT and retained by the actor must survive the
+    driver dropping its own handle: the worker's borrow note is ACKED before
+    the call's results ship (flush_borrower_notes), so the owner can never
+    process its release first."""
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV))
+    try:
+        @ray_tpu.remote
+        class Keeper:
+            def store(self, boxed):
+                self.ref = boxed[0]  # nested ref passes through unresolved
+                return True
+
+            def load(self):
+                return ray_tpu.get(self.ref)
+
+        k = Keeper.remote()
+        obj = ray_tpu.put(np.arange(2000))
+        assert ray_tpu.get(k.store.remote([obj]), timeout=30)
+        del obj  # driver's last handle: owner counts drop to the borrow only
+        import gc
+        gc.collect()
+        time.sleep(1.0)
+        np.testing.assert_array_equal(
+            ray_tpu.get(k.load.remote(), timeout=30), np.arange(2000))
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_hold_expiry_reclaims_after_consumer_death():
+    """If no release ever arrives (consumer died), the expiry frees the
+    object instead of leaking it forever."""
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"escrow_hold_expiry_s": 1.0})
+    try:
+        w = ray_tpu.core.core_worker.global_worker()
+
+        @ray_tpu.remote
+        def count_owned():
+            return 0
+
+        # place a hold directly (as a producer would) with no releaser
+        from ray_tpu.core.ids import ObjectID
+        oid = ObjectID.from_random()
+        w.memory_store.put(oid, b"payload")
+        ray_tpu.core.rpc.run_async(w.handle_escrow_hold(oid, "h1"))
+        ray_tpu.core.rpc.run_async(w._free_owned(oid))
+        assert w.memory_store.contains(oid)  # hold blocks the free
+        time.sleep(1.6)  # expiry passes; the retry timer frees it
+        deadline = time.monotonic() + 5
+        while w.memory_store.contains(oid) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not w.memory_store.contains(oid)
+    finally:
+        ray_tpu.shutdown()

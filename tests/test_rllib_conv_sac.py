@@ -1,0 +1,92 @@
+"""Conv (Atari-capable) model path + SAC (reference:
+rllib/models/torch/visionnet.py, rllib/algorithms/sac)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+pytest.importorskip("gymnasium")
+
+import ray_tpu  # noqa: E402
+
+
+def test_conv_model_forward_and_grad():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.conv import ActorCriticConv
+
+    # Atari-shaped: 84x84x4 stacked frames, Nature filters
+    model = ActorCriticConv(obs_shape=(84, 84, 4), action_dim=6)
+    params = model.init(jax.random.PRNGKey(0))
+    obs = jnp.zeros((3, 84, 84, 4), jnp.uint8)
+    pi, v = model.apply(params, obs)
+    assert pi.shape == (3, 6) and v.shape == (3,)
+
+    def loss(p):
+        pi, v = model.apply(p, obs.astype(jnp.float32))
+        return (pi ** 2).mean() + (v ** 2).mean()
+
+    grads = jax.grad(loss)(params)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in
+               jax.tree_util.tree_leaves(grads))
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_ppo_conv_learns_catch(ray_start_regular):
+    """Pixel-observation learning smoke: the conv torso must beat the
+    random policy (~-0.8 mean return) decisively on the Catch env."""
+    from ray_tpu.rllib.ppo import PPOConfig
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+    algo = (PPOConfig()
+            .environment("ray_tpu.rllib.examples_env:Catch-v0")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(lr=3e-4,
+                      model=dict(conv=True, filters=((16, 4, 2), (32, 3, 1)),
+                                 conv_hidden=128),
+                      entropy_coeff=0.01)
+            .debugging(seed=0, worker_env=dict(CPU_WORKER_ENV))
+            .build())
+    try:
+        best = -9.0
+        for _ in range(80):
+            r = algo.train()
+            erm = r["episode_return_mean"]
+            if np.isfinite(erm):
+                best = max(best, erm)
+            if best >= 0.5:
+                break
+        assert best >= 0.5, f"conv PPO failed to learn Catch: best={best}"
+    finally:
+        algo.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_sac_learns_pendulum(ray_start_regular):
+    """SAC on Pendulum-v1: random policy sits near -1400; learning must
+    pull the 100-episode mean above -750 within ~10k env steps."""
+    from ray_tpu.rllib.sac import SACConfig
+
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .env_runners(rollout_steps=200)
+            .training(batch_size=128, train_iters=200,
+                      replay=dict(capacity=50_000, learn_starts=600))
+            .debugging(seed=0)
+            .build())
+    try:
+        best = -1e9
+        for _ in range(50):
+            r = algo.train()
+            erm = r["episode_return_mean"]
+            if np.isfinite(erm):
+                best = max(best, erm)
+            if best > -750.0:
+                break
+        assert best > -750.0, f"SAC failed to learn Pendulum: best={best}"
+        assert np.isfinite(r["critic_loss"]) and np.isfinite(r["alpha"])
+    finally:
+        algo.stop()

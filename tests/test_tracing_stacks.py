@@ -1,0 +1,69 @@
+"""Trace-context propagation across processes + cluster stack dumps
+(reference: util/tracing/tracing_helper.py, dashboard/modules/reporter)."""
+
+import json
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_trace_context_propagates_to_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def child():
+        with tracing.span("inside-child"):
+            time.sleep(0.01)
+        return tracing.current_context()
+
+    with tracing.span("driver-phase") as _:
+        driver_ctx = tracing.current_context()
+        ref = child.remote()
+    ctx_in_task = ray_tpu.get(ref, timeout=60)
+    # the task executed under the driver span's trace id
+    assert ctx_in_task[0] == driver_ctx[0]
+
+    # events flush to the GCS once per second; the merged chrome trace must
+    # contain the driver span, the task slice (joined to the trace), and the
+    # worker-side nested span with a parent chain
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        trace = tracing.chrome_trace()
+        spans = {e["name"]: e for e in trace if e.get("ph") == "X"}
+        if "driver-phase" in spans and "inside-child" in spans \
+                and "child" in spans:
+            break
+        time.sleep(0.5)
+    assert "driver-phase" in spans and "inside-child" in spans
+    tid = driver_ctx[0]
+    assert spans["child"]["args"].get("trace_id") == tid
+    assert spans["inside-child"]["args"].get("trace_id") == tid
+    # flow arrows exist for the parent/child links
+    assert any(e.get("ph") == "s" for e in trace)
+    assert any(e.get("ph") == "f" for e in trace)
+
+
+def test_cluster_stack_dump(ray_start_regular):
+    from ray_tpu.dashboard.head import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def busy():
+        time.sleep(5.0)
+        return 1
+
+    ref = busy.remote()
+    time.sleep(1.0)  # let it start
+    port = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/stacks", timeout=60) as r:
+            stacks = json.loads(r.read())
+        assert stacks, "no nodes reported"
+        node = next(iter(stacks.values()))
+        assert "agent" in node
+        worker_dumps = [v for k, v in node.items() if k.startswith("worker-")]
+        assert worker_dumps, "no worker stacks"
+        assert any("busy" in d or "sleep" in d for d in worker_dumps)
+    finally:
+        stop_dashboard()
+        ray_tpu.get(ref, timeout=30)
