@@ -214,11 +214,15 @@ class TaskManager:
                 if owner and owner != self._w.address:
                     self._w.register_contained_borrow(oid, ObjectID(idbin),
                                                       owner, hold_id)
-                elif hold_id:
-                    # Our own object round-tripped through the result: the
-                    # producer's hold sits with US — drop it (the ref's
-                    # local count keeps the object alive from here).
-                    self._w.release_local_hold(ObjectID(idbin), hold_id)
+                else:
+                    # Our own object round-tripped through the result: pin
+                    # it for the RESULT's lifetime (the caller may have
+                    # dropped its original handle already), then drop the
+                    # producer's hold.
+                    self._w.register_contained_borrow(oid, ObjectID(idbin),
+                                                      "", None)
+                    if hold_id:
+                        self._w.release_local_hold(ObjectID(idbin), hold_id)
         self.num_finished += 1
         if get_config().lineage_reconstruction_enabled and any(
                 r[0] == "plasma" for r in results):
@@ -443,18 +447,21 @@ class LeasePool:
                              worker_id=lw.worker_id, worker_alive=False)
         except Exception:
             pass
-        requeued = False
+        retries: List[TaskSpec] = []
         for spec in specs:
             retry_spec = self.w.task_manager.use_retry(spec.task_id)
             if retry_spec is not None:
-                self.queue.appendleft(retry_spec)
-                requeued = True
+                retries.append(retry_spec)
             else:
                 self.w.task_manager.fail(
                     spec.task_id,
                     WorkerCrashedError(f"worker {lw.worker_id[:12]} died running "
                                        f"{spec.name}: {err}"), "")
-        if requeued:
+        if retries:
+            # Keep ORIGINAL submission order at the queue head: batching
+            # assumes queue order == dependency order (a reversed requeue
+            # could batch a consumer ahead of its producer).
+            self.queue.extendleft(reversed(retries))
             await asyncio.sleep(get_config().task_retry_delay_s)
             self._pump()
 

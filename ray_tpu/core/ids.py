@@ -11,6 +11,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+from typing import Optional
 
 _NIL = b""
 
@@ -43,12 +44,13 @@ def _fast_unique16() -> bytes:
 
 class BaseID:
     SIZE = 16
-    __slots__ = ("_bin",)
+    __slots__ = ("_bin", "_h")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
             raise ValueError(f"{type(self).__name__} must be {self.SIZE} bytes, got {len(binary)}")
         self._bin = binary
+        self._h: Optional[int] = None
 
     @classmethod
     def from_random(cls):
@@ -75,7 +77,12 @@ class BaseID:
         return type(other) is type(self) and other._bin == self._bin
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bin))
+        # hot path (dict keys in refcounting/stores): cache; cross-type
+        # collisions are fine — __eq__ checks the type
+        h = self._h
+        if h is None:
+            h = self._h = hash(self._bin)
+        return h
 
     def __repr__(self):
         return f"{type(self).__name__}({self.hex()[:12]})"

@@ -42,6 +42,14 @@ def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
                          f"(supported: {sorted(known)})")
+    pip = runtime_env.get("pip")
+    if pip is not None and not (
+            isinstance(pip, str)
+            or (isinstance(pip, (list, tuple))
+                and all(isinstance(p, str) for p in pip))):
+        raise ValueError(
+            "runtime_env['pip'] must be a list of requirement strings or a "
+            f"requirements-file path, got {type(pip).__name__}")
     return runtime_env
 
 
@@ -56,7 +64,9 @@ def pip_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     if not runtime_env or not runtime_env.get("pip"):
         return None
     import hashlib
-    spec = (sorted(runtime_env["pip"]),
+    pip = runtime_env["pip"]
+    # string form = requirements-file path (Ray-compatible); list = reqs
+    spec = (pip if isinstance(pip, str) else sorted(pip),
             list(runtime_env.get("pip_args") or []))
     return hashlib.sha1(repr(spec).encode()).hexdigest()[:16]
 
@@ -83,41 +93,55 @@ def materialize_pip_env(session_dir: str, runtime_env: Dict[str, Any]) -> str:
     if _venv_guard is None:
         _venv_guard = threading.Lock()
     h = pip_env_hash(runtime_env)
-    env_dir = os.path.join(session_dir, "envs", h)
+    env_root = os.path.join(session_dir, "envs")
+    env_dir = os.path.join(env_root, h)
     python = os.path.join(env_dir, "bin", "python")
     marker = os.path.join(env_dir, ".ready")
     with _venv_guard:
         lock = _venv_locks.setdefault(h, threading.Lock())
-    with lock:
-        if os.path.exists(marker):
+    os.makedirs(env_root, exist_ok=True)
+    import fcntl
+    lock_file = open(os.path.join(env_root, f".{h}.lock"), "w")
+    try:
+        with lock:
+            # Cross-PROCESS exclusion too: every node agent of a local
+            # cluster shares one session_dir, and venv.create(clear=True)
+            # on a tree another agent is mid-install into destroys it.
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            if os.path.exists(marker):
+                return python
+            venv_mod.create(env_dir, system_site_packages=True,
+                            with_pip=False, clear=True)
+            # The building interpreter may itself be a venv, whose packages
+            # system_site_packages does NOT expose (it points at the BASE
+            # prefix).  A .pth appends this process's site-packages so jax/
+            # numpy/cloudpickle stay importable; the env's own site-packages
+            # comes first on sys.path, so pip installs below shadow them.
+            import glob
+            import site
+            sp = glob.glob(os.path.join(env_dir, "lib", "python*",
+                                        "site-packages"))[0]
+            with open(os.path.join(sp, "_parent_sites.pth"), "w") as f:
+                f.write("\n".join(site.getsitepackages()))
+            # Install with the PARENT's pip targeting the env interpreter —
+            # avoids a slow ensurepip bootstrap per env.  A string pip spec
+            # is a requirements-file path (reference API form).
+            cmd = [sys.executable, "-m", "pip", "--python", python,
+                   "install", "--quiet", "--disable-pip-version-check"]
+            cmd += list(runtime_env.get("pip_args") or [])
+            pip = runtime_env["pip"]
+            cmd += ["-r", pip] if isinstance(pip, str) else list(pip)
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip install failed for runtime env {h}: "
+                    f"{proc.stderr[-2000:]}")
+            with open(marker, "w") as f:
+                f.write("ok")
             return python
-        venv_mod.create(env_dir, system_site_packages=True, with_pip=False,
-                        clear=True)
-        # The building interpreter may itself be a venv, whose packages
-        # system_site_packages does NOT expose (it points at the BASE
-        # prefix).  A .pth appends this process's site-packages so jax/
-        # numpy/cloudpickle stay importable; the env's own site-packages
-        # comes first on sys.path, so pip installs below shadow them.
-        import glob
-        import site
-        sp = glob.glob(os.path.join(env_dir, "lib", "python*",
-                                    "site-packages"))[0]
-        with open(os.path.join(sp, "_parent_sites.pth"), "w") as f:
-            f.write("\n".join(site.getsitepackages()))
-        # Install with the PARENT's pip targeting the env interpreter —
-        # avoids a slow ensurepip bootstrap per env.
-        cmd = [sys.executable, "-m", "pip", "--python", python, "install",
-               "--quiet", "--disable-pip-version-check"]
-        cmd += list(runtime_env.get("pip_args") or [])
-        cmd += list(runtime_env["pip"])
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=600)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"pip install failed for runtime env {h}: {proc.stderr[-2000:]}")
-        with open(marker, "w") as f:
-            f.write("ok")
-        return python
+    finally:
+        lock_file.close()  # releases the flock
 
 
 def publish(gcs_call, job_id_hex: str, runtime_env: Dict[str, Any]):

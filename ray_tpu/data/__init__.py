@@ -97,6 +97,18 @@ def read_tfrecords(paths, *, parallelism: int = -1, raw: bool = False) -> Datase
                            parallelism=parallelism)
 
 
+def read_orc(paths, *, parallelism: int = -1, columns=None) -> Dataset:
+    from .datasource import ORCDatasource
+    return read_datasource(ORCDatasource(paths, columns=columns),
+                           parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    from .datasource import WebDatasetDatasource
+    return read_datasource(WebDatasetDatasource(paths),
+                           parallelism=parallelism)
+
+
 def read_sql(sql: str, connection_factory, *, shard_queries=None,
              parallelism: int = -1) -> Dataset:
     return read_datasource(
@@ -116,6 +128,7 @@ __all__ = [
     "read_datasource", "range", "range_tensor", "from_items", "from_pandas",
     "from_arrow", "from_numpy", "from_huggingface", "read_parquet", "read_csv",
     "read_json", "read_numpy", "read_binary_files", "read_text",
-    "read_tfrecords", "read_sql", "read_images", "TFRecordDatasource",
-    "SQLDatasource", "ImageDatasource",
+    "read_tfrecords", "read_sql", "read_images", "read_orc",
+    "read_webdataset", "TFRecordDatasource", "SQLDatasource",
+    "ImageDatasource",
 ]

@@ -327,6 +327,12 @@ class Dataset:
     def write_tfrecords(self, path: str, **kw):
         return self._write(path, "tfrecords", **kw)
 
+    def write_orc(self, path: str, **kw):
+        return self._write(path, "orc", **kw)
+
+    def write_webdataset(self, path: str, **kw):
+        return self._write(path, "tar", **kw)
+
     def write_sql(self, sql: str, connection_factory) -> int:
         """Execute ``sql`` (an INSERT with ? placeholders) once per row;
         returns rows written (reference: ``Dataset.write_sql``)."""

@@ -184,6 +184,48 @@ class ParquetDatasource(FileBasedDatasource):
         yield pq.read_table(path, columns=columns)
 
 
+class ORCDatasource(FileBasedDatasource):
+    """Apache ORC columnar files via pyarrow.orc (reference:
+    ``python/ray/data/read_api.py`` read_orc)."""
+
+    _FILE_EXTENSION = ".orc"
+
+    def _read_file(self, path):
+        from pyarrow import orc as porc
+        columns = self._reader_args.get("columns")
+        yield porc.read_table(path, columns=columns)
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """WebDataset-style tar shards: samples are groups of files sharing a
+    basename (``0001.jpg`` + ``0001.cls`` -> one row with columns per
+    extension) — the standard large-scale ML ingest container (reference:
+    ``python/ray/data/read_api.py`` read_webdataset; stdlib tarfile, no
+    webdataset dependency)."""
+
+    _FILE_EXTENSION = ".tar"
+
+    def _read_file(self, path):
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base, dot, ext = member.name.partition(".")
+                if not dot:
+                    base, ext = member.name, "data"
+                if base not in samples:
+                    samples[base] = {"__key__": base}
+                    order.append(base)
+                samples[base][ext] = tf.extractfile(member).read()
+        rows = [samples[k] for k in order]
+        if rows:
+            yield BlockAccessor.for_block(rows).to_arrow()
+
+
 class CSVDatasource(FileBasedDatasource):
     _FILE_EXTENSION = ".csv"
 
@@ -543,6 +585,25 @@ def write_block(block: Block, path: str, file_format: str, index: int,
         cols = acc.to_numpy()
         key = "data" if "data" in cols else list(cols)[0]
         np.save(fname[:-4], cols[key])
+    elif file_format == "orc":
+        from pyarrow import orc as porc
+        porc.write_table(acc.to_arrow(), fname, **writer_args)
+    elif file_format == "tar":  # webdataset shard
+        import io as _io
+        import tarfile
+        with tarfile.open(fname, "w") as tf:
+            for i, row in enumerate(acc.iter_rows()):
+                if not isinstance(row, dict):
+                    row = {"data": row}
+                key = row.get("__key__", f"{index:06d}{i:06d}")
+                for ext, payload in row.items():
+                    if ext == "__key__":
+                        continue
+                    if not isinstance(payload, bytes):
+                        payload = str(payload).encode()
+                    info = tarfile.TarInfo(f"{key}.{ext}")
+                    info.size = len(payload)
+                    tf.addfile(info, _io.BytesIO(payload))
     elif file_format == "tfrecords":
         with open(fname, "wb") as f:
             for row in acc.iter_rows():

@@ -25,6 +25,7 @@ class TransformerConfig:
     use_rope: bool = True       # False => learned positional embeddings (GPT-2)
     rope_theta: float = 500_000.0
     use_rmsnorm: bool = True    # False => LayerNorm with bias (GPT-2)
+    use_qkv_bias: bool = False  # True => biases on Q/K/V only (Qwen-2)
     use_swiglu: bool = True     # False => GELU MLP (GPT-2)
     tied_embeddings: bool = False
     # MoE (Mixtral): num_experts > 1 enables the sparse MLP
@@ -119,6 +120,23 @@ def mixtral_8x7b(max_seq_len: int = 8192) -> TransformerConfig:
         rope_theta=1_000_000.0, num_experts=8, experts_per_token=2)
 
 
+def gemma2_2b(max_seq_len: int = 8192) -> TransformerConfig:
+    """Gemma-2-2B-class: GQA, GeGLU-family MLP, attention logit softcapping
+    (the architectural marker of the family), tied embeddings."""
+    return TransformerConfig(
+        vocab_size=256128, num_layers=26, hidden_size=2304, num_heads=8,
+        num_kv_heads=4, mlp_size=9216, max_seq_len=max_seq_len,
+        rope_theta=10_000.0, attn_logit_softcap=50.0, tied_embeddings=True)
+
+
+def qwen2_7b(max_seq_len: int = 8192) -> TransformerConfig:
+    """Qwen-2-7B-class: Llama-like with QKV biases (use_qkv_bias marker)."""
+    return TransformerConfig(
+        vocab_size=152064, num_layers=28, hidden_size=3584, num_heads=28,
+        num_kv_heads=4, mlp_size=18944, max_seq_len=max_seq_len,
+        rope_theta=1_000_000.0, use_qkv_bias=True)
+
+
 def tiny(vocab: int = 256, layers: int = 2, hidden: int = 64, heads: int = 4,
          seq: int = 64, experts: int = 1) -> TransformerConfig:
     """Test-size config (CPU mesh)."""
@@ -135,5 +153,7 @@ PRESETS = {
     "llama-1b": llama_1b,
     "llama-400m": llama_400m,
     "mixtral-8x7b": mixtral_8x7b,
+    "gemma2-2b": gemma2_2b,
+    "qwen2-7b": qwen2_7b,
     "tiny": tiny,
 }

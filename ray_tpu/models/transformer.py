@@ -70,10 +70,12 @@ def init_params(key: jax.Array, cfg: TransformerConfig,
         },
         "mlp_norm": norm_p(),
     }
-    if not cfg.use_rmsnorm:  # GPT-2 style biases
+    if not cfg.use_rmsnorm or cfg.use_qkv_bias:
+        # GPT-2 style (all biases) or Qwen-2 style (Q/K/V biases only)
         blocks["attn"]["bq"] = jnp.zeros((L, nh * hd), dtype)
         blocks["attn"]["bk"] = jnp.zeros((L, nkv * hd), dtype)
         blocks["attn"]["bv"] = jnp.zeros((L, nkv * hd), dtype)
+    if not cfg.use_rmsnorm:
         blocks["attn"]["bo"] = jnp.zeros((L, h), dtype)
     if cfg.num_experts > 1:
         e = cfg.num_experts

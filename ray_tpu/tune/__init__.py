@@ -5,9 +5,9 @@ controller event loop → trials as actors; search spaces; ASHA / median /
 PBT schedulers; per-trial checkpoints; experiment state snapshots.
 """
 
-from .search import (BasicVariantGenerator, BOHBSearcher, Categorical,
-                     Domain, Float, GridSearch, Integer, Searcher,
-                     TPESearcher, choice, grid_search, lograndint,
+from .search import (BasicVariantGenerator, BayesOptSearcher, BOHBSearcher,
+                     Categorical, Domain, Float, GridSearch, Integer,
+                     Searcher, TPESearcher, choice, grid_search, lograndint,
                      loguniform, qloguniform, quniform, randint, randn,
                      sample_from, uniform)
 from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
@@ -23,7 +23,8 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TuneController", "Trial",
-    "Searcher", "BasicVariantGenerator", "TPESearcher", "uniform", "loguniform", "quniform",
+    "Searcher", "BasicVariantGenerator", "TPESearcher", "BayesOptSearcher",
+    "uniform", "loguniform", "quniform",
     "qloguniform", "randint", "lograndint", "choice", "sample_from", "randn",
     "grid_search", "Domain", "Float", "Integer", "Categorical", "GridSearch",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",

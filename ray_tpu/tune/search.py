@@ -448,3 +448,131 @@ class BOHBSearcher(TPESearcher):
         # release the live slot (do NOT double-append to _obs)
         self._live.pop(trial_id, None)
         self._latest.pop(trial_id, None)
+
+
+class BayesOptSearcher(Searcher):
+    """Gaussian-process Bayesian optimization with Expected Improvement.
+
+    Reference parity target: ``python/ray/tune/search/bayesopt``
+    (BayesOptSearch wraps the ``bayes_opt`` package); self-contained here
+    because external optimizer packages are not in this image.
+
+    Numeric dimensions map to the unit cube (log-scaled where the domain
+    is); categoricals map to their normalized index.  The surrogate is a GP
+    with an RBF kernel fit by Cholesky; the acquisition (EI) is maximized
+    over random candidates plus jittered copies of the incumbent.
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: Optional[str] = None,
+                 mode: str = "max", *, n_startup: int = 8,
+                 n_candidates: int = 256, length_scale: float = 0.25,
+                 noise: float = 1e-6, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = space
+        self.domains = _flatten_domains(space)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.rng = random.Random(seed)
+        self._live: Dict[str, Dict[Tuple[str, ...], Any]] = {}
+        self._X: List[List[float]] = []   # unit-cube coordinates
+        self._y: List[float] = []
+        self._flats: List[Dict[Tuple[str, ...], Any]] = []
+
+    # -- encoding ---------------------------------------------------------
+
+    def _encode_dim(self, dom: Domain, v) -> float:
+        base = dom.inner if isinstance(dom, Quantized) else dom
+        if isinstance(base, (Float, Integer)):
+            lo, hi = base.lower, base.upper
+            if base.log:
+                import math as m
+                return ((m.log(float(v)) - m.log(lo))
+                        / max(m.log(hi) - m.log(lo), 1e-12))
+            return (float(v) - lo) / max(hi - lo, 1e-12)
+        if isinstance(base, Categorical):
+            return base.categories.index(v) / max(len(base.categories), 1)
+        return 0.5
+
+    def _decode_dim(self, dom: Domain, z: float):
+        import math as m
+        z = min(max(z, 0.0), 1.0)
+        base = dom.inner if isinstance(dom, Quantized) else dom
+        if isinstance(base, (Float, Integer)):
+            lo, hi = base.lower, base.upper
+            v = (m.exp(m.log(lo) + z * (m.log(hi) - m.log(lo)))
+                 if base.log else lo + z * (hi - lo))
+            if isinstance(dom, Quantized):
+                v = round(v / dom.q) * dom.q
+            if isinstance(base, Integer):
+                v = int(min(max(round(v), base.lower), base.upper - 1))
+            else:
+                v = min(max(v, lo), hi)
+            return v
+        if isinstance(base, Categorical):
+            idx = int(z * len(base.categories))
+            return base.categories[min(idx, len(base.categories) - 1)]
+        return base.sample(self.rng)
+
+    # -- GP surrogate ------------------------------------------------------
+
+    def _posterior(self, Xc):
+        import numpy as np
+        X = np.asarray(self._X)
+        y = np.asarray(self._y, dtype=float)
+        mu0, sd = y.mean(), max(y.std(), 1e-9)
+        yn = (y - mu0) / sd
+        ls = self.length_scale
+
+        def k(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / ls ** 2)
+
+        K = k(X, X) + (self.noise + 1e-8) * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = k(np.asarray(Xc), X)
+        mean = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mean * sd + mu0, np.sqrt(var) * sd
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        params = list(self.domains)
+        if len(self._y) < self.n_startup:
+            flat = {p: d.sample(self.rng) for p, d in self.domains.items()}
+        else:
+            import numpy as np
+            rng = np.random.default_rng(self.rng.randrange(1 << 30))
+            cand = rng.uniform(0, 1, (self.n_candidates, len(params)))
+            best_x = np.asarray(self._X[int(np.argmax(self._y))])
+            jitter = np.clip(best_x[None]
+                             + rng.normal(0, 0.08, (32, len(params))), 0, 1)
+            Xc = np.concatenate([cand, jitter])
+            mean, std = self._posterior(Xc)
+            best = max(self._y)
+            z = (mean - best) / std
+            from math import erf, exp, pi, sqrt
+            pdf = np.exp(-0.5 * z ** 2) / sqrt(2 * pi)
+            cdf = 0.5 * (1 + np.vectorize(erf)(z / sqrt(2)))
+            ei = (mean - best) * cdf + std * pdf
+            x = Xc[int(np.argmax(ei))]
+            flat = {p: self._decode_dim(self.domains[p], x[i])
+                    for i, p in enumerate(params)}
+        self._live[trial_id] = flat
+        return _build_config(self.space, flat, self.rng)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        flat = self._live.pop(trial_id, None)
+        if flat is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._X.append([self._encode_dim(self.domains[p], flat[p])
+                        for p in self.domains])
+        self._y.append(score)
+        self._flats.append(flat)

@@ -34,6 +34,30 @@ def test_forward_llama_style():
     assert bool(jnp.isfinite(logits).all())
 
 
+def test_forward_gemma_style():
+    """Gemma-2 family markers: attention logit softcap + tied embeddings."""
+    cfg = tiny()
+    cfg = TransformerConfig(**{**cfg.__dict__, "attn_logit_softcap": 30.0,
+                               "tied_embeddings": True})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits, _ = apply(params, toks, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_forward_qwen_style():
+    """Qwen-2 family marker: QKV biases on an otherwise Llama-style net."""
+    cfg = tiny()
+    cfg = TransformerConfig(**{**cfg.__dict__, "use_qkv_bias": True})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert "bq" in params["blocks"]["attn"]
+    assert "bo" not in params["blocks"]["attn"]  # qkv-only, unlike GPT-2
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits, _ = apply(params, toks, cfg)
+    assert bool(jnp.isfinite(logits).all())
+
+
 def test_causal_masking():
     """Changing future tokens must not change current logits."""
     cfg = tiny()

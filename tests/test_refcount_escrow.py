@@ -74,6 +74,30 @@ def test_actor_retained_arg_ref_survives_owner_release():
         ray_tpu.shutdown()
 
 
+def test_self_owned_ref_roundtrip_survives_handle_drop():
+    """A driver-owned ref round-tripped through a task result must stay
+    alive as long as the RESULT does, even after the driver drops its own
+    handle: complete() pins self-owned contained refs for the result's
+    lifetime (no grace window exists anymore)."""
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV))
+    try:
+        @ray_tpu.remote
+        def echo_box(box):
+            return {"back": box["ref"]}
+
+        obj = ray_tpu.put(np.arange(777))
+        res = echo_box.remote({"ref": obj})
+        ray_tpu.wait([res], timeout=30)
+        del obj  # only the result's contained-borrow pin remains
+        import gc
+        gc.collect()
+        time.sleep(1.5)  # worker's remove-note lands; no grace protects us
+        val = ray_tpu.get(ray_tpu.get(res, timeout=30)["back"], timeout=30)
+        np.testing.assert_array_equal(val, np.arange(777))
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_hold_expiry_reclaims_after_consumer_death():
     """If no release ever arrives (consumer died), the expiry frees the
     object instead of leaking it forever."""

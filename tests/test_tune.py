@@ -226,6 +226,37 @@ def test_tpe_log_and_int_domains():
         sum(abs(v - 4) for v in layer_picks[:5]) / 5 + 0.5
 
 
+def test_bayesopt_searcher_converges():
+    """GP-EI must concentrate near the optimum after startup and beat the
+    startup phase (seeded, offline — parity target: tune.search.bayesopt)."""
+    from ray_tpu.tune import BayesOptSearcher
+
+    space = {"x": tune.uniform(-1.0, 1.0),
+             "lr": tune.loguniform(1e-4, 1.0),
+             "k": tune.choice(["a", "b"])}
+    import math
+
+    def score(cfg):
+        return (-(cfg["x"] - 0.25) ** 2
+                - 0.3 * (math.log10(cfg["lr"]) + 2) ** 2
+                - 0.1 * (cfg["k"] != "b"))
+
+    s = BayesOptSearcher(space, metric="obj", mode="max", n_startup=8, seed=3)
+    xs, best = [], -1e9
+    for i in range(40):
+        cfg = s.suggest(f"t{i}")
+        assert -1.0 <= cfg["x"] <= 1.0 and 1e-4 <= cfg["lr"] <= 1.0
+        xs.append(cfg["x"])
+        val = score(cfg)
+        best = max(best, val)
+        s.on_trial_complete(f"t{i}", {"obj": val})
+    startup_err = sum(abs(x - 0.25) for x in xs[:8]) / 8
+    late_err = sum(abs(x - 0.25) for x in xs[-10:]) / 10
+    assert late_err < startup_err, (
+        f"no exploitation: late {late_err:.3f} vs startup {startup_err:.3f}")
+    assert best > -0.08, f"best {best} too far from optimum"
+
+
 def test_experiment_resume(ray_start_regular, tmp_path):
     """Kill an experiment mid-flight; Tuner.restore must finish the
     interrupted trials from their checkpoints and keep finished results."""
