@@ -98,6 +98,9 @@ def main():
     p.add_argument("--seq", type=int, default=0)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--remat", default="save_acts",
+                   help="full|save_acts|save_mlp|dots|none — see "
+                        "models/transformer.py remat_policy")
     args = p.parse_args()
 
     import jax
@@ -123,7 +126,8 @@ def main():
     opt = make_optimizer(total_steps=max(args.steps + args.warmup, 10))
     t0 = time.time()
     state, sh = init_sharded_state(cfg, mesh, opt)
-    step = make_train_step(cfg, mesh, opt, sh)
+    remat = None if args.remat in ("none", "None") else args.remat
+    step = make_train_step(cfg, mesh, opt, sh, remat=remat)
     toks = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
                               cfg.vocab_size)
     batch_dict = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}

@@ -182,6 +182,198 @@ def _bwd_blockwise(q, k, v, out, lse, g, causal: bool, block_kv: int):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Pallas backward kernels
+# ---------------------------------------------------------------------------
+#
+# Both kernels compute the score tile TRANSPOSED — s_t = [block_kv(sublanes),
+# block_q(lanes)] — so the per-q-row statistics (lse, delta) enter as natural
+# [1, block_q] rows and broadcast over sublanes, which Mosaic supports
+# directly; no lane-replicated stat arrays and no [1,N]->[N,1] relayout.
+# Every matmul contracts either d or a block dim, all MXU-shaped.
+#
+# Grids iterate over BOTH block axes (q and kv) with an f32 VMEM scratch
+# accumulator initialised on the first visit of an output tile and flushed on
+# the last, so per-program VMEM is O(block) at any sequence length (the
+# first version loaded full-sequence K/V per program and died at S>=4096).
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, dq_ref,
+                   acc_ref, *, causal: bool, scale: float):
+    """Grid (b, h, n_q, n_kv): accumulate one q-block's dq over KV blocks."""
+    qi, kj = pl.program_id(2), pl.program_id(3)
+    n_kv = pl.num_programs(3)
+    block_q, d = q_ref.shape[2], q_ref.shape[3]
+    block_kv = k_ref.shape[2]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Under causal masking, KV blocks strictly past this q-block's diagonal
+    # contribute nothing: skip their compute (loads are pipelined anyway).
+    live = (kj * block_kv < (qi + 1) * block_q) if causal else (kj >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]                               # [bq, d]
+        g = g_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bkv, d]
+        v = v_ref[0, 0]
+        lse = lse_ref[0, 0]                           # [1, bq] f32
+        dlt = dlt_ref[0, 0]
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bkv, bq]
+        if causal:
+            k_pos = kj * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 1)
+            s_t = jnp.where(q_pos >= k_pos, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - lse)                              # [bkv, bq]
+        dp_t = jax.lax.dot_general(
+            v, g, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bkv, bq]
+        ds_t = p_t * (dp_t - dlt) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds_t.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, d]
+
+    @pl.when(kj == n_kv - 1)
+    def _flush():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                    scale: float):
+    """Grid (b, kv_heads, n_kv, reps, n_q): accumulate one kv-block's dk/dv
+    over q blocks and over the `reps` query heads sharing it (GQA fold-back).
+    The two innermost grid dims revisit the same output tile consecutively,
+    which is what makes the scratch init/flush pattern valid."""
+    ki, r, qj = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    n_rep, n_q = pl.num_programs(3), pl.num_programs(4)
+    block_kv, d = k_ref.shape[2], k_ref.shape[3]
+    block_q = q_ref.shape[2]
+
+    @pl.when((r == 0) & (qj == 0))
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # q blocks strictly before this kv-block's diagonal see none of it.
+    live = ((qj + 1) * block_q > ki * block_kv) if causal else (qj >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]                               # [bq, d]
+        g = g_ref[0, 0]
+        k = k_ref[0, 0]                               # [bkv, d]
+        v = v_ref[0, 0]
+        lse = lse_ref[0, 0]                           # [1, bq] f32
+        dlt = dlt_ref[0, 0]
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bkv, bq]
+        if causal:
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 0)
+            q_pos = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 1)
+            s_t = jnp.where(q_pos >= k_pos, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - lse)
+        dv_acc[...] += jax.lax.dot_general(
+            p_t.astype(g.dtype), g, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bkv, d]
+        dp_t = jax.lax.dot_general(
+            v, g, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - dlt) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bkv, d]
+
+    @pl.when((r == n_rep - 1) & (qj == n_q - 1))
+    def _flush():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, block_q: int,
+                      block_kv: int, interpret: bool):
+    """Pallas flash backward: (dq, dk, dv), dk/dv in kv-head layout."""
+    b, h, s, d = q.shape
+    kv_heads = k.shape[1]
+    reps = h // kv_heads
+    scale = d ** -0.5
+    bq = min(block_q, s)
+    bkv = min(block_kv, s)
+
+    gf = g.astype(q.dtype)
+    # D_i = rowsum(dO * O), the softmax-jacobian diagonal term.
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    # Stats ride as [B, H, 1, S] so the (1, 1, 1, bq) block satisfies the
+    # Mosaic tiling rule (second-to-last block dim == full array dim).
+    lse4 = lse[:, :, None, :]
+    dlt4 = delta[:, :, None, :]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        grid=(b, h, s // bq, s // bkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, hi, qi, kj: (bi, hi // reps, kj, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, hi, qi, kj: (bi, hi // reps, kj, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda bi, hi, qi, kj: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda bi, hi, qi, kj: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, gf, lse4, dlt4)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
+        grid=(b, kv_heads, s // bkv, reps, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, gi, ki, r, qj: (bi, gi * reps + r, qj, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, gi, ki, r, qj: (bi, gi, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, gi, ki, r, qj: (bi, gi, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, gi, ki, r, qj: (bi, gi * reps + r, qj, 0)),
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda bi, gi, ki, r, qj: (bi, gi * reps + r, 0, qj)),
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda bi, gi, ki, r, qj: (bi, gi * reps + r, 0, qj)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, gi, ki, r, qj: (bi, gi, ki, 0)),
+            pl.BlockSpec((1, 1, bkv, d),
+                         lambda bi, gi, ki, r, qj: (bi, gi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv_heads, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, kv_heads, s, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
+                        pltpu.VMEM((bkv, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, gf, lse4, dlt4)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_kv, interpret):
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv, interpret)
@@ -189,12 +381,25 @@ def _flash(q, k, v, causal, block_q, block_kv, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_kv, interpret):
+    from jax.ad_checkpoint import checkpoint_name
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_kv, interpret)
+    # Under `jax.checkpoint(policy=save_only_these_names(...))` these names let
+    # the remat replay keep the flash residuals instead of re-running the
+    # forward kernel (models/transformer.py REMAT_SAVE_NAMES).
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_kv, interpret, res, g):
     q, k, v, out, lse = res
+    s = q.shape[2]
+    bq, bkv = min(block_q, s), min(block_kv, s)
+    # bq rides the lane dim of the stat rows (must be 128-aligned); bkv the
+    # sublane dim of the transposed score tile.
+    if bq % 128 == 0 and bkv % 128 == 0 and s % bq == 0 and s % bkv == 0:
+        return _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q,
+                                 block_kv, interpret)
     return _bwd_blockwise(q, k, v, out, lse, g, causal, block_kv)
 
 

@@ -56,26 +56,34 @@ def merge_layers(params):
     return {**params, "blocks": jax.tree.map(fix, params["blocks"])}
 
 
-def pipeline_param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+def pipeline_param_specs(cfg: TransformerConfig,
+                         auto_axes: Tuple[str, ...] = ()) -> Dict[str, Any]:
     """PartitionSpec tree for stage-partitioned params: blocks get a leading
-    pp stage dim; embed/head/final-norm replicated (their grads psum across
-    stages through the shard_map in-spec transpose)."""
+    pp stage dim; embed/head/final-norm replicated across stages (their grads
+    psum through the shard_map in-spec transpose).
+
+    ``auto_axes`` retains those mesh axes from the logical (tensor-parallel)
+    specs — used to build the STATE sharding when the pipeline shard_map
+    leaves e.g. ``tp`` automatic (pp manual + tp compiler-inserted
+    collectives).  With the default empty tuple this is the manual in-spec
+    view: everything but pp/dp replicated."""
     base = shard_rules.logical_param_specs(cfg)
 
+    def keep(d):
+        return d if d in auto_axes else None
+
     def add_stage_dim(spec: P) -> P:
-        # original leading dim was the layer dim (None); keep per-layer dims'
-        # fsdp/tp sharding out of the shard_map path: inside shard_map only
-        # pp/dp are partitioned, so drop other axes here.
-        return P("pp", *[None] * len(spec))
+        # original leading dim was the layer dim (None).
+        return P("pp", *[keep(d) for d in spec])
 
     blocks = jax.tree.map(add_stage_dim, base["blocks"],
                           is_leaf=lambda x: isinstance(x, P))
 
-    def replicated(spec: P) -> P:
-        return P(*[None] * len(spec))
+    def outer(spec: P) -> P:
+        return P(*[keep(d) for d in spec])
 
     out = {k: (blocks if k == "blocks" else
-               jax.tree.map(replicated, v, is_leaf=lambda x: isinstance(x, P)))
+               jax.tree.map(outer, v, is_leaf=lambda x: isinstance(x, P)))
            for k, v in base.items()}
     return out
 
@@ -101,6 +109,11 @@ def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
     M = num_microbatches
     dp_axes = tuple(a for a in dp_axes if a in mesh.axis_names
                     and mesh.shape[a] > 1) or None
+    # Mesh axes the pipeline leaves to the COMPILER (tensor parallelism):
+    # pp/dp are manual (ppermute ring, loss psum); tp matmul collectives are
+    # inserted by XLA because the axis stays automatic under shard_map.
+    auto_axes = tuple(a for a in ("tp",) if a in mesh.axis_names
+                      and mesh.shape[a] > 1)
 
     pspec_tree = pipeline_param_specs(cfg)
     batch_dim = dp_axes if dp_axes and len(dp_axes) > 1 else (
@@ -174,11 +187,15 @@ def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
     param_specs = jax.tree.map(lambda s: s, pspec_tree,
                                is_leaf=lambda x: isinstance(x, P))
 
+    smap_kwargs: Dict[str, Any] = {}
+    if auto_axes:
+        manual = {pp_axis} | set(dp_axes or ())
+        smap_kwargs["axis_names"] = manual
     smapped = jax.shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, batch_spec, batch_spec),
         out_specs=(P(), P()),
-        check_vma=False)
+        check_vma=False, **smap_kwargs)
 
     def loss_fn(params, batch):
         if "targets" in batch:
@@ -206,7 +223,11 @@ def init_pp_state(cfg: TransformerConfig, mesh: Mesh,
         return TrainState(params=params, opt_state=optimizer.init(params),
                           step=jnp.zeros((), jnp.int32))
 
-    pspecs = pipeline_param_specs(cfg)
+    # State arrays keep their tensor-parallel sharding on top of the stage
+    # partition — the loss shard_map treats tp as an automatic axis.
+    auto = tuple(a for a in ("tp",) if a in mesh.axis_names
+                 and mesh.shape[a] > 1)
+    pspecs = pipeline_param_specs(cfg, auto_axes=auto)
     param_sh = named_sharding(mesh, pspecs)
     shapes = jax.eval_shape(init_fn)
     from .train_step import state_shardings as _ss  # reuse opt-state recursion

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -106,7 +106,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
                     state_sh: TrainState,
                     compute_dtype=jnp.bfloat16,
                     sp_axis: Optional[str] = None,
-                    remat: bool = True) -> Callable:
+                    remat: Union[bool, str, None] = True) -> Callable:
     """Returns jitted (state, batch) -> (state, metrics)."""
     pctx = ParallelContext(mesh=mesh, sp_axis=sp_axis,
                            batch_axes=shard_rules.BATCH_AXES)
@@ -132,8 +132,19 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
         out_shardings=(state_sh, None),
         donate_argnums=(0,))
 
+    # Multi-controller (jax.distributed across hosts): each process feeds its
+    # LOCAL slice of the global batch; device_put can't target non-addressable
+    # shards (reference seam: train/torch/config.py rendezvous — here the
+    # equivalent is the global-array assembly step).
+    multiprocess = len({d.process_index for d in mesh.devices.flat}) > 1
+
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
-        batch = {k: jax.device_put(v, batch_sh) for k, v in batch.items()}
+        import numpy as np
+        if multiprocess:
+            batch = {k: jax.make_array_from_process_local_data(
+                batch_sh, np.asarray(v)) for k, v in batch.items()}
+        else:
+            batch = {k: jax.device_put(v, batch_sh) for k, v in batch.items()}
         return jitted(state, batch)
 
     step._jitted = jitted

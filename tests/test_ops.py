@@ -57,6 +57,27 @@ def test_flash_gradients_match_plain(causal):
         assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("kv_heads", [2, 4])
+def test_flash_pallas_backward_matches_plain(causal, kv_heads):
+    """Blocks >= 128 take the Pallas dq/dkv kernels (not the scan fallback)."""
+    q, k, v = _qkv(S=256, KV=kv_heads)
+    gup = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+
+    def lf(q, k, v):
+        return (flash_attention(q, k, v, causal=causal,
+                                block_q=128, block_kv=128) * gup).sum()
+
+    def lr(q, k, v):
+        return (attend(q, k, v, causal=causal) * gup).sum()
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-9
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
+
+
 def test_flash_uneven_seq_falls_back():
     """Non-block-divisible shapes take the plain path, still correct."""
     q, k, v = _qkv(S=48)
