@@ -11,7 +11,8 @@ Top-level import is lightweight (no jax): the compute-path modules
 
 from .core import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                    NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
-                   ObjectLostError, ObjectRef, PlacementGroup,
+                   ObjectLostError, ObjectRef, ObjectRefGenerator, OutOfMemoryError,
+                   PlacementGroup,
                    PlacementGroupSchedulingStrategy, RayTpuError, TaskError,
                    WorkerCrashedError, as_future, available_resources, cancel,
                    cluster_resources, get, get_actor, get_async, get_runtime_context,
@@ -26,8 +27,9 @@ __all__ = [
     "kill", "cancel", "get_actor", "get_async", "as_future", "nodes",
     "cluster_resources", "available_resources", "timeline", "ObjectRef",
     "placement_group", "remove_placement_group", "placement_group_table",
-    "PlacementGroup", "get_runtime_context", "TaskError", "RayTpuError",
+    "PlacementGroup", "ObjectRefGenerator", "get_runtime_context", "TaskError", "RayTpuError",
     "ActorDiedError", "ActorUnavailableError", "GetTimeoutError", "ObjectLostError",
+    "OutOfMemoryError",
     "WorkerCrashedError", "NodeAffinitySchedulingStrategy",
     "NodeLabelSchedulingStrategy", "PlacementGroupSchedulingStrategy", "__version__",
 ]

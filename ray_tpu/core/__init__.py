@@ -10,8 +10,10 @@ from .api import (as_future, available_resources, cancel, cluster_resources, get
                   put, remote, shutdown, timeline, wait)
 from .common import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                      NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
-                     ObjectLostError, PlacementGroupSchedulingStrategy, RayTpuError,
+                     ObjectLostError, OutOfMemoryError,
+                     PlacementGroupSchedulingStrategy, RayTpuError,
                      TaskError, WorkerCrashedError)
+from .generator import ObjectRefGenerator
 from .object_ref import ObjectRef
 from .placement_group import (PlacementGroup, placement_group,
                               placement_group_table, remove_placement_group)
@@ -21,6 +23,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put", "wait",
     "kill", "cancel", "get_actor", "get_async", "as_future", "nodes",
     "cluster_resources", "available_resources", "timeline", "ObjectRef",
+    "ObjectRefGenerator", "OutOfMemoryError",
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroup", "get_runtime_context", "TaskError", "RayTpuError",
     "ActorDiedError", "ActorUnavailableError", "GetTimeoutError", "ObjectLostError",

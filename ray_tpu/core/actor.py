@@ -23,19 +23,23 @@ from .rpc import run_async
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, generator_backpressure: int = 0):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._generator_backpressure = generator_backpressure
 
     def options(self, **opts) -> "ActorMethod":
         m = ActorMethod(self._handle, self._name,
-                        opts.get("num_returns", self._num_returns))
+                        opts.get("num_returns", self._num_returns),
+                        opts.get("generator_backpressure",
+                                 self._generator_backpressure))
         return m
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(self._name, args, kwargs,
-                                           self._num_returns)
+                                           self._num_returns,
+                                           self._generator_backpressure)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -59,9 +63,13 @@ class ActorHandle:
                 f"actor has no method {item!r}; methods: {self._method_names}")
         return ActorMethod(self, item)
 
-    def _submit_method(self, method: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method: str, args, kwargs, num_returns,
+                       generator_backpressure: int = 0):
+        from .common import STREAMING_RETURNS
         from .core_worker import global_worker
         w = global_worker()
+        if num_returns in ("streaming", "dynamic"):
+            num_returns = STREAMING_RETURNS
         args_blob, arg_refs = serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -75,9 +83,12 @@ class ActorHandle:
             actor_id=ActorID.from_hex(self._actor_id),
             actor_method=method,
             max_retries=self._max_task_retries,
+            generator_backpressure=int(generator_backpressure or 0),
             trace_ctx=_current_trace_ctx(),
         )
         refs = w.submit_actor_task(self._actor_id, spec, arg_refs)
+        if num_returns == STREAMING_RETURNS:
+            return refs  # an ObjectRefGenerator
         if num_returns == 0:
             return None
         return refs[0] if num_returns == 1 else refs
@@ -128,7 +139,10 @@ class ActorClass:
                 if callable(m) and not n.startswith("_")]
 
     def _is_async(self) -> bool:
+        # async generator methods (streaming returns) make an actor async just
+        # like coroutine methods do.
         return any(inspect.iscoroutinefunction(m)
+                   or inspect.isasyncgenfunction(m)
                    for _, m in inspect.getmembers(self._cls) if callable(m))
 
     def remote(self, *args, **kwargs) -> ActorHandle:

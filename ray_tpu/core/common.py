@@ -47,6 +47,11 @@ SchedulingStrategy = Any  # "DEFAULT" | "SPREAD" | one of the dataclasses above
 # Task spec
 # ---------------------------------------------------------------------------
 
+# Sentinel num_returns for streaming-generator tasks (``num_returns="streaming"``):
+# return count is dynamic; yields become owner-owned objects as they arrive.
+STREAMING_RETURNS = -1
+
+
 @dataclass
 class TaskSpec:
     task_id: TaskID
@@ -79,6 +84,9 @@ class TaskSpec:
     is_actor_task: bool = False
     actor_method: Optional[str] = None
     seq_no: int = 0
+    #: streaming generators: pause the producer once this many yields are
+    #: unconsumed (0 = unbounded; reference: _generator_backpressure_num_objects)
+    generator_backpressure: int = 0
     #: propagated trace context (trace_id, parent_span_id) — reference:
     #: util/tracing/tracing_helper.py serialized span context in the spec
     trace_ctx: Optional[tuple] = None
@@ -126,6 +134,11 @@ class TaskError(RayTpuError):
         super().__init__(f"task {task_name!r} failed: {type(cause).__name__}: {cause}"
                          + (f"\n--- remote traceback ---\n{remote_tb}" if remote_tb else ""))
 
+    def __reduce__(self):
+        # args holds the formatted message, not the ctor signature — without
+        # this, a pickle round-trip re-feeds the message as `cause`.
+        return (type(self), (self.cause, self.task_name, self.remote_traceback))
+
 
 class RuntimeEnvSetupError(RayTpuError):
     """The task's runtime environment could not be built (e.g. pip install
@@ -137,10 +150,19 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class OutOfMemoryError(RayTpuError):
+    """The node's memory monitor killed the worker running this task
+    (reference: ray.exceptions.OutOfMemoryError + memory_monitor.h:52).
+    Retriable: the retry runs under relieved memory pressure."""
+
+
 class ActorDiedError(RayTpuError):
     def __init__(self, actor_id=None, msg: str = ""):
         self.actor_id = actor_id
         super().__init__(msg or f"actor {actor_id} died")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id, str(self)))
 
 
 class ActorUnavailableError(RayTpuError):
@@ -151,6 +173,9 @@ class ObjectLostError(RayTpuError):
     def __init__(self, object_id, msg=""):
         self.object_id = object_id
         super().__init__(msg or f"object {object_id} lost and could not be reconstructed")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, str(self)))
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

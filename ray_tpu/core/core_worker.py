@@ -38,10 +38,13 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import serialization
-from .common import (ActorDiedError, GetTimeoutError, NodeAffinitySchedulingStrategy,
-                     ObjectLostError, PlacementGroupSchedulingStrategy, TaskError,
-                     TaskSpec, WorkerCrashedError, _TopLevelRef)
+from .common import (STREAMING_RETURNS, ActorDiedError, GetTimeoutError,
+                     NodeAffinitySchedulingStrategy, ObjectLostError,
+                     OutOfMemoryError, PlacementGroupSchedulingStrategy,
+                     RayTpuError, TaskError, TaskSpec, WorkerCrashedError,
+                     _TopLevelRef)
 from .config import get_config
+from .generator import ObjectRefGenerator, StreamState
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .object_ref import ObjectRef
 from .object_store import ErrorRecord, MemoryStore, PlasmaRecord, ShmReader, ShmSegment
@@ -192,37 +195,62 @@ class TaskManager:
             self._w.reference_counter.remove_submitted(r.id, r.owner)
         pt.arg_refs = []
 
+    def register_result_borrows(self, oid: ObjectID, res: tuple):
+        """Register borrows for ObjectRefs serialized inside a result NOW
+        (at receipt), not when the user eventually deserializes them in
+        ray.get: the producer's counts may hit zero right after it
+        replies, and the escrow grace must only have to cover RPC
+        latency — not user think-time (reference: reference_count.cc
+        borrower bookkeeping; the round-1 grace-only scheme lost objects
+        gotten later than ref_escrow_grace_s after production)."""
+        for desc in _result_contained_refs(res):
+            idbin, owner = desc[0], desc[1]
+            hold_id = desc[2] if len(desc) > 2 else None
+            if owner and owner != self._w.address:
+                self._w.register_contained_borrow(oid, ObjectID(idbin),
+                                                  owner, hold_id)
+            else:
+                # Our own object round-tripped through the result: pin
+                # it for the RESULT's lifetime (the caller may have
+                # dropped its original handle already), then drop the
+                # producer's hold.
+                self._w.register_contained_borrow(oid, ObjectID(idbin),
+                                                  "", None)
+                if hold_id:
+                    self._w.release_local_hold(ObjectID(idbin), hold_id)
+
     def complete(self, task_id: TaskID, results: List[tuple]):
         pt = self.pending.pop(task_id, None)
         if pt is None:
             return
         self._release_args(pt)
         spec = pt.spec
+        if results and results[0][0] in ("gen_done", "gen_buffered"):
+            self._complete_stream(task_id, spec, results[0])
+            return
+        if spec.num_returns == STREAMING_RETURNS and results \
+                and results[0][0] == "error":
+            # The generator body raised: the error is the stream's last item
+            # (any yields that streamed before the raise stay consumable).
+            st = self._w.streams.get(task_id)
+            if st is not None:
+                self._w.memory_store.put(
+                    ObjectID.for_task_return(task_id, st.available),
+                    ErrorRecord(results[0][1]))
+                st.available += 1
+                st.total = st.available
+                st.signal()
+                if st.replay:
+                    # Failed reconstruction replay: no consumer to pop it
+                    # (same cleanup as the success and fail() paths).
+                    self._w.streams.pop(task_id, None)
+            self.num_failed += 1
+            self._w.task_event(spec, "FAILED")
+            return
         for i, res in enumerate(results):
             oid = ObjectID.for_task_return(task_id, i)
             self._w.store_task_result(oid, res)
-            # Register borrows for ObjectRefs serialized inside the result NOW
-            # (at receipt), not when the user eventually deserializes them in
-            # ray.get: the producer's counts may hit zero right after it
-            # replies, and the escrow grace must only have to cover RPC
-            # latency — not user think-time (reference: reference_count.cc
-            # borrower bookkeeping; the round-1 grace-only scheme lost objects
-            # gotten later than ref_escrow_grace_s after production).
-            for desc in _result_contained_refs(res):
-                idbin, owner = desc[0], desc[1]
-                hold_id = desc[2] if len(desc) > 2 else None
-                if owner and owner != self._w.address:
-                    self._w.register_contained_borrow(oid, ObjectID(idbin),
-                                                      owner, hold_id)
-                else:
-                    # Our own object round-tripped through the result: pin
-                    # it for the RESULT's lifetime (the caller may have
-                    # dropped its original handle already), then drop the
-                    # producer's hold.
-                    self._w.register_contained_borrow(oid, ObjectID(idbin),
-                                                      "", None)
-                    if hold_id:
-                        self._w.release_local_hold(ObjectID(idbin), hold_id)
+            self.register_result_borrows(oid, res)
         self.num_finished += 1
         if get_config().lineage_reconstruction_enabled and any(
                 r[0] == "plasma" for r in results):
@@ -231,14 +259,54 @@ class TaskManager:
                 self.lineage.popitem(last=False)
         self._w.task_event(spec, "FINISHED")
 
+    def _complete_stream(self, task_id: TaskID, spec: TaskSpec, res: tuple):
+        """A streaming task finished: fix the stream's final length.
+        ("gen_buffered", [...]) is the no-live-writer fallback — yields
+        arrive here all at once instead of having streamed."""
+        st = self._w.streams.get(task_id)
+        if res[0] == "gen_buffered":
+            for i, r in enumerate(res[1]):
+                self._w._on_gen_yield(task_id, i, r, "")
+            total = len(res[1])
+        else:
+            total = res[1]
+        self.num_finished += 1
+        if st is not None:
+            st.total = total
+            st.signal()
+            if st.any_plasma and get_config().lineage_reconstruction_enabled:
+                self.lineage[task_id] = spec
+                while len(self.lineage) > 10000:
+                    self.lineage.popitem(last=False)
+            if st.replay:
+                # Reconstruction replay: no consumer will ever pop it.
+                self._w.streams.pop(task_id, None)
+        self._w.task_event(spec, "FINISHED")
+
     def fail(self, task_id: TaskID, exc: BaseException, tb: str = ""):
         pt = self.pending.pop(task_id, None)
         if pt is None:
             return
         self._release_args(pt)
-        err = ErrorRecord(pickle.dumps((exc, tb)))
+        # fail() is only reached for runtime-detected faults (worker death,
+        # OOM kill, retries exhausted) — never for a task body's own raise,
+        # which ships through the ("error", blob) result path.
+        err = ErrorRecord(pickle.dumps((exc, tb)), system=True)
         for i in range(pt.spec.num_returns):
             self._w.memory_store.put(ObjectID.for_task_return(task_id, i), err)
+        st = self._w.streams.get(task_id)
+        if st is not None:
+            # Streaming semantics: the error becomes the stream's LAST item —
+            # next() returns a ref whose get raises, then StopIteration
+            # (matches the reference's generator error delivery).
+            self._w.memory_store.put(
+                ObjectID.for_task_return(task_id, st.available), err)
+            st.available += 1
+            st.total = st.available
+            st.signal()
+            if st.replay:
+                # Failed reconstruction replay: no consumer exists to pop it.
+                self._w.streams.pop(task_id, None)
         self.num_failed += 1
         self._w.task_event(pt.spec, "FAILED", error=repr(exc))
 
@@ -255,6 +323,11 @@ class TaskManager:
         if pt.retries_left > 0:
             pt.retries_left -= 1
         pt.spec.retry_count += 1
+        st = self._w.streams.get(task_id)
+        if st is not None:
+            # The retried generator replays from yield 0; unconsumed indexes
+            # will be overwritten as the fresh run re-produces them.
+            st.reset_for_retry()
         return pt.spec
 
 
@@ -421,10 +494,13 @@ class LeasePool:
         for spec in specs:
             self.w.task_event(spec, "RUNNING", node_id=lw.node_id)
         try:
-            if len(specs) == 1:
+            if (len(specs) == 1
+                    and specs[0].num_returns != STREAMING_RETURNS):
                 results_list = [await client.call("push_task", spec=specs[0],
                                                   _timeout=86400.0)]
             else:
+                # Batch RPC even for one task when it streams: only the batch
+                # handler has the live writer that yield frames ride on.
                 results_list = await client.call("push_task_batch",
                                                  specs=specs,
                                                  _timeout=86400.0)
@@ -441,10 +517,13 @@ class LeasePool:
     async def _on_worker_failure(self, lw: LeasedWorker, specs: List[TaskSpec],
                                  err: Exception):
         self.leased.pop(lw.lease_id, None)
+        death_cause = None
         try:
             agent = self.w.agent_clients.get(lw.agent_address)
-            await agent.call("return_worker_lease", lease_id=lw.lease_id,
-                             worker_id=lw.worker_id, worker_alive=False)
+            res = await agent.call("return_worker_lease", lease_id=lw.lease_id,
+                                   worker_id=lw.worker_id, worker_alive=False)
+            if isinstance(res, dict):
+                death_cause = res.get("death_cause")
         except Exception:
             pass
         retries: List[TaskSpec] = []
@@ -452,6 +531,13 @@ class LeasePool:
             retry_spec = self.w.task_manager.use_retry(spec.task_id)
             if retry_spec is not None:
                 retries.append(retry_spec)
+            elif death_cause:
+                # The agent killed this worker deliberately (memory monitor):
+                # typed, policy-naming error (reference: OutOfMemoryError).
+                self.w.task_manager.fail(
+                    spec.task_id,
+                    OutOfMemoryError(f"task {spec.name} failed: {death_cause}"),
+                    "")
             else:
                 self.w.task_manager.fail(
                     spec.task_id,
@@ -549,6 +635,11 @@ class CoreWorker:
         self._submit_lock = threading.Lock()
         self._submit_flush_scheduled = False
         self.fn_cache: Dict[bytes, Any] = {}
+        # Streaming-generator state: owner side (task_id -> StreamState for
+        # tasks WE submitted) and executor side (task_id -> _GenEmitter for
+        # streaming tasks we are currently RUNNING).
+        self.streams: Dict[TaskID, "StreamState"] = {}
+        self._gen_emitters: Dict[TaskID, "_GenEmitter"] = {}
         self._view_cache: Tuple[float, Dict[str, NodeView]] = (0.0, {})
         self._task_events: List[dict] = []
         self._bg: List[asyncio.Task] = []
@@ -719,6 +810,13 @@ class CoreWorker:
             exc, tb = pickle.loads(record.error)
             if isinstance(exc, TaskError):
                 raise exc
+            if record.system and isinstance(exc, RayTpuError):
+                # Runtime-recorded faults (OutOfMemoryError, WorkerCrashed,
+                # ActorDied, …) surface typed, not wrapped — matches
+                # ray.exceptions semantics.  A task BODY that lets a
+                # RayTpuError propagate still wraps in TaskError below, so
+                # the failure stays attributed to the raising task.
+                raise exc
             raise TaskError(exc, ref.hex()[:12], tb) from None
         if record == serialization.none_bytes():
             return None
@@ -759,7 +857,7 @@ class CoreWorker:
                     return PlasmaRecord(rec[1], rec[2])
                 if rec[0] == "inline":
                     return rec[1]
-                return ErrorRecord(rec[1])
+                return ErrorRecord(rec[1], rec[2] if len(rec) > 2 else False)
 
     async def _record_to_value(self, ref: ObjectRef, record) -> Any:
         if isinstance(record, PlasmaRecord):
@@ -886,18 +984,26 @@ class CoreWorker:
 
     # ------------------------------------------------------------ submission
 
-    def submit_task(self, spec: TaskSpec, arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+    def submit_task(self, spec: TaskSpec, arg_refs: List[ObjectRef]):
         """Fire-and-forget: bookkeeping happens on the calling thread (dict
         ops under the GIL), dispatch hops to the IO loop without waiting for
         it.  Blocking the caller on a cross-thread round trip per submission
         capped async task throughput at ~1k/s (reference: task submission is
-        likewise a non-blocking enqueue, direct_task_transport.h:75)."""
-        refs = [ObjectRef(oid, owner=self.address)
-                for oid in spec.return_ids()]
+        likewise a non-blocking enqueue, direct_task_transport.h:75).
+
+        Returns a list of ObjectRefs, or an ObjectRefGenerator for
+        ``num_returns="streaming"`` tasks."""
+        if spec.num_returns == STREAMING_RETURNS:
+            self.streams[spec.task_id] = StreamState(
+                spec.task_id, spec.generator_backpressure)
+            ret = ObjectRefGenerator(self, spec.task_id)
+        else:
+            ret = [ObjectRef(oid, owner=self.address)
+                   for oid in spec.return_ids()]
         self.task_manager.add_pending(spec, arg_refs)
         self.task_event(spec, "SUBMITTED")
         self._enqueue_submit(("task", spec))
-        return refs
+        return ret
 
     def _enqueue_submit(self, item: tuple):
         with self._submit_lock:
@@ -961,15 +1067,21 @@ class CoreWorker:
         return aid
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,
-                          arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+                          arg_refs: List[ObjectRef]):
         """Fire-and-forget like submit_task: enqueue into the target's
         ordered outbox on the IO loop; the per-target pump batches and
-        sends."""
-        refs = [ObjectRef(oid, owner=self.address) for oid in spec.return_ids()]
+        sends.  Streaming methods return an ObjectRefGenerator."""
+        if spec.num_returns == STREAMING_RETURNS:
+            self.streams[spec.task_id] = StreamState(
+                spec.task_id, spec.generator_backpressure)
+            ret = ObjectRefGenerator(self, spec.task_id)
+        else:
+            ret = [ObjectRef(oid, owner=self.address)
+                   for oid in spec.return_ids()]
         self.task_manager.add_pending(spec, arg_refs)
         self.task_event(spec, "SUBMITTED")
         self._enqueue_submit(("actor", actor_id, spec))
-        return refs
+        return ret
 
     async def _actor_pump(self, actor_id: str, tgt: ActorTarget):
         try:
@@ -1021,10 +1133,13 @@ class CoreWorker:
                 s.seq_no = tgt.seq = tgt.seq + 1
                 self.task_event(s, "RUNNING")
             try:
-                if len(specs) == 1:
+                if (len(specs) == 1
+                        and specs[0].num_returns != STREAMING_RETURNS):
                     results_list = [await client.call(
                         "actor_task", spec=specs[0], _timeout=86400.0)]
                 else:
+                    # Batch RPC even for one call when it streams: only the
+                    # batch handler holds the writer yield frames ride on.
                     results_list = await client.call(
                         "actor_task_batch", specs=specs, _timeout=86400.0)
             except (ConnectionLost, OSError):
@@ -1282,7 +1397,7 @@ class CoreWorker:
         if isinstance(rec, PlasmaRecord):
             return ("plasma", rec.size, rec.locations)
         if isinstance(rec, ErrorRecord):
-            return ("error", rec.error)
+            return ("error", rec.error, rec.system)
         return ("inline", rec)
 
     async def handle_get_object(self, object_id: ObjectID):
@@ -1295,6 +1410,20 @@ class CoreWorker:
         self.memory_store.free(object_id)
         resub = pickle.loads(pickle.dumps(spec))
         resub.retry_count += 1
+        if resub.num_returns == STREAMING_RETURNS:
+            live = self.streams.get(resub.task_id)
+            if live is not None:
+                # A consumer still holds this stream: keep its cursor and
+                # let the replay overwrite unconsumed indexes (the task-retry
+                # contract) — installing a replay state here would rewind the
+                # consumer to index 0 and then vanish mid-iteration.
+                live.reset_for_retry()
+            else:
+                # Consumer long gone; a fresh replay-mode StreamState so
+                # _on_gen_yield re-stores every yield (only block refs live).
+                st = StreamState(resub.task_id, resub.generator_backpressure)
+                st.replay = True
+                self.streams[resub.task_id] = st
         self.task_manager.add_pending(resub, [])
         self._submit_spec(resub)
         return True
@@ -1318,6 +1447,18 @@ class CoreWorker:
         self.exec_queue.put(("task", spec, fut, asyncio.get_event_loop()))
         return await fut
 
+    def register_gen_emitter(self, spec: TaskSpec, writer, loop):
+        """Executor side: wire a streaming task to the live batch connection
+        before it runs (called from the batch RPC handlers, on the IO loop)."""
+        if spec.num_returns == STREAMING_RETURNS and writer is not None:
+            self._gen_emitters[spec.task_id] = _GenEmitter(writer, loop)
+
+    async def handle_generator_ack(self, task_id: TaskID, consumed: int):
+        """Backpressure credit from the consuming owner (one-way notify)."""
+        em = self._gen_emitters.get(task_id)
+        if em is not None:
+            em.ack(consumed)
+
     def _make_result_streamer(self, writer, task_id: TaskID):
         """Done-callback that pushes one task's results to the submitter the
         moment it finishes (req_id -1 frame on the batch connection).  This
@@ -1327,6 +1468,10 @@ class CoreWorker:
         from .rpc import _encode
 
         def _cb(fut):
+            # A streaming task that failed before its generator body ran
+            # never reaches _run_generator's finally: drop its emitter here
+            # (the one chokepoint every batch-dispatched task passes).
+            self._gen_emitters.pop(task_id, None)
             try:
                 writer.write(_encode((-1, "task_result",
                                       {"task_id": task_id,
@@ -1340,6 +1485,38 @@ class CoreWorker:
         if topic == "task_result":
             self.task_manager.complete(payload["task_id"],
                                        payload["results"])
+        elif topic == "gen_yield":
+            self._on_gen_yield(payload["task_id"], payload["index"],
+                               payload["result"], payload["worker"])
+
+    def _on_gen_yield(self, task_id: TaskID, index: int, res: tuple,
+                      worker_addr: str):
+        """Owner side: one yield arrived from a running streaming task.
+        Yields arrive in index order on the TCP stream (and before the final
+        task_result frame)."""
+        st = self.streams.get(task_id)
+        if st is None or st.abandoned:
+            return  # generator dropped: let the value die with the producer
+        oid = ObjectID.for_task_return(task_id, index)
+        self.store_task_result(oid, res)
+        self.task_manager.register_result_borrows(oid, res)
+        if res[0] == "plasma":
+            st.any_plasma = True
+        st.worker_addr = worker_addr
+        st.available = index + 1
+        if st.backpressure and worker_addr and index < st.next_read:
+            # Replay of an already-consumed index (task retry): the consumer
+            # won't call next() until production passes its cursor, so ack
+            # proactively — otherwise the fresh producer parks at the
+            # backpressure window with nobody left to drain it.
+            try:
+                client = self.worker_clients.get(worker_addr)
+                asyncio.ensure_future(client.notify(
+                    "generator_ack", task_id=task_id,
+                    consumed=st.next_read))
+            except Exception:
+                pass
+        st.signal()
 
     async def handle_push_task_batch(self, specs: List[TaskSpec],
                                      _writer=None):
@@ -1354,6 +1531,7 @@ class CoreWorker:
             if _writer is not None:
                 fut.add_done_callback(
                     self._make_result_streamer(_writer, spec.task_id))
+            self.register_gen_emitter(spec, _writer, loop)
             self.exec_queue.put(("task", spec, fut, loop))
             futs.append(fut)
         results = await asyncio.gather(*futs)
@@ -1374,6 +1552,7 @@ class CoreWorker:
         loop = asyncio.get_event_loop()
         futs = []
         for spec in specs:
+            self.register_gen_emitter(spec, _writer, loop)
             if self.actor_spec is not None and self.actor_spec.is_async_actor:
                 fut = asyncio.ensure_future(self._run_async_actor_task(spec))
             else:
@@ -1516,60 +1695,124 @@ class CoreWorker:
         return results
 
     def _package_returns(self, spec: TaskSpec, out) -> List[tuple]:
+        if spec.num_returns == STREAMING_RETURNS:
+            return self._run_generator(spec, out)
         n = spec.num_returns
         values = [out] if n == 1 else list(out) if n > 1 else []
         if n > 1 and len(values) != n:
             raise ValueError(f"task {spec.name} declared num_returns={n} but "
                              f"returned {len(values)} values")
-        results = []
+        return [self._package_one(spec, v, i) for i, v in enumerate(values)]
+
+    def _package_one(self, spec: TaskSpec, v, index: int) -> tuple:
+        """Package one return/yield value as a result descriptor tuple."""
         cfg = get_config()
-        for v in values:
-            if v is None:  # ubiquitous for side-effect calls: skip the pickler
-                results.append(("inline", serialization.none_bytes(), []))
-                continue
-            so = serialization.serialize(v)
-            # Ship descriptors of any ObjectRefs inside the value so the
-            # caller can register its borrows at receipt (see
-            # TaskManager.complete).  For refs owned ELSEWHERE, place an
-            # ACKED escrow hold with the owner before this result ships:
-            # our own counts may hit zero right after the reply, and the
-            # hold keeps the object alive until the consumer registers its
-            # borrow and releases (no timing window; reference:
-            # reference_count.cc WaitForRefRemoved).
-            contained = []
-            for r in so.contained_refs:
-                r_owner = r.owner or self.address
-                hold_id = f"{self.worker_id.hex()[:12]}:{next(self._hold_seq)}"
-                if r_owner == self.address:
-                    # We own it: hold locally — our last local ref may die
-                    # the moment this function returns, and the consumer's
-                    # borrow note is still in flight.
-                    self._escrow_holds.setdefault(r.id, {})[hold_id] = (
-                        time.monotonic()
-                        + get_config().escrow_hold_expiry_s)
-                else:
-                    try:
-                        run_async(self.worker_clients.get(r_owner).call(
-                            "escrow_hold", object_id=r.id, hold_id=hold_id))
-                    except Exception:
-                        hold_id = None  # owner gone: nothing to protect
-                contained.append((r.id.binary(), r_owner, hold_id))
-            size = so.flat_size()
-            if size <= cfg.max_direct_call_object_size or self.agent is None:
-                results.append(("inline", so.to_bytes(), contained))
+        if v is None:  # ubiquitous for side-effect calls: skip the pickler
+            return ("inline", serialization.none_bytes(), [])
+        so = serialization.serialize(v)
+        # Ship descriptors of any ObjectRefs inside the value so the
+        # caller can register its borrows at receipt (see
+        # TaskManager.complete).  For refs owned ELSEWHERE, place an
+        # ACKED escrow hold with the owner before this result ships:
+        # our own counts may hit zero right after the reply, and the
+        # hold keeps the object alive until the consumer registers its
+        # borrow and releases (no timing window; reference:
+        # reference_count.cc WaitForRefRemoved).
+        contained = []
+        for r in so.contained_refs:
+            r_owner = r.owner or self.address
+            hold_id = f"{self.worker_id.hex()[:12]}:{next(self._hold_seq)}"
+            if r_owner == self.address:
+                # We own it: hold locally — our last local ref may die
+                # the moment this function returns, and the consumer's
+                # borrow note is still in flight.
+                self._escrow_holds.setdefault(r.id, {})[hold_id] = (
+                    time.monotonic()
+                    + get_config().escrow_hold_expiry_s)
             else:
-                oid = ObjectID.for_task_return(spec.task_id, len(results))
-                res = run_async(self.agent.call("store_create", object_id=oid,
-                                                size=size))
-                seg = ShmSegment(res["path"], size, create=False)
                 try:
-                    so.write_into(seg.view())
-                finally:
-                    seg.close()
-                run_async(self.agent.notify("store_seal", object_id=oid))
-                results.append(("plasma", size,
-                                [(self.node_id, self.agent_address)], contained))
-        return results
+                    run_async(self.worker_clients.get(r_owner).call(
+                        "escrow_hold", object_id=r.id, hold_id=hold_id))
+                except Exception:
+                    hold_id = None  # owner gone: nothing to protect
+            contained.append((r.id.binary(), r_owner, hold_id))
+        size = so.flat_size()
+        if size <= cfg.max_direct_call_object_size or self.agent is None:
+            return ("inline", so.to_bytes(), contained)
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        res = run_async(self.agent.call("store_create", object_id=oid,
+                                        size=size))
+        seg = ShmSegment(res["path"], size, create=False)
+        try:
+            so.write_into(seg.view())
+        finally:
+            seg.close()
+        run_async(self.agent.notify("store_seal", object_id=oid))
+        return ("plasma", size,
+                [(self.node_id, self.agent_address)], contained)
+
+    def _run_generator(self, spec: TaskSpec, out) -> List[tuple]:
+        """Drive a streaming task's generator body: package each yield and
+        ship it immediately through the batch connection's push channel
+        (reference: _raylet.pyx:267 streaming generator protocol).
+
+        Runs on the executor thread.  With no emitter (a dispatch path that
+        has no live writer, e.g. spillback push), yields buffer and ship in
+        the final reply instead — correct, just not streaming."""
+        emitter = self._gen_emitters.get(spec.task_id)
+        buffered: List[tuple] = []
+        n = 0
+        try:
+            for v in iter(out) if not hasattr(out, "__next__") else out:
+                res = self._package_one(spec, v, n)
+                # Borrow notes for refs inside this yield must be acked
+                # before it ships (same invariant as whole-task results).
+                self.flush_borrower_notes()
+                if emitter is not None:
+                    emitter.wait_capacity(spec.generator_backpressure)
+                    emitter.send(spec.task_id, n, res, self.address)
+                else:
+                    buffered.append(res)
+                n += 1
+        finally:
+            self._gen_emitters.pop(spec.task_id, None)
+        if emitter is None:
+            return [("gen_buffered", buffered)]
+        return [("gen_done", n)]
+
+    async def _run_generator_async(self, spec: TaskSpec, gen) -> List[tuple]:
+        """Async-actor variant of _run_generator: drives an async OR sync
+        generator on the actor's private loop (Serve token streaming runs
+        through here).  Sync generators still execute their body inline, but
+        the backpressure wait is awaitable so only this task parks."""
+        emitter = self._gen_emitters.get(spec.task_id)
+        buffered: List[tuple] = []
+        n = 0
+
+        async def _aiter(g):
+            if hasattr(g, "__anext__"):
+                async for v in g:
+                    yield v
+            else:
+                for v in iter(g):
+                    yield v
+                    await asyncio.sleep(0)  # keep the actor loop responsive
+
+        try:
+            async for v in _aiter(gen):
+                res = self._package_one(spec, v, n)
+                self.flush_borrower_notes()
+                if emitter is not None:
+                    await emitter.wait_capacity_async(spec.generator_backpressure)
+                    emitter.send(spec.task_id, n, res, self.address)
+                else:
+                    buffered.append(res)
+                n += 1
+        finally:
+            self._gen_emitters.pop(spec.task_id, None)
+        if emitter is None:
+            return [("gen_buffered", buffered)]
+        return [("gen_done", n)]
 
     def _execute_actor_creation(self, spec: TaskSpec):
         cls = self._load_function(spec.fn_id, spec.job_id)
@@ -1608,6 +1851,11 @@ class CoreWorker:
             res = method(*args, **kwargs)
             if asyncio.iscoroutine(res):
                 res = await res
+            if spec.num_returns == STREAMING_RETURNS:
+                # Sync generators route through the async driver too — its
+                # backpressure wait is awaitable, so a slow consumer parks
+                # only this task, not the actor's whole event loop.
+                return await self._run_generator_async(spec, res)
             results = self._package_returns(spec, res)
             self.flush_borrower_notes()  # see _execute_task
             return results
@@ -1619,6 +1867,67 @@ class CoreWorker:
             tb = traceback.format_exc()
             return [("error", pickle.dumps((_strip_exc(e), tb)))
                     for _ in range(max(1, spec.num_returns))]
+
+
+class _GenEmitter:
+    """Executor-side channel for one RUNNING streaming task.
+
+    ``send`` hops yield frames onto the IO loop for the owner's batch
+    connection (same req_id -1 push channel as per-task result streaming, so
+    yields and the final task_result frame share the TCP stream's ordering).
+    ``wait_capacity``/``ack`` implement consumer-driven backpressure: the
+    executor thread parks once `produced - consumed` hits the spec's limit and
+    the owner's generator_ack notifies it forward."""
+
+    #: give up waiting for acks after this long (owner died / dropped the
+    #: generator mid-stream) — proceeding just buffers, it can't corrupt.
+    STALL_TIMEOUT_S = 600.0
+
+    def __init__(self, writer, loop):
+        self._writer = writer
+        self._loop = loop
+        self._produced = 0
+        self._consumed = 0
+        self._cond = threading.Condition()
+
+    def send(self, task_id: TaskID, index: int, res: tuple, worker_addr: str):
+        from .rpc import _encode
+        frame = _encode((-1, "gen_yield", {
+            "task_id": task_id, "index": index, "result": res,
+            "worker": worker_addr}))
+
+        def _write():
+            try:
+                self._writer.write(frame)
+            except Exception:
+                pass  # connection gone: the batch reply path handles it
+
+        self._loop.call_soon_threadsafe(_write)
+        with self._cond:
+            self._produced = index + 1
+
+    def ack(self, consumed: int):
+        with self._cond:
+            self._consumed = max(self._consumed, consumed)
+            self._cond.notify_all()
+
+    def wait_capacity(self, backpressure: int):
+        if not backpressure:
+            return
+        deadline = time.monotonic() + self.STALL_TIMEOUT_S
+        with self._cond:
+            while (self._produced - self._consumed >= backpressure
+                   and time.monotonic() < deadline):
+                self._cond.wait(timeout=1.0)
+
+    async def wait_capacity_async(self, backpressure: int):
+        """Async-actor variant: park in a thread so the actor loop stays live."""
+        if not backpressure:
+            return
+        if self._produced - self._consumed < backpressure:
+            return
+        await asyncio.get_event_loop().run_in_executor(
+            None, self.wait_capacity, backpressure)
 
 
 def _strip_exc(e: BaseException) -> BaseException:

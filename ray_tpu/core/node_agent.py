@@ -99,6 +99,21 @@ class NodeAgent:
         self._inflight_pulls: Dict[ObjectID, "asyncio.Future"] = {}
         self._lease_counter = 0
         self._shutting_down = False
+        # Same-host identity for zero-copy object sharing: two agents with
+        # equal host_key share one /dev/shm, so a "transfer" between them is
+        # an mmap attach of the source's pool slice (plasma same-node
+        # sharing, generalized across agents).
+        import socket as _socket
+        try:
+            shm_dev = os.stat("/dev/shm").st_dev if os.path.isdir(
+                "/dev/shm") else 0
+        except OSError:
+            shm_dev = 0
+        self.host_key = f"{_socket.gethostname()}:{shm_dev}"
+        # worker_id -> memory-monitor kill cause, consumed by the lease
+        # return so the owner raises a typed OutOfMemoryError.
+        self._oom_kills: Dict[str, str] = {}
+        self._oom_kill_count = 0  # lifetime total, exported in stats
 
     # ------------------------------------------------------------------ boot
 
@@ -467,6 +482,10 @@ class NodeAgent:
 
     async def handle_return_worker_lease(self, lease_id: str, worker_id: str,
                                          worker_alive: bool = True):
+        # Surface the death cause to the owner: an OOM-killed worker's task
+        # should fail with a typed OutOfMemoryError naming the policy, not a
+        # generic WorkerCrashedError.
+        death_cause = self._oom_kills.pop(worker_id, None)
         w0 = self.workers.get(worker_id)
         if w0 is not None and w0.blocked and w0.lease_id == lease_id:
             # Block already released the resources; just drop the record.
@@ -484,7 +503,7 @@ class NodeAgent:
             elif not worker_alive:
                 await self._kill_worker_proc(w)
         await self._process_lease_queue()
-        return True
+        return {"ok": True, "death_cause": death_cause}
 
     async def _process_lease_queue(self):
         i = 0
@@ -642,12 +661,57 @@ class NodeAgent:
         e = self.store._entries.get(object_id)
         if e is not None and e.sealed and e.segment.path == path:
             return True
+        # Same-host proxy: the pin we hold on the source's real entry keeps
+        # that slice from being evicted (and its offset from being reused)
+        # for as long as the proxy exists, so presence-at-path IS validity.
+        p = self.store._proxies.get(object_id)
+        if p is not None and p.path == path:
+            return True
         # evicted-but-spilled (or restored elsewhere): not at `path` anymore
         return False
 
+    async def handle_object_info(self, object_id: ObjectID):
+        """Describe a sealed local object for a prospective puller: same-host
+        pullers (matching host_key) zero-copy attach `path` instead of
+        pulling bytes (see _pull_object).
+
+        Answers from metadata only — a spilled entry returns None rather
+        than being restored from disk just to satisfy a probe from a puller
+        that may pick a different source (the byte-pull path restores on
+        read_chunk when this node is actually chosen)."""
+        e = self.store._entries.get(object_id)
+        if e is not None and e.sealed:
+            return {"path": e.segment.path, "size": e.size,
+                    "host_key": self.host_key, "proxy": False}
+        p = self.store._proxies.get(object_id)
+        if p is not None:
+            return {"path": p.path, "size": p.size,
+                    "host_key": self.host_key, "proxy": True}
+        return None
+
+    async def handle_pin_object(self, object_id: ObjectID) -> bool:
+        """Pin a REAL local entry for a same-host proxy holder (proxies can't
+        be pinned — the second-level puller falls back to the true origin)."""
+        e = self.store._entries.get(object_id)
+        if e is None or not e.sealed:
+            return False
+        self.store.pin(object_id)
+        return True
+
+    async def handle_unpin_object(self, object_id: ObjectID):
+        self.store.unpin(object_id)
+
     async def handle_store_free(self, object_ids: List[ObjectID]):
         for oid in object_ids:
-            self.store.free(oid)
+            source = self.store.free(oid)
+            if source:
+                # Freed a same-host proxy: release the pin we hold on the
+                # source store so the origin becomes evictable again.
+                try:
+                    await self.agent_clients.get(source).notify(
+                        "unpin_object", object_id=oid)
+                except Exception:
+                    pass
         return True
 
     async def handle_store_contains(self, object_id: ObjectID) -> bool:
@@ -717,6 +781,39 @@ class NodeAgent:
             candidates = [(nid, addr) for nid, addr in locations
                           if addr != self.server.address]
             random.shuffle(candidates)
+            # Same-host fast path: attach the source's pool slice instead of
+            # copying bytes through a socket — the source pins the object for
+            # us until we free our proxy (zero-copy same-host broadcast).
+            for node_id, addr in candidates:
+                client = self.agent_clients.get(addr)
+                try:
+                    info = await client.call("object_info",
+                                             object_id=object_id)
+                except Exception:
+                    continue
+                if (not info or info.get("proxy")
+                        or info.get("host_key") != self.host_key):
+                    continue
+                try:
+                    if await client.call("pin_object", object_id=object_id):
+                        self.store.add_proxy(object_id, info["path"],
+                                             info["size"], addr)
+                        if owner:
+                            # A proxy holder IS a source for byte pullers
+                            # (read_chunk serves through get_path); same-host
+                            # pullers skip it via object_info.proxy and go
+                            # to the origin (no proxy-of-proxy pin chains).
+                            try:
+                                await self.worker_clients.get(owner).notify(
+                                    "add_object_location",
+                                    object_id=object_id,
+                                    node_id=self.node_id.hex(),
+                                    address=self.server.address)
+                            except Exception:
+                                pass
+                        return {"path": info["path"], "size": info["size"]}
+                except Exception:
+                    continue
             for node_id, addr in candidates:
                 client = self.agent_clients.get(addr)
                 try:
@@ -795,6 +892,12 @@ class NodeAgent:
                 if victim is None:
                     continue
                 victim.state = "DRAINING"
+                self._oom_kill_count += 1
+                self._oom_kills[victim.worker_id] = (
+                    f"worker killed by the memory monitor: node memory "
+                    f"{usage:.0%} >= threshold "
+                    f"{cfg.memory_usage_threshold:.0%} "
+                    f"(retriable-LIFO worker killing policy)")
                 if victim.is_actor and victim.actor_id:
                     # _kill_worker_proc releases leases but does not tell
                     # the GCS — an unreported actor death would leave the
@@ -935,6 +1038,7 @@ class NodeAgent:
                                   "actor_id": w.actor_id}
                             for wid, w in self.workers.items()},
                 "store": self.store.stats(),
+                "oom_kills": self._oom_kill_count,
                 "queue_len": len(self.lease_queue),
                 "queued_demands": [r.resources for r in self.lease_queue],
                 "cluster_view": {nid: {"available": v.available, "alive": v.alive}

@@ -170,6 +170,21 @@ class _Entry:
     last_access: float = field(default_factory=time.monotonic)
 
 
+@dataclass
+class _ProxyEntry:
+    """Zero-copy reference to a SAME-HOST peer store's sealed object.
+
+    Plasma's same-node sharing, extended across node agents that share one
+    /dev/shm: instead of copying the bytes through a socket, this node serves
+    the SOURCE store's pool-slice path directly (workers attach it with the
+    same ``_pool_attach`` mmap cache) and the source holds a pin for us until
+    we free.  An N-node same-host broadcast therefore moves zero bytes —
+    every consumer reads the origin's pages through the shared page cache."""
+    path: str
+    size: int
+    source_addr: str
+
+
 class NodeObjectStore:
     """Plasma-equivalent store; all methods are called on the agent's IO loop."""
 
@@ -187,6 +202,9 @@ class NodeObjectStore:
         self.capacity = capacity
         self.used = 0
         self._entries: Dict[ObjectID, _Entry] = {}
+        # Same-host zero-copy references (see _ProxyEntry): not counted
+        # against capacity — the bytes live in the source node's arena.
+        self._proxies: Dict[ObjectID, _ProxyEntry] = {}
         self._sealed_events: Dict[ObjectID, asyncio.Event] = {}
         self.num_creates = 0
         self.num_evictions = 0
@@ -316,11 +334,14 @@ class NodeObjectStore:
     # -- reads ------------------------------------------------------------
 
     def contains(self, object_id: ObjectID) -> bool:
-        """Locally retrievable: sealed in shm OR spilled to this node's disk
-        (get_path restores spilled entries transparently — without this,
-        fetch_object would declare a spilled-but-local object lost)."""
+        """Locally retrievable: sealed in shm, proxied from a same-host peer,
+        OR spilled to this node's disk (get_path restores spilled entries
+        transparently — without this, fetch_object would declare a
+        spilled-but-local object lost)."""
         e = self._entries.get(object_id)
         if e is not None and e.sealed:
+            return True
+        if object_id in self._proxies:
             return True
         return object_id in self._spilled
 
@@ -335,7 +356,14 @@ class NodeObjectStore:
         except asyncio.TimeoutError:
             return False
 
+    def add_proxy(self, object_id: ObjectID, path: str, size: int,
+                  source_addr: str):
+        self._proxies[object_id] = _ProxyEntry(path, size, source_addr)
+
     def get_path(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
+        p = self._proxies.get(object_id)
+        if p is not None:
+            return p.path, p.size
         e = self._entries.get(object_id)
         if e is None or not e.sealed:
             if e is None:
@@ -372,7 +400,10 @@ class NodeObjectStore:
         if e and e.pinned > 0:
             e.pinned -= 1
 
-    def free(self, object_id: ObjectID):
+    def free(self, object_id: ObjectID) -> Optional[str]:
+        """Free a local object.  Returns the SOURCE agent address when the
+        freed entry was a same-host proxy — the caller must send the unpin."""
+        proxy = self._proxies.pop(object_id, None)
         # A freed object may live in shm, on the spill disk, or both.
         spilled = self._spilled.pop(object_id, None)
         if spilled:
@@ -382,10 +413,11 @@ class NodeObjectStore:
                 pass
         e = self._entries.pop(object_id, None)
         if e is None:
-            return
+            return proxy.source_addr if proxy else None
         self.used -= e.size
         e.segment.close()
         e.segment.unlink()
+        return proxy.source_addr if proxy else None
 
     def _evict(self, need_bytes: int):
         """LRU-evict sealed unpinned entries; spill them first if configured."""
@@ -437,6 +469,7 @@ class NodeObjectStore:
             "capacity": self.capacity,
             "used": self.used,
             "num_objects": len(self._entries),
+            "num_proxies": len(self._proxies),
             "num_creates": self.num_creates,
             "num_evictions": self.num_evictions,
         }
@@ -510,8 +543,15 @@ class PlasmaRecord:
 
 @dataclass
 class ErrorRecord:
-    """A task error stored in place of a value; raised on get."""
+    """A task error stored in place of a value; raised on get.
+
+    ``system`` marks faults recorded by the RUNTIME (OOM kill, worker crash,
+    actor death) rather than raised by the task body: system faults surface
+    typed from ``get`` (ray.exceptions semantics), while user exceptions —
+    even RayTpuError subclasses a task let propagate from an inner get —
+    wrap in TaskError so failures stay attributed to the right task."""
     error: bytes  # pickled exception
+    system: bool = False
 
 
 class MemoryStore:

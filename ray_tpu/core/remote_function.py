@@ -12,7 +12,8 @@ import hashlib
 from typing import Any, Dict, List, Optional
 
 from . import serialization
-from .common import PlacementGroupSchedulingStrategy, TaskSpec, _TopLevelRef
+from .common import (STREAMING_RETURNS, PlacementGroupSchedulingStrategy,
+                     TaskSpec, _TopLevelRef)
 from .config import get_config
 from .ids import TaskID
 from .object_ref import ObjectRef
@@ -115,6 +116,8 @@ class RemoteFunction:
         if self._captured_refs:
             arg_refs = arg_refs + self._captured_refs
         num_returns = o.get("num_returns", 1)
+        if num_returns in ("streaming", "dynamic"):
+            num_returns = STREAMING_RETURNS
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=w.job_id,
@@ -128,9 +131,12 @@ class RemoteFunction:
             max_retries=o.get("max_retries", get_config().default_task_max_retries),
             retry_exceptions=bool(o.get("retry_exceptions", False)),
             runtime_env=o.get("runtime_env"),
+            generator_backpressure=int(o.get("generator_backpressure", 0)),
             trace_ctx=_current_trace_ctx(),
         )
         refs = w.submit_task(spec, arg_refs)
+        if num_returns == STREAMING_RETURNS:
+            return refs  # an ObjectRefGenerator
         if num_returns == 0:
             return None
         return refs[0] if num_returns == 1 else refs

@@ -31,6 +31,11 @@ class DataContext:
     default_batch_format: str = "numpy"
     # Whether map tasks should eagerly release input block refs.
     eager_free: bool = True
+    # Streaming-generator map tasks: downstream operators consume output
+    # blocks while the producing task still runs (num_returns="streaming").
+    use_streaming_generators: bool = True
+    # Producer pauses after this many unconsumed streamed blocks (0 = off).
+    generator_backpressure: int = 8
     # Random seed used by random_shuffle/randomize_block_order when the user
     # does not pass one (None = nondeterministic).
     seed: Optional[int] = None

@@ -134,10 +134,19 @@ class StreamingExecutor:
                     # input gets to run; skip dispatching anything upstream.
                     break
 
-            # 4. Wait for any in-flight task.
+            # 4. Drain streaming-generator yields (non-blocking): blocks
+            # flow downstream while their producing tasks are still running.
+            streams_live = False
+            for op in topo:
+                if op.gen_in_flight:
+                    streams_live = True
+                    if op.poll_streams():
+                        progressed = True
+
+            # 5. Wait for any in-flight task.
             in_flight = {}
             for op in topo:
-                for ref in op.in_flight:
+                for ref in op.pending_refs():
                     in_flight[ref] = op
             if in_flight:
                 ready, _ = ray_wait(list(in_flight), num_returns=1, timeout=0.1)
@@ -145,8 +154,9 @@ class StreamingExecutor:
                     in_flight[ref].on_task_done(ref)
                     progressed = True
             elif not progressed:
-                # Nothing in flight and nothing moved: avoid a hot spin.
-                self._stop.wait(0.005)
+                # Nothing moved: park briefly (short tick while streams are
+                # live so fresh yields are picked up promptly).
+                self._stop.wait(0.005 if streams_live else 0.02)
 
 
 def execute_to_bundles(output_op: PhysicalOperator, name: str = "dataset"

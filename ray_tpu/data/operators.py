@@ -167,6 +167,35 @@ def _run_map_task(stages: List[MapStage], target_block_size: int,
     return _bundle_of(apply_stages(stages, blocks, target_block_size))
 
 
+def _yield_block_pairs(blocks: Iterable[Block], input_files=None):
+    """Streaming body core: alternately yield block, then its metadata, per
+    non-empty output block (reference:
+    data/_internal/execution/operators/map_operator.py generator returns).
+
+    The block ships as a streamed RETURN object (caller-owned) rather than a
+    worker-side ray_put: a put inside the task leaves the transient worker
+    (or pool actor) as the ref's owner, and its idle-reaping/shutdown would
+    strand every block it produced ("owner died") before downstream
+    consumed them."""
+    for b in blocks:
+        acc = BlockAccessor.for_block(b)
+        if acc.num_rows() == 0:
+            continue
+        yield b
+        yield acc.metadata(input_files)
+
+
+def _stream_map_task(stages: List[MapStage], target_block_size: int,
+                     *blocks: Block):
+    yield from _yield_block_pairs(
+        apply_stages(stages, blocks, target_block_size))
+
+
+def _stream_read_task(task: ReadTask):
+    yield from _yield_block_pairs(task(),
+                                  input_files=task.metadata.input_files)
+
+
 def _run_write_task(path: str, file_format: str, writer_args: dict,
                     index: int, *blocks: Block) -> List[tuple]:
     import pyarrow as pa
@@ -195,6 +224,10 @@ class PhysicalOperator:
         self.output_queue: collections.deque[RefBundle] = collections.deque()
         self._inputs_done = False
         self.in_flight: Dict[ObjectRef, Any] = {}
+        # completed-but-unreleased task results (see on_task_done ordering)
+        self._done_tasks: Dict[ObjectRef, Any] = {}
+        # streaming-generator tasks currently producing for this operator
+        self.gen_in_flight: List[Any] = []
         self.ctx = DataContext.get_current()
         self.metrics = collections.Counter()
 
@@ -211,15 +244,74 @@ class PhysicalOperator:
     # work dispatch
     def can_dispatch(self) -> bool:
         return (bool(self.input_queue)
-                and len(self.in_flight) < self.ctx.max_tasks_in_flight_per_op)
+                and len(self.in_flight) + len(self.gen_in_flight)
+                < self.ctx.max_tasks_in_flight_per_op)
 
     def dispatch_one(self):
         raise NotImplementedError
 
+    def pending_refs(self) -> List[ObjectRef]:
+        """Refs the executor should still wait on (completed-but-held results
+        are excluded so they aren't re-delivered)."""
+        return [r for r in self.in_flight if r not in self._done_tasks]
+
     def on_task_done(self, ref: ObjectRef):
-        ctx = self.in_flight.pop(ref)
-        bundle_list = ray_get(ref)
-        self._handle_result(ctx, bundle_list)
+        """Buffer out-of-order completions; release results in DISPATCH order
+        (in_flight's insertion order), so downstream block order matches the
+        input order instead of ray_wait readiness order."""
+        self._done_tasks[ref] = ray_get(ref)
+        while self.in_flight:
+            first = next(iter(self.in_flight))
+            if first not in self._done_tasks:
+                break
+            ctx = self.in_flight.pop(first)
+            self._handle_result(ctx, self._done_tasks.pop(first))
+            self.metrics["tasks_finished"] += 1
+
+    def poll_streams(self) -> bool:
+        """Drain whatever streaming tasks have yielded so far (non-blocking).
+        Each yield is one (block_ref, metadata) pair — it becomes an output
+        bundle immediately, while the producing task keeps running.
+
+        Yields are released in task-DISPATCH order: only the head stream
+        feeds the output queue; younger streams hold their yields (bounded
+        by generator_backpressure) until the head completes.  Without this,
+        whichever task yields first wins and take()/iteration order diverges
+        from the buffered path."""
+        progressed = False
+        while self.gen_in_flight:
+            g = self.gen_in_flight[0]
+            while True:
+                ref = g.try_next()
+                if ref is None:
+                    break
+                # Yields alternate block, metadata (see _yield_block_pairs):
+                # the block ref passes through un-fetched; only the small
+                # metadata yield is materialized here.
+                pending = getattr(g, "_pending_block", None)
+                if pending is None:
+                    g._pending_block = ref
+                else:
+                    g._pending_block = None
+                    self._handle_result(None, [(pending, ray_get(ref))])
+                progressed = True
+            if not g.completed():
+                break
+            pending = getattr(g, "_pending_block", None)
+            if pending is not None:
+                # A lone trailing yield is the task's error item (pairs are
+                # produced atomically): fetching it raises the task error.
+                g._pending_block = None
+                ray_get(pending)
+            self.gen_in_flight.pop(0)
+            self._on_stream_complete(g)
+            self.metrics["tasks_finished"] += 1
+            progressed = True  # next stream's buffered yields drain next pass
+        return progressed
+
+    def _on_stream_complete(self, g) -> None:
+        """Hook: a streaming task finished and was released (ActorPool uses
+        this to return the producing actor to the idle pool)."""
 
     def _handle_result(self, ctx, bundle_list):
         metas = [BlockMetadata(**m.__dict__) if not isinstance(m, BlockMetadata)
@@ -227,13 +319,12 @@ class PhysicalOperator:
         bundle = RefBundle(list(zip([r for r, _ in bundle_list], metas)))
         if bundle.blocks:
             self.output_queue.append(bundle)
-        self.metrics["tasks_finished"] += 1
         self.metrics["rows_out"] += bundle.num_rows() or 0
 
     # completion
     def is_finished(self) -> bool:
         return (self._inputs_done and not self.input_queue
-                and not self.in_flight)
+                and not self.in_flight and not self.gen_in_flight)
 
     def shutdown(self):
         pass
@@ -260,18 +351,27 @@ class ReadOperator(PhysicalOperator):
         self._tasks = collections.deque(read_tasks)
         self._inputs_done = True
         self._remote = ray_remote(_run_read_task)
+        self._stream_remote = ray_remote(_stream_read_task).options(
+            num_returns="streaming",
+            # 2 yields per block: keep the backpressure knob block-denominated
+            generator_backpressure=2 * self.ctx.generator_backpressure)
 
     def can_dispatch(self):
         return (bool(self._tasks)
-                and len(self.in_flight) < self.ctx.max_tasks_in_flight_per_op)
+                and len(self.in_flight) + len(self.gen_in_flight)
+                < self.ctx.max_tasks_in_flight_per_op)
 
     def dispatch_one(self):
         task = self._tasks.popleft()
+        if self.ctx.use_streaming_generators:
+            self.gen_in_flight.append(self._stream_remote.remote(task))
+            return
         ref = self._remote.remote(task)
         self.in_flight[ref] = task
 
     def is_finished(self):
-        return not self._tasks and not self.in_flight
+        return (not self._tasks and not self.in_flight
+                and not self.gen_in_flight)
 
 
 class TaskPoolMapOperator(PhysicalOperator):
@@ -280,9 +380,19 @@ class TaskPoolMapOperator(PhysicalOperator):
         super().__init__(name, [input_op])
         self._stages = stages
         self._remote = ray_remote(_run_map_task).options(**(ray_remote_args or {}))
+        self._stream_remote = ray_remote(_stream_map_task).options(
+            num_returns="streaming",
+            generator_backpressure=2 * self.ctx.generator_backpressure,
+            **(ray_remote_args or {}))
 
     def dispatch_one(self):
         bundle = self.input_queue.popleft()
+        if self.ctx.use_streaming_generators:
+            gen = self._stream_remote.remote(self._stages,
+                                             self.ctx.target_max_block_size,
+                                             *bundle.refs())
+            self.gen_in_flight.append(gen)
+            return
         ref = self._remote.remote(self._stages,
                                   self.ctx.target_max_block_size,
                                   *bundle.refs())
@@ -303,6 +413,10 @@ class _MapWorker:
         return _bundle_of(apply_stages(stages, blocks, target_block_size,
                                        fn_cache=self._fn_cache))
 
+    def run_stream(self, stages, target_block_size, *blocks):
+        yield from _yield_block_pairs(apply_stages(
+            stages, blocks, target_block_size, fn_cache=self._fn_cache))
+
 
 class ActorPoolMapOperator(PhysicalOperator):
     def __init__(self, name: str, input_op: PhysicalOperator,
@@ -314,6 +428,7 @@ class ActorPoolMapOperator(PhysicalOperator):
         self._remote_args = ray_remote_args or {}
         self._actors: List[Any] = []
         self._idle: collections.deque = collections.deque()
+        self._gen_actor: Dict[int, Any] = {}  # id(gen) -> producing actor
         self._started = False
 
     def _ensure_actors(self):
@@ -344,14 +459,29 @@ class ActorPoolMapOperator(PhysicalOperator):
     def dispatch_one(self):
         bundle = self.input_queue.popleft()
         actor = self._idle.popleft()
+        if self.ctx.use_streaming_generators:
+            g = actor.run_stream.options(
+                num_returns="streaming",
+                generator_backpressure=2 * self.ctx.generator_backpressure,
+            ).remote(self._stages, self.ctx.target_max_block_size,
+                     *bundle.refs())
+            self.gen_in_flight.append(g)
+            self._gen_actor[id(g)] = actor
+            return
         ref = actor.run.remote(self._stages, self.ctx.target_max_block_size,
                                *bundle.refs())
         self.in_flight[ref] = (bundle, actor)
 
     def on_task_done(self, ref: ObjectRef):
-        bundle, actor = self.in_flight.pop(ref)
-        self._idle.append(actor)
-        self._handle_result((bundle, actor), ray_get(ref))
+        if ref not in self._done_tasks:
+            _, actor = self.in_flight[ref]
+            self._idle.append(actor)  # free at completion, not release
+        super().on_task_done(ref)
+
+    def _on_stream_complete(self, g) -> None:
+        actor = self._gen_actor.pop(id(g), None)
+        if actor is not None:
+            self._idle.append(actor)
 
     def shutdown(self):
         from ..core.api import kill

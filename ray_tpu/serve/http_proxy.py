@@ -18,7 +18,6 @@ from __future__ import annotations
 import asyncio
 import json
 import random
-import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from .replica import Request
@@ -231,22 +230,25 @@ class HTTPProxyActor:
 
     async def _stream_response(self, http_request, deployment: str,
                                req: Request):
+        """Chunked HTTP response over a native streaming-generator actor call:
+        each chunk the replica yields arrives as its own owner-side object
+        push — no next_chunks long-poll round trips (the buffered
+        handle_request_streaming/next_chunks protocol remains for deployment
+        handles that poll)."""
         from aiohttp import web
         name = await self.router.choose(deployment)
         h = self.router._handle_for(name)
-        stream_id = uuid.uuid4().hex
-        done_ref = h.handle_request_streaming.remote(stream_id, (req,), {},
-                                                     None)
+        gen = h.handle_request_gen.options(
+            num_returns="streaming", generator_backpressure=256).remote(
+            (req,), {}, None)
         resp = web.StreamResponse()
         resp.headers["Content-Type"] = "text/plain; charset=utf-8"
         await resp.prepare(http_request)
-        cursor, done = 0, False
-        while not done:
-            chunks, cursor, done = await self.router._aget(
-                h.next_chunks.remote(stream_id, cursor))
-            for c in chunks:
-                await resp.write(self._chunk_bytes(c))
-        await self.router._aget(done_ref)  # surface generator errors
+        async for ref in gen:
+            # Surfaces generator errors too: a raise lands as the stream's
+            # final ref and re-raises here (truncating the chunked body).
+            c = await self.router._aget(ref)
+            await resp.write(self._chunk_bytes(c))
         await resp.write_eof()
         return resp
 

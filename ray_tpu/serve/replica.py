@@ -124,6 +124,34 @@ class ReplicaActor:
             self.num_ongoing -= 1
             self.num_processed += 1
 
+    async def handle_request_gen(self, args: tuple, kwargs: dict,
+                                 method: Optional[str] = None):
+        """Streaming endpoint as a native streaming-generator actor method
+        (called with ``num_returns="streaming"``): each chunk ships to the
+        caller the moment it is yielded — no next_chunks long-poll round
+        trips (that path remains for deployment handles that want the
+        buffered protocol)."""
+        if self._draining:
+            raise RuntimeError(f"replica {self.replica_id} is draining")
+        self.num_ongoing += 1
+        try:
+            fn = self._resolve(method)
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = await out
+            if inspect.isasyncgen(out):
+                async for chunk in out:
+                    yield chunk
+            elif inspect.isgenerator(out):
+                for chunk in out:
+                    yield chunk
+                    await asyncio.sleep(0)  # keep the actor loop responsive
+            else:
+                yield out
+        finally:
+            self.num_ongoing -= 1
+            self.num_processed += 1
+
     async def next_chunks(self, stream_id: str, cursor: int) -> tuple:
         """Poll a stream: returns (new_chunks, next_cursor, done)."""
         for _ in range(200):  # long-poll up to ~2s per call

@@ -16,7 +16,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu import ActorDiedError, GetTimeoutError, TaskError
+from ray_tpu import ActorDiedError, GetTimeoutError, RayTpuError, TaskError
 
 from .backend import BackendConfig
 from .checkpoint import Checkpoint, CheckpointManager
@@ -121,6 +121,10 @@ class BackendExecutor:
         except TaskError as e:
             raise TrainingFailedError(
                 f"train loop raised: {e}", cause=e)
+        except RayTpuError as e:
+            # Typed system faults (OutOfMemoryError, WorkerCrashedError, …)
+            # become a restartable training failure, not a raw crash.
+            raise TrainingFailedError(f"worker group fault: {e}", cause=e)
         kinds = {kind for kind, _, _ in results}
         if kinds == {"done"}:
             return ("done", results[0][1])
