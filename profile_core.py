@@ -1,0 +1,149 @@
+"""Where do tasks_async / actor-call cycles go?  (VERDICT r3 item 9)
+
+Statistical wall-clock profile of the DRIVER process (user thread + the
+raytpu-io loop thread) while running the two weakest perf.py scenarios:
+``tasks_async`` (1000 noop tasks, one batched get) and
+``actor_calls_async_n_n`` (2000 calls over 4 actors).  A sampler thread
+walks ``sys._current_frames()`` at ~200 Hz and aggregates inclusive samples
+per (function, file) frame, per thread.
+
+Output: PROFILE_CORE.md — top frames per thread per scenario, with the
+sample share.  This is the committed analysis artifact; the companion
+numbers live in PERF_r04.json.
+
+Usage: python profile_core.py [--hz 200] [--out PROFILE_CORE.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import sys
+import threading
+import time
+
+
+class Sampler:
+    def __init__(self, hz: float = 200.0):
+        self.period = 1.0 / hz
+        self.counts: dict = collections.defaultdict(collections.Counter)
+        self.totals: collections.Counter = collections.Counter()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._names: dict = {}
+
+    def _run(self):
+        me = threading.get_ident()
+        while not self._stop.is_set():
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                self.totals[tid] += 1
+                f = frame
+                seen = set()
+                while f is not None:
+                    code = f.f_code
+                    key = (code.co_name, code.co_filename, f.f_lineno
+                           if f is frame else code.co_firstlineno)
+                    # inclusive: count each distinct frame once per sample
+                    k2 = (code.co_name, code.co_filename)
+                    if k2 not in seen:
+                        seen.add(k2)
+                        self.counts[tid][k2] += 1
+                    f = f.f_back
+            time.sleep(self.period)
+
+    def start(self):
+        for t in threading.enumerate():
+            self._names[t.ident] = t.name
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="profiler")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()
+        for t in threading.enumerate():
+            self._names.setdefault(t.ident, t.name)
+
+    def report(self, top: int = 25) -> str:
+        out = []
+        for tid, ctr in sorted(self.counts.items(),
+                               key=lambda kv: -self.totals[kv[0]]):
+            total = self.totals[tid]
+            if total < 10:
+                continue
+            name = self._names.get(tid, str(tid))
+            out.append(f"\n### thread `{name}` ({total} samples)\n")
+            out.append("| share | function | file |")
+            out.append("|---|---|---|")
+            for (fn, path), n in ctr.most_common(top):
+                short = path.split("/ray_tpu/")[-1] if "/ray_tpu/" in path \
+                    else path.rsplit("/", 1)[-1]
+                out.append(f"| {n / total:.0%} | `{fn}` | {short} |")
+        return "\n".join(out)
+
+
+def scenario_tasks_async(ray_tpu, noop, n=1000):
+    ray_tpu.get([noop.remote() for _ in range(n)])
+
+
+def scenario_actors_nn(ray_tpu, actors, n=2000):
+    ray_tpu.get([actors[i % len(actors)].ping.remote() for i in range(n)])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--hz", type=float, default=200.0)
+    p.add_argument("--out", default="PROFILE_CORE.md")
+    p.add_argument("--rounds", type=int, default=5)
+    args = p.parse_args()
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def noop(_x=None):
+        return None
+
+    @ray_tpu.remote
+    class Counter:
+        def ping(self):
+            return None
+
+    sections = []
+    try:
+        ray_tpu.get([noop.remote() for _ in range(8)])  # warm pool
+        actors = [Counter.remote() for _ in range(4)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+
+        for title, fn in [
+            ("tasks_async (1000 noop tasks, batched get)",
+             lambda: scenario_tasks_async(ray_tpu, noop)),
+            ("actor_calls_async_n_n (2000 calls over 4 actors)",
+             lambda: scenario_actors_nn(ray_tpu, actors)),
+        ]:
+            fn()  # warmup round
+            s = Sampler(args.hz)
+            s.start()
+            t0 = time.perf_counter()
+            for _ in range(args.rounds):
+                fn()
+            dt = time.perf_counter() - t0
+            s.stop()
+            sections.append(f"\n## {title}\n\nwall: {dt:.2f}s for "
+                            f"{args.rounds} rounds\n" + s.report())
+    finally:
+        ray_tpu.shutdown()
+
+    body = ("# Core RPC hot-path profile (driver process)\n\n"
+            "Sampled wall-clock stacks (~200 Hz, inclusive per-frame "
+            "share per thread) during the two weakest PERF scenarios.\n"
+            + "\n".join(sections) + "\n")
+    with open(args.out, "w") as f:
+        f.write(body)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

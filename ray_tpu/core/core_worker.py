@@ -1465,7 +1465,7 @@ class CoreWorker:
         is what makes batching deadlock-free: a consumer later in the batch
         (or holding the producer's ref indirectly) can resolve it at the
         owner without waiting for the whole batch to reply."""
-        from .rpc import _encode
+        from .rpc import _encode, coalesced_write
 
         def _cb(fut):
             # A streaming task that failed before its generator body ran
@@ -1473,9 +1473,12 @@ class CoreWorker:
             # (the one chokepoint every batch-dispatched task passes).
             self._gen_emitters.pop(task_id, None)
             try:
-                writer.write(_encode((-1, "task_result",
-                                      {"task_id": task_id,
-                                       "results": fut.result()})))
+                # Same coalescing as the reply path: every frame on this
+                # writer must queue through coalesced_write or interleaved
+                # direct writes would reorder against buffered ones.
+                coalesced_write(writer, _encode((-1, "task_result",
+                                                 {"task_id": task_id,
+                                                  "results": fut.result()})))
             except Exception:
                 pass  # connection gone: the batch reply path handles it
 
@@ -1891,14 +1894,14 @@ class _GenEmitter:
         self._cond = threading.Condition()
 
     def send(self, task_id: TaskID, index: int, res: tuple, worker_addr: str):
-        from .rpc import _encode
+        from .rpc import _encode, coalesced_write
         frame = _encode((-1, "gen_yield", {
             "task_id": task_id, "index": index, "result": res,
             "worker": worker_addr}))
 
         def _write():
             try:
-                self._writer.write(frame)
+                coalesced_write(self._writer, frame)
             except Exception:
                 pass  # connection gone: the batch reply path handles it
 

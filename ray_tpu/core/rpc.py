@@ -63,6 +63,52 @@ def _encode(msg) -> bytes:
     return len(payload).to_bytes(4, "big") + payload
 
 
+def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
+    """Queue a frame and flush once per event-loop tick.
+
+    One socket write per message was the top cost in PROFILE_CORE.md (53-68%
+    of IO-loop samples in streams.write during tasks_async / n:n actors):
+    every task submission, reply, and streamed result paid its own
+    transport write.  Buffering frames and writing the concatenation on the
+    next loop tick batches everything enqueued in the current tick into one
+    syscall, preserving FIFO order PROVIDED every frame on a given writer
+    goes through this function (mixing with direct writer.write would
+    reorder).  Flow control: callers in coroutine context should
+    ``await drain_if_needed(writer)`` after queueing."""
+    buf = getattr(writer, "_raytpu_buf", None)
+    if buf is None:
+        buf = writer._raytpu_buf = []
+    buf.append(data)
+    if not getattr(writer, "_raytpu_flush_scheduled", False):
+        writer._raytpu_flush_scheduled = True
+        asyncio.get_event_loop().call_soon(_flush_writer, writer)
+
+
+def _flush_writer(writer: "asyncio.StreamWriter") -> None:
+    writer._raytpu_flush_scheduled = False
+    buf = getattr(writer, "_raytpu_buf", None)
+    if not buf:
+        return
+    data = b"".join(buf) if len(buf) > 1 else buf[0]
+    buf.clear()
+    try:
+        writer.write(data)
+    except Exception:
+        pass  # connection died; the read loop surfaces it
+
+
+async def drain_if_needed(writer: "asyncio.StreamWriter",
+                          high_water: int = 1 << 20) -> None:
+    """Apply backpressure only when the transport buffer is actually deep —
+    an unconditional drain() per frame defeats the coalescing."""
+    try:
+        if writer.transport.get_write_buffer_size() > high_water:
+            _flush_writer(writer)
+            await writer.drain()
+    except Exception:
+        pass
+
+
 async def _read_msg(reader: asyncio.StreamReader):
     hdr = await reader.readexactly(4)
     n = int.from_bytes(hdr, "big")
@@ -168,8 +214,8 @@ class RpcServer:
                                    f"{result!r:.500}")
                 payload = _encode((req_id, False, (err, "")))
             try:
-                writer.write(payload)
-                await writer.drain()
+                coalesced_write(writer, payload)
+                await drain_if_needed(writer)
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
@@ -269,8 +315,8 @@ class RpcClient:
         req_id = next(self._req_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
-        self._writer.write(_encode((req_id, method, kwargs)))
-        await self._writer.drain()
+        coalesced_write(self._writer, _encode((req_id, method, kwargs)))
+        await drain_if_needed(self._writer)
         return fut
 
     async def call(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
@@ -280,8 +326,8 @@ class RpcClient:
 
     async def notify(self, method: str, **kwargs):
         await self._ensure_connected()
-        self._writer.write(_encode((-1, method, kwargs)))
-        await self._writer.drain()
+        coalesced_write(self._writer, _encode((-1, method, kwargs)))
+        await drain_if_needed(self._writer)
 
     def call_sync(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
         return run_async(self.call(method, _timeout=_timeout, **kwargs),
@@ -291,6 +337,7 @@ class RpcClient:
         self._closed = True
         if self._writer:
             try:
+                _flush_writer(self._writer)  # don't drop coalesced frames
                 self._writer.close()
             except Exception:
                 pass
