@@ -96,6 +96,11 @@ class Config:
     # -- rpc ---------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0
+    #: Actor __init__ runs arbitrary user code (model loads, XLA compiles —
+    #: an LLM replica warms minutes of prefill buckets): the creation call
+    #: must not be bounded by the generic RPC timeout, or the agent kills
+    #: the worker mid-compile and the GCS retries forever.
+    actor_creation_timeout_s: float = 3600.0
 
     # -- pubsub / syncer ---------------------------------------------------
     #: Resource-view gossip period (reference: RaySyncer, ray_syncer.h:86).

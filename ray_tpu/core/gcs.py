@@ -314,7 +314,9 @@ class GcsServer:
             if nid is not None:
                 agent = self.agent_clients.get(self.nodes[nid].address)
                 try:
-                    res = await agent.call("create_actor", spec=spec)
+                    res = await agent.call(
+                        "create_actor", spec=spec,
+                        _timeout=get_config().actor_creation_timeout_s + 30)
                     if self.actors.get(aid) is not info or info["state"] == "DEAD":
                         # Killed while the creation RPC was in flight: reap the
                         # freshly created worker instead of resurrecting.

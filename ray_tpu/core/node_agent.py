@@ -586,7 +586,8 @@ class NodeAgent:
         w.actor_id = spec.actor_id.hex()
         client = self.worker_clients.get(grant["worker_address"])
         try:
-            await client.call("create_actor", spec=spec)
+            await client.call("create_actor", spec=spec,
+                              _timeout=get_config().actor_creation_timeout_s)
         except Exception:
             await self._kill_worker_proc(w)
             self._release_lease_resources(grant["lease_id"])
@@ -1029,6 +1030,35 @@ class NodeAgent:
 
     async def handle_ping(self):
         return "pong"
+
+    async def handle_list_logs(self) -> List[dict]:
+        """Session log files on this node (reference: dashboard log module's
+        per-node listing)."""
+        logdir = os.path.join(self.session_dir, "logs")
+        out = []
+        try:
+            for name in sorted(os.listdir(logdir)):
+                p = os.path.join(logdir, name)
+                if os.path.isfile(p):
+                    out.append({"name": name, "size": os.path.getsize(p)})
+        except OSError:
+            pass
+        return out
+
+    async def handle_tail_log(self, name: str, nbytes: int = 65536) -> str:
+        """Last `nbytes` of one session log file.  The name is confined to
+        the log directory (no path components)."""
+        if "/" in name or "\\" in name or name.startswith("."):
+            return "(invalid log name)"
+        p = os.path.join(self.session_dir, "logs", name)
+        try:
+            size = os.path.getsize(p)
+            with open(p, "rb") as f:
+                if size > nbytes:
+                    f.seek(size - nbytes)
+                return f.read(nbytes).decode("utf-8", "replace")
+        except OSError as e:
+            return f"(unreadable: {e})"
 
     async def handle_node_info(self):
         return {"node_id": self.node_id.hex(), "address": self.server.address,

@@ -151,3 +151,31 @@ def test_actor_restart(ray_start_regular):
         ray_tpu.get(p.maybe_die.remote(True), timeout=30)
     # State reset after restart (fresh instance).
     assert ray_tpu.get(p.maybe_die.remote(False), timeout=30) == 1
+
+
+def test_slow_actor_init_survives_rpc_timeout(ray_start_regular):
+    """Actor __init__ may run far longer than the generic RPC call timeout
+    (model loads, XLA warmup): creation is bounded by
+    actor_creation_timeout_s, NOT rpc_call_timeout_s.  Regression: a 120s
+    default call timeout killed an LLM replica mid-warmup and the GCS
+    retried the creation forever."""
+    from ray_tpu.core.config import get_config
+    cfg = get_config()
+    old = cfg.rpc_call_timeout_s
+    cfg.rpc_call_timeout_s = 3.0
+    try:
+        @ray_tpu.remote
+        class SlowInit:
+            def __init__(self):
+                import time
+                time.sleep(6.0)  # 2x the generic call timeout
+                self.ok = True
+
+            def ready(self):
+                return self.ok
+
+        a = SlowInit.remote()
+        assert ray_tpu.get(a.ready.remote(), timeout=60) is True
+        ray_tpu.kill(a)
+    finally:
+        cfg.rpc_call_timeout_s = old
