@@ -1,19 +1,19 @@
-"""LLM serving benchmark: req/s + TTFT through the Serve stack.
+"""LLM serving benchmark: dense vs paged KV cache through the Serve stack.
 
 BASELINE.json's second north-star metric is "Serve req/s + p50 TTFT" for a
 continuous-batching LLM deployment (config #4).  This drives the real stack:
 HTTP-less handle path -> router -> replica actor -> LLMEngine (slot-scheduled
 continuous batching, bucketed prefill, single compiled decode step) on the
-local accelerator.
+local accelerator, THREE times over the same long-prompt mix:
 
-Prints ONE JSON line:
-  {"metric": "serve_llm", "req_per_s": ..., "p50_ttft_ms": ...,
-   "p99_ttft_ms": ..., "decode_tok_per_s": ...}
+  1. dense  — slots x max_len KV rows (the r2 configuration)
+  2. paged  — block-table KV pages (models/paged_decode.py)
+  3. paged + shared-prefix workload — every prompt shares a long common
+     prefix, so prefill hits the refcounted prefix cache
 
-vs_baseline: the reference has no LLM server to compare against (SURVEY §2.7)
-— the serving-stack overhead budget is the comparable: decode throughput
-through the full serving stack should be within 20% of the engine-only rate.
-vs_baseline = served_decode_tok_s / bare_engine_decode_tok_s; >= 0.8 passes.
+Prints ONE JSON line.  vs_baseline = paged req/s / dense req/s on the same
+mix (>= 1.0 means paging pays for itself; the reference has no LLM server to
+compare against, SURVEY §2.7).
 """
 
 from __future__ import annotations
@@ -28,90 +28,36 @@ import time
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="llama-1b")
-    p.add_argument("--clients", type=int, default=16)
-    p.add_argument("--requests", type=int, default=64)
-    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--prompt-len", type=int, default=256,
+                   help="max prompt length in the mix (min is 1/4 of this)")
     p.add_argument("--max-tokens", type=int, default=64)
     p.add_argument("--num-slots", type=int, default=16)
-    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--max-len", type=int, default=1024)
     args = p.parse_args()
 
     import ray_tpu
     from ray_tpu import serve
-    from ray_tpu.serve.llm import LLMEngine, llm_deployment
+    from ray_tpu.serve.llm import llm_deployment
 
-    # --- bare-engine baseline: same model/config, no serving stack.
-    # vs_baseline below = served decode throughput / this number (the
-    # serving-overhead ratio this file's docstring defines; the reference
-    # has no LLM server to compare against, SURVEY §2.7).
-    from ray_tpu.models import config as mcfg
     rng = random.Random(0)
+    buckets = (args.prompt_len // 4, args.prompt_len // 2, args.prompt_len)
 
-    def prompt():
-        n = rng.randint(args.prompt_len // 2, args.prompt_len)
+    def mixed_prompt():
+        """Long-prompt mix: lengths spread across all prefill buckets."""
+        n = rng.randint(args.prompt_len // 4, args.prompt_len)
         return [rng.randint(1, 1000) for _ in range(n)]
 
-    eng = LLMEngine(mcfg.PRESETS[args.preset](), num_slots=args.num_slots,
-                    max_len=args.max_len, buckets=(args.prompt_len,))
-    list(eng.stream(prompt(), max_tokens=4))  # compile
-    bare_tokens = 0
-    bare_t0 = time.time()
-    from ray_tpu.serve.llm import _FLUSH
-    pending = [eng.submit(prompt(), max_tokens=args.max_tokens)
-               for _ in range(args.num_slots * 2)]
-    for req in pending:
-        while True:
-            item = req.out.get()
-            if item is _FLUSH:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            bare_tokens += 1
-    bare_tok_s = bare_tokens / (time.time() - bare_t0)
-    eng.shutdown()
+    _prefix = [rng.randint(1, 1000) for _ in range(args.prompt_len - 32)]
 
-    # Paged-engine probe (same workload through the block-table KV cache +
-    # prefix caching): guarded — the primary serving metric must survive a
-    # paged compile failure on an exotic backend.
-    paged_tok_s = None
-    peng = None
-    try:
-        peng = LLMEngine(mcfg.PRESETS[args.preset](),
-                         num_slots=args.num_slots, max_len=args.max_len,
-                         buckets=(args.prompt_len,), paged=True)
-        list(peng.stream(prompt(), max_tokens=4))  # compile
-        n = 0
-        t0 = time.time()
-        reqs = [peng.submit(prompt(), max_tokens=args.max_tokens)
-                for _ in range(args.num_slots * 2)]
-        for req in reqs:
-            while True:
-                item = req.out.get()
-                if item is _FLUSH:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                n += 1
-        paged_tok_s = round(n / (time.time() - t0), 1)
-    except Exception as e:  # noqa: BLE001 — report, don't fail the bench
-        paged_tok_s = f"error: {type(e).__name__}: {e}"[:200]
-    finally:
-        if peng is not None:
-            # always stop the decode thread: a leaked engine would compete
-            # with the serve benchmark measured next
-            peng.shutdown()
+    def prefix_prompt():
+        """Shared-prefix workload: identical long prefix + short unique tail
+        (multi-turn / system-prompt shape; hits the paged prefix cache)."""
+        return _prefix + [rng.randint(1, 1000) for _ in range(32)]
 
-    ray_tpu.init(num_cpus=8)
-    try:
-        dep = llm_deployment(
-            args.preset, num_slots=args.num_slots, max_len=args.max_len,
-            max_concurrent_queries=256, health_check_timeout_s=600.0,
-            engine_kwargs={"buckets": (args.prompt_len,),
-                           "warmup_buckets": True})
-        h = serve.run(dep, timeout_s=600)
-        # warmup: compile prefill buckets + decode
-        list(h.stream({"tokens": prompt(), "max_tokens": 4}))
-
+    def drive(handle, make_prompt):
+        """Run the client fleet; returns (req_s, p50_ttft, p99_ttft, tok_s)."""
         ttfts, latencies, tokens = [], [], [0]
         lock = threading.Lock()
         reqs_per_client = args.requests // args.clients
@@ -119,10 +65,9 @@ def main():
         def client():
             for _ in range(reqs_per_client):
                 t0 = time.monotonic()
-                first = None
-                n = 0
-                for _tok in h.stream({"tokens": prompt(),
-                                      "max_tokens": args.max_tokens}):
+                first, n = None, 0
+                for _tok in handle.stream({"tokens": make_prompt(),
+                                           "max_tokens": args.max_tokens}):
                     if first is None:
                         first = time.monotonic() - t0
                     n += 1
@@ -140,34 +85,62 @@ def main():
         for t in threads:
             t.join()
         wall = time.time() - t0
-
         n_reqs = len(latencies)
         ttfts.sort()
-        stats = h.stats.remote().result(timeout_s=60)
+        return {
+            "req_per_s": round(n_reqs / wall, 2),
+            "p50_ttft_ms": round(ttfts[n_reqs // 2] * 1000, 1),
+            "p99_ttft_ms": round(
+                ttfts[min(n_reqs - 1, int(n_reqs * 0.99))] * 1000, 1),
+            "decode_tok_per_s": round(tokens[0] / wall, 1),
+        }
+
+    def run_serve(paged: bool, make_prompt, label: str):
+        """One full cluster lifecycle per configuration: the TPU is held
+        exclusively by the replica process, so the next configuration's
+        replica can only initialize after a complete teardown."""
+        print(f"# {label}: deploying…", flush=True)
+        ray_tpu.init(num_cpus=8)
+        try:
+            dep = llm_deployment(
+                args.preset, num_slots=args.num_slots, max_len=args.max_len,
+                max_concurrent_queries=256, health_check_timeout_s=600.0,
+                engine_kwargs={"buckets": buckets, "warmup_buckets": True,
+                               "paged": paged})
+            h = serve.run(dep, timeout_s=900)
+            list(h.stream({"tokens": make_prompt(), "max_tokens": 4}))
+            return drive(h, make_prompt)
+        finally:
+            try:
+                serve.shutdown()
+            except Exception:
+                pass
+            ray_tpu.shutdown()
+            time.sleep(5)  # let the replica process release the chip
+
+    try:
+        dense = run_serve(False, mixed_prompt, "dense")
+        paged = run_serve(True, mixed_prompt, "paged")
+        prefix = run_serve(True, prefix_prompt, "paged+prefix")
         print(json.dumps({
             "metric": "serve_llm_req_per_s",
-            "value": round(n_reqs / wall, 2),
+            "value": paged["req_per_s"],
             "unit": "req/s",
-            # served decode throughput as a fraction of the bare engine on
-            # the same box — the serving-stack overhead ratio (>= 0.8 is the
-            # budget; there is no reference LLM server, SURVEY 2.7)
-            "vs_baseline": round((tokens[0] / wall) / max(bare_tok_s, 1e-9),
-                                 3),
-            "bare_engine_tok_per_s": round(bare_tok_s, 1),
-            "paged_engine_tok_per_s": paged_tok_s,
-            "p50_ttft_ms": round(ttfts[n_reqs // 2] * 1000, 1),
-            "p99_ttft_ms": round(ttfts[min(n_reqs - 1,
-                                           int(n_reqs * 0.99))] * 1000, 1),
-            "decode_tok_per_s": round(tokens[0] / wall, 1),
+            # paging must at least match dense on the same long-prompt mix
+            "vs_baseline": round(
+                paged["req_per_s"] / max(dense["req_per_s"], 1e-9), 3),
+            "dense": dense,
+            "paged": paged,
+            "paged_prefix_hit": prefix,
             "model": args.preset,
-            "clients": args.clients, "requests": n_reqs,
-            "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
-            "num_slots": args.num_slots,
-            "engine_steps": stats["steps"],
+            "clients": args.clients, "requests": args.requests,
+            "prompt_mix": [args.prompt_len // 4, args.prompt_len],
+            "max_tokens": args.max_tokens,
+            "num_slots": args.num_slots, "max_len": args.max_len,
         }))
     finally:
-        serve.shutdown()
-        ray_tpu.shutdown()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
 
 
 if __name__ == "__main__":

@@ -246,17 +246,30 @@ class StandardAutoscaler:
         nt = self.config.node_types[node_type]
         try:
             pid = self.provider.create_node(node_type, dict(nt.labels))
-        except Exception:
+        except Exception as e:
             self.num_failed_launches += 1
+            self._event("WARNING", f"launch of {node_type} failed",
+                        error=repr(e))
             return None
         self._owned[pid] = node_type
         self._launched_at[pid] = time.monotonic()
         self.num_launches += 1
+        self._event("INFO", f"launched {node_type}", provider_id=pid)
         return pid
 
     def _terminate(self, pid: str):
+        node_type = self._owned.get(pid)
         self.provider.terminate_node(pid)
         self._owned.pop(pid, None)
         self._idle_since.pop(pid, None)
         self._launched_at.pop(pid, None)
         self.num_terminations += 1
+        self._event("INFO", f"terminated {node_type}", provider_id=pid)
+
+    def _event(self, severity: str, message: str, **labels):
+        """Structured cluster event (util/events; reference RAY_EVENT)."""
+        try:
+            from ray_tpu.util import events
+            events.record(severity, "autoscaler", message, **labels)
+        except Exception:
+            pass  # events require a live GCS; never break scaling on them

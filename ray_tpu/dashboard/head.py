@@ -131,12 +131,19 @@ class DashboardHead:
     async def serve_status(self, _req):
         import ray_tpu
         from ray_tpu.serve.controller import CONTROLLER_NAME
-        try:
-            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-        except Exception:
-            return _json({})
-        return _json(await _off(
-            lambda: ray_tpu.get(ctrl.get_status.remote(), timeout=30)))
+
+        # get_actor blocks on a GCS round-trip serviced by this same loop —
+        # it must run in the executor like every other blocking API here
+        # (calling it inline raised in run_async and leaked the un-awaited
+        # RPC coroutine while this handler silently answered {}).
+        def _status():
+            try:
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+            except Exception:
+                return {}
+            return ray_tpu.get(ctrl.get_status.remote(), timeout=30)
+
+        return _json(await _off(_status))
 
     async def serve_deploy(self, req):
         """Declarative deploy over REST (reference:
@@ -150,6 +157,14 @@ class DashboardHead:
     async def timeline(self, _req):
         from ray_tpu.util.tracing import chrome_trace
         return _json(await _off(chrome_trace))
+
+    async def events(self, req):
+        """Structured cluster events (reference: dashboard/modules/event)."""
+        from ray_tpu.util import events as ev
+        severity = req.query.get("severity")
+        source = req.query.get("source")
+        return _json(await _off(
+            lambda: ev.list_events(severity=severity, source=source)))
 
     async def stacks(self, _req):
         """Cluster-wide thread stacks (reference: dashboard reporter's
@@ -174,6 +189,56 @@ class DashboardHead:
             return out
 
         return _json(await _off(collect))
+
+    def _agent_addr(self, node_id: str) -> Optional[str]:
+        import ray_tpu
+        for n in ray_tpu.nodes():
+            if n.get("NodeID", "").startswith(node_id) and n.get("Alive"):
+                return n.get("AgentAddress")
+        return None
+
+    async def node_logs(self, req):
+        """List a node's session log files (reference: dashboard log module
+        backed by the per-node agent)."""
+        from ray_tpu.core.rpc import RpcClient, run_async
+        node_id = req.match_info["node_id"]
+
+        def fetch():
+            addr = self._agent_addr(node_id)
+            if addr is None:
+                return []
+            client = RpcClient(addr)
+            try:
+                return run_async(client.call("list_logs", _timeout=10.0),
+                                 timeout=15)
+            finally:
+                run_async(client.close(), timeout=2)
+
+        return _json(await _off(fetch))
+
+    async def node_log_tail(self, req):
+        from aiohttp import web
+        from ray_tpu.core.rpc import RpcClient, run_async
+        node_id = req.match_info["node_id"]
+        name = req.match_info["name"]
+        try:
+            nbytes = int(req.query.get("bytes", 64 * 1024))
+        except ValueError:
+            nbytes = 64 * 1024
+
+        def fetch():
+            addr = self._agent_addr(node_id)
+            if addr is None:
+                return "(node not found)"
+            client = RpcClient(addr)
+            try:
+                return run_async(client.call("tail_log", name=name,
+                                             nbytes=nbytes, _timeout=10.0),
+                                 timeout=15)
+            finally:
+                run_async(client.close(), timeout=2)
+
+        return web.Response(text=await _off(fetch))
 
     async def index(self, _req):
         from aiohttp import web
@@ -210,6 +275,9 @@ class DashboardHead:
         r.add_post("/api/serve/deploy", self.serve_deploy)
         r.add_get("/api/stacks", self.stacks)
         r.add_get("/api/timeline", self.timeline)
+        r.add_get("/api/logs/{node_id}", self.node_logs)
+        r.add_get("/api/logs/{node_id}/{name}", self.node_log_tail)
+        r.add_get("/api/events", self.events)
         # Web UI (reference: dashboard/client React SPA; here a no-build
         # vanilla SPA served from package data over the same REST API).
         r.add_get("/", self.index)

@@ -35,9 +35,9 @@ function table(cols, rows) {
 
 function badge(text) {
   const s = String(text || "").toUpperCase();
-  const cls = ["ALIVE", "RUNNING", "FINISHED", "CREATED", "SUCCEEDED", "HEALTHY"].includes(s)
-    ? "ok" : ["PENDING", "RESTARTING", "WAITING", "UPDATING"].includes(s)
-    ? "warn" : ["DEAD", "FAILED", "STOPPED", "INFEASIBLE", "UNHEALTHY"].includes(s)
+  const cls = ["ALIVE", "RUNNING", "FINISHED", "CREATED", "SUCCEEDED", "HEALTHY", "INFO", "DEBUG"].includes(s)
+    ? "ok" : ["PENDING", "RESTARTING", "WAITING", "UPDATING", "WARNING"].includes(s)
+    ? "warn" : ["DEAD", "FAILED", "STOPPED", "INFEASIBLE", "UNHEALTHY", "ERROR", "FATAL"].includes(s)
     ? "err" : "";
   const el = h("span", { class: "badge " + cls }, s || "?");
   return el;
@@ -138,17 +138,108 @@ const pages = {
   },
 
   async timeline() {
-    return h("div", {}, h("h2", {}, "Timeline"),
-      h("p", {}, "Chrome-trace export of task events. Load it in ",
-        h("span", { class: "mono" }, "chrome://tracing"), " or Perfetto."),
-      h("button", { onclick: async () => {
-        const data = await api("timeline");
+    const data = await api("timeline");
+    const slices = data.filter((e) => e.ph === "X" && e.dur > 0);
+    const view = h("div", {}, h("h2", {}, `Timeline (${slices.length} slices)`),
+      h("button", { onclick: () => {
         const blob = new Blob([JSON.stringify(data)], { type: "application/json" });
         const a = h("a", { href: URL.createObjectURL(blob), download: "timeline.json" });
         a.click();
-      } }, "Download timeline.json"));
+      } }, "Download timeline.json (chrome://tracing / Perfetto)"));
+    if (!slices.length) {
+      view.append(h("p", { class: "muted" }, "no task slices recorded yet"));
+      return view;
+    }
+    view.append(renderGantt(slices));
+    return view;
+  },
+
+  async events() {
+    const evs = await api("events");
+    return h("div", {}, h("h2", {}, `Events (${evs.length})`),
+      table(["time", "severity", "source", "message", "labels"],
+        evs.map((e) => [
+          new Date(e.ts * 1000).toLocaleTimeString(), badge(e.severity),
+          e.source, e.message, JSON.stringify(e.labels || {})])));
+  },
+
+  async logs() {
+    const nodes = await api("nodes");
+    const alive = nodes.filter((n) => n.Alive);
+    const sel = location.hash.split("/");          // #logs/<node>/<file>
+    const nodeId = sel[1] || (alive[0] && alive[0].NodeID) || "";
+    if (!nodeId) return h("p", { class: "muted" }, "no live nodes");
+    const picker = h("div", { class: "toolbar" },
+      alive.map((n) => h("a", {
+        class: "plain" + (n.NodeID === nodeId ? " active" : ""),
+        href: `#logs/${n.NodeID}` }, (n.NodeID || "").slice(0, 12))));
+    if (sel.length >= 3) {                          // tail one file, live
+      const name = decodeURIComponent(sel.slice(2).join("/"));
+      const text = await api(`logs/${nodeId}/${encodeURIComponent(name)}`)
+        .catch((e) => "error: " + e.message);
+      const pre = h("pre", { class: "logs", id: "logtail" }, text || "(empty)");
+      queueMicrotask(() => { pre.scrollTop = pre.scrollHeight; });
+      return h("div", {}, h("h2", {}, `Logs — ${name}`), picker,
+        h("p", {}, h("a", { class: "plain", href: `#logs/${nodeId}` }, "« all files"),
+          h("span", { class: "muted" }, "  (auto-refreshes; tail of file)")),
+        pre);
+    }
+    const files = await api(`logs/${nodeId}`).catch(() => []);
+    return h("div", {}, h("h2", {}, "Logs"), picker,
+      table(["file", "size"], files.map((f) => [
+        h("a", { class: "plain",
+                 href: `#logs/${nodeId}/${encodeURIComponent(f.name)}` }, f.name),
+        `${f.size} B`])));
   },
 };
+
+/* SVG Gantt over chrome-trace "X" slices: one lane per pid/tid, bar color
+   hashed from the event name, hover shows name + duration. */
+function renderGantt(allSlices) {
+  // Cap BEFORE computing extents/lanes: spread-args over 100k+ slices
+  // blows the call stack, and uncapped lanes make the SVG unusable anyway.
+  const slices = allSlices.slice(-2000);
+  let t0 = Infinity, t1 = -Infinity;
+  for (const s of slices) {
+    if (s.ts < t0) t0 = s.ts;
+    if (s.ts + s.dur > t1) t1 = s.ts + s.dur;
+  }
+  const span = Math.max(t1 - t0, 1);
+  const lanes = [...new Set(slices.map((s) => `${s.pid}/${s.tid}`))].sort();
+  const laneH = 22, width = 960, labelW = 150;
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("width", width + labelW);
+  svg.setAttribute("height", lanes.length * laneH + 24);
+  svg.setAttribute("class", "gantt");
+  const mk = (tag, attrs, text) => {
+    const el = document.createElementNS("http://www.w3.org/2000/svg", tag);
+    for (const [k, v] of Object.entries(attrs)) el.setAttribute(k, v);
+    if (text) el.textContent = text;
+    svg.append(el);
+    return el;
+  };
+  lanes.forEach((lane, i) => {
+    mk("text", { x: 4, y: i * laneH + 15, class: "lane-label" },
+      lane.length > 22 ? lane.slice(0, 22) + "…" : lane);
+    mk("line", { x1: labelW, y1: (i + 1) * laneH, x2: width + labelW,
+                 y2: (i + 1) * laneH, class: "lane-line" });
+  });
+  for (const s of slices) {
+    const lane = lanes.indexOf(`${s.pid}/${s.tid}`);
+    const x = labelW + ((s.ts - t0) / span) * width;
+    const w = Math.max((s.dur / span) * width, 1.5);
+    let hash = 0;
+    for (const ch of s.name || "") hash = (hash * 31 + ch.charCodeAt(0)) | 0;
+    const r = mk("rect", { x, y: lane * laneH + 3, width: w, height: laneH - 6,
+                           rx: 2, fill: `hsl(${((hash % 360) + 360) % 360},65%,55%)` });
+    const title = document.createElementNS("http://www.w3.org/2000/svg", "title");
+    title.textContent = `${s.name}  ${(s.dur / 1000).toFixed(2)} ms`;
+    r.append(title);
+  }
+  mk("text", { x: labelW, y: lanes.length * laneH + 18, class: "lane-label" },
+    `${(span / 1000).toFixed(1)} ms total`);
+  return svg;
+}
 
 async function jobDetail(jobId) {
   const info = await api(`jobs/${jobId}`).catch(() => ({}));

@@ -99,11 +99,23 @@ class Router:
             if replica_name in self._table.get(deployment, []):
                 self._table[deployment].remove(replica_name)
             self._handles.pop(replica_name, None)
-        try:
-            _controller().report_replica_failure.remote(deployment,
-                                                        replica_name)
-        except Exception:
-            pass
+
+        def _report():
+            try:
+                _controller().report_replica_failure.remote(deployment,
+                                                            replica_name)
+            except Exception:
+                pass
+
+        # _evict also fires from ref done-callbacks, which run ON the IO
+        # loop thread — get_actor's blocking GCS round-trip would raise in
+        # run_async there (silently dropping the report).  Evictions are
+        # rare; a short-lived thread keeps the report path thread-agnostic.
+        if threading.current_thread().name == "raytpu-io":
+            threading.Thread(target=_report, daemon=True,
+                             name="router-evict-report").start()
+        else:
+            _report()
 
     # ------------------------------------------------------- p2c selection
 

@@ -12,9 +12,11 @@ from .search import (BasicVariantGenerator, BayesOptSearcher, BOHBSearcher,
                      sample_from, uniform)
 from .schedulers import (PB2, AsyncHyperBandScheduler, FIFOScheduler,
                          HyperBandScheduler, MedianStoppingRule,
-                         PopulationBasedTraining, TrialScheduler)
+                         PopulationBasedTraining, ResourceChangingScheduler,
+                         TrialScheduler)
 from .session import (get_checkpoint, get_session, get_trial_dir,
-                      get_trial_id, report, report_bridge)
+                      get_trial_id, get_trial_resources, report,
+                      report_bridge)
 from .trial import Trial
 from .controller import TuneController
 from .tuner import ResultGrid, TuneConfig, Tuner
@@ -31,5 +33,6 @@ __all__ = [
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining", "PB2", "BOHBSearcher",
     "report", "get_checkpoint", "get_session", "get_trial_id",
-    "get_trial_dir", "report_bridge",
+    "get_trial_dir", "get_trial_resources", "report_bridge",
+    "ResourceChangingScheduler",
 ]

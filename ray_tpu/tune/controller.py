@@ -20,7 +20,8 @@ import ray_tpu
 from ray_tpu import ActorDiedError, RayTpuError, TaskError
 
 from . import schedulers as sched_mod
-from .schedulers import CONTINUE, PERTURB, STOP, FIFOScheduler, TrialScheduler
+from .schedulers import (CONTINUE, PERTURB, RESIZE, STOP, FIFOScheduler,
+                         TrialScheduler)
 from .search import BasicVariantGenerator, Searcher
 from .trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial, TrialRunner)
 
@@ -82,10 +83,11 @@ class TuneController:
     def _start_trial(self, trial: Trial,
                      checkpoint_path: Optional[str] = None) -> None:
         cls = ray_tpu.remote(TrialRunner)
-        opts: Dict[str, Any] = {"num_cpus": self.resources.get("CPU", 1)}
-        if self.resources.get("TPU"):
-            opts["num_tpus"] = self.resources["TPU"]
-        extra = {k: v for k, v in self.resources.items()
+        res = trial.resources or self.resources
+        opts: Dict[str, Any] = {"num_cpus": res.get("CPU", 1)}
+        if res.get("TPU"):
+            opts["num_tpus"] = res["TPU"]
+        extra = {k: v for k, v in res.items()
                  if k not in ("CPU", "TPU", "GPU")}
         if extra:
             opts["resources"] = extra
@@ -98,7 +100,7 @@ class TuneController:
         # method ordering guarantees run() precedes the next_result() poll.
         trial.runner.run.remote(
             self.trainable, trial.config, trial.trial_id, trial.trial_dir,
-            checkpoint_path or trial.latest_checkpoint)
+            checkpoint_path or trial.latest_checkpoint, resources=res)
         trial.status = RUNNING
 
     def _stop_trial(self, trial: Trial, status: str = TERMINATED) -> None:
@@ -146,6 +148,14 @@ class TuneController:
         elif decision == STOP:
             self._stop_trial(trial)
             self.searcher.on_trial_complete(trial.trial_id, metrics)
+        elif isinstance(decision, tuple) and decision[0] == RESIZE:
+            # ResourceChangingScheduler: restart the trial actor with the
+            # new allocation, resuming from its latest checkpoint.
+            _, new_resources = decision
+            self._stop_trial(trial, status=PENDING)
+            trial.resources = new_resources
+            trial.restarts += 1
+            self._start_trial(trial)
         elif isinstance(decision, tuple) and decision[0] == PERTURB:
             _, new_config, donor_id = decision
             donor = next((t for t in self.trials

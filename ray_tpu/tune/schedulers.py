@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 CONTINUE = "CONTINUE"
 STOP = "STOP"
 PERTURB = "PERTURB"
+RESIZE = "RESIZE"
 
 
 class TrialScheduler:
@@ -356,3 +357,53 @@ class PB2(PopulationBasedTraining):
             nv = min(max(nv, lo), hi)
             new[key] = int(round(nv)) if isinstance(v, int) else nv
         return new
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate a trial's resources mid-run (reference:
+    ``tune/schedulers/resource_changing_scheduler.py``).
+
+    Wraps a base scheduler; after each result the
+    ``resources_allocation_function(controller_state, trial, result)`` may
+    return a new resources dict — the controller then checkpoints-restarts
+    the trial actor with the new allocation, and the trainable reads it via
+    ``tune.get_trial_resources()``.  The base scheduler's decision applies
+    when no reallocation happens (a RESIZE supersedes CONTINUE but not
+    STOP)."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        # NOTE: metric/mode are delegating properties over self.base, so it
+        # must exist before the base-class __init__ assigns them.
+        super().__init__(self.base.metric, self.base.mode)
+        self.alloc_fn = resources_allocation_function
+
+    @property
+    def metric(self):  # delegate scoring config to the base scheduler
+        return self.base.metric
+
+    @metric.setter
+    def metric(self, v):
+        self.base.metric = v
+
+    @property
+    def mode(self):
+        return self.base.mode
+
+    @mode.setter
+    def mode(self, v):
+        self.base.mode = v
+
+    def on_result(self, trial, result):
+        decision = self.base.on_result(trial, result)
+        if decision != CONTINUE or self.alloc_fn is None:
+            # STOP and PERTURB take precedence: a PBT exploit must not be
+            # silently swallowed by a same-result resize (the base already
+            # updated its perturb bookkeeping).  The resize retries on the
+            # next report.
+            return decision
+        new = self.alloc_fn(None, trial, result)
+        if new and dict(new) != dict(trial.resources or {}):
+            return (RESIZE, dict(new))
+        return decision

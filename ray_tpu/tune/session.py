@@ -18,10 +18,12 @@ from ..train.context import SessionFinished
 
 class _TuneSession:
     def __init__(self, trial_id: str, trial_dir: str,
-                 checkpoint: Optional[Checkpoint] = None):
+                 checkpoint: Optional[Checkpoint] = None,
+                 resources: Optional[Dict[str, float]] = None):
         self.trial_id = trial_id
         self.trial_dir = trial_dir
         self.checkpoint = checkpoint
+        self.trial_resources = dict(resources or {})
         self._q: "queue.Queue" = queue.Queue()
         self._evt = threading.Event()
         self._aborted = False
@@ -87,6 +89,13 @@ def get_trial_id() -> str:
 
 def get_trial_dir() -> str:
     return get_session().trial_dir
+
+
+def get_trial_resources() -> Dict[str, float]:
+    """Resources currently allocated to this trial (reference:
+    tune.get_trial_resources, used with ResourceChangingScheduler to adapt
+    e.g. batch size to a mid-run reallocation)."""
+    return dict(get_session().trial_resources)
 
 
 def report_bridge(metrics: Dict[str, Any], checkpoint=None) -> None:

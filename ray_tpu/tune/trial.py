@@ -40,6 +40,9 @@ class Trial:
     # training_iteration, which resets to 1 after a PBT perturb / failure
     # restart and would merge fresh files into a stale directory.
     ckpt_seq: int = 0
+    # Per-trial resource override (ResourceChangingScheduler); None = the
+    # experiment-wide resources_per_trial.
+    resources: Optional[Dict[str, float]] = None
     _pending_ref: Any = None  # outstanding next_result ref (controller-owned)
 
     @property
@@ -66,14 +69,16 @@ class TrialRunner:
         self._thread: Optional[threading.Thread] = None
 
     def run(self, trainable, config: Dict[str, Any], trial_id: str,
-            trial_dir: str, checkpoint_path: Optional[str]) -> None:
+            trial_dir: str, checkpoint_path: Optional[str],
+            resources: Optional[Dict[str, float]] = None) -> None:
         from . import session as tune_session
         from ..train.checkpoint import Checkpoint
         from ..train.context import SessionFinished
 
         sess = tune_session._TuneSession(
             trial_id=trial_id, trial_dir=trial_dir,
-            checkpoint=Checkpoint(checkpoint_path) if checkpoint_path else None)
+            checkpoint=Checkpoint(checkpoint_path) if checkpoint_path else None,
+            resources=resources)
         self._session = sess
         tune_session._set_session(sess)
 

@@ -1,0 +1,86 @@
+"""Structured event framework (reference: ``src/ray/util/event.h:41``
+``RAY_EVENT`` macros + ``dashboard/modules/event``).
+
+Any process in the cluster records severity-leveled, labeled events;
+they land in a bounded ring buffer in the GCS KV (namespace ``events``)
+and are queryable cluster-wide (``list_events``) and over the dashboard
+REST route ``/api/events``.  Redesigned for the pure-Python control
+plane: instead of the reference's per-process event files + an agent
+that tails and aggregates them, events ride the existing KV + pubsub —
+one write per event, no files to rotate, and the ring bound is enforced
+at the writer.
+
+Usage::
+
+    from ray_tpu.util import events
+    events.record("WARNING", "autoscaler", "scale-up failed",
+                  node_type="v5e-8", error="quota")
+    events.list_events(severity="WARNING")
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+_NS = "events"
+_RING = 1000  # per-writer ring size; a writer's oldest events are evicted
+_seq = itertools.count()
+
+
+def _kv():
+    from ray_tpu.experimental import internal_kv
+    return internal_kv
+
+
+def record(severity: str, source: str, message: str,
+           **labels: Any) -> Dict[str, Any]:
+    """Record one structured event; returns the event dict."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"severity must be one of {SEVERITIES}")
+    kv = _kv()
+    ev = {
+        "severity": severity,
+        "source": source,
+        "message": message,
+        "labels": {k: str(v) for k, v in labels.items()},
+        "ts": time.time(),
+        "pid": os.getpid(),
+    }
+    # Per-writer ring: each process cycles its own _RING keys (no global
+    # counter round-trip); readers order by `ts`.
+    seq = next(_seq) % _RING
+    kv.internal_kv_put(f"ev:{os.getpid()}:{seq:04d}",
+                       json.dumps(ev).encode(), namespace=_NS)
+    return ev
+
+
+def list_events(severity: Optional[str] = None,
+                source: Optional[str] = None,
+                limit: int = 200) -> List[Dict[str, Any]]:
+    """Cluster-wide events, newest first, optionally filtered."""
+    kv = _kv()
+    out: List[Dict[str, Any]] = []
+    for key in kv.internal_kv_keys("ev:", namespace=_NS):
+        blob = kv.internal_kv_get(key, namespace=_NS)
+        if not blob:
+            continue
+        try:
+            ev = json.loads(blob)
+        except ValueError:
+            continue
+        if severity and ev.get("severity") != severity:
+            continue
+        if source and ev.get("source") != source:
+            continue
+        out.append(ev)
+    out.sort(key=lambda e: -e.get("ts", 0.0))
+    return out[:limit]
+
+
+__all__ = ["record", "list_events", "SEVERITIES"]

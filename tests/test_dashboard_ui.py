@@ -34,3 +34,53 @@ def test_dashboard_serves_spa(ray_start_regular):
             json.loads(body)
     finally:
         stop_dashboard()
+
+
+def test_dashboard_timeline_and_logs_views(ray_start_regular):
+    """The two r4 UI views have data behind them: /api/timeline returns
+    renderable X-slices after tasks ran, and the log endpoints list + tail a
+    node's session logs (VERDICT r3 item 10)."""
+    import ray_tpu
+    from ray_tpu.dashboard.head import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    assert ray_tpu.get([work.remote(i) for i in range(4)]) == [0, 2, 4, 6]
+
+    port = start_dashboard(port=0)
+    try:
+        # timeline: the SPA's gantt renders ph="X" slices — assert they
+        # exist (task events flush to the GCS once per second; poll)
+        import time
+        deadline = time.monotonic() + 15
+        slices = []
+        while time.monotonic() < deadline and not slices:
+            status, body = _get(port, "/api/timeline")
+            assert status == 200
+            slices = [e for e in json.loads(body)
+                      if e.get("ph") == "X" and e.get("dur", 0) > 0]
+            if not slices:
+                time.sleep(0.5)
+        assert slices, "no complete task slices in timeline"
+        assert all("pid" in s and "ts" in s for s in slices)
+
+        # the SPA itself contains the gantt renderer + logs page wiring
+        _, appjs = _get(port, "/static/app.js")
+        assert "renderGantt" in appjs and "logs/" in appjs
+
+        # logs: list files on the node, then tail one with content
+        status, body = _get(port, "/api/nodes")
+        node_id = json.loads(body)[0]["NodeID"]
+        status, body = _get(port, f"/api/logs/{node_id}")
+        assert status == 200
+        files = json.loads(body)
+        assert files and all("name" in f and "size" in f for f in files)
+        worker_logs = [f for f in files if f["name"].startswith("worker-")]
+        assert worker_logs, files
+        status, body = _get(port, f"/api/logs/{node_id}/"
+                                  f"{worker_logs[0]['name']}?bytes=4096")
+        assert status == 200
+    finally:
+        stop_dashboard()

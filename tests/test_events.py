@@ -1,0 +1,54 @@
+"""Structured event framework (reference: src/ray/util/event.h:41 RAY_EVENT
++ dashboard/modules/event)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import events
+
+
+def test_record_and_list(ray_start_regular):
+    events.record("INFO", "test", "hello world", key="v1")
+    events.record("WARNING", "test", "watch out", node="n1")
+    events.record("ERROR", "other", "boom")
+
+    evs = events.list_events()
+    assert len(evs) >= 3
+    assert evs[0]["ts"] >= evs[-1]["ts"]  # newest first
+
+    warns = events.list_events(severity="WARNING")
+    assert warns and all(e["severity"] == "WARNING" for e in warns)
+    assert warns[0]["labels"] == {"node": "n1"}
+
+    mine = events.list_events(source="other")
+    assert all(e["source"] == "other" for e in mine)
+    with pytest.raises(ValueError):
+        events.record("LOUD", "test", "nope")
+
+
+def test_events_visible_from_workers_and_dashboard(ray_start_regular):
+    pytest.importorskip("aiohttp")
+
+    @ray_tpu.remote
+    def emit():
+        from ray_tpu.util import events as ev
+        ev.record("ERROR", "worker-task", "task-side event", attempt="1")
+        return True
+
+    assert ray_tpu.get(emit.remote(), timeout=60)
+    evs = events.list_events(source="worker-task")
+    assert evs and evs[0]["message"] == "task-side event"
+
+    from ray_tpu.dashboard.head import start_dashboard, stop_dashboard
+    port = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/events?severity=ERROR",
+                timeout=30) as r:
+            body = json.loads(r.read())
+        assert any(e["source"] == "worker-task" for e in body)
+    finally:
+        stop_dashboard()

@@ -133,3 +133,48 @@ def test_pb2_beats_random_on_quadratic(ray_start_regular, tmp_path):
     # after perturbation, some trial must have moved lr off the grid values
     final_lrs = [t.config["lr"] for t in results.trials]
     assert any(lr not in (0.05, 0.1, 0.9, 0.95) for lr in final_lrs), final_lrs
+
+
+def test_resource_changing_scheduler(ray_start_regular, tmp_path):
+    """After iteration 2 the allocation fn doubles the trial's CPUs: the
+    controller checkpoint-restarts the trial actor with the new allocation
+    and the trainable observes it via tune.get_trial_resources()
+    (reference: tune/schedulers/resource_changing_scheduler.py)."""
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.tune import ResourceChangingScheduler
+
+    def trainable(config):
+        start = 0
+        ck = tune.get_checkpoint()
+        if ck is not None:
+            with open(os.path.join(ck.path, "it")) as f:
+                start = int(f.read())
+        for i in range(start, 5):
+            cdir = os.path.join(tune.get_trial_dir(), f"rck_{i}")
+            os.makedirs(cdir, exist_ok=True)
+            with open(os.path.join(cdir, "it"), "w") as f:
+                f.write(str(i + 1))
+            tune.report({"score": 1.0, "training_iteration": i + 1,
+                         "cpus": tune.get_trial_resources().get("CPU", 0)},
+                        checkpoint=Checkpoint(cdir))
+
+    def alloc(_state, trial, result):
+        if result.get("training_iteration", 0) >= 2:
+            return {"CPU": 2}
+        return None
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=ResourceChangingScheduler(
+                resources_allocation_function=alloc)),
+        run_config=RunConfig(name="rcs", storage_path=str(tmp_path)),
+    ).fit()
+    (t,) = results.trials
+    assert t.restarts >= 1
+    assert t.resources == {"CPU": 2}
+    # the trainable saw the new allocation after the restart
+    cpus = [m["cpus"] for m in t.metrics_history]
+    assert cpus[0] == 1.0 and cpus[-1] == 2.0, cpus
