@@ -1,0 +1,266 @@
+"""Autoscaler v2: GCS-authoritative instance manager.
+
+Reference: ``python/ray/autoscaler/v2/`` +
+``src/ray/protobuf/experimental/instance_manager.proto`` — v1 keeps the
+fleet picture in the head-side loop's memory, so a head restart forgets
+which cloud instances it launched and why; v2 moves the instance lifecycle
+state machine into the GCS, with the head-side loop reduced to (a) a
+demand→target calculator and (b) a provider reconciler that converges
+actual instances toward the GCS-recorded targets.
+
+TPU-first redesign: instead of a new protobuf service + storage table, the
+instance table and targets live in the GCS KV (namespace ``autoscaler``),
+which the GCS already snapshots to disk and restores on restart — the
+authority/durability property of the reference's GcsAutoscalerStateManager
+with zero new wire surface.  Preemption (the dominant failure on TPU
+fleets) is a provider-reported disappearance: the reconciler marks the
+instance TERMINATED and the next tick relaunches to target.
+
+Instance lifecycle::
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RUNNING
+                   \\-> FAILED               \\-> TERMINATING -> TERMINATED
+                                             \\-> TERMINATED   (preempted)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+QUEUED = "QUEUED"            # target raised; not yet requested from provider
+REQUESTED = "REQUESTED"      # provider.create_node in flight
+ALLOCATED = "ALLOCATED"      # provider id assigned; node booting
+RUNNING = "RUNNING"          # registered with the cluster (has a node_id)
+TERMINATING = "TERMINATING"  # terminate requested
+TERMINATED = "TERMINATED"    # gone (graceful or preempted)
+FAILED = "FAILED"            # launch failed
+
+_NS = "autoscaler"
+_LIVE = (QUEUED, REQUESTED, ALLOCATED, RUNNING)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    provider_id: Optional[str] = None
+    node_id: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    detail: str = ""
+
+
+class InstanceManager:
+    """CRUD + lifecycle transitions over the GCS KV (the authority)."""
+
+    def __init__(self):
+        from ray_tpu.experimental import internal_kv
+        self._kv = internal_kv
+
+    # -- targets -----------------------------------------------------------
+
+    def set_target(self, node_type: str, count: int) -> None:
+        self._kv.internal_kv_put(f"target:{node_type}",
+                                 str(int(count)).encode(), namespace=_NS)
+
+    def get_targets(self) -> Dict[str, int]:
+        out = {}
+        for key in self._kv.internal_kv_keys("target:", namespace=_NS):
+            blob = self._kv.internal_kv_get(key, namespace=_NS)
+            if blob:
+                out[key.split(":", 1)[1]] = int(blob)
+        return out
+
+    # -- instances ---------------------------------------------------------
+
+    def _put(self, inst: Instance) -> None:
+        inst.updated_at = time.time()
+        self._kv.internal_kv_put(f"inst:{inst.instance_id}",
+                                 json.dumps(asdict(inst)).encode(),
+                                 namespace=_NS)
+
+    def instances(self) -> List[Instance]:
+        out = []
+        for key in self._kv.internal_kv_keys("inst:", namespace=_NS):
+            blob = self._kv.internal_kv_get(key, namespace=_NS)
+            if blob:
+                out.append(Instance(**json.loads(blob)))
+        return out
+
+    def live(self, node_type: Optional[str] = None) -> List[Instance]:
+        return [i for i in self.instances() if i.status in _LIVE
+                and (node_type is None or i.node_type == node_type)]
+
+    def queue(self, node_type: str) -> Instance:
+        inst = Instance(instance_id=uuid.uuid4().hex[:12],
+                        node_type=node_type)
+        self._put(inst)
+        return inst
+
+    def transition(self, inst: Instance, status: str, *,
+                   provider_id: Optional[str] = None,
+                   node_id: Optional[str] = None,
+                   detail: str = "") -> Instance:
+        inst.status = status
+        if provider_id is not None:
+            inst.provider_id = provider_id
+        if node_id is not None:
+            inst.node_id = node_id
+        inst.detail = detail
+        self._put(inst)
+        try:
+            from ray_tpu.util import events
+            sev = "WARNING" if status in (FAILED, TERMINATED) else "INFO"
+            events.record(sev, "autoscaler-v2",
+                          f"instance {inst.instance_id} -> {status}",
+                          node_type=inst.node_type,
+                          provider_id=inst.provider_id or "",
+                          detail=detail)
+        except Exception:
+            pass
+        return inst
+
+
+class Reconciler:
+    """Converge provider reality toward the GCS-recorded targets.
+
+    Stateless across restarts by construction: every decision derives from
+    the KV instance table + ``provider.non_terminated_nodes()`` — a fresh
+    reconciler (new head process) picks up exactly where the old one
+    stopped (reference: autoscaler v2's core property)."""
+
+    def __init__(self, provider, im: Optional[InstanceManager] = None,
+                 max_launches_per_tick: int = 2,
+                 requested_timeout_s: float = 300.0,
+                 max_terminal_records: int = 50):
+        self.provider = provider
+        self.im = im or InstanceManager()
+        self.max_launches = max_launches_per_tick
+        self.requested_timeout_s = requested_timeout_s
+        self.max_terminal = max_terminal_records
+
+    def tick(self) -> Dict[str, int]:
+        """One reconciliation pass; returns action counts (for tests)."""
+        actions = {"launched": 0, "terminated": 0, "preempted": 0,
+                   "queued": 0, "failed": 0, "orphans": 0}
+        im = self.im
+        targets = im.get_targets()
+        alive_pids = set(self.provider.non_terminated_nodes())
+        all_insts = im.instances()
+        by_type: Dict[str, List[Instance]] = {}
+        for inst in all_insts:
+            by_type.setdefault(inst.node_type, []).append(inst)
+        launched_pids: set = set()
+
+        for ntype, target in targets.items():
+            insts = by_type.get(ntype, [])
+            now = time.time()
+            for inst in insts:
+                # provider-reported disappearance (preemption / crash)
+                if inst.status in (ALLOCATED, RUNNING) and \
+                        inst.provider_id not in alive_pids:
+                    im.transition(inst, TERMINATED, detail="preempted")
+                    actions["preempted"] += 1
+                # a crash between transition(REQUESTED) and the
+                # ALLOCATED/FAILED write strands the instance: time it out
+                # so the slot recovers (any node it DID launch is reclaimed
+                # by the orphan sweep below).
+                elif inst.status == REQUESTED and \
+                        now - inst.updated_at > self.requested_timeout_s:
+                    im.transition(inst, FAILED, detail="requested-timeout")
+                    actions["failed"] += 1
+                # terminate failed (or crashed) mid-flight last tick: retry
+                # until the provider confirms the node gone.
+                elif inst.status == TERMINATING:
+                    if inst.provider_id not in alive_pids:
+                        im.transition(inst, TERMINATED, detail="confirmed")
+                    else:
+                        try:
+                            self.provider.terminate_node(inst.provider_id)
+                            im.transition(inst, TERMINATED,
+                                          detail="scale-down")
+                        except Exception:
+                            pass  # stays TERMINATING; retried next tick
+            live = [i for i in insts if i.status in _LIVE]
+            # under target: queue + launch (bounded per tick)
+            for _ in range(max(0, target - len(live))):
+                live.append(im.queue(ntype))
+                actions["queued"] += 1
+            launched = 0
+            for inst in live:
+                if inst.status != QUEUED or launched >= self.max_launches:
+                    continue
+                im.transition(inst, REQUESTED)
+                try:
+                    pid = self.provider.create_node(ntype, {})
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    im.transition(inst, FAILED, detail=repr(e))
+                    actions["failed"] += 1
+                    continue
+                im.transition(inst, ALLOCATED, provider_id=pid)
+                launched_pids.add(pid)
+                actions["launched"] += 1
+                launched += 1
+            # over target: drop queued first, then the NEWEST non-running
+            # booting instance (keep the one closest to registering)
+            excess = len(live) - target
+            if excess > 0:
+                for inst in sorted(live, key=lambda i: (
+                        i.status == RUNNING, -i.created_at))[:excess]:
+                    if inst.status in (QUEUED, REQUESTED):
+                        im.transition(inst, TERMINATED, detail="un-queued")
+                    elif inst.provider_id:
+                        im.transition(inst, TERMINATING)
+                        try:
+                            self.provider.terminate_node(inst.provider_id)
+                            im.transition(inst, TERMINATED,
+                                          detail="scale-down")
+                        except Exception:
+                            pass  # stays TERMINATING; retried next tick
+                    actions["terminated"] += 1
+            # promote ALLOCATED -> RUNNING once the node registers
+            if hasattr(self.provider, "raytpu_node_id"):
+                for inst in live:
+                    if inst.status == ALLOCATED and inst.provider_id:
+                        nid = self.provider.raytpu_node_id(inst.provider_id)
+                        if nid:
+                            im.transition(inst, RUNNING, node_id=nid)
+
+        # Orphan sweep: provider nodes referenced by NO instance record
+        # (create_node returned but the head died before the ALLOCATED
+        # write).  Authoritative state means unaccounted nodes are leaks.
+        referenced = {i.provider_id for i in im.instances()
+                      if i.provider_id and i.status != TERMINATED}
+        for pid in alive_pids - referenced - launched_pids:
+            try:
+                self.provider.terminate_node(pid)
+                actions["orphans"] += 1
+            except Exception:
+                pass  # retried next tick
+
+        self._gc_terminal()
+        return actions
+
+    def _gc_terminal(self) -> None:
+        """Bound dead-record growth: keep only the newest max_terminal
+        TERMINATED/FAILED records (preemption-heavy fleets churn hundreds
+        per day; each tick lists every key)."""
+        terminal = [i for i in self.im.instances()
+                    if i.status in (TERMINATED, FAILED)]
+        if len(terminal) <= self.max_terminal:
+            return
+        from ray_tpu.experimental import internal_kv
+        terminal.sort(key=lambda i: i.updated_at)
+        for inst in terminal[:-self.max_terminal]:
+            internal_kv.internal_kv_del(f"inst:{inst.instance_id}",
+                                        namespace=_NS)
+
+
+__all__ = ["Instance", "InstanceManager", "Reconciler",
+           "QUEUED", "REQUESTED", "ALLOCATED", "RUNNING",
+           "TERMINATING", "TERMINATED", "FAILED"]

@@ -1,0 +1,160 @@
+"""Autoscaler v2: GCS-authoritative instance manager (reference:
+python/ray/autoscaler/v2/ + experimental/instance_manager.proto)."""
+
+from typing import Dict
+
+from ray_tpu.autoscaler.v2 import (REQUESTED, TERMINATED, Reconciler)
+
+
+class MockProvider:
+    """In-memory provider with on-command preemption."""
+
+    def __init__(self, fail_first_n: int = 0):
+        self.nodes: Dict[str, str] = {}   # provider_id -> node_type
+        self._n = 0
+        self._fail = fail_first_n
+
+    def create_node(self, node_type, labels):
+        if self._fail > 0:
+            self._fail -= 1
+            raise RuntimeError("quota")
+        self._n += 1
+        pid = f"i-{self._n:03d}"
+        self.nodes[pid] = node_type
+        return pid
+
+    def terminate_node(self, pid):
+        self.nodes.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+    def preempt(self, pid):
+        self.nodes.pop(pid, None)
+
+
+def test_targets_converge_and_scale_down(ray_start_regular):
+    prov = MockProvider()
+    rec = Reconciler(prov, max_launches_per_tick=2)
+    rec.im.set_target("v5e-8", 3)
+
+    a1 = rec.tick()
+    assert a1["queued"] == 3 and a1["launched"] == 2  # bounded per tick
+    a2 = rec.tick()
+    assert a2["launched"] == 1
+    assert len(prov.nodes) == 3
+    assert len(rec.im.live("v5e-8")) == 3
+
+    rec.im.set_target("v5e-8", 1)
+    a3 = rec.tick()
+    assert a3["terminated"] == 2
+    assert len(prov.nodes) == 1
+    assert len(rec.im.live("v5e-8")) == 1
+
+
+def test_preemption_relaunches(ray_start_regular):
+    prov = MockProvider()
+    rec = Reconciler(prov, max_launches_per_tick=4)
+    rec.im.set_target("v5e-8", 2)
+    rec.tick()
+    assert len(prov.nodes) == 2
+
+    victim = next(iter(prov.nodes))
+    prov.preempt(victim)
+    a = rec.tick()
+    assert a["preempted"] == 1 and a["launched"] == 1
+    assert len(prov.nodes) == 2
+    preempted = [i for i in rec.im.instances() if i.status == TERMINATED]
+    assert any(i.detail == "preempted" for i in preempted)
+
+
+def test_state_is_gcs_authoritative(ray_start_regular):
+    """A brand-new reconciler (head restart) resumes from the KV-recorded
+    state — the v2 property v1 lacked."""
+    prov = MockProvider()
+    rec = Reconciler(prov, max_launches_per_tick=4)
+    rec.im.set_target("v5e-8", 2)
+    rec.tick()
+    ids_before = {i.instance_id for i in rec.im.live("v5e-8")}
+
+    fresh = Reconciler(prov, max_launches_per_tick=4)  # no shared python state
+    assert fresh.im.get_targets() == {"v5e-8": 2}
+    assert {i.instance_id for i in fresh.im.live("v5e-8")} == ids_before
+    a = fresh.tick()
+    assert a["launched"] == 0 and a["queued"] == 0  # nothing to redo
+
+
+def test_launch_failure_retries(ray_start_regular):
+    prov = MockProvider(fail_first_n=1)
+    rec = Reconciler(prov, max_launches_per_tick=4)
+    rec.im.set_target("v5e-8", 1)
+    a1 = rec.tick()
+    assert a1["failed"] == 1 and len(prov.nodes) == 0
+    a2 = rec.tick()  # FAILED is not live -> re-queued and launched
+    assert a2["queued"] == 1 and a2["launched"] == 1
+    assert len(prov.nodes) == 1
+
+
+def test_stale_requested_recovers_and_orphan_reclaimed(ray_start_regular):
+    """Head crash between REQUESTED and ALLOCATED: the instance times out
+    (slot recovers) and the node it launched — referenced by no record —
+    is reclaimed by the orphan sweep."""
+    prov = MockProvider()
+    rec = Reconciler(prov, max_launches_per_tick=4, requested_timeout_s=0.0)
+    rec.im.set_target("v5e-8", 1)
+    # simulate the crash: REQUESTED written, create_node happened, but the
+    # ALLOCATED transition never landed
+    inst = rec.im.queue("v5e-8")
+    rec.im.transition(inst, REQUESTED)
+    leaked = prov.create_node("v5e-8", {})
+    import time
+    time.sleep(0.01)
+
+    a = rec.tick()
+    assert a["failed"] == 1          # stale REQUESTED timed out
+    assert a["orphans"] == 1         # the unaccounted node was terminated
+    assert leaked not in prov.nodes
+    # the slot recovered within the same tick: fresh queue + launch
+    assert a["queued"] == 1 and a["launched"] == 1
+    assert len(rec.im.live("v5e-8")) == 1
+
+
+def test_terminate_failure_retried(ray_start_regular):
+    """A failing terminate leaves the instance TERMINATING; later ticks
+    retry until the provider confirms — no silently leaked node."""
+    class FlakyTerm(MockProvider):
+        def __init__(self):
+            super().__init__()
+            self.fail_terms = 1
+
+        def terminate_node(self, pid):
+            if self.fail_terms > 0:
+                self.fail_terms -= 1
+                raise RuntimeError("api flake")
+            super().terminate_node(pid)
+
+    prov = FlakyTerm()
+    rec = Reconciler(prov, max_launches_per_tick=4)
+    rec.im.set_target("v5e-8", 2)
+    rec.tick()
+    assert len(prov.nodes) == 2
+    rec.im.set_target("v5e-8", 1)
+    rec.tick()                       # terminate fails -> TERMINATING
+    assert len(prov.nodes) == 2
+    rec.tick()                       # retried -> gone
+    assert len(prov.nodes) == 1
+
+
+def test_terminal_records_bounded(ray_start_regular):
+    prov = MockProvider()
+    rec = Reconciler(prov, max_launches_per_tick=8, max_terminal_records=5)
+    rec.im.set_target("v5e-8", 2)
+    rec.tick()
+    for _ in range(10):              # churn: preempt both, relaunch
+        for pid in list(prov.nodes):
+            prov.preempt(pid)
+        rec.tick()
+        rec.tick()
+    terminal = [i for i in rec.im.instances()
+                if i.status in ("TERMINATED", "FAILED")]
+    assert len(terminal) <= 5
