@@ -182,10 +182,6 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
-class RuntimeEnvSetupError(RayTpuError):
-    pass
-
-
 # ---------------------------------------------------------------------------
 # Resources
 # ---------------------------------------------------------------------------

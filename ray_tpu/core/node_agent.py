@@ -56,6 +56,9 @@ class WorkerHandle:
     #: pip-env identity: workers run the env's venv interpreter and are only
     #: leased to tasks with the same hash (None = the plain interpreter)
     env_hash: Optional[str] = None
+    #: (runtime_path, container_name) for containerized workers — killing
+    #: the `run` client does not stop the container; teardown must `rm -f`.
+    container_ref: Optional[tuple] = None
 
 
 @dataclass
@@ -245,10 +248,11 @@ class NodeAgent:
     async def _spawn_worker(self, is_actor: bool = False,
                             runtime_env: Optional[dict] = None
                             ) -> WorkerHandle:
-        from .runtime_env import materialize_pip_env, pip_env_hash
-        env_hash = pip_env_hash(runtime_env)
+        from .runtime_env import (materialize_pip_env, pip_env_hash,
+                                  worker_env_hash)
+        env_hash = worker_env_hash(runtime_env)
         python_exe = sys.executable
-        if env_hash is not None:
+        if pip_env_hash(runtime_env) is not None:
             # Build (or reuse) the env's venv off-loop — pip takes seconds —
             # and launch the worker under its interpreter so the task sees
             # the env's package versions, isolated from every other env
@@ -274,13 +278,36 @@ class NodeAgent:
             "RAYTPU_CONFIG_JSON": get_config().to_json(),
             "RAYTPU_SESSION_DIR": self.session_dir,
         })
+        container = (runtime_env or {}).get("container")
+        container_ref = None
+        if container:
+            # Container isolation (reference: runtime_env/container.py):
+            # the worker runs inside `podman/docker run` sharing host
+            # network, IPC + /dev/shm (object store), session dir, and the
+            # framework source read-only.  The argv builds BEFORE the log
+            # file opens so a missing-runtime error leaks no fd.
+            from .common import RuntimeEnvSetupError
+            from .runtime_env import container_worker_argv
+            cname = f"raytpu-{worker_id[:12]}"
+            try:
+                argv = container_worker_argv(
+                    container, self.session_dir, pkg_root, env,
+                    passthrough=set(self.worker_env), name=cname)
+            except Exception as e:  # noqa: BLE001 — deterministic config
+                raise RuntimeEnvSetupError(str(e)) from e
+            container_ref = (argv[0], cname)
         log = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
         logf = open(log, "ab", buffering=0)
-        proc = await asyncio.create_subprocess_exec(
-            python_exe, "-m", "ray_tpu.core.worker_main",
-            stdout=logf, stderr=logf, env=env)
+        if container:
+            proc = await asyncio.create_subprocess_exec(
+                *argv, stdout=logf, stderr=logf, env=env)
+        else:
+            proc = await asyncio.create_subprocess_exec(
+                python_exe, "-m", "ray_tpu.core.worker_main",
+                stdout=logf, stderr=logf, env=env)
         w = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid,
                          is_actor=is_actor, env_hash=env_hash)
+        w.container_ref = container_ref
         self.workers[worker_id] = w
         asyncio.ensure_future(self._monitor_worker(w))
         return w
@@ -329,6 +356,17 @@ class NodeAgent:
             else:
                 self._release_lease_resources(w.lease_id)
             w.lease_id = None
+        if w.container_ref is not None:
+            # SIGKILLing the podman/docker CLIENT leaves the container (and
+            # the worker inside it) running; remove it by name.
+            runtime, cname = w.container_ref
+            try:
+                await asyncio.create_subprocess_exec(
+                    runtime, "rm", "-f", cname,
+                    stdout=asyncio.subprocess.DEVNULL,
+                    stderr=asyncio.subprocess.DEVNULL)
+            except Exception:
+                pass
         if w.proc is not None:
             try:
                 w.proc.kill()
@@ -402,7 +440,7 @@ class NodeAgent:
         return None
 
     async def _grant_lease(self, resources, bundle, runtime_env) -> dict:
-        from .runtime_env import pip_env_hash
+        from .runtime_env import worker_env_hash
         pool = self._resource_pool_for(bundle)
         pool.acquire(resources)
         lease_id = self._next_lease_id()
@@ -411,7 +449,7 @@ class NodeAgent:
         else:
             self._lease_resources[lease_id] = {}
             self._bundle_of_lease[lease_id] = (tuple(bundle), dict(resources))
-        env_hash = pip_env_hash(runtime_env)
+        env_hash = worker_env_hash(runtime_env)
         w = self._pop_idle_worker(env_hash)
         if w is None:
             try:

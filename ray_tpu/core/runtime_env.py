@@ -37,7 +37,8 @@ def _pack_dir(path: str) -> bytes:
 
 
 def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
-    known = {"py_modules", "env_vars", "working_dir", "pip", "pip_args"}
+    known = {"py_modules", "env_vars", "working_dir", "pip", "pip_args",
+             "container"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
@@ -50,6 +51,29 @@ def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(
             "runtime_env['pip'] must be a list of requirement strings or a "
             f"requirements-file path, got {type(pip).__name__}")
+    container = runtime_env.get("container")
+    if container is not None:
+        if not isinstance(container, dict) or "image" not in container:
+            raise ValueError(
+                "runtime_env['container'] must be a dict with at least an "
+                "'image' key, e.g. {'image': 'python:3.12', "
+                "'run_options': ['--gpus=all']}")
+        if container.get("run_options") is not None and not (
+                isinstance(container["run_options"], (list, tuple))
+                and all(isinstance(o, str)
+                        for o in container["run_options"])):
+            raise ValueError("container['run_options'] must be a list "
+                             "of strings")
+        ev = container.get("env_vars")
+        if ev is not None and not (
+                isinstance(ev, dict)
+                and all(isinstance(k, str) and isinstance(v, str)
+                        for k, v in ev.items())):
+            raise ValueError("container['env_vars'] must be a dict of "
+                             "str -> str")
+        if "pip" in runtime_env:
+            raise ValueError("container and pip runtime envs cannot be "
+                             "combined: bake the packages into the image")
     return runtime_env
 
 
@@ -69,6 +93,84 @@ def pip_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     spec = (pip if isinstance(pip, str) else sorted(pip),
             list(runtime_env.get("pip_args") or []))
     return hashlib.sha1(repr(spec).encode()).hexdigest()[:16]
+
+
+def worker_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Pool key for worker processes: tasks share an idle worker only when
+    their isolation spec (pip venv AND/OR container) is identical."""
+    parts = []
+    h = pip_env_hash(runtime_env)
+    if h:
+        parts.append(f"pip:{h}")
+    c = (runtime_env or {}).get("container")
+    if c:
+        import hashlib
+        spec = (c["image"], list(c.get("run_options") or []),
+                c.get("runtime") or "",
+                sorted((c.get("env_vars") or {}).items()))
+        parts.append(
+            "ctr:" + hashlib.sha1(repr(spec).encode()).hexdigest()[:16])
+    return "+".join(parts) or None
+
+
+# ---------------------------------------------------------------------------
+# container isolation (reference: _private/runtime_env/container.py —
+# worker commands wrapped in `podman run`)
+# ---------------------------------------------------------------------------
+
+def container_runtime(container: Dict[str, Any]) -> str:
+    """Resolve the container runtime binary, honoring an explicit
+    ``container['runtime']``.  Raises with a clear message when no runtime
+    exists on the node (CI boxes without podman/docker)."""
+    import shutil
+    explicit = container.get("runtime")
+    candidates = [explicit] if explicit else ["podman", "docker"]
+    for c in candidates:
+        path = shutil.which(c)
+        if path:
+            return path
+    raise RuntimeError(
+        f"runtime_env['container'] requires a container runtime "
+        f"({' or '.join(candidates)}) on the node, but none was found "
+        f"on PATH")
+
+
+def container_worker_argv(container: Dict[str, Any], session_dir: str,
+                          pkg_root: str, env: Dict[str, str],
+                          passthrough: Optional[set] = None,
+                          name: Optional[str] = None,
+                          worker_module: str = "ray_tpu.core.worker_main"
+                          ) -> list:
+    """Build the argv that launches a worker inside the container.
+
+    The container shares the host network (the worker dials the agent on
+    127.0.0.1), the host IPC namespace + /dev/shm (the object store is a
+    shm arena — without this, zero-copy reads cannot attach pool slices),
+    the session dir (logs, spill, venv cache) and the framework source.
+    Env passthrough is explicit (`run` starts from a clean environment by
+    design): RAYTPU_*, the jax/TPU tuning vars, every key in
+    ``passthrough`` (the agent passes its worker_env keys, so
+    ``init(worker_env=...)`` behaves identically in and out of
+    containers), plus container['env_vars'].  ``name`` makes the container
+    addressable for teardown — killing the `run` CLIENT does not stop the
+    container."""
+    runtime = container_runtime(container)
+    argv = [runtime, "run", "--rm", "--network=host", "--ipc=host",
+            "-v", "/dev/shm:/dev/shm",
+            "-v", f"{session_dir}:{session_dir}",
+            "-v", f"{pkg_root}:{pkg_root}:ro"]
+    if name:
+        argv += ["--name", name]
+    keep = set(passthrough or ())
+    for k, v in env.items():
+        if (k.startswith(("RAYTPU_", "JAX_", "XLA_", "TPU_", "LIBTPU_"))
+                or k == "PYTHONPATH" or k in keep):
+            argv += ["-e", f"{k}={v}"]
+    for k, v in (container.get("env_vars") or {}).items():
+        argv += ["-e", f"{k}={v}"]
+    argv += list(container.get("run_options") or [])
+    argv += [container["image"], "python", "-m", worker_module]
+    return argv
 
 
 _venv_locks: Dict[str, Any] = {}
