@@ -182,14 +182,12 @@ class ActorClass:
             lifetime=lifetime,
             runtime_env=o.get("runtime_env"),
         )
-        if o.get("get_if_exists") and o.get("name"):
-            existing = run_async(w.gcs.call(
-                "get_actor_info", name=o["name"],
-                namespace=o.get("namespace") or "default"))
-            if existing is not None and existing["state"] != "DEAD":
-                return ActorHandle(existing["actor_id"], self._method_names(),
-                                   spec.max_task_retries, o.get("name"))
-        aid = w.create_actor(spec)
+        # get_if_exists resolves ATOMICALLY in the GCS register handler —
+        # concurrent get-or-create callers race at the single serialization
+        # point and losers receive the winner's actor id (no client-side
+        # pre-check TOCTOU).
+        get_if_exists = bool(o.get("get_if_exists") and o.get("name"))
+        aid = w.create_actor(spec, get_if_exists=get_if_exists)
         # Stash method names in GCS so get_actor() can rebuild handles.
         run_async(w.gcs.call("kv_put", ns="actor_meta", key=aid,
                              value=serialization.dumps(

@@ -1058,12 +1058,14 @@ class CoreWorker:
 
     # -------------------------------------------------------------- actors
 
-    def create_actor(self, spec: TaskSpec) -> str:
-        return run_async(self._create_actor_async(spec))
+    def create_actor(self, spec: TaskSpec, get_if_exists: bool = False) -> str:
+        return run_async(self._create_actor_async(spec, get_if_exists))
 
-    async def _create_actor_async(self, spec: TaskSpec) -> str:
-        aid = await self.gcs.call("register_actor", spec=spec)
-        self.actor_targets[aid] = ActorTarget(aid)
+    async def _create_actor_async(self, spec: TaskSpec,
+                                  get_if_exists: bool = False) -> str:
+        aid = await self.gcs.call("register_actor", spec=spec,
+                                  get_if_exists=get_if_exists)
+        self.actor_targets.setdefault(aid, ActorTarget(aid))
         return aid
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,

@@ -279,13 +279,22 @@ class GcsServer:
 
     # ---------------------------------------------------------------- actors
 
-    async def handle_register_actor(self, spec: TaskSpec):
+    async def handle_register_actor(self, spec: TaskSpec,
+                                    get_if_exists: bool = False):
+        """Register (or, with get_if_exists, atomically adopt) an actor.
+
+        The GCS is the single serialization point for names: concurrent
+        get-or-create callers race HERE, not at a client-side pre-check, so
+        the loser receives the winner's actor id (reference:
+        GcsActorManager name-conflict handling for get_if_exists)."""
         aid = spec.actor_id.hex()
         if spec.actor_name:
             key = (spec.namespace or "default", spec.actor_name)
             if key in self.named_actors:
                 existing = self.named_actors[key]
                 if self.actors.get(existing, {}).get("state") != "DEAD":
+                    if get_if_exists:
+                        return existing
                     raise ValueError(f"actor name {spec.actor_name!r} already taken")
             self.named_actors[key] = aid
         self.actors[aid] = {

@@ -179,3 +179,28 @@ def test_slow_actor_init_survives_rpc_timeout(ray_start_regular):
         ray_tpu.kill(a)
     finally:
         cfg.rpc_call_timeout_s = old
+
+
+def test_get_if_exists_concurrent_race(ray_start_regular):
+    """N concurrent get_if_exists creators of one name must all end up on
+    the SAME actor (TOCTOU regression: racers past the pre-check got
+    'name already taken' instead of adopting the winner)."""
+    @ray_tpu.remote
+    class Shared:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def get_or_create():
+        h = Shared.options(name="shared-goe", get_if_exists=True,
+                           num_cpus=0).remote()
+        return ray_tpu.get(h.bump.remote(), timeout=60)
+
+    results = ray_tpu.get([get_or_create.remote() for _ in range(4)],
+                          timeout=120)
+    # all four bumped ONE counter: the results are 1..4 in some order
+    assert sorted(results) == [1, 2, 3, 4], results
