@@ -19,12 +19,16 @@ from .learner import Learner, LearnerGroup
 from .models import ActorCriticMLP, build_model
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
                           RockPaperScissors)
+from .offline import (BCConfig, MARWIL, MARWILConfig, OfflineDataset,
+                      collect_episodes, write_episodes)
 from .ppo import PPO, PPOConfig
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+           "BCConfig", "MARWIL", "MARWILConfig", "OfflineDataset",
+           "collect_episodes", "write_episodes",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
            "RockPaperScissors",
            "QNetwork", "EnvRunner", "Learner", "LearnerGroup",

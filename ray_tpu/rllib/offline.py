@@ -1,0 +1,249 @@
+"""Offline RL: episode datasets + BC / MARWIL training.
+
+Reference: ``rllib/offline/`` (JSON episode readers, the input_/output_
+config) and ``rllib/algorithms/marwil`` (MARWIL — Monotonic Advantage
+Re-Weighted Imitation Learning; BC is its beta=0 special case).
+
+TPU-first shape: offline data is just arrays — one jitted update does
+advantage estimation (Monte-Carlo returns minus the value head), the
+exponentially advantage-weighted NLL policy loss, and the value
+regression, data-parallel over a mesh like every other learner here.
+Episodes read/write as JSON-lines files (one episode per line), the same
+wire shape the reference's JsonReader consumes, so corpora can be shared.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# episode IO (reference: offline/json_reader.py / json_writer.py)
+# ---------------------------------------------------------------------------
+
+def write_episodes(path: str, episodes: List[Dict[str, Any]]) -> int:
+    """Append episodes ({obs, actions, rewards} lists) as JSON lines."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        for ep in episodes:
+            f.write(json.dumps({
+                "obs": np.asarray(ep["obs"]).tolist(),
+                "actions": np.asarray(ep["actions"]).tolist(),
+                "rewards": np.asarray(ep["rewards"]).tolist(),
+            }) + "\n")
+    return len(episodes)
+
+
+def collect_episodes(env_name: str, policy_fn: Callable[[np.ndarray], int],
+                     num_episodes: int, path: Optional[str] = None,
+                     env_config: Optional[dict] = None,
+                     seed: int = 0) -> List[Dict[str, Any]]:
+    """Roll a (scripted or learned) policy and optionally persist the
+    episodes — the offline corpus generator for tests/demos."""
+    import gymnasium as gym
+    env = gym.make(env_name, **(env_config or {}))
+    episodes = []
+    for i in range(num_episodes):
+        obs, _ = env.reset(seed=seed + i)
+        ep = {"obs": [], "actions": [], "rewards": []}
+        done = False
+        while not done:
+            a = int(policy_fn(np.asarray(obs)))
+            ep["obs"].append(np.asarray(obs).tolist())
+            ep["actions"].append(a)
+            obs, r, term, trunc, _ = env.step(a)
+            ep["rewards"].append(float(r))
+            done = term or trunc
+        episodes.append(ep)
+    if path:
+        write_episodes(path, episodes)
+    return episodes
+
+
+class OfflineDataset:
+    """Flattened (obs, action, mc_return) transitions from episode files."""
+
+    def __init__(self, obs: np.ndarray, actions: np.ndarray,
+                 returns: np.ndarray):
+        self.obs = obs
+        self.actions = actions
+        self.returns = returns
+
+    def __len__(self):
+        return len(self.obs)
+
+    @classmethod
+    def from_jsonl(cls, path: str, gamma: float = 0.99) -> "OfflineDataset":
+        obs, acts, rets = [], [], []
+        with open(path) as f:
+            for line in f:
+                ep = json.loads(line)
+                r = np.asarray(ep["rewards"], np.float32)
+                # discounted Monte-Carlo return-to-go per step
+                g = np.zeros_like(r)
+                acc = 0.0
+                for t in range(len(r) - 1, -1, -1):
+                    acc = r[t] + gamma * acc
+                    g[t] = acc
+                obs.append(np.asarray(ep["obs"], np.float32))
+                acts.append(np.asarray(ep["actions"]))
+                rets.append(g)
+        return cls(np.concatenate(obs), np.concatenate(acts),
+                   np.concatenate(rets))
+
+
+# ---------------------------------------------------------------------------
+# MARWIL / BC
+# ---------------------------------------------------------------------------
+
+class MARWILConfig:
+    """Builder mirroring the on-policy config style (reference:
+    algorithms/marwil/marwil.py MARWILConfig).  beta=0.0 is exact behavior
+    cloning (the advantage weight collapses to 1)."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: dict = {}
+        self.input_path: Optional[str] = None
+        self.cfg: Dict[str, Any] = {
+            "lr": 1e-3, "beta": 1.0, "vf_coeff": 1.0, "grad_clip": 10.0,
+            "train_batch_size": 512, "gamma": 0.99, "hidden": (64, 64),
+            "advantage_clip": 10.0, "updates_per_iter": 50, "seed": 0,
+        }
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = env_config or {}
+        return self
+
+    def offline_data(self, input_path: str):
+        self.input_path = input_path
+        return self
+
+    def training(self, **kwargs):
+        self.cfg.update(kwargs)
+        return self
+
+    def build(self) -> "MARWIL":
+        assert self.env_name and self.input_path, \
+            "need .environment(...) and .offline_data(...)"
+        return MARWIL(self)
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta=0 (reference: algorithms/bc)."""
+
+    def __init__(self):
+        super().__init__()
+        self.cfg["beta"] = 0.0
+
+
+class MARWIL:
+    """Offline learner: one jitted update over sampled minibatches."""
+
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import gymnasium as gym
+
+        from .models import ActorCriticMLP
+
+        self.config = config
+        cfg = config.cfg
+        env = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(env.action_space.n)
+        self._eval_env = env
+        self.model = ActorCriticMLP(obs_dim, act_dim,
+                                    hidden=tuple(cfg["hidden"]))
+        self.params = self.model.init(jax.random.PRNGKey(cfg["seed"]))
+        self.opt = optax.chain(optax.clip_by_global_norm(cfg["grad_clip"]),
+                               optax.adam(cfg["lr"]))
+        self.opt_state = self.opt.init(self.params)
+        self.data = OfflineDataset.from_jsonl(config.input_path,
+                                              gamma=cfg["gamma"])
+        self._rng = np.random.default_rng(cfg["seed"])
+        self.iteration = 0
+
+        beta = float(cfg["beta"])
+        vf_coeff = float(cfg["vf_coeff"])
+        aclip = float(cfg["advantage_clip"])
+        model = self.model
+
+        def loss_fn(params, obs, actions, returns):
+            pi_out, value = model.apply(params, obs)
+            logp = model.log_prob(pi_out, actions)
+            if beta > 0:
+                adv = returns - jax.lax.stop_gradient(value)
+                # RMS-normalize before exponentiating (reference MARWIL's
+                # running moment): keeps beta's meaning independent of the
+                # env's reward scale instead of saturating the clip bound.
+                adv = adv / (jnp.sqrt(jnp.mean(adv ** 2)) + 1e-8)
+                w = jax.lax.stop_gradient(
+                    jnp.exp(jnp.clip(beta * adv, -aclip, aclip)))
+                vf_loss = ((value - returns) ** 2).mean()
+                vf = vf_coeff
+            else:
+                # pure BC: no advantage weight, and no value head to fit —
+                # its huge early regression gradients would only eat the
+                # shared global-norm clip budget.
+                w = 1.0
+                vf_loss = jnp.zeros(())
+                vf = 0.0
+            pi_loss = -(w * logp).mean()
+            return pi_loss + vf * vf_loss, (pi_loss, vf_loss)
+
+        @jax.jit
+        def update(params, opt_state, obs, actions, returns):
+            (loss, (pl, vl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, obs, actions, returns)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, pl, vl
+
+        self._update = update
+        self._jnp = jnp
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config.cfg
+        bs = min(cfg["train_batch_size"], len(self.data))
+        losses, pls, vls = [], [], []
+        for _ in range(cfg["updates_per_iter"]):
+            idx = self._rng.integers(0, len(self.data), bs)
+            self.params, self.opt_state, loss, pl, vl = self._update(
+                self.params, self.opt_state,
+                self._jnp.asarray(self.data.obs[idx]),
+                self._jnp.asarray(self.data.actions[idx]),
+                self._jnp.asarray(self.data.returns[idx]))
+            losses.append(float(loss))
+            pls.append(float(pl))
+            vls.append(float(vl))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "loss": float(np.mean(losses)),
+                "policy_loss": float(np.mean(pls)),
+                "vf_loss": float(np.mean(vls)),
+                "num_transitions": len(self.data)}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+        pi_out, _ = self.model.apply(self.params,
+                                     jnp.asarray(obs)[None, :])
+        return int(np.argmax(np.asarray(pi_out)[0]))
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 10_000) -> float:
+        """Greedy-policy mean episode return in the real env."""
+        eps = collect_episodes(self.config.env_name, self.compute_action,
+                               num_episodes,
+                               env_config=self.config.env_config, seed=seed)
+        return float(np.mean([sum(ep["rewards"]) for ep in eps]))
+
+
+__all__ = ["BCConfig", "MARWIL", "MARWILConfig", "OfflineDataset",
+           "collect_episodes", "write_episodes"]
