@@ -117,6 +117,9 @@ class NodeAgent:
         # return so the owner raises a typed OutOfMemoryError.
         self._oom_kills: Dict[str, str] = {}
         self._oom_kill_count = 0  # lifetime total, exported in stats
+        # strong refs to fire-and-forget loop tasks (event writes): the
+        # event loop itself only holds weak references
+        self._bg_tasks: set = set()
 
     # ------------------------------------------------------------------ boot
 
@@ -932,6 +935,22 @@ class NodeAgent:
                     continue
                 victim.state = "DRAINING"
                 self._oom_kill_count += 1
+                try:
+                    # This loop runs ON the agent's IO loop: write the
+                    # event through our async GCS client (the blocking
+                    # events.record() would raise in run_async here).
+                    # Keep a strong ref to the task — the loop holds only
+                    # weak ones — and record_via swallows KV failures.
+                    from ray_tpu.util import events
+                    task = asyncio.ensure_future(events.record_via(
+                        self.gcs.call, "WARNING", "memory-monitor",
+                        f"killed worker {victim.worker_id[:12]}",
+                        policy="retriable-LIFO", usage=f"{usage:.0%}",
+                        node=self.node_id.hex()[:12]))
+                    self._bg_tasks.add(task)
+                    task.add_done_callback(self._bg_tasks.discard)
+                except Exception:
+                    pass  # the kill must proceed even with no live GCS
                 self._oom_kills[victim.worker_id] = (
                     f"worker killed by the memory monitor: node memory "
                     f"{usage:.0%} >= threshold "

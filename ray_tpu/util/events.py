@@ -38,12 +38,13 @@ def _kv():
     return internal_kv
 
 
-def record(severity: str, source: str, message: str,
-           **labels: Any) -> Dict[str, Any]:
-    """Record one structured event; returns the event dict."""
+def make_event(severity: str, source: str, message: str,
+               **labels: Any):
+    """Build one event's (key, value-bytes, dict) without writing it —
+    for callers that must write through their own async KV path (e.g.
+    the node agent's IO loop, where the blocking record() would raise)."""
     if severity not in SEVERITIES:
         raise ValueError(f"severity must be one of {SEVERITIES}")
-    kv = _kv()
     ev = {
         "severity": severity,
         "source": source,
@@ -55,8 +56,29 @@ def record(severity: str, source: str, message: str,
     # Per-writer ring: each process cycles its own _RING keys (no global
     # counter round-trip); readers order by `ts`.
     seq = next(_seq) % _RING
-    kv.internal_kv_put(f"ev:{os.getpid()}:{seq:04d}",
-                       json.dumps(ev).encode(), namespace=_NS)
+    return f"ev:{os.getpid()}:{seq:04d}", json.dumps(ev).encode(), ev
+
+
+def record(severity: str, source: str, message: str,
+           **labels: Any) -> Dict[str, Any]:
+    """Record one structured event; returns the event dict."""
+    key, blob, ev = make_event(severity, source, message, **labels)
+    _kv().internal_kv_put(key, blob, namespace=_NS)
+    return ev
+
+
+async def record_via(gcs_call, severity: str, source: str, message: str,
+                     **labels: Any) -> Dict[str, Any]:
+    """Async variant for IO-loop callers (node agent, GCS-side loops):
+    writes through a caller-supplied async ``call(method, **kw)`` client so
+    the namespace/key scheme stays owned by this module.  KV failures are
+    swallowed — event emission must never break the emitting subsystem."""
+    key, blob, ev = make_event(severity, source, message, **labels)
+    try:
+        await gcs_call("kv_put", ns=_NS, key=key, value=blob,
+                       overwrite=True)
+    except Exception:
+        pass
     return ev
 
 
@@ -83,4 +105,5 @@ def list_events(severity: Optional[str] = None,
     return out[:limit]
 
 
-__all__ = ["record", "list_events", "SEVERITIES"]
+__all__ = ["record", "record_via", "make_event", "list_events",
+           "SEVERITIES"]

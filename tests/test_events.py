@@ -52,3 +52,4 @@ def test_events_visible_from_workers_and_dashboard(ray_start_regular):
         assert any(e["source"] == "worker-task" for e in body)
     finally:
         stop_dashboard()
+

@@ -89,3 +89,37 @@ def test_oom_killed_task_retries(ray_start_regular, tmp_path):
     assert ray_tpu.get(ref, timeout=120) == "done"
     # at least two attempts ran (original + post-kill retry)
     assert len(os.listdir(str(tmp_path))) >= 2
+
+
+def test_oom_kill_emits_event(ray_start_regular):
+    """The monitor's kill lands as a WARNING structured event, written
+    through the agent's async KV path (its loop cannot block in
+    events.record())."""
+    from ray_tpu.util import events
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        time.sleep(30)
+
+    hog.remote()
+    agent = _agent()
+    deadline = time.monotonic() + 20
+    while not any(w.state == "LEASED" for w in agent.workers.values()):
+        assert time.monotonic() < deadline, "task never started"
+        time.sleep(0.1)
+    from ray_tpu.core.config import get_config
+    cfg = get_config()
+    old = cfg.memory_usage_threshold
+    try:
+        cfg.memory_usage_threshold = 0.0
+        assert _wait_for_oom_kill(agent), "monitor never killed a worker"
+    finally:
+        cfg.memory_usage_threshold = old
+    deadline = time.monotonic() + 15
+    evs = []
+    while time.monotonic() < deadline and not evs:
+        evs = events.list_events(source="memory-monitor")
+        time.sleep(0.2)
+    assert evs, "no memory-monitor event recorded"
+    assert evs[0]["severity"] == "WARNING"
+    assert evs[0]["labels"]["policy"] == "retriable-LIFO"
