@@ -951,7 +951,7 @@ class NodeAgent:
                     task.add_done_callback(self._bg_tasks.discard)
                 except Exception:
                     pass  # the kill must proceed even with no live GCS
-                self._oom_kills[victim.worker_id] = (
+                cause = (
                     f"worker killed by the memory monitor: node memory "
                     f"{usage:.0%} >= threshold "
                     f"{cfg.memory_usage_threshold:.0%} "
@@ -959,13 +959,22 @@ class NodeAgent:
                 if victim.is_actor and victim.actor_id:
                     # _kill_worker_proc releases leases but does not tell
                     # the GCS — an unreported actor death would leave the
-                    # actor ALIVE forever and hang its callers
+                    # actor ALIVE forever and hang its callers.  Actors have
+                    # no lease return to consume _oom_kills, so thread the
+                    # typed cause straight into the death reason instead.
                     try:
                         await self.gcs.call(
                             "report_actor_death", actor_id=victim.actor_id,
-                            reason="worker killed by memory monitor (OOM)")
+                            reason=f"OutOfMemoryError: {cause}")
                     except Exception:
                         pass
+                else:
+                    self._oom_kills[victim.worker_id] = cause
+                    # Bound the dict: an owner that dies before returning
+                    # the lease never consumes its entry (insertion order =
+                    # kill order, so the evictee is the oldest).
+                    while len(self._oom_kills) > 256:
+                        self._oom_kills.pop(next(iter(self._oom_kills)))
                 await self._kill_worker_proc(victim)
                 try:
                     print(f"[memory-monitor] node memory {usage:.0%} >= "

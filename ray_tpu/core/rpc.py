@@ -78,7 +78,9 @@ def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
     buf = getattr(writer, "_raytpu_buf", None)
     if buf is None:
         buf = writer._raytpu_buf = []
+        writer._raytpu_buf_bytes = 0
     buf.append(data)
+    writer._raytpu_buf_bytes += len(data)
     if not getattr(writer, "_raytpu_flush_scheduled", False):
         writer._raytpu_flush_scheduled = True
         asyncio.get_event_loop().call_soon(_flush_writer, writer)
@@ -91,6 +93,7 @@ def _flush_writer(writer: "asyncio.StreamWriter") -> None:
         return
     data = b"".join(buf) if len(buf) > 1 else buf[0]
     buf.clear()
+    writer._raytpu_buf_bytes = 0
     try:
         writer.write(data)
     except Exception:
@@ -100,9 +103,14 @@ def _flush_writer(writer: "asyncio.StreamWriter") -> None:
 async def drain_if_needed(writer: "asyncio.StreamWriter",
                           high_water: int = 1 << 20) -> None:
     """Apply backpressure only when the transport buffer is actually deep —
-    an unconditional drain() per frame defeats the coalescing."""
+    an unconditional drain() per frame defeats the coalescing.  Pending
+    coalesced frames still sit in the Python-level buffer until the next
+    loop tick, so they must count toward the high-water mark: a coroutine
+    emitting many frames without a real await never yields to the loop,
+    and the transport alone would read as empty forever."""
     try:
-        if writer.transport.get_write_buffer_size() > high_water:
+        pending = getattr(writer, "_raytpu_buf_bytes", 0)
+        if (pending + writer.transport.get_write_buffer_size()) > high_water:
             _flush_writer(writer)
             await writer.drain()
     except Exception:

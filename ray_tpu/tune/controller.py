@@ -150,12 +150,18 @@ class TuneController:
             self.searcher.on_trial_complete(trial.trial_id, metrics)
         elif isinstance(decision, tuple) and decision[0] == RESIZE:
             # ResourceChangingScheduler: restart the trial actor with the
-            # new allocation, resuming from its latest checkpoint.
+            # new allocation, resuming from its latest checkpoint.  Before
+            # the first checkpoint a restart would lose all progress (same
+            # hazard the PERTURB no-donor path guards), so defer the resize
+            # to a later report.
             _, new_resources = decision
-            self._stop_trial(trial, status=PENDING)
-            trial.resources = new_resources
-            trial.restarts += 1
-            self._start_trial(trial)
+            if trial.latest_checkpoint is None:
+                trial.runner.resume.remote()
+            else:
+                self._stop_trial(trial, status=PENDING)
+                trial.resources = new_resources
+                trial.restarts += 1
+                self._start_trial(trial)
         elif isinstance(decision, tuple) and decision[0] == PERTURB:
             _, new_config, donor_id = decision
             donor = next((t for t in self.trials

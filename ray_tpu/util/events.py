@@ -31,6 +31,19 @@ SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
 _NS = "events"
 _RING = 1000  # per-writer ring size; a writer's oldest events are evicted
 _seq = itertools.count()
+_writer_id: Optional[tuple] = None  # (pid, token) — regenerated after fork
+
+
+def _writer_token() -> str:
+    """Random per-writer token: PIDs repeat across nodes and process
+    lifetimes, so keying the ring on the PID alone lets two writers
+    silently overwrite each other's rings.  Cache per-PID so a forked
+    child mints its own token."""
+    global _writer_id
+    pid = os.getpid()
+    if _writer_id is None or _writer_id[0] != pid:
+        _writer_id = (pid, os.urandom(4).hex())
+    return _writer_id[1]
 
 
 def _kv():
@@ -54,9 +67,11 @@ def make_event(severity: str, source: str, message: str,
         "pid": os.getpid(),
     }
     # Per-writer ring: each process cycles its own _RING keys (no global
-    # counter round-trip); readers order by `ts`.
+    # counter round-trip); readers order by `ts`.  The key embeds a random
+    # writer token because PIDs collide across nodes and restarts.
     seq = next(_seq) % _RING
-    return f"ev:{os.getpid()}:{seq:04d}", json.dumps(ev).encode(), ev
+    return (f"ev:{os.getpid()}:{_writer_token()}:{seq:04d}",
+            json.dumps(ev).encode(), ev)
 
 
 def record(severity: str, source: str, message: str,
@@ -82,12 +97,20 @@ async def record_via(gcs_call, severity: str, source: str, message: str,
     return ev
 
 
+_GLOBAL_CAP = 5000  # cluster-wide bound enforced lazily by readers
+
+
 def list_events(severity: Optional[str] = None,
                 source: Optional[str] = None,
                 limit: int = 200) -> List[Dict[str, Any]]:
-    """Cluster-wide events, newest first, optionally filtered."""
+    """Cluster-wide events, newest first, optionally filtered.
+
+    Also the reclamation point: writer tokens are unique per process
+    lifetime, so dead writers' ring keys are never overwritten — each read
+    (the dashboard polls this) prunes the oldest entries beyond
+    ``_GLOBAL_CAP`` to keep the namespace bounded under process churn."""
     kv = _kv()
-    out: List[Dict[str, Any]] = []
+    rows: List[tuple] = []  # (ts, key, ev)
     for key in kv.internal_kv_keys("ev:", namespace=_NS):
         blob = kv.internal_kv_get(key, namespace=_NS)
         if not blob:
@@ -95,13 +118,20 @@ def list_events(severity: Optional[str] = None,
         try:
             ev = json.loads(blob)
         except ValueError:
+            kv.internal_kv_del(key, namespace=_NS)
             continue
-        if severity and ev.get("severity") != severity:
-            continue
-        if source and ev.get("source") != source:
-            continue
-        out.append(ev)
-    out.sort(key=lambda e: -e.get("ts", 0.0))
+        rows.append((ev.get("ts", 0.0), key, ev))
+    rows.sort(key=lambda r: -r[0])
+    for _, key, _ev in rows[_GLOBAL_CAP:]:
+        try:
+            kv.internal_kv_del(key, namespace=_NS)
+        except Exception:
+            pass
+    out = [ev for _, _, ev in rows]
+    if severity:
+        out = [e for e in out if e.get("severity") == severity]
+    if source:
+        out = [e for e in out if e.get("source") == source]
     return out[:limit]
 
 
