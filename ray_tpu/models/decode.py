@@ -313,3 +313,97 @@ def prefill_and_sample(params: Params, cache: KVCache, tokens: jnp.ndarray,
     cache, logits = prefill(params, cache, tokens, lengths, slot_ids, cfg,
                             compute_dtype)
     return cache, sample_per_slot(logits, key, temperature, top_k)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident autoregressive state (zero host ops in the serving loop)
+# ---------------------------------------------------------------------------
+#
+# Over a tunneled backend every EAGER op or small host->device transfer costs
+# a full round trip (~60-80 ms measured) while a jitted dispatch is async and
+# ~0.1 ms.  The serving engine therefore keeps the complete per-slot
+# autoregressive state ON DEVICE and only ever calls two jitted programs:
+#
+#   decode_state_loop(params, cache, state, n)   — n steps, state evolves
+#   prefill_admit(params, cache, state, <numpy admit batch>)
+#
+# `state` carries tokens/active/temps/budget/eos + the PRNG key; active slots
+# DECAY on device (budget exhausted or EOS sampled) by the same predicate the
+# host applies to the emitted tokens, so the host's scheduling mirror stays
+# consistent without a single eager device write.
+
+def init_decode_state(num_slots: int, key: jax.Array) -> Dict[str, Any]:
+    """All-device per-slot autoregressive state (incl. the scratch slot)."""
+    return {
+        "tokens": jnp.zeros((num_slots,), jnp.int32),
+        "active": jnp.zeros((num_slots,), bool),
+        "temps": jnp.zeros((num_slots,), jnp.float32),
+        "budget": jnp.zeros((num_slots,), jnp.int32),
+        "eos": jnp.full((num_slots,), -1, jnp.int32),
+        "key": key,
+    }
+
+
+def _merge_admit(state: Dict[str, Any], first: jnp.ndarray,
+                 slot_ids: jnp.ndarray, temps: jnp.ndarray,
+                 budgets: jnp.ndarray, eos: jnp.ndarray,
+                 real_mask: jnp.ndarray) -> Dict[str, Any]:
+    """Merge one admit batch into the decode state.  The sampled first token
+    spends one unit of budget; a 1-token request (or an immediate EOS) is
+    born inactive."""
+    budgets = budgets - 1
+    act = real_mask & (budgets > 0) & (first != eos)
+    return {
+        "tokens": state["tokens"].at[slot_ids].set(first),
+        "active": state["active"].at[slot_ids].set(act),
+        "temps": state["temps"].at[slot_ids].set(temps),
+        "budget": state["budget"].at[slot_ids].set(budgets),
+        "eos": state["eos"].at[slot_ids].set(eos),
+        "key": jax.random.fold_in(state["key"], 0x5EED),
+    }
+
+
+def prefill_admit(params: Params, cache: KVCache, state: Dict[str, Any],
+                  tokens: jnp.ndarray, lengths: jnp.ndarray,
+                  slot_ids: jnp.ndarray, temps: jnp.ndarray,
+                  budgets: jnp.ndarray, eos: jnp.ndarray,
+                  real_mask: jnp.ndarray, cfg: TransformerConfig,
+                  top_k: int = 0, compute_dtype=jnp.bfloat16):
+    """Prefill + sample + merge into the decode state, one fixed-shape
+    program.  Returns (cache, state, first_tokens [B])."""
+    cache, logits = prefill(params, cache, tokens, lengths, slot_ids, cfg,
+                            compute_dtype)
+    first = sample_per_slot(logits, state["key"], temps, top_k)
+    state = _merge_admit(state, first, slot_ids, temps, budgets, eos,
+                         real_mask)
+    return cache, state, first
+
+
+def decode_state_loop(params: Params, cache: KVCache, state: Dict[str, Any],
+                      n_steps: int, cfg: TransformerConfig, top_k: int = 0,
+                      compute_dtype=jnp.bfloat16):
+    """``n_steps`` decode+sample steps with on-device active decay.
+
+    Returns (cache, state, emitted [n_steps, slots]).  A slot goes inactive
+    the step its budget hits zero or it samples its EOS token; inactive
+    slots repeat their last token (the host emits only to live requests)."""
+    temps, eos, key = state["temps"], state["eos"], state["key"]
+
+    def body(carry, i):
+        cache, toks, active, budget = carry
+        cache, logits = decode_step(params, cache, toks, active, cfg,
+                                    compute_dtype)
+        nxt = sample_per_slot(logits, jax.random.fold_in(key, i), temps,
+                              top_k)
+        nxt = jnp.where(active, nxt, toks)
+        budget = jnp.where(active, budget - 1, budget)
+        active = active & (budget > 0) & (nxt != eos)
+        return (cache, nxt, active, budget), nxt
+
+    carry = (cache, state["tokens"], state["active"], state["budget"])
+    (cache, toks, active, budget), emitted = jax.lax.scan(
+        body, carry, jnp.arange(n_steps))
+    state = {"tokens": toks, "active": active, "budget": budget,
+             "temps": temps, "eos": eos,
+             "key": jax.random.fold_in(key, n_steps)}
+    return cache, state, emitted

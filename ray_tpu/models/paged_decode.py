@@ -244,6 +244,54 @@ def paged_decode_loop(params: Params, cache: PagedKVCache,
     return cache, tokens, emitted
 
 
+def paged_prefill_admit(params: Params, cache: PagedKVCache, state,
+                        tokens: jnp.ndarray, lengths: jnp.ndarray,
+                        slot_ids: jnp.ndarray, start_pos: jnp.ndarray,
+                        bt_rows: jnp.ndarray, temps: jnp.ndarray,
+                        budgets: jnp.ndarray, eos: jnp.ndarray,
+                        real_mask: jnp.ndarray, cfg: TransformerConfig,
+                        top_k: int = 0, compute_dtype=jnp.bfloat16):
+    """Paged admit in one program: write the admitted slots' block-table
+    rows, prefill the uncached suffixes, sample, merge into the decode
+    state (``decode.init_decode_state`` layout).  bt_rows: [B, MP] int32."""
+    from .decode import _merge_admit
+
+    cache = dict(cache)
+    cache["block_table"] = cache["block_table"].at[slot_ids].set(bt_rows)
+    cache, logits = paged_prefill(params, cache, tokens, lengths, slot_ids,
+                                  start_pos, cfg, compute_dtype)
+    first = sample_per_slot(logits, state["key"], temps, top_k)
+    state = _merge_admit(state, first, slot_ids, temps, budgets, eos,
+                         real_mask)
+    return cache, state, first
+
+
+def paged_decode_state_loop(params: Params, cache: PagedKVCache, state,
+                            n_steps: int, cfg: TransformerConfig,
+                            top_k: int = 0, compute_dtype=jnp.bfloat16):
+    """Paged twin of ``decode.decode_state_loop`` (on-device active decay)."""
+    temps, eos, key = state["temps"], state["eos"], state["key"]
+
+    def body(carry, i):
+        cache, toks, active, budget = carry
+        cache, logits = paged_decode_step(params, cache, toks, active, cfg,
+                                          compute_dtype)
+        nxt = sample_per_slot(logits, jax.random.fold_in(key, i), temps,
+                              top_k)
+        nxt = jnp.where(active, nxt, toks)
+        budget = jnp.where(active, budget - 1, budget)
+        active = active & (budget > 0) & (nxt != eos)
+        return (cache, nxt, active, budget), nxt
+
+    carry = (cache, state["tokens"], state["active"], state["budget"])
+    (cache, toks, active, budget), emitted = jax.lax.scan(
+        body, carry, jnp.arange(n_steps))
+    state = {"tokens": toks, "active": active, "budget": budget,
+             "temps": temps, "eos": eos,
+             "key": jax.random.fold_in(key, n_steps)}
+    return cache, state, emitted
+
+
 # ---------------------------------------------------------------------------
 # Host-side page allocator + prefix cache
 # ---------------------------------------------------------------------------

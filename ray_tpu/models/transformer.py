@@ -69,6 +69,10 @@ class ParallelContext:
     mesh: Optional[Any] = None
     sp_axis: Optional[str] = None     # sequence-parallel axis name (ring attn)
     batch_axes: Tuple[str, ...] = ("dp",)
+    # True when the caller is ALREADY inside a shard_map where sp_axis is
+    # manual (the pipeline): ring attention then runs its per-shard body
+    # directly instead of opening a nested shard_map.
+    manual_collectives: bool = False
 
     @property
     def use_ring(self) -> bool:
@@ -194,7 +198,11 @@ def _attention_block(x, p, cfg: TransformerConfig, positions, pctx: ParallelCont
     q = checkpoint_name(q, "attn_q")
     k = checkpoint_name(k, "attn_k")
     v = checkpoint_name(v, "attn_v")
-    if pctx.use_ring:
+    if pctx.use_ring and pctx.manual_collectives:
+        from ..ops.ring_attention import _ring_attn_shard
+        out = _ring_attn_shard(q, k, v, pctx.sp_axis, causal=cfg.causal,
+                               logit_softcap=cfg.attn_logit_softcap)
+    elif pctx.use_ring:
         from ..ops.ring_attention import ring_attention
         out = ring_attention(q, k, v, pctx.mesh, pctx.sp_axis,
                              causal=cfg.causal, batch_axes=pctx.batch_axes,

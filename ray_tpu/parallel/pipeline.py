@@ -111,10 +111,12 @@ def pipeline_param_specs(cfg: TransformerConfig,
     return out
 
 
-def _stage_apply(x, stage_params, cfg, positions, compute_dtype):
+def _stage_apply(x, stage_params, cfg, positions, compute_dtype,
+                 pctx=transformer.ParallelContext()):
     """Run this device's L/P layers on x [mb, S, H]."""
     def body(x, layer_params):
-        x, aux = transformer.block_forward(x, layer_params, cfg, positions)
+        x, aux = transformer.block_forward(x, layer_params, cfg, positions,
+                                           pctx)
         return x, aux
 
     body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
@@ -122,11 +124,30 @@ def _stage_apply(x, stage_params, cfg, positions, compute_dtype):
     return x, aux.sum()
 
 
+def _pp_axis_split(mesh: Mesh, dp_axes, sp_axis: str):
+    """Partition the mesh axes for the pipeline shard_map.
+
+    Returns (dp_axes, sp, auto_axes): dp_axes are the MANUAL batch axes,
+    ``sp`` is the manual sequence axis (or None), and auto_axes stay with
+    the COMPILER — tp's megatron collectives and fsdp's ZeRO
+    gather/reduce-scatter of the stage-sharded params are both inserted by
+    XLA from the storage shardings (scaling-book recipe), so composing
+    pp x fsdp needs no hand-written gathers."""
+    auto_axes = tuple(a for a in ("tp", "fsdp") if a in mesh.axis_names
+                      and mesh.shape[a] > 1)
+    dp_axes = tuple(a for a in dp_axes if a in mesh.axis_names
+                    and mesh.shape[a] > 1 and a not in auto_axes) or None
+    sp = (sp_axis if sp_axis in mesh.axis_names
+          and mesh.shape[sp_axis] > 1 else None)
+    return dp_axes, sp, auto_axes
+
+
 def _final_stage_loss(final, params, targets, cfg, loss_chunk,
                       p_idx, n_stages, dp_axes, pp_axis):
     """Loss head shared by both pipeline schedules: final-norm + lm-head +
     (chunked) CE on the LAST stage, psum-masked SPMD-uniform, pmean over
-    data axes."""
+    data axes (batch AND, under sequence parallelism, the sp shard axis —
+    every shard holds an equal token count, so mean-of-means is exact)."""
     n, s, h = final.shape[0] * final.shape[1], final.shape[2], final.shape[3]
     final = final.reshape(n, s, h)
     x = transformer._norm(final, params["final_norm"], cfg)
@@ -166,31 +187,37 @@ def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
                      num_microbatches: int,
                      compute_dtype=jnp.bfloat16,
                      loss_chunk: Optional[int] = 0,
-                     pp_axis: str = "pp", dp_axes: Tuple[str, ...] = ("dp", "fsdp")):
+                     pp_axis: str = "pp",
+                     dp_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                     sp_axis: str = "sp"):
     """Returns loss(params_staged, batch) -> (loss, metrics), shard_mapped
-    over the pp (stages) and dp/fsdp (batch) mesh axes."""
+    over the pp (stages), dp (batch) and sp (sequence, ring attention) mesh
+    axes; tp and fsdp stay automatic (compiler-inserted collectives — fsdp
+    is the ZeRO sharding of the stage-local params and optimizer state)."""
     M = num_microbatches
-    dp_axes = tuple(a for a in dp_axes if a in mesh.axis_names
-                    and mesh.shape[a] > 1) or None
-    # Mesh axes the pipeline leaves to the COMPILER (tensor parallelism):
-    # pp/dp are manual (ppermute ring, loss psum); tp matmul collectives are
-    # inserted by XLA because the axis stays automatic under shard_map.
-    auto_axes = tuple(a for a in ("tp",) if a in mesh.axis_names
-                      and mesh.shape[a] > 1)
+    dp_axes, sp, auto_axes = _pp_axis_split(mesh, dp_axes, sp_axis)
+    if sp and not cfg.use_rope:
+        raise ValueError("pp x sp needs RoPE positions (learned positional "
+                         "embeddings are not sequence-shard aware)")
 
     pspec_tree = pipeline_param_specs(cfg)
     batch_dim = dp_axes if dp_axes and len(dp_axes) > 1 else (
         dp_axes[0] if dp_axes else None)
-    batch_spec = P(batch_dim)
+    batch_spec = P(batch_dim, sp)
+    reduce_axes = tuple(dp_axes or ()) + ((sp,) if sp else ()) or None
+    pctx = transformer.ParallelContext(mesh=mesh, sp_axis=sp,
+                                       manual_collectives=True)
 
     def body(params, tokens, targets):
         p_idx = jax.lax.axis_index(pp_axis)
         n_stages = jax.lax.psum(1, pp_axis)
         # Local view of the stage-sharded blocks has stage-dim extent 1.
         stage = jax.tree.map(lambda x: x[0], params["blocks"])
-        b_local, s = tokens.shape
+        b_local, s = tokens.shape   # s is the sp-LOCAL sequence shard
         mb = b_local // M
         positions = jnp.arange(s)
+        if sp:
+            positions = positions + jax.lax.axis_index(sp) * s
 
         toks_mb = tokens.reshape(M, mb, s)
         h = cfg.hidden_size
@@ -207,7 +234,7 @@ def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
                 cfg, compute_dtype)
             act = jnp.where((p_idx == 0) & (t < M), inject, act)
             act, aux = _stage_apply(act, stage, cfg, positions,
-                                    compute_dtype)
+                                    compute_dtype, pctx)
             # Rotate activations one hop forward along the pp ring; the wrap
             # from the last stage back to 0 carries garbage that the next
             # tick's stage-0 inject overwrites.
@@ -224,10 +251,10 @@ def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
         P_static = mesh.shape[pp_axis]
         final = outs[P_static - 1: P_static - 1 + M]        # [M, mb, S, H]
         loss = _final_stage_loss(final, params, targets, cfg, loss_chunk,
-                                 p_idx, n_stages, dp_axes, pp_axis)
+                                 p_idx, n_stages, reduce_axes, pp_axis)
         moe_aux = jax.lax.psum(auxes.sum(), pp_axis) / (M * n_stages)
-        if dp_axes:
-            moe_aux = jax.lax.pmean(moe_aux, dp_axes)
+        if reduce_axes:
+            moe_aux = jax.lax.pmean(moe_aux, reduce_axes)
         return loss, moe_aux
 
     param_specs = jax.tree.map(lambda s: s, pspec_tree,
@@ -235,7 +262,7 @@ def pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
 
     smap_kwargs: Dict[str, Any] = {}
     if auto_axes:
-        manual = {pp_axis} | set(dp_axes or ())
+        manual = {pp_axis} | set(dp_axes or ()) | ({sp} if sp else set())
         smap_kwargs["axis_names"] = manual
     smapped = jax.shard_map(
         body, mesh=mesh,
@@ -250,7 +277,8 @@ def interleaved_pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
                                  compute_dtype=jnp.bfloat16,
                                  loss_chunk: Optional[int] = 0,
                                  pp_axis: str = "pp",
-                                 dp_axes: Tuple[str, ...] = ("dp", "fsdp")):
+                                 dp_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                                 sp_axis: str = "sp"):
     """Interleaved (virtual-stage) pipeline schedule — Megatron-style.
 
     Device d owns V layer chunks (global chunks d, P+d, 2P+d, …); a
@@ -273,10 +301,10 @@ def interleaved_pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
     into a carried output buffer that the final-stage loss consumes."""
     M = num_microbatches
     V = virtual_stages
-    dp_axes = tuple(a for a in dp_axes if a in mesh.axis_names
-                    and mesh.shape[a] > 1) or None
-    auto_axes = tuple(a for a in ("tp",) if a in mesh.axis_names
-                      and mesh.shape[a] > 1)
+    dp_axes, sp, auto_axes = _pp_axis_split(mesh, dp_axes, sp_axis)
+    if sp and not cfg.use_rope:
+        raise ValueError("pp x sp needs RoPE positions (learned positional "
+                         "embeddings are not sequence-shard aware)")
     P_static = mesh.shape[pp_axis]
     assert M % P_static == 0, \
         (f"interleaved schedule injects waves of P: num_microbatches {M} "
@@ -286,7 +314,10 @@ def interleaved_pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
     pspec_tree = pipeline_param_specs(cfg)
     batch_dim = dp_axes if dp_axes and len(dp_axes) > 1 else (
         dp_axes[0] if dp_axes else None)
-    batch_spec = P(batch_dim)
+    batch_spec = P(batch_dim, sp)
+    reduce_axes = tuple(dp_axes or ()) + ((sp,) if sp else ()) or None
+    pctx = transformer.ParallelContext(mesh=mesh, sp_axis=sp,
+                                       manual_collectives=True)
 
     def body(params, tokens, targets):
         p_idx = jax.lax.axis_index(pp_axis)
@@ -297,6 +328,8 @@ def interleaved_pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
         b_local, s = tokens.shape
         mb = b_local // M
         positions = jnp.arange(s)
+        if sp:
+            positions = positions + jax.lax.axis_index(sp) * s
         h = cfg.hidden_size
         VP = V * n_stages
         # Embeddings once, outside the scan (the per-tick inject only
@@ -323,7 +356,8 @@ def interleaved_pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
             chunk = jax.tree.map(
                 lambda x: jax.lax.dynamic_slice_in_dim(x, c * lc, lc, 0),
                 stage)
-            act, aux = _stage_apply(act, chunk, cfg, positions, compute_dtype)
+            act, aux = _stage_apply(act, chunk, cfg, positions, compute_dtype,
+                                    pctx)
             aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
             # a resident finishing circuit V-1 at the last stage is done
             done = (p_idx == n_stages - 1) & (c == V - 1) & valid
@@ -345,17 +379,18 @@ def interleaved_pipeline_loss_fn(cfg: TransformerConfig, mesh: Mesh,
             tick, init, jnp.arange(n_ticks))
 
         loss = _final_stage_loss(out_buf, params, targets, cfg, loss_chunk,
-                                 p_idx, n_stages, dp_axes, pp_axis)
+                                 p_idx, n_stages, reduce_axes, pp_axis)
         # Same convention as the GPipe path (sum over all layer-chunk aux
         # values / (M * P)) so the two schedules are interchangeable.
         moe_aux = jax.lax.psum(aux_sum, pp_axis) / (M * P_static)
-        if dp_axes:
-            moe_aux = jax.lax.pmean(moe_aux, dp_axes)
+        if reduce_axes:
+            moe_aux = jax.lax.pmean(moe_aux, reduce_axes)
         return loss, moe_aux
 
     smap_kwargs: Dict[str, Any] = {}
     if auto_axes:
-        smap_kwargs["axis_names"] = {pp_axis} | set(dp_axes or ())
+        smap_kwargs["axis_names"] = ({pp_axis} | set(dp_axes or ())
+                                     | ({sp} if sp else set()))
     smapped = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pspec_tree, batch_spec, batch_spec),
@@ -378,9 +413,11 @@ def init_pp_state(cfg: TransformerConfig, mesh: Mesh,
         return TrainState(params=params, opt_state=optimizer.init(params),
                           step=jnp.zeros((), jnp.int32))
 
-    # State arrays keep their tensor-parallel sharding on top of the stage
-    # partition — the loss shard_map treats tp as an automatic axis.
-    auto = tuple(a for a in ("tp",) if a in mesh.axis_names
+    # State arrays keep their tensor-parallel AND ZeRO (fsdp) shardings on
+    # top of the stage partition — the loss shard_map treats both as
+    # automatic axes, so XLA inserts the tp matmul collectives and the
+    # fsdp param-gather / grad-reduce-scatter from these storage shardings.
+    auto = tuple(a for a in ("tp", "fsdp") if a in mesh.axis_names
                  and mesh.shape[a] > 1)
     pspecs = pipeline_param_specs(cfg, auto_axes=auto)
     param_sh = named_sharding(mesh, pspecs)

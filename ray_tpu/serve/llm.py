@@ -136,32 +136,36 @@ class LLMEngine:
             self.mesh = Mesh(devs[:tp], ("tp",))
             self.params, self.cache = self._apply_tp_sharding(
                 self.params, self.cache)
-        self._key = jax.random.PRNGKey(seed + 1)
-        self._step_counter = 0
+        # Device-resident autoregressive state: token/active/temp/budget/eos
+        # per slot plus the PRNG key.  EVERYTHING the scheduler loop touches
+        # on the device goes through exactly two jitted programs — over a
+        # tunneled backend each eager op or small transfer costs a full
+        # round trip (~60-80 ms measured), which round-4's per-retire
+        # `.at[].set` and per-dispatch eager `fold_in` paid on every loop
+        # iteration, capping the engine at ~130 tok/s vs the >2000 tok/s
+        # the compiled decode program itself sustains.
+        self._state = dec.init_decode_state(num_slots + 1,
+                                            jax.random.PRNGKey(seed + 1))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._state = jax.device_put(
+                self._state, NamedSharding(self.mesh, P()))
 
-        # Device-resident autoregressive state: last token + sampling
-        # temperature per slot.  The decode program samples on device and
-        # feeds the token back, so the host never sits in the loop.
-        self._tokens_dev = jnp.zeros((num_slots + 1,), jnp.int32)
-        self._active_dev = jnp.zeros((num_slots + 1,), bool)
-        self._temps_dev = jnp.zeros((num_slots + 1,), jnp.float32)
-
-        # Compiled programs: one decode step (cache donated — the multi-GB
-        # cache must be updated in place, not copied; the token array is NOT
-        # donated because the fetch pipeline still holds earlier versions),
-        # one prefill per bucket (lazy unless warmup_buckets).
+        # Compiled programs: one decode dispatch (cache + state donated —
+        # the multi-GB cache must be updated in place, not copied), one
+        # prefill per bucket (lazy unless warmup_buckets).
         if paged:
             self._decode_fn = jax.jit(
-                lambda p, c, t, a, tmp, k: self._pdec.paged_decode_loop(
-                    p, c, t, a, tmp, k, self.steps_per_dispatch, cfg, top_k,
+                lambda p, c, st: self._pdec.paged_decode_state_loop(
+                    p, c, st, self.steps_per_dispatch, cfg, top_k,
                     self.compute_dtype),
-                donate_argnums=(1,))
+                donate_argnums=(1, 2))
         else:
             self._decode_fn = jax.jit(
-                lambda p, c, t, a, tmp, k: dec.decode_loop(
-                    p, c, t, a, tmp, k, self.steps_per_dispatch, cfg, top_k,
+                lambda p, c, st: dec.decode_state_loop(
+                    p, c, st, self.steps_per_dispatch, cfg, top_k,
                     self.compute_dtype),
-                donate_argnums=(1,))
+                donate_argnums=(1, 2))
         self._prefill_fns: Dict[int, Any] = {}
 
         # scheduler state
@@ -280,40 +284,29 @@ class LLMEngine:
             cfg, dt, tk = self.cfg, self.compute_dtype, self.top_k
             dec = self._dec
 
-            def prefill_merge(p, c, t, ln, sl, tmp, k, tokens_dev,
-                              active_dev, temps_dev, real_mask):
-                # Prefill + merge into the decode state in ONE fixed-shape
-                # program: a varying admit count would otherwise compile a
-                # fresh eager scatter per batch size (seconds each over a
-                # tunneled backend).  Padding rows target the scratch slot.
-                c, first = dec.prefill_and_sample(p, c, t, ln, sl, tmp, k,
-                                                  cfg, tk, dt)
-                tokens_dev = tokens_dev.at[sl].set(first)
-                active_dev = active_dev.at[sl].set(real_mask)
-                temps_dev = temps_dev.at[sl].set(tmp)
-                return c, first, tokens_dev, active_dev, temps_dev
-
-            def paged_prefill_merge(p, c, t, ln, sl, start, tmp, k,
-                                    tokens_dev, active_dev, temps_dev,
-                                    real_mask):
+            # Prefill + sample + merge into the decode state in ONE
+            # fixed-shape program (a varying admit count would compile a
+            # fresh program per batch size).  Admit batches arrive as plain
+            # numpy arrays — transferred as part of the async dispatch, not
+            # as per-array eager round trips.  Padding rows target the
+            # scratch slot.
+            if self.paged:
                 pdec = self._pdec
-                c, logits = pdec.paged_prefill(p, c, t, ln, sl, start, cfg,
-                                               dt)
-                first = pdec.sample_per_slot(logits, k, tmp, tk)
-                tokens_dev = tokens_dev.at[sl].set(first)
-                active_dev = active_dev.at[sl].set(real_mask)
-                temps_dev = temps_dev.at[sl].set(tmp)
-                return c, first, tokens_dev, active_dev, temps_dev
 
-            fn = self._jax.jit(
-                paged_prefill_merge if self.paged else prefill_merge,
-                donate_argnums=(1,))
+                def admit_fn(p, c, st, t, ln, sl, start, bt, tmp, bud, eos,
+                             real_mask):
+                    return pdec.paged_prefill_admit(
+                        p, c, st, t, ln, sl, start, bt, tmp, bud, eos,
+                        real_mask, cfg, tk, dt)
+            else:
+                def admit_fn(p, c, st, t, ln, sl, tmp, bud, eos, real_mask):
+                    return dec.prefill_admit(
+                        p, c, st, t, ln, sl, tmp, bud, eos, real_mask, cfg,
+                        tk, dt)
+
+            fn = self._jax.jit(admit_fn, donate_argnums=(1, 2))
             self._prefill_fns[bucket] = fn
         return fn
-
-    def _next_key(self):
-        self._step_counter += 1
-        return self._jax.random.fold_in(self._key, self._step_counter)
 
     def _loop(self):
         while not self._stop:
@@ -347,29 +340,44 @@ class LLMEngine:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
 
+    def _admit_arrays(self, reqs: List[GenRequest], bucket: int,
+                      slots: List[int], starts: Optional[List[int]] = None):
+        """Build one admit batch as plain numpy arrays (no device ops)."""
+        import numpy as np
+        n_pad = self.prefill_batch - len(reqs)
+        starts = starts or [0] * len(reqs)
+        rows = [r.tokens[st:] for r, st in zip(reqs, starts)]
+        toks = np.zeros((self.prefill_batch, bucket), np.int32)
+        for i, row in enumerate(rows):
+            toks[i, :len(row)] = row
+        lengths = np.asarray([len(row) for row in rows] + [1] * n_pad,
+                             np.int32)
+        slots_arr = np.asarray(slots + [self._scratch_slot] * n_pad,
+                               np.int32)
+        temps = np.asarray([r.temperature for r in reqs] + [0.0] * n_pad,
+                           np.float32)
+        # effective budget mirrors the host retire predicate:
+        # min(max_tokens, room left before max_len)
+        budgets = np.asarray(
+            [min(r.max_tokens, self.max_len - len(r.tokens)) for r in reqs]
+            + [1] * n_pad, np.int32)
+        eos = np.asarray(
+            [-1 if r.eos_id is None else int(r.eos_id) for r in reqs]
+            + [-1] * n_pad, np.int32)
+        real_mask = np.asarray([True] * len(reqs) + [False] * n_pad)
+        return toks, lengths, slots_arr, temps, budgets, eos, real_mask
+
     def _admit(self, reqs: List[GenRequest], bucket: int):
         if self.paged:
             self._admit_paged(reqs, bucket)
             return
-        jnp = self._jnp
-        n_pad = self.prefill_batch - len(reqs)
-        rows = [r.tokens + [0] * (bucket - len(r.tokens)) for r in reqs]
-        rows += [[0] * bucket] * n_pad
-        toks = jnp.asarray(rows, jnp.int32)
-        lengths = jnp.asarray([len(r.tokens) for r in reqs] + [1] * n_pad,
-                              jnp.int32)
         slots = [self._free_slots.pop(0) for _ in reqs]
-        slots_arr = jnp.asarray(slots + [self._scratch_slot] * n_pad,
-                                jnp.int32)
-        temps = jnp.asarray([r.temperature for r in reqs] + [0.0] * n_pad,
-                            jnp.float32)
-        real_mask = jnp.asarray([True] * len(reqs) + [False] * n_pad)
+        (toks, lengths, slots_arr, temps, budgets, eos,
+         real_mask) = self._admit_arrays(reqs, bucket, slots)
         try:
-            (self.cache, first, self._tokens_dev, self._active_dev,
-             self._temps_dev) = self._prefill_fn(bucket)(
-                self.params, self.cache, toks, lengths, slots_arr, temps,
-                self._next_key(), self._tokens_dev, self._active_dev,
-                self._temps_dev, real_mask)
+            self.cache, self._state, first = self._prefill_fn(bucket)(
+                self.params, self.cache, self._state, toks, lengths,
+                slots_arr, temps, budgets, eos, real_mask)
         except BaseException as e:  # noqa: BLE001
             for r, s in zip(reqs, slots):
                 self._free_slots.append(s)
@@ -410,7 +418,7 @@ class LLMEngine:
         return reused, rpages + private
 
     def _admit_paged(self, reqs: List[GenRequest], bucket: int):
-        jnp = self._jnp
+        import numpy as np
         planned = []
         for r in reqs:
             plan = self._plan_pages(r)
@@ -425,36 +433,21 @@ class LLMEngine:
         sbucket = self._bucket_for(max(
             len(r.tokens) - reused for r, (reused, _pages) in planned))
         n_pad = self.prefill_batch - len(planned)
-        rows, lengths, starts, slots, temps = [], [], [], [], []
-        bt = self.cache["block_table"]
-        for r, (reused, pages) in planned:
-            suffix = r.tokens[reused:]
-            rows.append(suffix + [0] * (sbucket - len(suffix)))
-            lengths.append(len(suffix))
-            starts.append(reused)
-            s = self._free_slots.pop(0)
-            slots.append(s)
-            temps.append(r.temperature)
+        preqs = [r for r, _plan in planned]
+        slots = [self._free_slots.pop(0) for _ in planned]
+        starts = [reused for _r, (reused, _pages) in planned]
+        bt_rows = np.zeros((self.prefill_batch, self.max_pages_per_slot),
+                           np.int32)
+        for i, (r, (_reused, pages)) in enumerate(planned):
             r.pages = pages
-            row = pages + [0] * (self.max_pages_per_slot - len(pages))
-            bt = bt.at[s].set(jnp.asarray(row[:self.max_pages_per_slot],
-                                          jnp.int32))
-        rows += [[0] * sbucket] * n_pad
-        lengths += [1] * n_pad
-        starts += [0] * n_pad
-        temps += [0.0] * n_pad
-        self.cache["block_table"] = bt
-        slots_arr = jnp.asarray(slots + [self._scratch_slot] * n_pad,
-                                jnp.int32)
-        real_mask = jnp.asarray([True] * len(planned) + [False] * n_pad)
+            bt_rows[i, :len(pages)] = pages[:self.max_pages_per_slot]
+        (toks, lengths, slots_arr, temps, budgets, eos,
+         real_mask) = self._admit_arrays(preqs, sbucket, slots, starts)
+        starts_arr = np.asarray(starts + [0] * n_pad, np.int32)
         try:
-            (self.cache, first, self._tokens_dev, self._active_dev,
-             self._temps_dev) = self._prefill_fn(sbucket)(
-                self.params, self.cache, jnp.asarray(rows, jnp.int32),
-                jnp.asarray(lengths, jnp.int32), slots_arr,
-                jnp.asarray(starts, jnp.int32),
-                jnp.asarray(temps, jnp.float32), self._next_key(),
-                self._tokens_dev, self._active_dev, self._temps_dev,
+            self.cache, self._state, first = self._prefill_fn(sbucket)(
+                self.params, self.cache, self._state, toks, lengths,
+                slots_arr, starts_arr, bt_rows, temps, budgets, eos,
                 real_mask)
         except BaseException as e:  # noqa: BLE001
             for (r, (_reused, pages)), s in zip(planned, slots):
@@ -476,9 +469,8 @@ class LLMEngine:
         self.steps += 1
 
     def _dispatch_step(self):
-        self.cache, self._tokens_dev, emitted = self._decode_fn(
-            self.params, self.cache, self._tokens_dev, self._active_dev,
-            self._temps_dev, self._next_key())
+        self.cache, self._state, emitted = self._decode_fn(
+            self.params, self.cache, self._state)
         self._unfetched.append((emitted, dict(self._active), None))
         self.steps += self.steps_per_dispatch
 
@@ -512,10 +504,14 @@ class LLMEngine:
             self._retire(r)
 
     def _retire(self, r: GenRequest):
+        # No device write: the decode program decays `active` on device by
+        # the same budget/EOS predicate the host applies in _emit, so the
+        # device copy is already False by the time the host sees the final
+        # token.  (An eager .at[].set here cost a tunnel round trip per
+        # retired request.)
         if r.slot in self._active and self._active[r.slot] is r:
             del self._active[r.slot]
             self._free_slots.append(r.slot)
-            self._active_dev = self._active_dev.at[r.slot].set(False)
             if self.paged and r.pages:
                 # refcounted: shared prefix pages survive on the prefix
                 # cache's refs; private pages return to the free list.

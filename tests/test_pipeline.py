@@ -165,3 +165,69 @@ def test_interleaved_train_step_decreases_loss():
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_fsdp_loss_matches_plain():
+    """pp x fsdp (ZeRO param/opt sharding inside the pipeline, fsdp left to
+    the compiler) == single-device loss on identical f32 params."""
+    cfg = _cfg()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                              cfg.vocab_size)
+    batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    ref_loss, _ = transformer.causal_lm_loss(params, batch, cfg,
+                                             compute_dtype=jnp.float32,
+                                             loss_chunk=None)
+
+    mesh = make_mesh(4, pp=2, fsdp=2)
+    loss_fn = pipeline_loss_fn(cfg, mesh, num_microbatches=2,
+                               compute_dtype=jnp.float32, loss_chunk=None)
+    staged = partition_layers(params, 2)
+    _pp_loss, metrics = jax.jit(loss_fn)(staged, batch)
+    assert abs(float(ref_loss) - float(metrics["loss"])) < 1e-5, (
+        float(ref_loss), float(metrics["loss"]))
+
+
+def test_pipeline_sp_loss_matches_plain():
+    """pp x sp (ring attention across the sequence shards inside each
+    pipeline stage) == single-device loss on identical f32 params."""
+    cfg = _cfg()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                              cfg.vocab_size)
+    batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    ref_loss, _ = transformer.causal_lm_loss(params, batch, cfg,
+                                             compute_dtype=jnp.float32,
+                                             loss_chunk=None)
+
+    mesh = make_mesh(4, pp=2, sp=2)
+    loss_fn = pipeline_loss_fn(cfg, mesh, num_microbatches=2,
+                               compute_dtype=jnp.float32, loss_chunk=None)
+    staged = partition_layers(params, 2)
+    _pp_loss, metrics = jax.jit(loss_fn)(staged, batch)
+    assert abs(float(ref_loss) - float(metrics["loss"])) < 1e-5, (
+        float(ref_loss), float(metrics["loss"]))
+
+
+def test_pipeline_fsdp_sp_train_steps():
+    """pp x fsdp and pp x sp full train steps: state stays sharded, loss
+    decreases (the historical sharding-rule bug sites — VERDICT r4 weak #6)."""
+    cfg = _cfg()
+    opt = make_optimizer(learning_rate=1e-3, warmup_steps=2, total_steps=50)
+    for kw in (dict(pp=2, fsdp=2, dp=2), dict(pp=2, sp=2, dp=2)):
+        mesh = MeshSpec(**kw).build(jax.devices()[:8])
+        state, sh = init_pp_state(cfg, mesh, opt)
+        step = make_pp_train_step(cfg, mesh, opt, sh, num_microbatches=2)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+        losses = []
+        for _ in range(6):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], (kw, losses)
+        if "fsdp" in kw and kw.get("fsdp", 1) > 1:
+            w = state.params["blocks"]["attn"]["wq"]
+            assert "fsdp" in str(w.sharding.spec), w.sharding.spec
