@@ -16,6 +16,7 @@ from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
 from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner, LearnerGroup
+from .learner_group import DistributedLearnerGroup, LearnerWorker
 from .models import ActorCriticMLP, build_model
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
                           RockPaperScissors)
@@ -32,5 +33,6 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
            "RockPaperScissors",
            "QNetwork", "EnvRunner", "Learner", "LearnerGroup",
+           "DistributedLearnerGroup", "LearnerWorker",
            "ActorCriticMLP", "ActorCriticConv", "build_model",
            "ReplayBuffer", "PrioritizedReplayBuffer"]

@@ -40,7 +40,8 @@ class IMPALAConfig:
         self.num_env_runners = 2
         self.num_envs_per_runner = 1
         self.rollout_len = 64
-        self.num_learners = 1
+        self.num_learners = 0
+        self.num_devices_per_learner = 1
         self.seed = 0
         self.model: Dict[str, Any] = {"hidden": (64, 64)}
         self.train: Dict[str, Any] = {
@@ -65,8 +66,13 @@ class IMPALAConfig:
         self.rollout_len = rollout_fragment_length
         return self
 
-    def learners(self, num_learners: int = 1):
+    def learners(self, num_learners: int = 0,
+                 num_devices_per_learner: int = 1):
+        """0 = driver-local learner; N >= 1 = N learner actors on one
+        jax.distributed mesh (learner_group.py) — the decoupled
+        actor/learner split the IMPALA paper describes."""
         self.num_learners = num_learners
+        self.num_devices_per_learner = num_devices_per_learner
         return self
 
     def training(self, **kwargs):
@@ -174,7 +180,7 @@ class ImpalaLearner(Learner):
                                              params, updates)
             return params, opt_state, aux
 
-        return jax.jit(update)
+        return self._compile(update)
 
 
 class IMPALA:
@@ -199,8 +205,18 @@ class IMPALA:
                                action_dim=action_dim,
                                hidden=tuple(config.model["hidden"]),
                                continuous=continuous)
-        model = build_model(self.model_spec)
-        self.learner = ImpalaLearner(model, config.train, seed=config.seed)
+        if config.num_learners >= 1:
+            from .learner_group import DistributedLearnerGroup
+
+            self.learner = DistributedLearnerGroup(
+                self.model_spec, config.train,
+                num_learners=config.num_learners, seed=config.seed,
+                learner_cls=ImpalaLearner,
+                devices_per_learner=config.num_devices_per_learner)
+        else:
+            model = build_model(self.model_spec)
+            self.learner = ImpalaLearner(model, config.train,
+                                         seed=config.seed)
         runner_cls = ray_tpu.remote(_ER)
         self.runners = [
             runner_cls.options(num_cpus=1).remote(
@@ -282,6 +298,8 @@ class IMPALA:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+        if hasattr(self.learner, "shutdown"):
+            self.learner.shutdown()
 
     def get_weights(self):
         return self.learner.get_weights()

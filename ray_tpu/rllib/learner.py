@@ -34,6 +34,13 @@ class Learner:
         )
         self.opt_state = self.opt.init(self.params)
         self.mesh = mesh
+        # Does the mesh span >1 process (learner actors under
+        # jax.distributed)?  Then host-local batches must be assembled into
+        # global jax.Arrays before the jitted call.
+        self._multiprocess = (
+            mesh is not None
+            and len({d.process_index for d in mesh.devices.flat}) > 1)
+        self._state_placed = False
         self._update_fn = self._build_update()
         self._key = jax.random.PRNGKey(seed + 1)
         self._jax = jax
@@ -139,23 +146,62 @@ class Learner:
             metrics = {k: v[-1, -1] for k, v in aux.items()}
             return params, opt_state, metrics
 
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        return self._compile(update)
 
-            mesh = self.mesh
-            repl = NamedSharding(mesh, P())
-            # batch axis [T, B, ...]: shard B over the dp axis; params and
-            # optimizer state replicated -> XLA emits the gradient allreduce
-            data_sharding = {
-                k: NamedSharding(mesh, P(None, "dp"))
-                for k in ("obs", "actions", "logp", "values", "rewards",
-                          "dones")}
-            data_sharding["last_values"] = NamedSharding(mesh, P("dp"))
-            return jax.jit(
-                update,
-                in_shardings=(repl, repl, data_sharding, repl),
-                out_shardings=(repl, repl, repl))
-        return jax.jit(update)
+    # --------------------------------------------------- mesh + multihost
+
+    def _compile(self, update):
+        """Jit the update.  On a mesh: params/opt replicated out; batch
+        shardings come from the committed input arrays (``_place``), which
+        is what lets the SAME compiled program serve both the local
+        multi-device mesh and a jax.distributed mesh spanning learner-actor
+        processes (reference learner_group.py:61's NCCL allreduce becomes
+        XLA's gradient psum over dp)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.jit(update)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+        return jax.jit(update, out_shardings=(repl, repl, repl))
+
+    def _batch_spec(self, name: str, ndim: int):
+        """Batch axis: axis 1 of [T, B, ...] arrays, axis 0 of 1-D
+        last_values."""
+        from jax.sharding import PartitionSpec as P
+
+        if ndim <= 1:
+            return P("dp")
+        return P(None, "dp")
+
+    def _place_batch(self, rollout):
+        import jax
+        from jax.sharding import NamedSharding
+
+        out = {}
+        for k, v in rollout.items():
+            v = np.asarray(v)
+            sh = NamedSharding(self.mesh, self._batch_spec(k, v.ndim))
+            if self._multiprocess:
+                # v is THIS process's slice of the batch; assemble the
+                # global array (dp is process-major, so each process owns a
+                # contiguous block of the batch axis).
+                out[k] = jax.make_array_from_process_local_data(sh, v)
+            else:
+                out[k] = jax.device_put(v, sh)
+        return out
+
+    def _place_repl(self, tree):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+        if self._multiprocess:
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    repl, np.asarray(x)), tree)
+        return jax.device_put(tree, repl)
 
     # -------------------------------------------------------------- public
 
@@ -163,7 +209,15 @@ class Learner:
         import jax.numpy as jnp
 
         self._key, sub = self._jax.random.split(self._key)
-        rollout = {k: jnp.asarray(v) for k, v in rollout.items()}
+        if self.mesh is not None:
+            rollout = self._place_batch(rollout)
+            if not self._state_placed:
+                self.params = self._place_repl(self.params)
+                self.opt_state = self._place_repl(self.opt_state)
+                self._state_placed = True
+            sub = self._place_repl(sub)
+        else:
+            rollout = {k: jnp.asarray(v) for k, v in rollout.items()}
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, rollout, sub)
         return {k: float(v) for k, v in metrics.items()}

@@ -23,7 +23,8 @@ class PPOConfig:
         self.num_env_runners = 2
         self.num_envs_per_runner = 1
         self.rollout_len = 128
-        self.num_learners = 1
+        self.num_learners = 0
+        self.num_devices_per_learner = 1
         self.train: Dict[str, Any] = dict(
             lr=3e-4, gamma=0.99, clip_param=0.2, vf_loss_coeff=0.5,
             entropy_coeff=0.0, num_epochs=4, num_minibatches=4,
@@ -45,8 +46,13 @@ class PPOConfig:
         self.rollout_len = rollout_fragment_length
         return self
 
-    def learners(self, num_learners: int = 1):
+    def learners(self, num_learners: int = 0,
+                 num_devices_per_learner: int = 1):
+        """Reference semantics (AlgorithmConfig.learners): 0 = update in the
+        driver process on its local devices; N >= 1 = place N learner ACTORS
+        forming one jax.distributed mesh (learner_group.py)."""
         self.num_learners = num_learners
+        self.num_devices_per_learner = num_devices_per_learner
         return self
 
     def training(self, **kwargs):
@@ -109,10 +115,18 @@ class PPO:
                                    action_dim=action_dim,
                                    hidden=tuple(config.model["hidden"]),
                                    continuous=continuous)
-        model = build_model(self.model_spec)
-        self.learner_group = LearnerGroup(model, config.train,
-                                          num_learners=config.num_learners,
-                                          seed=config.seed)
+        if config.num_learners >= 1:
+            from .learner_group import DistributedLearnerGroup
+
+            self.learner_group = DistributedLearnerGroup(
+                self.model_spec, config.train,
+                num_learners=config.num_learners, seed=config.seed,
+                devices_per_learner=config.num_devices_per_learner)
+        else:
+            model = build_model(self.model_spec)
+            self.learner_group = LearnerGroup(model, config.train,
+                                              num_learners=1,
+                                              seed=config.seed)
         runner_cls = ray_tpu.remote(_ER)
         self.runners = [
             runner_cls.options(num_cpus=1).remote(
@@ -166,6 +180,8 @@ class PPO:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+        if hasattr(self.learner_group, "shutdown"):
+            self.learner_group.shutdown()
 
     def get_weights(self):
         return self.learner_group.get_weights()
