@@ -215,7 +215,12 @@ class IMPALA:
                 devices_per_learner=config.num_devices_per_learner)
         else:
             model = build_model(self.model_spec)
-            self.learner = ImpalaLearner(model, config.train,
+            mesh = None
+            if config.num_devices_per_learner > 1:
+                import jax
+                devs = jax.devices()[:config.num_devices_per_learner]
+                mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
+            self.learner = ImpalaLearner(model, config.train, mesh=mesh,
                                          seed=config.seed)
         runner_cls = ray_tpu.remote(_ER)
         self.runners = [

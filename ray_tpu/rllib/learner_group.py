@@ -124,11 +124,17 @@ class DistributedLearnerGroup:
                 model_spec, train_cfg, learner_cls, seed,
                 devices_per_learner)
             for _ in range(self.world)]
-        coordinator = ray_tpu.get(
-            self.workers[0].pick_coordinator.remote(), timeout=120)
-        self.info = ray_tpu.get(
-            [w.setup.remote(coordinator, i, self.world)
-             for i, w in enumerate(self.workers)], timeout=600)[0]
+        try:
+            coordinator = ray_tpu.get(
+                self.workers[0].pick_coordinator.remote(), timeout=120)
+            self.info = ray_tpu.get(
+                [w.setup.remote(coordinator, i, self.world)
+                 for i, w in enumerate(self.workers)], timeout=600)[0]
+        except BaseException:
+            # a rank failing setup leaves the others blocked inside
+            # jax.distributed.initialize — reap them all before raising
+            self.shutdown()
+            raise
 
     def _split(self, rollout: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
         shards: List[Dict[str, np.ndarray]] = [dict() for _ in range(self.world)]

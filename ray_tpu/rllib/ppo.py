@@ -123,10 +123,13 @@ class PPO:
                 num_learners=config.num_learners, seed=config.seed,
                 devices_per_learner=config.num_devices_per_learner)
         else:
+            # driver-local: num_devices_per_learner > 1 maps to an
+            # in-process dp mesh over that many local devices
             model = build_model(self.model_spec)
-            self.learner_group = LearnerGroup(model, config.train,
-                                              num_learners=1,
-                                              seed=config.seed)
+            self.learner_group = LearnerGroup(
+                model, config.train,
+                num_learners=max(1, config.num_devices_per_learner),
+                seed=config.seed)
         runner_cls = ray_tpu.remote(_ER)
         self.runners = [
             runner_cls.options(num_cpus=1).remote(

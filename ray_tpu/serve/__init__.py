@@ -7,8 +7,9 @@ streaming responses, and an HTTP ingress — plus a TPU-first continuous-
 batching LLM deployment (``ray_tpu.serve.llm``).
 """
 
-from .api import (delete, get_deployment_handle, http_config, run, shutdown,
-                  start, status)
+from .api import (delete, get_deployment_handle, grpc_config, http_config,
+                  run, shutdown, start, status)
+from .asgi import ASGIApp, ASGIRequest, ingress
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .config import AutoscalingConfig, DeploymentConfig
@@ -22,4 +23,5 @@ __all__ = [
     "DeploymentHandle", "Request", "batch", "run", "start", "status",
     "delete", "shutdown", "get_deployment_handle", "http_config",
     "multiplexed", "get_multiplexed_model_id", "DAGDriver",
+    "ingress", "ASGIApp", "ASGIRequest", "grpc_config",
 ]

@@ -20,7 +20,8 @@ from .router import DeploymentHandle, reset_router
 
 
 def _get_controller(create: bool = False, http: bool = False,
-                    http_host: str = "127.0.0.1", http_port: int = 0):
+                    http_host: str = "127.0.0.1", http_port: int = 0,
+                    grpc: bool = False, grpc_port: int = 0):
     ctrl = None
     try:
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -40,16 +41,26 @@ def _get_controller(create: bool = False, http: bool = False,
         port = ray_tpu.get(proxy.ready.remote(), timeout=30)
         ray_tpu.get(ctrl.set_http_config.remote(
             {"host": http_host, "port": port}), timeout=30)
+    if grpc:
+        from .grpc_proxy import GRPC_PROXY_NAME, GrpcProxyActor
+        gproxy = ray_tpu.remote(GrpcProxyActor).options(
+            name=GRPC_PROXY_NAME, lifetime="detached", max_concurrency=1000,
+            num_cpus=0.1, get_if_exists=True).remote(http_host, grpc_port)
+        ray_tpu.get(gproxy.ready.remote(), timeout=30)
     return ctrl
 
 
-def start(detached: bool = True, http_options: Optional[dict] = None):
-    """Start the Serve control plane (controller + optional HTTP proxy)."""
+def start(detached: bool = True, http_options: Optional[dict] = None,
+          grpc_options: Optional[dict] = None):
+    """Start the Serve control plane: controller + optional HTTP proxy +
+    optional gRPC proxy (reference serve.start's gRPCOptions)."""
     http_options = http_options or {}
     return _get_controller(
         create=True, http=bool(http_options),
         http_host=http_options.get("host", "127.0.0.1"),
-        http_port=http_options.get("port", 0))
+        http_port=http_options.get("port", 0),
+        grpc=grpc_options is not None,
+        grpc_port=(grpc_options or {}).get("port", 0))
 
 
 def run(target: Union[Deployment, Dict[str, Deployment]], *,
@@ -119,6 +130,15 @@ def http_config() -> Optional[dict]:
     return ray_tpu.get(ctrl.get_http_config.remote(), timeout=30)
 
 
+def grpc_config() -> Optional[dict]:
+    from .grpc_proxy import GRPC_PROXY_NAME
+    try:
+        gproxy = ray_tpu.get_actor(GRPC_PROXY_NAME)
+    except Exception:
+        return None
+    return ray_tpu.get(gproxy.get_config.remote(), timeout=30)
+
+
 def delete(name: str, timeout_s: float = 30.0):
     ctrl = _get_controller()
     ray_tpu.get(ctrl.delete_deployment.remote(name), timeout=30)
@@ -141,6 +161,13 @@ def shutdown():
         proxy = ray_tpu.get_actor(PROXY_NAME)
         ray_tpu.get(proxy.drain.remote(), timeout=10)
         ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        from .grpc_proxy import GRPC_PROXY_NAME
+        gproxy = ray_tpu.get_actor(GRPC_PROXY_NAME)
+        ray_tpu.get(gproxy.drain.remote(), timeout=10)
+        ray_tpu.kill(gproxy)
     except Exception:
         pass
     try:

@@ -235,6 +235,7 @@ class HTTPProxyActor:
         push — no next_chunks long-poll round trips (the buffered
         handle_request_streaming/next_chunks protocol remains for deployment
         handles that poll)."""
+        from .asgi import ASGIStart
         from aiohttp import web
         name = await self.router.choose(deployment)
         h = self.router._handle_for(name)
@@ -243,12 +244,30 @@ class HTTPProxyActor:
             (req,), {}, None)
         resp = web.StreamResponse()
         resp.headers["Content-Type"] = "text/plain; charset=utf-8"
-        await resp.prepare(http_request)
+        prepared = False
         async for ref in gen:
             # Surfaces generator errors too: a raise lands as the stream's
             # final ref and re-raises here (truncating the chunked body).
             c = await self.router._aget(ref)
+            if not prepared and isinstance(c, ASGIStart):
+                # ASGI ingress streams (ASGIStart, *body chunks): apply the
+                # app's status/headers before the response is prepared.
+                # Length/framing headers are dropped — this path chunks.
+                resp.set_status(c.status)
+                keep = [(k, v) for k, v in c.headers
+                        if k.lower() not in ("content-length",
+                                             "transfer-encoding")]
+                for k in {k for k, _ in keep}:
+                    resp.headers.popall(k, None)
+                for k, v in keep:  # add() preserves repeats (Set-Cookie)
+                    resp.headers.add(k, v)
+                continue
+            if not prepared:
+                await resp.prepare(http_request)
+                prepared = True
             await resp.write(self._chunk_bytes(c))
+        if not prepared:
+            await resp.prepare(http_request)
         await resp.write_eof()
         return resp
 

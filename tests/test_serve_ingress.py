@@ -1,0 +1,223 @@
+"""Ingress parity tests: ASGI-app mounting + gRPC proxy (reference:
+``python/ray/serve/api.py:194`` @serve.ingress, ``_private/grpc_util.py``).
+
+The ASGI tests serve a 2-route app with middleware through the real HTTP
+proxy; the gRPC tests drive the rayserve.ServeAPI service with a raw
+grpc channel and identity serializers (the wire format the generic
+handlers speak — protoc-compiled stubs produce identical bytes).
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_runtime():
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+    info = ray_tpu.init(num_cpus=8, worker_env=dict(CPU_WORKER_ENV))
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def serve_clean(serve_runtime):
+    yield
+    serve.shutdown()
+
+
+def _make_app():
+    app = serve.ASGIApp()
+
+    @app.middleware
+    async def stamp(req, call_next):
+        # middleware sees every request: short-circuit + header mutation
+        if req.headers.get("x-block") == "1":
+            return 403, [("content-type", "text/plain")], b"blocked"
+        status, headers, payload = await call_next(req)
+        headers = list(headers) + [("x-served-by", "asgi-ingress")]
+        return status, headers, payload
+
+    @app.get("/hello/{name}")
+    async def hello(req):
+        return {"hello": req.path_params["name"]}
+
+    @app.post("/count")
+    async def count(req):
+        replica = req.state.get("replica")
+        replica.hits += 1
+        return {"hits": replica.hits, "n": req.json()["n"]}
+
+    @app.get("/sse")
+    async def sse(req):
+        async def gen():
+            for i in range(int(req.query.get("n", 3))):
+                yield f"data: {i}\n"
+        return gen()
+
+    return app
+
+
+def test_asgi_ingress_routes_middleware_state(serve_clean):
+    import requests
+
+    @serve.deployment(route_prefix="/site")
+    @serve.ingress(_make_app())
+    class Site:
+        def __init__(self):
+            self.hits = 0
+
+    serve.run(Site, http=True)
+    cfg = serve.http_config()
+    base = f"http://{cfg['host']}:{cfg['port']}/site"
+
+    r = requests.get(f"{base}/hello/tpu", timeout=30)
+    assert r.status_code == 200
+    assert r.json() == {"hello": "tpu"}
+    assert r.headers["x-served-by"] == "asgi-ingress"
+
+    # replica state survives across requests (scope["state"]["replica"])
+    for want in (1, 2):
+        r = requests.post(f"{base}/count", json={"n": 7}, timeout=30)
+        assert r.json() == {"hits": want, "n": 7}
+
+    # middleware short-circuit carries its own status code
+    r = requests.get(f"{base}/hello/x", headers={"x-block": "1"}, timeout=30)
+    assert r.status_code == 403
+    assert r.text == "blocked"
+
+    # app-level 404 (unknown route INSIDE the app, not the proxy's 404)
+    r = requests.get(f"{base}/missing", timeout=30)
+    assert r.status_code == 404
+    assert "no route" in r.text
+
+
+def test_asgi_ingress_streaming(serve_clean):
+    import requests
+
+    @serve.deployment(route_prefix="/app")
+    @serve.ingress(_make_app())
+    class App:
+        def __init__(self):
+            self.hits = 0
+
+    serve.run(App, http=True)
+    cfg = serve.http_config()
+    r = requests.get(f"http://{cfg['host']}:{cfg['port']}/app/sse?n=4",
+                     timeout=30, stream=True)
+    assert r.status_code == 200
+    body = b"".join(r.iter_content(None)).decode()
+    assert body == "data: 0\ndata: 1\ndata: 2\ndata: 3\n"
+
+
+def test_asgi_ingress_coexists_with_plain_http(serve_clean):
+    """A plain Request deployment and an ASGI ingress share the proxy."""
+    import requests
+
+    @serve.deployment(route_prefix="/plain")
+    def plain(request: serve.Request):
+        return {"ok": True}
+
+    @serve.deployment(route_prefix="/app")
+    @serve.ingress(_make_app())
+    class App:
+        def __init__(self):
+            self.hits = 0
+
+    serve.run({"plain": plain, "App": App}, http=True)
+    cfg = serve.http_config()
+    base = f"http://{cfg['host']}:{cfg['port']}"
+    assert requests.get(f"{base}/plain", timeout=30).json() == {"ok": True}
+    assert requests.get(f"{base}/app/hello/a", timeout=30).json() == \
+        {"hello": "a"}
+
+
+# ----------------------------------------------------------------- gRPC
+
+
+def _grpc_channel_call(port, method, payload: bytes, metadata,
+                       stream: bool = False):
+    import grpc
+    from ray_tpu.serve.grpc_proxy import decode_payload, encode_payload
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    full = f"/rayserve.ServeAPI/{method}"
+    if stream:
+        fn = chan.unary_stream(full, request_serializer=encode_payload,
+                               response_deserializer=decode_payload)
+        out = [bytes(c) for c in fn(payload, metadata=metadata, timeout=30)]
+    else:
+        fn = chan.unary_unary(full, request_serializer=encode_payload,
+                              response_deserializer=decode_payload)
+        out = bytes(fn(payload, metadata=metadata, timeout=30))
+    chan.close()
+    return out
+
+
+def test_grpc_ingress_unary_and_errors(serve_clean):
+    import grpc
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request: serve.Request):
+            return {"got": request.body.decode(),
+                    "proto": request.method}
+
+        def shout(self, request: serve.Request):
+            return request.body.decode().upper()
+
+    serve.start(grpc_options={"port": 0})
+    serve.run(Echo)
+    cfg = serve.grpc_config()
+    assert cfg and cfg["port"] > 0
+
+    out = _grpc_channel_call(cfg["port"], "Predict", b"hi",
+                             [("deployment", "Echo")])
+    assert json.loads(out) == {"got": "hi", "proto": "GRPC"}
+
+    # method routing via metadata
+    out = _grpc_channel_call(cfg["port"], "Predict", b"quiet",
+                             [("deployment", "Echo"), ("method", "shout")])
+    assert out == b"QUIET"
+
+    # healthz + deployment listing
+    assert _grpc_channel_call(cfg["port"], "Healthz", b"", []) == b"ok"
+
+    # missing metadata -> INVALID_ARGUMENT, unknown -> NOT_FOUND
+    with pytest.raises(grpc.RpcError) as e:
+        _grpc_channel_call(cfg["port"], "Predict", b"x", [])
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    with pytest.raises(grpc.RpcError) as e:
+        _grpc_channel_call(cfg["port"], "Predict", b"x",
+                           [("deployment", "nope")])
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_grpc_ingress_streaming(serve_clean):
+    @serve.deployment
+    def ticker(request: serve.Request):
+        for i in range(int(request.body or b"3")):
+            yield f"t{i}"
+
+    serve.start(grpc_options={"port": 0})
+    serve.run(ticker)
+    cfg = serve.grpc_config()
+    chunks = _grpc_channel_call(cfg["port"], "PredictStream", b"4",
+                                [("deployment", "ticker")], stream=True)
+    assert chunks == [b"t0", b"t1", b"t2", b"t3"]
+
+
+def test_proto_wire_codec_roundtrip():
+    """The hand-rolled proto3 codec interoperates with google.protobuf."""
+    from google.protobuf import descriptor_pb2  # noqa: F401 — runtime check
+    from ray_tpu.serve.grpc_proxy import decode_payload, encode_payload
+
+    for payload in (b"", b"x", b"a" * 300, bytes(range(256))):
+        assert decode_payload(encode_payload(payload)) == payload
+    # a protoc-style message with extra unknown fields still parses
+    extra = b"\x10\x05" + encode_payload(b"keep") + b"\x1a\x03abc"
+    assert decode_payload(extra) == b"keep"
