@@ -116,12 +116,28 @@ def main():
             except Exception:
                 pass
             ray_tpu.shutdown()
-            time.sleep(5)  # let the replica process release the chip
+            time.sleep(20)  # let the replica process release the chip
+            # (the tunnel-side lock can take O(10s) to clear after the
+            # worker exits; 5 s proved too short in the round-5 run)
+
+    partial = {}
+
+    def phase(key, *a):
+        """Run one configuration and persist its numbers IMMEDIATELY — a
+        later phase wedging the TPU tunnel must not lose earlier results
+        (the round-4/5 lesson: phase 3 hung for 900 s and phases 1-2's
+        numbers evaporated with it)."""
+        res = run_serve(*a)
+        partial[key] = res
+        print(f"# {key}: {json.dumps(res)}", flush=True)
+        with open("BENCH_LLM_partial.json", "w") as f:
+            json.dump(partial, f, indent=1)
+        return res
 
     try:
-        dense = run_serve(False, mixed_prompt, "dense")
-        paged = run_serve(True, mixed_prompt, "paged")
-        prefix = run_serve(True, prefix_prompt, "paged+prefix")
+        dense = phase("dense", False, mixed_prompt, "dense")
+        paged = phase("paged", True, mixed_prompt, "paged")
+        prefix = phase("paged_prefix", True, prefix_prompt, "paged+prefix")
         print(json.dumps({
             "metric": "serve_llm_req_per_s",
             "value": paged["req_per_s"],

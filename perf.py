@@ -43,16 +43,8 @@ def timeit(fn, n: int, warmup: int = 1) -> float:
     return n / dt
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--out", default=None)
-    p.add_argument("--scale", type=float, default=1.0,
-                   help="shrink/grow iteration counts")
-    p.add_argument("--serve", action="store_true",
-                   help="include the Serve noop benchmark (slower)")
-    args = p.parse_args()
-    S = args.scale
-
+def run_suite(S: float, with_serve: bool) -> dict:
+    """One full pass over the microbench suite on a fresh cluster."""
     import numpy as np
 
     import ray_tpu
@@ -147,7 +139,7 @@ def main():
 
         results["pg_create_remove"] = timeit(pg_cycle, n)
 
-        if args.serve:
+        if with_serve:
             # free the microbench actors' CPUs for the serve replicas
             for actor in [a, aa, *actors]:
                 ray_tpu.kill(actor)
@@ -166,11 +158,48 @@ def main():
             serve.shutdown()
     finally:
         ray_tpu.shutdown()
+    return results
 
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="shrink/grow iteration counts")
+    p.add_argument("--serve", action="store_true",
+                   help="include the Serve noop benchmark (slower)")
+    p.add_argument("--runs", type=int, default=1,
+                   help="repeat the whole suite N times (fresh cluster "
+                        "each) and report per-metric median + IQR — "
+                        "single runs on this 1-core box swing +/-40%%, so "
+                        "perf claims need --runs >= 5")
+    args = p.parse_args()
+
+    all_runs = []
+    for r in range(args.runs):
+        res = run_suite(args.scale, args.serve)
+        all_runs.append(res)
+        if args.runs > 1:
+            print(f"# run {r + 1}/{args.runs}: "
+                  f"{json.dumps({k: round(v, 1) for k, v in res.items()})}",
+                  flush=True)
+
+    def quantile(xs, q):
+        xs = sorted(xs)
+        i = (len(xs) - 1) * q
+        lo, hi = int(i), min(int(i) + 1, len(xs) - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
+
+    metrics = list(all_runs[0])
+    med = {k: quantile([r[k] for r in all_runs], 0.5) for k in metrics}
+    iqr = {k: quantile([r[k] for r in all_runs], 0.75)
+           - quantile([r[k] for r in all_runs], 0.25) for k in metrics}
     out = {"metric": "core_microbench", "unit": "ops/s",
-           "results": {k: round(v, 1) for k, v in results.items()},
-           "vs_baseline": {k: round(results[k] / BASELINE[k], 3)
-                           for k in results if k in BASELINE}}
+           "runs": args.runs,
+           "results": {k: round(v, 1) for k, v in med.items()},
+           "iqr": {k: round(v, 1) for k, v in iqr.items()},
+           "vs_baseline": {k: round(med[k] / BASELINE[k], 3)
+                           for k in metrics if k in BASELINE}}
     line = json.dumps(out)
     print(line)
     if args.out:

@@ -215,6 +215,8 @@ def shutdown():
         atexit.unregister(shutdown)
     except Exception:
         pass
+    from .config import reset_config
+    reset_config()
 
 
 # ---------------------------------------------------------------------------

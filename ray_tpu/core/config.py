@@ -92,10 +92,26 @@ class Config:
     #: (reference: GcsHealthCheckManager defaults).
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
+    #: Memory-monitor victim policy: "group_by_owner" kills the newest
+    #: worker of the owner with the LARGEST fan-out (reference:
+    #: worker_killing_policy_group_by_owner.h:85 — the biggest submitter is
+    #: both the likeliest cause and the cheapest to retry); "retriable_lifo"
+    #: kills the newest leased task worker regardless of owner.
+    oom_worker_killing_policy: str = "group_by_owner"
+    #: OOM kills of the SAME task use this separate retry budget (reference:
+    #: task_oom_retries) — after this many memory-monitor kills the task
+    #: fails with a typed, actionable OutOfMemoryError instead of retrying
+    #: forever; -1 = unlimited.
+    task_oom_retries: int = 3
 
     # -- rpc ---------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0
+    #: Chaos injection (reference: ray's chaos_network_delay.yaml release
+    #: harness): every outbound RPC frame is delayed this many ms before
+    #: hitting the socket.  Set RAYTPU_CHAOS_RPC_DELAY_MS before booting a
+    #: cluster and every process inherits the laggy links; 0 disables.
+    chaos_rpc_delay_ms: float = 0.0
     #: Actor __init__ runs arbitrary user code (model loads, XLA compiles —
     #: an LLM replica warms minutes of prefill buckets): the creation call
     #: must not be bounded by the generic RPC timeout, or the agent kills
@@ -164,3 +180,11 @@ def get_config() -> Config:
 def set_config(cfg: Config) -> None:
     global _global_config
     _global_config = cfg
+
+
+def reset_config() -> None:
+    """Drop the singleton so the next get_config() re-derives from the
+    environment — called by shutdown() so a driver's ``_system_config``
+    overrides do not leak into the process's next cluster."""
+    global _global_config
+    _global_config = None

@@ -184,6 +184,13 @@ class TaskManager:
         self.lineage: "collections.OrderedDict[TaskID, TaskSpec]" = collections.OrderedDict()
         self.num_finished = 0
         self.num_failed = 0
+        #: memory-monitor kills per task (reference task_oom_retries budget)
+        self.oom_kill_counts: Dict[TaskID, int] = {}
+
+    def note_oom_kill(self, task_id: TaskID) -> int:
+        n = self.oom_kill_counts.get(task_id, 0) + 1
+        self.oom_kill_counts[task_id] = n
+        return n
 
     def add_pending(self, spec: TaskSpec, arg_refs: List[ObjectRef]):
         self.pending[spec.task_id] = PendingTask(spec, spec.max_retries, arg_refs)
@@ -221,6 +228,7 @@ class TaskManager:
 
     def complete(self, task_id: TaskID, results: List[tuple]):
         pt = self.pending.pop(task_id, None)
+        self.oom_kill_counts.pop(task_id, None)
         if pt is None:
             return
         self._release_args(pt)
@@ -285,6 +293,7 @@ class TaskManager:
 
     def fail(self, task_id: TaskID, exc: BaseException, tb: str = ""):
         pt = self.pending.pop(task_id, None)
+        self.oom_kill_counts.pop(task_id, None)
         if pt is None:
             return
         self._release_args(pt)
@@ -314,13 +323,19 @@ class TaskManager:
         pt = self.pending.get(task_id)
         return pt is not None and pt.retries_left != 0
 
-    def use_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
+    def use_retry(self, task_id: TaskID,
+                  consume: bool = True) -> Optional[TaskSpec]:
         """Negative retries_left means retry forever (max_retries=-1, same
-        semantics as the reference's infinite task/actor retries)."""
+        semantics as the reference's infinite task/actor retries).
+
+        ``consume=False`` re-queues without spending the generic budget —
+        used for memory-monitor kills, which have their own bounded
+        ``task_oom_retries`` budget (reference: OOM retries are counted
+        separately from application failures)."""
         pt = self.pending.get(task_id)
         if pt is None or pt.retries_left == 0:
             return None
-        if pt.retries_left > 0:
+        if consume and pt.retries_left > 0:
             pt.retries_left -= 1
         pt.spec.retry_count += 1
         st = self._w.streams.get(task_id)
@@ -449,6 +464,8 @@ class LeasePool:
                                              bundle=self.bundle,
                                              runtime_env=self.runtime_env,
                                              allow_spillback=(hops < 4),
+                                             owner=self.w.address,
+                                             task_label=str(self.key[0]),
                                              _timeout=3600.0)
                 except (ConnectionLost, OSError):
                     target_addr = None
@@ -527,17 +544,37 @@ class LeasePool:
         except Exception:
             pass
         retries: List[TaskSpec] = []
+        oom_limit = get_config().task_oom_retries
         for spec in specs:
+            if death_cause:
+                # The agent killed this worker deliberately (memory
+                # monitor).  OOM kills have their OWN bounded budget
+                # (task_oom_retries) and do not consume the generic retry
+                # budget — but an always-OOM task must FAIL with advice
+                # rather than loop forever (reference: task_oom_retries +
+                # the group-by-owner policy's infeasible-task escape).
+                n = self.w.task_manager.note_oom_kill(spec.task_id)
+                if oom_limit < 0 or n <= oom_limit:
+                    retry_spec = self.w.task_manager.use_retry(
+                        spec.task_id, consume=False)
+                    if retry_spec is not None:
+                        retries.append(retry_spec)
+                        continue
+                self.w.task_manager.fail(
+                    spec.task_id,
+                    OutOfMemoryError(
+                        f"task {spec.name} was killed by the memory monitor "
+                        f"{n} time(s) ({death_cause}); no retries remain "
+                        f"(task_oom_retries={oom_limit}, "
+                        f"max_retries={spec.max_retries}). The task's "
+                        "working set appears to exceed what this node can "
+                        "admit — reduce its memory footprint, raise its "
+                        "resource request so fewer tasks run concurrently, "
+                        "or add memory/nodes."), "")
+                continue
             retry_spec = self.w.task_manager.use_retry(spec.task_id)
             if retry_spec is not None:
                 retries.append(retry_spec)
-            elif death_cause:
-                # The agent killed this worker deliberately (memory monitor):
-                # typed, policy-naming error (reference: OutOfMemoryError).
-                self.w.task_manager.fail(
-                    spec.task_id,
-                    OutOfMemoryError(f"task {spec.name} failed: {death_cause}"),
-                    "")
             else:
                 self.w.task_manager.fail(
                     spec.task_id,

@@ -440,7 +440,21 @@ class GcsServer:
                            "lifetime": lifetime, "created_at": time.time()}
         self._pg_events[pg_id] = asyncio.Event()
         asyncio.ensure_future(self._schedule_pg(pg_id))
-        return pg_id
+        # common case on an uncontended cluster: the placement settles
+        # within one agent round trip — piggyback the result on the create
+        # reply so the client's ready() needs no second RPC.  Only wait
+        # when a placement is packable RIGHT NOW; a pending-forever PG
+        # must not add latency to batch creates (the long-poll
+        # wait_placement_group remains the general path).
+        if pack_bundles(self.nodes, bundles, strategy) is not None:
+            ev = self._pg_events.get(pg_id)
+            try:
+                await asyncio.wait_for(ev.wait(), 0.25)
+            except asyncio.TimeoutError:
+                pass
+        info = self.pgs.get(pg_id)
+        return {"pg_id": pg_id,
+                "info": info if info and info["state"] != "PENDING" else None}
 
     def _pg_settled(self, pg_id: str):
         ev = self._pg_events.get(pg_id)
@@ -454,51 +468,57 @@ class GcsServer:
         for attempt in range(200):
             placement = pack_bundles(self.nodes, info["bundles"], info["strategy"])
             if placement is not None:
-                # 2-phase: prepare on all nodes, then commit (reference:
-                # PrepareBundleResources/CommitBundleResources RPCs).  Both
-                # phases fan out concurrently — the RPCs are independent per
-                # bundle, so wall time is one round trip per phase, not one
-                # per bundle.
-                async def _prepare(i: int, nid: str) -> bool:
+                # 2-phase prepare/commit (reference PrepareBundleResources/
+                # CommitBundleResources), batched to ONE RPC per node per
+                # phase; a placement that lands entirely on one node takes
+                # the fused prepare_commit path — no cross-node atomicity
+                # to coordinate, so one round trip creates the whole PG.
+                by_node: Dict[str, Dict[int, dict]] = {}
+                for i, nid in enumerate(placement):
+                    by_node.setdefault(nid, {})[i] = info["bundles"][i]
+
+                async def _phase(method: str, nid: str, payload) -> bool:
                     agent = self.agent_clients.get(self.nodes[nid].address)
                     try:
                         return bool(await agent.call(
-                            "prepare_bundle", pg_id=pg_id, bundle_index=i,
-                            resources=info["bundles"][i]))
+                            method, pg_id=pg_id, **payload))
                     except Exception:
                         return False
 
-                results = await asyncio.gather(
-                    *[_prepare(i, nid) for i, nid in enumerate(placement)])
-                prepared = [(nid, i) for i, (nid, good)
-                            in enumerate(zip(placement, results)) if good]
-                if all(results):
-                    async def _commit(i: int, nid: str):
-                        agent = self.agent_clients.get(self.nodes[nid].address)
-                        await agent.call("commit_bundle", pg_id=pg_id,
-                                         bundle_index=i)
-
-                    await asyncio.gather(
-                        *[_commit(i, nid) for i, nid in enumerate(placement)])
+                if len(by_node) == 1:
+                    nid, bundles = next(iter(by_node.items()))
+                    ok = await _phase("prepare_commit_bundles", nid,
+                                      {"bundles": bundles})
+                    results = {nid: ok}
+                else:
+                    results = dict(zip(by_node, await asyncio.gather(
+                        *[_phase("prepare_bundles", nid, {"bundles": b})
+                          for nid, b in by_node.items()])))
+                    if all(results.values()):
+                        # a failed COMMIT must also fail the attempt — a
+                        # PG published CREATED with an uncommitted bundle
+                        # breaks every lease against it
+                        commits = await asyncio.gather(
+                            *[_phase("commit_bundles", nid,
+                                     {"indices": list(b)})
+                              for nid, b in by_node.items()])
+                        for nid, ok in zip(by_node, commits):
+                            results[nid] = results[nid] and ok
+                if all(results.values()):
                     info.update(state="CREATED",
                                 placement=[(nid, self.nodes[nid].address)
                                            for nid in placement])
                     self._pg_settled(pg_id)
                     self._publish("pgs", {"pg_id": pg_id, "state": "CREATED"})
                     return
-
-                async def _rollback(i: int, nid: str):
-                    agent = self.agent_clients.get(self.nodes[nid].address)
-                    try:
-                        await agent.call("return_bundle", pg_id=pg_id,
-                                         bundle_index=i)
-                    except Exception:
-                        pass
-
-                await asyncio.gather(*[_rollback(i, nid) for nid, i in prepared])
+                await asyncio.gather(
+                    *[_phase("return_bundles", nid, {"indices": list(b)})
+                      for nid, b in by_node.items() if results.get(nid)])
             if self.pgs.get(pg_id) is None:
                 return
-            await asyncio.sleep(0.25)
+            # quick first retries (a bundle freed a moment ago — e.g. an
+            # async PG removal still returning resources), then back off
+            await asyncio.sleep(min(0.02 * (2 ** min(attempt, 4)), 0.25))
         info["state"] = "INFEASIBLE"
         self._pg_settled(pg_id)
 
@@ -526,17 +546,30 @@ class GcsServer:
         if info is None:
             return False
         if info.get("placement"):
-            async def _return(i: int, addr: str):
+            # resource return is OFF the reply path (reference: removal is
+            # async server-side); agents see the return frames before any
+            # later prepare from this same GCS connection, and _schedule_pg
+            # quick-retries cover scheduling races.
+            by_addr: Dict[str, list] = {}
+            for i, (nid, addr) in enumerate(info["placement"]):
+                if nid in self.nodes:
+                    by_addr.setdefault(addr, []).append(i)
+
+            async def _return(addr: str, indices: list):
                 try:
                     await self.agent_clients.get(addr).call(
-                        "return_bundle", pg_id=pg_id, bundle_index=i)
+                        "return_bundles", pg_id=pg_id, indices=indices)
                 except Exception:
                     pass
 
-            await asyncio.gather(
-                *[_return(i, addr)
-                  for i, (nid, addr) in enumerate(info["placement"])
-                  if nid in self.nodes])
+            if not hasattr(self, "_bg_tasks"):
+                self._bg_tasks = set()
+            for addr, indices in by_addr.items():
+                # strong ref until done — the loop holds only weak refs,
+                # and a GC'd task would leak the bundle's resources forever
+                task = asyncio.ensure_future(_return(addr, indices))
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
         self._publish("pgs", {"pg_id": pg_id, "state": "REMOVED"})
         return True
 

@@ -56,6 +56,10 @@ class WorkerHandle:
     #: pip-env identity: workers run the env's venv interpreter and are only
     #: leased to tasks with the same hash (None = the plain interpreter)
     env_hash: Optional[str] = None
+    #: lease provenance for the group-by-owner OOM policy: the submitting
+    #: CoreWorker's address and its scheduling-key label
+    owner: Optional[str] = None
+    task_label: str = ""
     #: (runtime_path, container_name) for containerized workers — killing
     #: the `run` client does not stop the container; teardown must `rm -f`.
     container_ref: Optional[tuple] = None
@@ -69,6 +73,8 @@ class LeaseRequest:
     future: "asyncio.Future"
     runtime_env: Optional[dict] = None
     allow_spillback: bool = True
+    owner: Optional[str] = None
+    task_label: str = ""
 
 
 class NodeAgent:
@@ -414,13 +420,16 @@ class NodeAgent:
     async def handle_request_worker_lease(self, resources: Dict[str, float],
                                           bundle: Optional[Tuple[str, int]] = None,
                                           runtime_env: Optional[dict] = None,
-                                          allow_spillback: bool = True):
+                                          allow_spillback: bool = True,
+                                          owner: Optional[str] = None,
+                                          task_label: str = ""):
         """Grant {worker_address, worker_id, lease_id} | {spillback: node} | queue."""
         pool = self._resource_pool_for(bundle)
         if bundle is None and not ResourceSet(self.total.to_dict()).can_fit(resources):
             return {"infeasible": True}
         if pool.can_fit(resources):
-            return await self._grant_lease(resources, bundle, runtime_env)
+            return await self._grant_lease(resources, bundle, runtime_env,
+                                           owner=owner, task_label=task_label)
         # Saturated: spill to a node that can run it now (reference spillback).
         spill = self._spillback_target(resources) if (allow_spillback and
                                                       bundle is None) else None
@@ -429,7 +438,8 @@ class NodeAgent:
         fut = asyncio.get_event_loop().create_future()
         req = LeaseRequest(self._next_lease_id(), resources,
                            tuple(bundle) if bundle else None, fut, runtime_env,
-                           allow_spillback=allow_spillback)
+                           allow_spillback=allow_spillback,
+                           owner=owner, task_label=task_label)
         self.lease_queue.append(req)
         return await fut
 
@@ -442,7 +452,9 @@ class NodeAgent:
                                   "address": others[target].address}}
         return None
 
-    async def _grant_lease(self, resources, bundle, runtime_env) -> dict:
+    async def _grant_lease(self, resources, bundle, runtime_env,
+                           owner: Optional[str] = None,
+                           task_label: str = "") -> dict:
         from .runtime_env import worker_env_hash
         pool = self._resource_pool_for(bundle)
         pool.acquire(resources)
@@ -465,6 +477,8 @@ class NodeAgent:
         w.state = "LEASED"
         w.leased_at = time.monotonic()
         w.lease_id = lease_id
+        w.owner = owner
+        w.task_label = task_label
         try:
             await asyncio.wait_for(w.registered.wait(),
                                    get_config().worker_register_timeout_s)
@@ -561,7 +575,9 @@ class NodeAgent:
                 self.lease_queue.pop(i)
                 try:
                     grant = await self._grant_lease(req.resources, req.bundle,
-                                                    req.runtime_env)
+                                                    req.runtime_env,
+                                                    owner=req.owner,
+                                                    task_label=req.task_label)
                     if not req.future.done():
                         req.future.set_result(grant)
                 except Exception as e:  # noqa: BLE001
@@ -638,30 +654,80 @@ class NodeAgent:
 
     # ------------------------------------------------------ placement bundles
 
+    # Single-bundle RPCs: thin wrappers over the batched forms below so the
+    # prepare/commit/return semantics live in exactly one place.
+
     async def handle_prepare_bundle(self, pg_id: str, bundle_index: int,
                                     resources: Dict[str, float]) -> bool:
-        key = (pg_id, bundle_index)
-        if key in self.prepared_bundles or key in self.bundles:
-            return True
-        if not self.available.can_fit(resources):
-            return False
-        self.available.acquire(resources)
-        self.prepared_bundles[key] = ResourceSet(resources)
-        return True
+        return await self.handle_prepare_bundles(
+            pg_id, {bundle_index: resources})
 
     async def handle_commit_bundle(self, pg_id: str, bundle_index: int) -> bool:
         key = (pg_id, bundle_index)
-        rs = self.prepared_bundles.pop(key, None)
-        if rs is None:
-            return key in self.bundles
-        self.bundles[key] = rs
-        return True
+        if key not in self.prepared_bundles and key in self.bundles:
+            return True
+        if key not in self.prepared_bundles:
+            return False
+        return await self.handle_commit_bundles(pg_id, [bundle_index])
 
     async def handle_return_bundle(self, pg_id: str, bundle_index: int) -> bool:
-        key = (pg_id, bundle_index)
-        rs = self.prepared_bundles.pop(key, None) or self.bundles.pop(key, None)
-        if rs is not None:
-            self.available.release(rs.to_dict())
+        return await self.handle_return_bundles(pg_id, [bundle_index])
+
+    # Batched bundle RPCs: the GCS PG manager fans out ONE call per node
+    # per phase (or a single fused call for single-node placements) instead
+    # of one per bundle — the 2-phase protocol is unchanged, only the RPC
+    # count drops (reference PrepareBundleResources batches the same way,
+    # gcs_placement_group_scheduler.cc).
+
+    def _acquire_all(self, pg_id: str,
+                     bundles: Dict[int, Dict[str, float]]) -> bool:
+        """All-or-nothing local prepare of several bundles."""
+        taken = []
+        for idx, resources in bundles.items():
+            key = (pg_id, int(idx))
+            if key in self.prepared_bundles or key in self.bundles:
+                continue
+            if not self.available.can_fit(resources):
+                for k in taken:
+                    self.available.release(self.prepared_bundles.pop(k).to_dict())
+                return False
+            self.available.acquire(resources)
+            self.prepared_bundles[key] = ResourceSet(resources)
+            taken.append(key)
+        return True
+
+    async def handle_prepare_bundles(self, pg_id: str,
+                                     bundles: Dict[int, Dict[str, float]]) -> bool:
+        return self._acquire_all(pg_id, bundles)
+
+    async def handle_commit_bundles(self, pg_id: str, indices) -> bool:
+        for idx in indices:
+            key = (pg_id, int(idx))
+            rs = self.prepared_bundles.pop(key, None)
+            if rs is not None:
+                self.bundles[key] = rs
+        return True
+
+    async def handle_prepare_commit_bundles(
+            self, pg_id: str, bundles: Dict[int, Dict[str, float]]) -> bool:
+        """Fused single-round-trip path: safe when the WHOLE placement is on
+        this node (no cross-node atomicity to wait for)."""
+        if not self._acquire_all(pg_id, bundles):
+            return False
+        for idx in bundles:
+            key = (pg_id, int(idx))
+            rs = self.prepared_bundles.pop(key, None)
+            if rs is not None:
+                self.bundles[key] = rs
+        return True
+
+    async def handle_return_bundles(self, pg_id: str, indices) -> bool:
+        for idx in indices:
+            key = (pg_id, int(idx))
+            rs = (self.prepared_bundles.pop(key, None)
+                  or self.bundles.pop(key, None))
+            if rs is not None:
+                self.available.release(rs.to_dict())
         await self._process_lease_queue()
         return True
 
@@ -911,12 +977,14 @@ class NodeAgent:
         """Kill a worker before the kernel OOM-killer takes the whole node.
 
         Reference: ``src/ray/common/memory_monitor.h:52`` + the raylet's
-        retriable-LIFO worker-killing policy (``worker_killing_policy.h:64``):
-        when node memory passes the threshold, kill the newest leased
-        (task-running) worker first — its task retries, and admission
-        backpressure (fewer workers) relieves the pressure.  Actors are
-        spared unless they are the only candidates (restarting an actor is
-        costlier than retrying a task)."""
+        worker-killing policies (``worker_killing_policy.h:64`` retriable-
+        LIFO, ``worker_killing_policy_group_by_owner.h:85`` group-by-owner,
+        selected by config.oom_worker_killing_policy): when node memory
+        passes the threshold, kill a leased task-running worker — its task
+        retries (bounded by task_oom_retries), and admission backpressure
+        (fewer workers) relieves the pressure.  Actors are spared unless
+        they are the only candidates (restarting an actor is costlier than
+        retrying a task)."""
         cfg = get_config()
         if not cfg.memory_monitor_enabled:
             return
@@ -945,7 +1013,9 @@ class NodeAgent:
                     task = asyncio.ensure_future(events.record_via(
                         self.gcs.call, "WARNING", "memory-monitor",
                         f"killed worker {victim.worker_id[:12]}",
-                        policy="retriable-LIFO", usage=f"{usage:.0%}",
+                        policy=cfg.oom_worker_killing_policy,
+                        usage=f"{usage:.0%}",
+                        owner=victim.owner or "",
                         node=self.node_id.hex()[:12]))
                     self._bg_tasks.add(task)
                     task.add_done_callback(self._bg_tasks.discard)
@@ -955,7 +1025,8 @@ class NodeAgent:
                     f"worker killed by the memory monitor: node memory "
                     f"{usage:.0%} >= threshold "
                     f"{cfg.memory_usage_threshold:.0%} "
-                    f"(retriable-LIFO worker killing policy)")
+                    f"({cfg.oom_worker_killing_policy} worker killing "
+                    f"policy)")
                 if victim.is_actor and victim.actor_id:
                     # _kill_worker_proc releases leases but does not tell
                     # the GCS — an unreported actor death would leave the
@@ -979,7 +1050,8 @@ class NodeAgent:
                 try:
                     print(f"[memory-monitor] node memory {usage:.0%} >= "
                           f"{cfg.memory_usage_threshold:.0%}: killed worker "
-                          f"{victim.worker_id[:12]} (retriable-LIFO)",
+                          f"{victim.worker_id[:12]} "
+                          f"({cfg.oom_worker_killing_policy})",
                           flush=True)
                 except Exception:
                     pass
@@ -994,7 +1066,18 @@ class NodeAgent:
         pool = tasks or leased
         if not pool:
             return None
-        # LIFO by lease time: the newest lease loses the least progress
+        if get_config().oom_worker_killing_policy == "group_by_owner":
+            # Group leased workers by submitting owner; the owner with the
+            # LARGEST fan-out loses its newest lease (reference:
+            # worker_killing_policy_group_by_owner.h:85).  Singleton groups
+            # tie-break to the newest lease overall == retriable-LIFO.
+            groups: Dict[str, list] = {}
+            for w in pool:
+                groups.setdefault(w.owner or w.worker_id, []).append(w)
+            grp = max(groups.values(),
+                      key=lambda g: (len(g), max(w.leased_at for w in g)))
+            return max(grp, key=lambda w: w.leased_at)
+        # retriable-LIFO: the newest lease loses the least progress
         return max(pool, key=lambda w: w.leased_at)
 
     # ---------------------------------------------------------- observability

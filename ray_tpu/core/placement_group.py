@@ -40,6 +40,8 @@ class PlacementGroup:
         return global_worker().gcs
 
     def ready(self, timeout: float = 60.0) -> bool:
+        if self._placement is not None:  # settled on the create reply
+            return True
         info = run_async(self._gcs().call("wait_placement_group", pg_id=self.id,
                                           timeout=timeout, _timeout=timeout + 10))
         if info and info["state"] == "CREATED":
@@ -73,10 +75,15 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     from .core_worker import global_worker
     w = global_worker()
     pg_id = PlacementGroupID.from_random().hex()
-    run_async(w.gcs.call("create_placement_group", pg_id=pg_id,
-                         bundles=[dict(b) for b in bundles], strategy=strategy,
-                         name=name, lifetime=lifetime))
-    return PlacementGroup(pg_id, bundles, strategy)
+    reply = run_async(w.gcs.call("create_placement_group", pg_id=pg_id,
+                                 bundles=[dict(b) for b in bundles],
+                                 strategy=strategy,
+                                 name=name, lifetime=lifetime))
+    pg = PlacementGroup(pg_id, bundles, strategy)
+    info = reply.get("info") if isinstance(reply, dict) else None
+    if info and info["state"] == "CREATED":
+        pg._placement = info["placement"]
+    return pg
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

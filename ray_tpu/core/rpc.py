@@ -265,6 +265,8 @@ class RpcClient:
         self._connect_lock: asyncio.Lock | None = None
         self._closed = False
         self._push_handler: Callable[[str, dict], None] | None = None
+        # chaos harness: per-link added latency (config or set_link_delay)
+        self._chaos_delay_s = get_config().chaos_rpc_delay_ms / 1000.0
 
     def on_push(self, fn: Callable[[str, dict], None]):
         """Register a callback for server-initiated one-way messages."""
@@ -320,12 +322,18 @@ class RpcClient:
         if self._closed:
             raise RpcError("client closed")
         await self._ensure_connected()
+        if self._chaos_delay_s > 0.0:
+            await asyncio.sleep(self._chaos_delay_s)
         req_id = next(self._req_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
         coalesced_write(self._writer, _encode((req_id, method, kwargs)))
         await drain_if_needed(self._writer)
         return fut
+
+    def set_link_delay(self, delay_s: float):
+        """Chaos harness: add one-way latency to every frame on this link."""
+        self._chaos_delay_s = float(delay_s)
 
     async def call(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
         fut = await self.call_start(method, **kwargs)
@@ -334,6 +342,8 @@ class RpcClient:
 
     async def notify(self, method: str, **kwargs):
         await self._ensure_connected()
+        if self._chaos_delay_s > 0.0:
+            await asyncio.sleep(self._chaos_delay_s)
         coalesced_write(self._writer, _encode((-1, method, kwargs)))
         await drain_if_needed(self._writer)
 

@@ -51,7 +51,7 @@ def test_oom_kill_is_typed_and_names_policy(ray_start_regular):
         with pytest.raises(ray_tpu.OutOfMemoryError) as ei:
             ray_tpu.get(ref, timeout=30)
         msg = str(ei.value)
-        assert "memory monitor" in msg and "retriable-LIFO" in msg, msg
+        assert "memory monitor" in msg and "worker killing policy" in msg, msg
     finally:
         cfg.memory_usage_threshold = old
 
@@ -122,4 +122,67 @@ def test_oom_kill_emits_event(ray_start_regular):
         time.sleep(0.2)
     assert evs, "no memory-monitor event recorded"
     assert evs[0]["severity"] == "WARNING"
-    assert evs[0]["labels"]["policy"] == "retriable-LIFO"
+    assert evs[0]["labels"]["policy"] == "group_by_owner"  # config default
+
+
+def test_group_by_owner_victim_policy():
+    """The owner with the largest fan-out loses its NEWEST worker, even
+    when another owner holds the newest lease overall (reference:
+    worker_killing_policy_group_by_owner.h:85)."""
+    from ray_tpu.core.node_agent import NodeAgent, WorkerHandle
+
+    agent = NodeAgent.__new__(NodeAgent)  # policy is pure over .workers
+
+    def mk(wid, owner, leased_at, actor=False):
+        w = WorkerHandle(worker_id=wid, proc=None, state="LEASED",
+                         is_actor=actor)
+        w.owner = owner
+        w.leased_at = leased_at
+        return w
+
+    fanout = [mk(f"a{i}", "owner-A", float(i)) for i in range(3)]
+    lone = mk("b0", "owner-B", 99.0)  # newest lease, smallest group
+    agent.workers = {w.worker_id: w for w in (*fanout, lone)}
+    victim = agent._pick_oom_victim()
+    assert victim.owner == "owner-A", victim.worker_id
+    assert victim.worker_id == "a2"  # newest within the big group
+
+    # singleton groups degrade to retriable-LIFO (newest overall)
+    agent.workers = {w.worker_id: w
+                     for w in (mk("x", "o1", 1.0), mk("y", "o2", 2.0))}
+    assert agent._pick_oom_victim().worker_id == "y"
+
+
+@pytest.mark.timeout(120)
+def test_always_oom_task_fails_with_advice(ray_start_regular):
+    """An always-OOM task stops retry-looping after task_oom_retries kills
+    and fails with a typed, actionable message — even with infinite
+    generic retries (reference: the task_oom_retries budget)."""
+    from ray_tpu.core.config import get_config
+    cfg = get_config()
+
+    @ray_tpu.remote(max_retries=-1)  # would otherwise retry forever
+    def hog():
+        time.sleep(30)
+        return "never"
+
+    ref = hog.remote()
+    agent = _agent()
+    deadline = time.monotonic() + 20
+    while not any(w.state == "LEASED" for w in agent.workers.values()):
+        assert time.monotonic() < deadline, "task never started"
+        time.sleep(0.1)
+    old_thr, old_retries = cfg.memory_usage_threshold, cfg.task_oom_retries
+    try:
+        cfg.task_oom_retries = 1
+        cfg.memory_usage_threshold = 0.0  # every poll kills the worker
+        with pytest.raises(ray_tpu.OutOfMemoryError) as ei:
+            ray_tpu.get(ref, timeout=90)
+    finally:
+        cfg.memory_usage_threshold = old_thr
+        cfg.task_oom_retries = old_retries
+    msg = str(ei.value)
+    assert "task_oom_retries=1" in msg, msg
+    assert "2 time(s)" in msg, msg          # killed limit+1 times
+    assert "working set" in msg, msg        # the actionable advice
+    assert agent._oom_kill_count >= 2
