@@ -511,9 +511,14 @@ class GcsServer:
                     self._pg_settled(pg_id)
                     self._publish("pgs", {"pg_id": pg_id, "state": "CREATED"})
                     return
+                # Roll back on EVERY node of the attempt, including ones
+                # whose prepare/commit RPC failed — a lost reply may have
+                # applied server-side, and return_bundles is idempotent
+                # (pops whatever exists), so over-returning is safe while
+                # under-returning leaks the bundle until agent restart.
                 await asyncio.gather(
                     *[_phase("return_bundles", nid, {"indices": list(b)})
-                      for nid, b in by_node.items() if results.get(nid)])
+                      for nid, b in by_node.items()])
             if self.pgs.get(pg_id) is None:
                 return
             # quick first retries (a bundle freed a moment ago — e.g. an

@@ -257,7 +257,8 @@ class NodeAgent:
     async def _spawn_worker(self, is_actor: bool = False,
                             runtime_env: Optional[dict] = None
                             ) -> WorkerHandle:
-        from .runtime_env import (materialize_pip_env, pip_env_hash,
+        from .runtime_env import (conda_env_hash, materialize_conda_env,
+                                  materialize_pip_env, pip_env_hash,
                                   worker_env_hash)
         env_hash = worker_env_hash(runtime_env)
         python_exe = sys.executable
@@ -270,6 +271,17 @@ class NodeAgent:
             try:
                 python_exe = await asyncio.get_event_loop().run_in_executor(
                     None, materialize_pip_env, self.session_dir, runtime_env)
+            except Exception as e:
+                raise RuntimeEnvSetupError(str(e)) from e
+        elif conda_env_hash(runtime_env) is not None:
+            # Same off-loop materialization for conda (reference:
+            # _private/runtime_env/conda.py) — workers launch under the
+            # conda env's interpreter, pooled per spec hash.
+            from .common import RuntimeEnvSetupError
+            try:
+                python_exe = await asyncio.get_event_loop().run_in_executor(
+                    None, materialize_conda_env, self.session_dir,
+                    runtime_env)
             except Exception as e:
                 raise RuntimeEnvSetupError(str(e)) from e
         worker_id = WorkerID.from_random().hex()
@@ -1109,7 +1121,9 @@ class NodeAgent:
         app.router.add_get("/metrics", metrics_handler)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
+        # bind where the agent's RPC server binds so the dashboard head can
+        # scrape remote nodes at their advertised address
+        site = web.TCPSite(runner, self.server.host, 0)
         await site.start()
         port = site._server.sockets[0].getsockname()[1]
         self._metrics_runner = runner

@@ -7,13 +7,15 @@ job-level environment — ``py_modules`` directories and ``env_vars`` packed
 at ``ray_tpu.init(runtime_env=...)`` into the GCS KV; every worker
 materializes them once per job before executing that job's first task, so
 multi-node deployments distribute real packages, not just cloudpickle
-closures.  (conda/pip env building is out of scope on a no-network image;
-the plug point is ``_materialize``.)
+closures — plus the pip-venv, conda, and container isolation plugins
+(workers pooled per env hash, launched under the env's interpreter or
+inside ``podman run``).
 """
 
 from __future__ import annotations
 
 import io
+import json
 import os
 import sys
 import tarfile
@@ -38,7 +40,7 @@ def _pack_dir(path: str) -> bytes:
 
 def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
     known = {"py_modules", "env_vars", "working_dir", "pip", "pip_args",
-             "container"}
+             "container", "conda"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)} "
@@ -51,6 +53,20 @@ def validate(runtime_env: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(
             "runtime_env['pip'] must be a list of requirement strings or a "
             f"requirements-file path, got {type(pip).__name__}")
+    conda = runtime_env.get("conda")
+    if conda is not None:
+        if not (isinstance(conda, str)
+                or (isinstance(conda, dict) and "dependencies" in conda)):
+            raise ValueError(
+                "runtime_env['conda'] must be an existing env name (str) "
+                "or an environment spec dict with a 'dependencies' list "
+                f"(reference conda.py), got {type(conda).__name__}")
+        if "pip" in runtime_env:
+            # reference: conda.py raises on conda+pip; pip deps belong in
+            # the conda spec's dependencies themselves
+            raise ValueError(
+                "conda and pip runtime envs cannot be combined; put pip "
+                "packages inside the conda spec's dependencies")
     container = runtime_env.get("container")
     if container is not None:
         if not isinstance(container, dict) or "image" not in container:
@@ -95,13 +111,29 @@ def pip_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     return hashlib.sha1(repr(spec).encode()).hexdigest()[:16]
 
 
+def conda_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Cache/pool key for a conda environment (reference:
+    conda.py get_conda_env_name — content hash of the spec)."""
+    if not runtime_env or not runtime_env.get("conda"):
+        return None
+    import hashlib
+    conda = runtime_env["conda"]
+    spec = conda if isinstance(conda, str) else json.dumps(conda,
+                                                           sort_keys=True)
+    return hashlib.sha1(repr(spec).encode()).hexdigest()[:16]
+
+
 def worker_env_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     """Pool key for worker processes: tasks share an idle worker only when
-    their isolation spec (pip venv AND/OR container) is identical."""
+    their isolation spec (pip venv / conda env AND/OR container) is
+    identical."""
     parts = []
     h = pip_env_hash(runtime_env)
     if h:
         parts.append(f"pip:{h}")
+    ch = conda_env_hash(runtime_env)
+    if ch:
+        parts.append(f"conda:{ch}")
     c = (runtime_env or {}).get("container")
     if c:
         import hashlib
@@ -242,6 +274,91 @@ def materialize_pip_env(session_dir: str, runtime_env: Dict[str, Any]) -> str:
             with open(marker, "w") as f:
                 f.write("ok")
             return python
+    finally:
+        lock_file.close()  # releases the flock
+
+
+# ---------------------------------------------------------------------------
+# conda isolation (reference: _private/runtime_env/conda.py — named envs
+# activate, dict specs create content-hashed envs under the session dir)
+# ---------------------------------------------------------------------------
+
+def find_conda_exe() -> str:
+    """Resolve the conda binary: RAYTPU_CONDA_EXE (the test seam and the
+    operator override) beats PATH lookup of conda/mamba/micromamba."""
+    import shutil
+    explicit = os.environ.get("RAYTPU_CONDA_EXE")
+    candidates = [explicit] if explicit else ["conda", "mamba", "micromamba"]
+    for c in candidates:
+        path = shutil.which(c)
+        if path:
+            return path
+    raise RuntimeError(
+        "runtime_env['conda'] requires a conda binary "
+        f"({' or '.join(candidates)}) on the node, but none was found on "
+        "PATH (set RAYTPU_CONDA_EXE to point at one)")
+
+
+def materialize_conda_env(session_dir: str,
+                          runtime_env: Dict[str, Any]) -> str:
+    """Return the python interpreter of the env's conda environment.
+
+    * name form (``conda="myenv"``): resolve the EXISTING env's python via
+      ``conda run -n myenv python -c 'print(sys.executable)'`` — no
+      mutation, matching the reference's activate-by-name path.
+    * spec form (dict): ``conda env create -p {session}/conda/{hash}`` from
+      the spec written as JSON (a YAML subset conda accepts), cached by
+      content hash with a ``.ready`` marker + flock, exactly like the pip
+      venv cache above.
+    """
+    import fcntl
+    import subprocess
+
+    conda_exe = find_conda_exe()
+    conda = runtime_env["conda"]
+    if isinstance(conda, str):
+        proc = subprocess.run(
+            [conda_exe, "run", "-n", conda, "python", "-c",
+             "import sys; print(sys.executable)"],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"conda env {conda!r} is not usable via {conda_exe}: "
+                f"{proc.stderr[-2000:]}")
+        return proc.stdout.strip().splitlines()[-1]
+
+    h = conda_env_hash(runtime_env)
+    env_root = os.path.join(session_dir, "conda")
+    env_dir = os.path.join(env_root, h)
+    python = os.path.join(env_dir, "bin", "python")
+    marker = os.path.join(env_dir, ".ready")
+    os.makedirs(env_root, exist_ok=True)
+    lock_file = open(os.path.join(env_root, f".{h}.lock"), "w")
+    try:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        if os.path.exists(marker):
+            return python
+        if os.path.isdir(env_dir):
+            # a previous create died mid-install (no marker): conda
+            # refuses to create into a non-empty prefix, so self-heal by
+            # clearing it — the pip path's venv.create(clear=True)
+            # equivalent
+            import shutil
+            shutil.rmtree(env_dir, ignore_errors=True)
+        spec_path = os.path.join(env_root, f"{h}.yml")
+        with open(spec_path, "w") as f:
+            json.dump(conda, f)  # JSON is valid YAML: conda reads it
+        proc = subprocess.run(
+            [conda_exe, "env", "create", "-y", "-p", env_dir,
+             "-f", spec_path],
+            capture_output=True, text=True, timeout=1800)
+        if proc.returncode != 0 or not os.path.exists(python):
+            raise RuntimeError(
+                f"conda env create failed for runtime env {h}: "
+                f"{proc.stderr[-2000:]}")
+        with open(marker, "w") as f:
+            f.write("ok")
+        return python
     finally:
         lock_file.close()  # releases the flock
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import time
 from typing import Any, Optional
 
 _STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -166,6 +167,102 @@ class DashboardHead:
         return _json(await _off(
             lambda: ev.list_events(severity=severity, source=source)))
 
+    async def actor_detail(self, req):
+        """Per-actor drill-down (reference: dashboard/client/src/pages/
+        actor/ActorDetailPage): the actor row + its task events."""
+        from ray_tpu.util import state
+        aid = req.match_info["actor_id"]
+        actors = await _off(lambda: state.list_actors(limit=5000))
+        row = next((a for a in actors
+                    if (a.get("actor_id") or "").startswith(aid)), None)
+        if row is None:
+            return _json({"error": f"no actor {aid!r}"}, status=404)
+        full = row.get("actor_id") or aid
+        tasks = await _off(lambda: state.list_tasks(limit=10000))
+        mine = [t for t in tasks if t.get("actor_id") == full]
+        mine.sort(key=lambda e: e.get("ts", 0))
+        return _json({"actor": row, "tasks": mine[-500:]})
+
+    async def task_detail(self, req):
+        """Per-task drill-down: the task's full event history (SUBMITTED →
+        RUNNING → FINISHED/FAILED with node, error, span ids)."""
+        from ray_tpu.util import state
+        tid = req.match_info["task_id"]
+        rows = await _off(lambda: state.list_tasks(limit=10000))
+        evs = [t for t in rows if (t.get("task_id") or "").startswith(tid)]
+        if not evs:
+            return _json({"error": f"no task {tid!r}"}, status=404)
+        evs.sort(key=lambda e: e.get("ts", 0))
+        return _json({"task_id": evs[-1].get("task_id"),
+                      "name": evs[-1].get("name"),
+                      "state": evs[-1].get("state"),
+                      "events": evs})
+
+    async def metrics(self, _req):
+        """Scrape every node agent's Prometheus endpoint (advertised via
+        the node label metrics_port) and return parsed samples per node —
+        the data feed for the UI's sparkline view (reference:
+        dashboard metrics pages over grafana/prometheus)."""
+        import aiohttp
+
+        from ray_tpu.util import state
+        nodes = await _off(state.list_nodes)
+        out: dict = {}
+
+        async def scrape(sess, nid: str, host: str, port: str):
+            try:
+                async with sess.get(
+                        f"http://{host}:{port}/metrics",
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    text = await resp.text()
+            except Exception:
+                return
+            samples = {}
+            for line in text.splitlines():
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    key, val = line.rsplit(None, 1)
+                    samples[key] = float(val)
+                except ValueError:
+                    continue
+            out[nid] = samples
+
+        jobs = []
+        for n in nodes:
+            port = (n.get("labels") or {}).get("metrics_port")
+            if not n.get("alive") or not port:
+                continue
+            # scrape at the node's agent host — loopback is only right for
+            # the head's own machine
+            host = (n.get("address") or "127.0.0.1:0").rsplit(":", 1)[0]
+            jobs.append(((n.get("node_id") or "")[:12], host, port))
+        async with aiohttp.ClientSession() as sess:
+            # concurrent: one timeout of wall clock, not one per dead node
+            await asyncio.gather(
+                *[scrape(sess, nid, host, port) for nid, host, port in jobs])
+        return _json({"ts": time.time(), "nodes": out})
+
+    async def workflow_send_event(self, req):
+        """HTTP event provider (reference: workflow/http_event_provider.py):
+        external systems POST a JSON payload here to unblock every workflow
+        waiting on ``wait_for_event(key)``."""
+        from ray_tpu.workflow import events as wf_events
+        key = req.match_info["key"]
+        try:
+            payload = await req.json() if req.can_read_body else None
+        except Exception:
+            payload = (await req.read()).decode() or None
+        await _off(lambda: wf_events.send_event(key, payload))
+        return _json({"delivered": True, "key": key})
+
+    async def workflow_event_status(self, req):
+        from ray_tpu.workflow import events as wf_events
+        key = req.match_info["key"]
+        received = await _off(lambda: wf_events.event_received(key))
+        return _json({"key": key, "received": received})
+
     async def stacks(self, _req):
         """Cluster-wide thread stacks (reference: dashboard reporter's
         py-spy endpoint; here via each node agent's node_stacks)."""
@@ -262,7 +359,10 @@ class DashboardHead:
         r.add_get("/api/cluster", self.cluster)
         r.add_get("/api/nodes", self.nodes)
         r.add_get("/api/actors", self.actors)
+        r.add_get("/api/actors/{actor_id}", self.actor_detail)
         r.add_get("/api/tasks", self.tasks)
+        r.add_get("/api/tasks/{task_id:[0-9a-f]{8,}}", self.task_detail)
+        r.add_get("/api/metrics", self.metrics)
         r.add_get("/api/tasks/summarize", self.tasks_summarize)
         r.add_get("/api/objects", self.objects)
         r.add_get("/api/placement_groups", self.placement_groups)
@@ -278,6 +378,8 @@ class DashboardHead:
         r.add_get("/api/logs/{node_id}", self.node_logs)
         r.add_get("/api/logs/{node_id}/{name}", self.node_log_tail)
         r.add_get("/api/events", self.events)
+        r.add_post("/api/workflow/events/{key}", self.workflow_send_event)
+        r.add_get("/api/workflow/events/{key}", self.workflow_event_status)
         # Web UI (reference: dashboard/client React SPA; here a no-build
         # vanilla SPA served from package data over the same REST API).
         r.add_get("/", self.index)

@@ -82,7 +82,9 @@ const pages = {
     return h("div", {}, h("h2", {}, "Actors"),
       table(["actor id", "class", "state", "name", "pid", "node"],
         actors.map((a) => [
-          (a.actor_id || "").slice(0, 12), a.class_name || "", badge(a.state),
+          h("a", { class: "plain", href: `#actor/${a.actor_id || ""}` },
+            (a.actor_id || "").slice(0, 12)),
+          a.class_name || "", badge(a.state),
           a.name || "", a.pid || "", (a.node_id || "").slice(0, 12)])));
   },
 
@@ -92,8 +94,40 @@ const pages = {
     return h("div", {}, h("h2", {}, `Tasks (${tasks.length}, last 200 shown)`),
       table(["task id", "name", "state", "node"],
         recent.map((t) => [
-          (t.task_id || "").slice(0, 12), t.name || "", badge(t.state),
+          h("a", { class: "plain", href: `#task/${t.task_id || ""}` },
+            (t.task_id || "").slice(0, 12)),
+          t.name || "", badge(t.state),
           (t.node_id || "").slice(0, 12)])));
+  },
+
+  async metrics() {
+    /* Sparkline view over every node's Prometheus endpoint: the page's
+       5 s auto-refresh doubles as the scrape loop; history lives in a
+       module-global ring so navigation keeps the curves. */
+    const data = await api("metrics");
+    const hist = (window._metricsHist = window._metricsHist || {});
+    for (const [nid, samples] of Object.entries(data.nodes || {})) {
+      for (const [key, val] of Object.entries(samples)) {
+        const k = `${nid} ${key}`;
+        (hist[k] = hist[k] || []).push(val);
+        if (hist[k].length > 120) hist[k].shift();
+      }
+    }
+    const keys = Object.keys(hist).sort();
+    if (!keys.length) {
+      return h("div", {}, h("h2", {}, "Metrics"),
+        h("p", { class: "muted" }, "no node metrics endpoints found yet"));
+    }
+    return h("div", {}, h("h2", {}, `Metrics (${keys.length} series)`),
+      h("div", { class: "metric-grid" }, keys.map((k) => {
+        const vals = hist[k];
+        const last = vals[vals.length - 1];
+        return h("div", { class: "metric" },
+          h("div", { class: "metric-name mono" }, k),
+          h("div", { class: "metric-row" }, sparkline(vals),
+            h("span", { class: "metric-val" },
+              Math.round(last * 100) / 100)));
+      })));
   },
 
   async pgs() {
@@ -241,6 +275,65 @@ function renderGantt(allSlices) {
   return svg;
 }
 
+/* Tiny SVG sparkline: min-max normalized polyline over the value ring. */
+function sparkline(vals, w = 180, ht = 28) {
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("width", w);
+  svg.setAttribute("height", ht);
+  svg.setAttribute("class", "spark");
+  if (vals.length < 2) return svg;
+  let lo = Math.min(...vals), hi = Math.max(...vals);
+  if (hi === lo) { hi += 1; lo -= 1; }
+  const pts = vals.map((v, i) =>
+    `${(i / (vals.length - 1)) * w},${ht - 2 - ((v - lo) / (hi - lo)) * (ht - 4)}`);
+  const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+  line.setAttribute("points", pts.join(" "));
+  line.setAttribute("fill", "none");
+  line.setAttribute("stroke", "currentColor");
+  line.setAttribute("stroke-width", "1.5");
+  svg.append(line);
+  return svg;
+}
+
+async function actorDetail(actorId) {
+  const d = await api(`actors/${actorId}`);
+  const a = d.actor || {};
+  return h("div", {},
+    h("h2", {}, `Actor ${(a.actor_id || actorId).slice(0, 12)}`),
+    h("div", { class: "cards" },
+      card("class", a.class_name || "?"),
+      card("state", badge(a.state)),
+      card("name", a.name || "—"),
+      card("pid", a.pid || "?"),
+      card("node", (a.node_id || "").slice(0, 12)),
+      card("restarts", a.num_restarts ?? a.restarts ?? 0)),
+    a.death_cause ? h("p", { class: "err mono" }, a.death_cause) : "",
+    h("h2", {}, `Task events (${d.tasks.length})`),
+    table(["time", "task", "method", "state", "node"],
+      d.tasks.slice(-100).reverse().map((t) => [
+        new Date((t.ts || 0) * 1000).toLocaleTimeString(),
+        h("a", { class: "plain", href: `#task/${t.task_id || ""}` },
+          (t.task_id || "").slice(0, 12)),
+        t.name || "", badge(t.state), (t.node_id || "").slice(0, 12)])));
+}
+
+async function taskDetail(taskId) {
+  const d = await api(`tasks/${taskId}`);
+  const err = (d.events.find((e) => e.error) || {}).error;
+  return h("div", {},
+    h("h2", {}, `Task ${(d.task_id || taskId).slice(0, 12)}`),
+    h("p", {}, h("span", { class: "mono" }, d.name || ""), " ",
+      badge(d.state)),
+    err ? h("pre", { class: "logs" }, err) : "",
+    h("h2", {}, "Lifecycle"),
+    table(["time", "state", "node", "span"],
+      d.events.map((e) => [
+        new Date((e.ts || 0) * 1000).toLocaleTimeString() +
+          "." + String(Math.round(((e.ts || 0) % 1) * 1000)).padStart(3, "0"),
+        badge(e.state), (e.node_id || "").slice(0, 12),
+        e.span_id || ""])));
+}
+
 async function jobDetail(jobId) {
   const info = await api(`jobs/${jobId}`).catch(() => ({}));
   const logs = await api(`jobs/${jobId}/logs`).catch(() => "");
@@ -264,6 +357,8 @@ async function render() {
   let view;
   try {
     if (hash.startsWith("job/")) view = await jobDetail(hash.slice(4));
+    else if (hash.startsWith("actor/")) view = await actorDetail(hash.slice(6));
+    else if (hash.startsWith("task/")) view = await taskDetail(hash.slice(5));
     else view = await (pages[hash] || pages.overview)();
     $("#refresh-state").textContent = "updated " + new Date().toLocaleTimeString();
   } catch (e) {

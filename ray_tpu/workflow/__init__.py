@@ -11,6 +11,9 @@ survives GCS restarts via the snapshot file (test_fault_tolerance.py).
 
 from .api import (get_output, get_status, list_all, resume, run, run_async,
                   step)
+from .events import (EventListener, KVEventListener, event_received,
+                     send_event, wait_for_event)
 
 __all__ = ["step", "run", "run_async", "resume", "get_output", "get_status",
-           "list_all"]
+           "list_all", "wait_for_event", "send_event", "event_received",
+           "EventListener", "KVEventListener"]

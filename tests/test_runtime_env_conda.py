@@ -1,0 +1,128 @@
+"""conda runtime-env plugin (reference: ``python/ray/_private/runtime_env/
+conda.py``), tested offline through a FAKE conda binary — the same pattern
+as test_runtime_env_container.py's fake podman: the fake records its argv
+and produces a working "env" backed by the host interpreter, so the full
+agent -> materialize -> spawn-through-env-python path runs without a real
+conda install."""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime_env import (conda_env_hash, find_conda_exe,
+                                      materialize_conda_env, validate,
+                                      worker_env_hash)
+
+
+def _fake_conda(tmp_path, record_name="conda_argv.txt"):
+    """A conda stand-in: `env create -p P -f SPEC` makes P/bin/python as a
+    symlink to the host interpreter; `run -n NAME python -c ...` prints the
+    host interpreter.  Every invocation records its argv."""
+    record = tmp_path / record_name
+    fake = tmp_path / "fakeconda"
+    # The env's "python" is a wrapper exec-ing the host interpreter (a bare
+    # symlink would lose the host venv's pyvenv.cfg and with it
+    # site-packages); it exports its own path so tasks can prove they ran
+    # through the env interpreter.
+    fake.write_text(f"""#!/bin/sh
+echo "$@" >> {record}
+if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+    while [ "$1" != "-p" ]; do shift; done
+    mkdir -p "$2/bin"
+    cat > "$2/bin/python" <<WRAP
+#!/bin/sh
+export RAYTPU_TEST_CONDA_ENV="\\$0"
+exec {sys.executable} "\\$@"
+WRAP
+    chmod +x "$2/bin/python"
+    exit 0
+fi
+if [ "$1" = "run" ]; then
+    echo {sys.executable}
+    exit 0
+fi
+exit 1
+""")
+    fake.chmod(stat.S_IRWXU)
+    return fake, record
+
+
+def test_validate_and_hash():
+    validate({"conda": "existing-env"})
+    validate({"conda": {"dependencies": ["python=3.11", "numpy"]}})
+    with pytest.raises(ValueError, match="dependencies"):
+        validate({"conda": {"channels": ["defaults"]}})
+    with pytest.raises(ValueError, match="combined"):
+        validate({"conda": "e", "pip": ["x"]})
+
+    # name and spec hash differently; spec hash is content-stable
+    h_name = conda_env_hash({"conda": "e1"})
+    h_spec = conda_env_hash({"conda": {"dependencies": ["a"]}})
+    assert h_name and h_spec and h_name != h_spec
+    assert conda_env_hash({"conda": {"dependencies": ["a"]}}) == h_spec
+    # pooled separately from plain and pip workers
+    assert worker_env_hash({"conda": "e1"}).startswith("conda:")
+    assert worker_env_hash({"conda": "e1"}) != worker_env_hash({"conda": "e2"})
+    assert worker_env_hash(None) is None
+
+
+def test_find_conda_exe_env_override(tmp_path, monkeypatch):
+    fake, _ = _fake_conda(tmp_path)
+    monkeypatch.setenv("RAYTPU_CONDA_EXE", str(fake))
+    assert find_conda_exe() == str(fake)
+    monkeypatch.setenv("RAYTPU_CONDA_EXE", str(tmp_path / "nope"))
+    with pytest.raises(RuntimeError, match="RAYTPU_CONDA_EXE"):
+        find_conda_exe()
+
+
+def test_materialize_named_and_spec_envs(tmp_path, monkeypatch):
+    fake, record = _fake_conda(tmp_path)
+    monkeypatch.setenv("RAYTPU_CONDA_EXE", str(fake))
+
+    # named env resolves through `conda run`
+    py = materialize_conda_env(str(tmp_path), {"conda": "ml-env"})
+    assert py == sys.executable
+    assert "run -n ml-env python" in record.read_text()
+
+    # spec env creates once, caches by hash thereafter
+    spec = {"conda": {"dependencies": ["python", {"pip": ["einops"]}]}}
+    py1 = materialize_conda_env(str(tmp_path), spec)
+    assert os.path.exists(py1) and "/conda/" in py1
+    creates = record.read_text().count("env create")
+    py2 = materialize_conda_env(str(tmp_path), spec)
+    assert py2 == py1
+    assert record.read_text().count("env create") == creates  # cache hit
+    # the spec file handed to conda is the user's spec verbatim
+    h = conda_env_hash(spec)
+    on_disk = json.load(open(tmp_path / "conda" / f"{h}.yml"))
+    assert on_disk == spec["conda"]
+
+
+@pytest.mark.timeout(180)
+def test_task_runs_through_fake_conda(ray_start_regular, tmp_path,
+                                      monkeypatch):
+    """End-to-end: the worker that runs the task was spawned under the
+    conda env's interpreter (the fake env's python IS a distinct path, so
+    sys.executable inside the task proves the route)."""
+    fake, record = _fake_conda(tmp_path)
+    monkeypatch.setenv("RAYTPU_CONDA_EXE", str(fake))
+
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["python"]}})
+    def inside():
+        return os.environ.get("RAYTPU_TEST_CONDA_ENV", "")
+
+    exe = ray_tpu.get(inside.remote(), timeout=120)
+    h = conda_env_hash({"conda": {"dependencies": ["python"]}})
+    assert exe.endswith(f"/conda/{h}/bin/python"), exe
+    assert "env create" in record.read_text()
+
+    # plain tasks don't share the conda worker pool
+    @ray_tpu.remote
+    def outside():
+        return os.environ.get("RAYTPU_TEST_CONDA_ENV", "")
+
+    assert ray_tpu.get(outside.remote(), timeout=60) == ""
