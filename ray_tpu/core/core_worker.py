@@ -543,6 +543,10 @@ class LeasePool:
                 death_cause = res.get("death_cause")
         except Exception:
             pass
+        # backstop: the killing agent may have pushed the cause directly
+        # (handle_worker_killed) if the lease return raced the kill
+        death_cause = death_cause or self.w._kill_causes.pop(
+            lw.worker_id, None)
         retries: List[TaskSpec] = []
         oom_limit = get_config().task_oom_retries
         for spec in specs:
@@ -675,6 +679,8 @@ class CoreWorker:
         # Streaming-generator state: owner side (task_id -> StreamState for
         # tasks WE submitted) and executor side (task_id -> _GenEmitter for
         # streaming tasks we are currently RUNNING).
+        #: worker_id -> typed death cause pushed by the killing agent
+        self._kill_causes: Dict[str, str] = {}
         self.streams: Dict[TaskID, "StreamState"] = {}
         self._gen_emitters: Dict[TaskID, "_GenEmitter"] = {}
         self._view_cache: Tuple[float, Dict[str, NodeView]] = (0.0, {})
@@ -1242,6 +1248,23 @@ class CoreWorker:
         except Exception:
             return
         asyncio.run_coroutine_threadsafe(self._free_owned(oid), loop)
+
+    async def handle_worker_killed(self, worker_id: str, address: str,
+                                   cause: str):
+        """Agent notification: a worker running OUR lease was deliberately
+        killed (memory monitor).  Stash the typed cause and force-close our
+        client to the dead worker so an in-flight push fails with
+        ConnectionLost NOW — prompt typed-OOM delivery that does not
+        depend on EOF timing (the lease-return death_cause remains the
+        primary source; this is the backstop)."""
+        self._kill_causes[worker_id] = cause
+        while len(self._kill_causes) > 256:
+            self._kill_causes.pop(next(iter(self._kill_causes)))
+        try:
+            await self.worker_clients.close(address)
+        except Exception:
+            pass
+        return True
 
     async def handle_add_object_location(self, object_id: ObjectID,
                                          node_id: str, address: str):

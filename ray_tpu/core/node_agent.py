@@ -888,6 +888,23 @@ class NodeAgent:
         finally:
             self._inflight_pulls.pop(object_id, None)
 
+    def _trace_transfer(self, **ev):
+        """Opt-in per-transfer timeline (RAYTPU_TRANSFER_TRACE_DIR): one
+        JSONL per agent recording every chunk pull / zero-copy attach with
+        wall-clock start/end — the artifact that shows where broadcast
+        overlap lives (or dies) on a given box."""
+        d = os.environ.get("RAYTPU_TRANSFER_TRACE_DIR")
+        if not d:
+            return
+        try:
+            import json as _json
+            with open(os.path.join(d, f"transfer-{os.getpid()}.jsonl"),
+                      "a") as f:
+                f.write(_json.dumps(
+                    {"node": self.node_id.hex()[:12], **ev}) + "\n")
+        except Exception:
+            pass
+
     async def _pull_object(self, object_id: ObjectID, size: int,
                            locations: List[Tuple[str, str]],
                            owner: Optional[str]):
@@ -915,9 +932,14 @@ class NodeAgent:
                         or info.get("host_key") != self.host_key):
                     continue
                 try:
+                    t_pin = time.time()
                     if await client.call("pin_object", object_id=object_id):
                         self.store.add_proxy(object_id, info["path"],
                                              info["size"], addr)
+                        self._trace_transfer(
+                            kind="proxy_attach", object=object_id.hex()[:12],
+                            source=addr, bytes=info["size"],
+                            t0=t_pin, t1=time.time())
                         if owner:
                             # A proxy holder IS a source for byte pullers
                             # (read_chunk serves through get_path); same-host
@@ -950,10 +972,16 @@ class NodeAgent:
                     async def pull(off: int):
                         async with window:
                             n = min(chunk_n, size - off)
+                            t_c = time.time()
                             chunk = await client.call(
                                 "read_chunk", object_id=object_id,
                                 offset=off, length=n)
                             seg.view()[off:off + len(chunk)] = chunk
+                            self._trace_transfer(
+                                kind="chunk",
+                                object=object_id.hex()[:12],
+                                source=addr, offset=off, bytes=n,
+                                t0=t_c, t1=time.time())
 
                     pulls = [asyncio.ensure_future(pull(o)) for o in offsets]
                     try:
@@ -1059,6 +1087,18 @@ class NodeAgent:
                     while len(self._oom_kills) > 256:
                         self._oom_kills.pop(next(iter(self._oom_kills)))
                 await self._kill_worker_proc(victim)
+                if victim.owner and not victim.is_actor:
+                    # Proactive typed-death delivery: don't rely on the
+                    # owner's in-flight RPC seeing EOF — tell the lease
+                    # owner directly so it force-fails the connection and
+                    # surfaces OutOfMemoryError promptly (the EOF path
+                    # remains as backstop).
+                    try:
+                        await self.worker_clients.get(victim.owner).notify(
+                            "worker_killed", worker_id=victim.worker_id,
+                            address=victim.address, cause=cause)
+                    except Exception:
+                        pass
                 try:
                     print(f"[memory-monitor] node memory {usage:.0%} >= "
                           f"{cfg.memory_usage_threshold:.0%}: killed worker "

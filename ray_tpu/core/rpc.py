@@ -381,6 +381,14 @@ class ClientPool:
             self._clients[address] = c
         return c
 
+    async def close(self, address: str):
+        """Drop one connection; its pending futures fail with
+        ConnectionLost (used to force-surface a peer the caller KNOWS is
+        dead without waiting on EOF delivery)."""
+        c = self._clients.pop(address, None)
+        if c is not None:
+            await c.close()
+
     async def close_all(self):
         for c in self._clients.values():
             await c.close()

@@ -121,6 +121,18 @@ def read_images(paths, *, size=None, mode=None, parallelism: int = -1) -> Datase
                            parallelism=parallelism)
 
 
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, client_factory=None,
+               parallelism: int = -1) -> Dataset:
+    """Reference: ``ray.data.read_mongo`` — see MongoDatasource for the
+    pymongo/client_factory contract on this no-pymongo image."""
+    from .datasource import MongoDatasource
+    return read_datasource(
+        MongoDatasource(uri, database, collection, pipeline=pipeline,
+                        client_factory=client_factory),
+        parallelism=parallelism)
+
+
 __all__ = [
     "Dataset", "GroupedData", "DataContext", "DataIterator", "Datasource",
     "ReadTask", "Block", "BlockAccessor", "BlockMetadata",
@@ -128,7 +140,7 @@ __all__ = [
     "read_datasource", "range", "range_tensor", "from_items", "from_pandas",
     "from_arrow", "from_numpy", "from_huggingface", "read_parquet", "read_csv",
     "read_json", "read_numpy", "read_binary_files", "read_text",
-    "read_tfrecords", "read_sql", "read_images", "read_orc",
+    "read_tfrecords", "read_sql", "read_images", "read_orc", "read_mongo",
     "read_webdataset", "TFRecordDatasource", "SQLDatasource",
     "ImageDatasource",
 ]

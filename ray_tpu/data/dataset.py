@@ -352,6 +352,20 @@ class Dataset:
             conn.close()
         return n
 
+    def write_mongo(self, uri: str, database: str, collection: str, *,
+                    client_factory=None) -> int:
+        """Insert every row as a document; returns documents written
+        (reference: ``Dataset.write_mongo``; client_factory injects the
+        pymongo client on this no-pymongo image)."""
+        from .datasource import write_mongo_block
+        n = 0
+        for bundle in self._stream():
+            for ref, _ in bundle.blocks:
+                acc = BlockAccessor.for_block(ray_get(ref))
+                n += write_mongo_block(acc, uri, database, collection,
+                                       client_factory=client_factory)
+        return n
+
     def __repr__(self):
         names = [op.name() for op in self._logical.chain()]
         return f"Dataset({' -> '.join(names)})"

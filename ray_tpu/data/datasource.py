@@ -613,3 +613,108 @@ def write_block(block: Block, path: str, file_format: str, index: int,
     else:
         raise ValueError(f"unknown write format {file_format}")
     return fname
+
+
+class MongoDatasource(Datasource):
+    """Documents from a MongoDB collection, partitioned by skip/limit.
+
+    Reference: ``python/ray/data/_internal/datasource/mongo_datasource.py``
+    (read_mongo/write_mongo over pymongo).  pymongo is not baked into this
+    image, so the client comes from an injectable ``client_factory``
+    (production: ``lambda: pymongo.MongoClient(uri)``; tests: a fake) and
+    the default factory raises a clear ImportError only when actually used.
+    An optional aggregation ``pipeline`` runs server-side before the
+    partition window, matching the reference's pipeline argument.
+    """
+
+    def __init__(self, uri: str, database: str, collection: str,
+                 pipeline: Optional[List[dict]] = None,
+                 client_factory: Optional[Callable[[], Any]] = None):
+        self._uri = uri
+        self._db = database
+        self._coll = collection
+        self._pipeline = list(pipeline or [])
+        self._factory = client_factory or _default_mongo_client(uri)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        factory, db, coll = self._factory, self._db, self._coll
+        pipeline = self._pipeline
+
+        def make(stages):
+            def read():
+                c = factory()
+                try:
+                    cur = c[db][coll].aggregate(stages)
+                    docs = [{k: v for k, v in d.items() if k != "_id"}
+                            for d in cur]
+                finally:
+                    _close_quietly(c)
+                if not docs:
+                    return []
+                cols = sorted({k for d in docs for k in d})
+                return [pa.table({k: pa.array([d.get(k) for d in docs])
+                                  for k in cols})]
+            return read
+
+        meta = BlockMetadata(num_rows=None, size_bytes=None)
+        if pipeline:
+            # An aggregation pipeline can change cardinality ($unwind,
+            # $group), so collection-count skip/limit windows would drop or
+            # duplicate output rows — run it as ONE partition (the
+            # reference partitions on _id ranges BEFORE the pipeline; that
+            # needs server-side _id introspection pymongo-side).
+            return [ReadTask(make(list(pipeline)), meta)]
+        client = factory()
+        try:
+            total = client[db][coll].count_documents({})
+        finally:
+            _close_quietly(client)
+        if total == 0:
+            # empty collection: one windowless scan (MongoDB rejects
+            # {"$limit": 0})
+            return [ReadTask(make([]), meta)]
+        parallelism = max(1, min(parallelism if parallelism > 0 else 8,
+                                 total))
+        per = -(-total // parallelism)  # ceil
+        # $sort on _id pins a stable document order so the independent
+        # per-partition cursors neither overlap nor leave gaps
+        return [ReadTask(make([{"$sort": {"_id": 1}},
+                               {"$skip": i * per}, {"$limit": per}]), meta)
+                for i in range(parallelism)]
+
+
+def _default_mongo_client(uri: str) -> Callable[[], Any]:
+    def factory():
+        try:
+            import pymongo
+        except ImportError as e:
+            raise ImportError(
+                "read_mongo requires pymongo (not in this image); pass "
+                "client_factory=... to supply a client") from e
+        return pymongo.MongoClient(uri)
+    return factory
+
+
+def _close_quietly(client: Any) -> None:
+    try:
+        client.close()
+    except Exception:
+        pass
+
+
+def write_mongo_block(block_acc, uri: str, database: str, collection: str,
+                      client_factory: Optional[Callable[[], Any]] = None
+                      ) -> int:
+    """Write one block's rows as documents; returns the insert count
+    (reference: MongoDatasink.write)."""
+    factory = client_factory or _default_mongo_client(uri)
+    docs = [dict(r) if isinstance(r, dict) else {"value": r}
+            for r in block_acc.iter_rows()]
+    if not docs:
+        return 0
+    client = factory()
+    try:
+        client[database][collection].insert_many(docs)
+    finally:
+        _close_quietly(client)
+    return len(docs)

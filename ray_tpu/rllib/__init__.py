@@ -25,8 +25,10 @@ from .offline import (BCConfig, MARWIL, MARWILConfig, OfflineDataset,
 from .ppo import PPO, PPOConfig
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .sac import SAC, SACConfig
+from .td3 import TD3, TD3Config
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+           "TD3", "TD3Config",
            "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
            "BCConfig", "MARWIL", "MARWILConfig", "OfflineDataset",
            "collect_episodes", "write_episodes",

@@ -1,0 +1,360 @@
+"""TD3: twin-delayed deterministic policy gradient for continuous control.
+
+Reference: ``rllib/algorithms/td3/`` (TD3Config/TD3, itself DDPG +
+the three TD3 fixes).  The components: twin critics with clipped double-Q
+targets, TARGET POLICY SMOOTHING (clipped Gaussian noise on the target
+action), and DELAYED policy/target updates (actor steps every
+``policy_delay`` critic steps).  TPU-first shape, same as sac.py: each
+update is a jitted program (two compiled variants — with and without the
+actor step — selected by the delay counter); rollouts ride remote runner
+actors with replay on the driver.  Shares ``QNetworkSA`` and the replay
+buffer with SAC.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .sac import QNetworkSA
+
+
+class DeterministicPolicy:
+    """MLP -> tanh action in [-1, 1]^A (DDPG/TD3 actor)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(256, 256)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        sizes = (self.obs_dim,) + self.hidden + (self.action_dim,)
+        params = {}
+        keys = jax.random.split(key, len(sizes))
+        for i in range(len(sizes) - 1):
+            scale = (2.0 / sizes[i]) ** 0.5 if i < len(sizes) - 2 else 0.01
+            params[f"w{i}"] = jax.random.normal(
+                keys[i], (sizes[i], sizes[i + 1])) * scale
+            params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+        return params
+
+    def apply(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        n = len(self.hidden)
+        for i in range(n):
+            x = jnp.maximum(x @ params[f"w{i}"] + params[f"b{i}"], 0.0)
+        return jnp.tanh(x @ params[f"w{n}"] + params[f"b{n}"])
+
+
+class TD3Runner:
+    """Rollout actor: deterministic policy + exploration noise."""
+
+    def __init__(self, env_name: str, spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0,
+                 env_config: Optional[dict] = None,
+                 explore_noise: float = 0.1):
+        import gymnasium as gym
+
+        self._envs = [gym.make(env_name, **(env_config or {}))
+                      for _ in range(num_envs)]
+        self._policy = DeterministicPolicy(
+            spec["obs_dim"], spec["action_dim"], spec["hidden"])
+        self._obs = [e.reset(seed=seed + i)[0] for i, e in
+                     enumerate(self._envs)]
+        self._rng = np.random.default_rng(seed)
+        self._noise = explore_noise
+        low = self._envs[0].action_space.low
+        high = self._envs[0].action_space.high
+        self._mid, self._half = (high + low) / 2.0, (high - low) / 2.0
+        self._returns: List[float] = []
+        self._ep_ret = [0.0] * num_envs
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return self._mid + self._half * a
+
+    def sample(self, params_blob, steps: int, random_actions: bool = False
+               ) -> Dict[str, np.ndarray]:
+        import jax
+
+        params = params_blob
+        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        for _ in range(steps):
+            for i, env in enumerate(self._envs):
+                o = np.asarray(self._obs[i], np.float32).reshape(-1)
+                if random_actions:
+                    a = self._rng.uniform(-1.0, 1.0,
+                                          self._policy.action_dim)
+                else:
+                    a = np.asarray(jax.device_get(
+                        self._policy.apply(params, o[None]))[0])
+                    a = np.clip(
+                        a + self._rng.normal(0.0, self._noise, a.shape),
+                        -1.0, 1.0)
+                nxt, r, term, trunc, _ = env.step(
+                    self._scale(a.astype(np.float32)))
+                self._ep_ret[i] += float(r)
+                obs_l.append(o)
+                act_l.append(a.astype(np.float32))
+                rew_l.append(float(r))
+                done_l.append(float(term))
+                next_l.append(np.asarray(nxt, np.float32).reshape(-1))
+                if term or trunc:
+                    self._returns.append(self._ep_ret[i])
+                    self._ep_ret[i] = 0.0
+                    nxt = env.reset()[0]
+                self._obs[i] = nxt
+        return {"obs": np.stack(obs_l), "actions": np.stack(act_l),
+                "rewards": np.asarray(rew_l, np.float32),
+                "dones": np.asarray(done_l, np.float32),
+                "next_obs": np.stack(next_l)}
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._returns)
+        if clear:
+            self._returns.clear()
+        return out
+
+
+class TD3Config:
+    """Builder, same surface shape as SACConfig."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: dict = {}
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 1
+        self.rollout_steps = 256
+        self.model: Dict[str, Any] = {"hidden": (256, 256)}
+        self.train: Dict[str, Any] = {
+            "actor_lr": 3e-4, "critic_lr": 3e-4, "gamma": 0.99,
+            "tau": 0.005, "policy_noise": 0.2, "noise_clip": 0.5,
+            "policy_delay": 2, "explore_noise": 0.1,
+            "batch_size": 256, "train_iters": 32,
+        }
+        self.replay: Dict[str, Any] = {
+            "capacity": 100_000, "learn_starts": 1000,
+            "random_warmup": True,
+        }
+        self.seed = 0
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners: int = 1,
+                    num_envs_per_env_runner: int = 1,
+                    rollout_steps: int = 256):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_steps = rollout_steps
+        return self
+
+    def training(self, **kwargs):
+        if "model" in kwargs:
+            self.model.update(kwargs.pop("model"))
+        if "replay" in kwargs:
+            self.replay.update(kwargs.pop("replay"))
+        self.train.update(kwargs)
+        return self
+
+    def debugging(self, seed: int = 0):
+        self.seed = seed
+        return self
+
+    def build(self) -> "TD3":
+        if not self.env_name:
+            raise ValueError("call .environment(env_name) first")
+        return TD3(self)
+
+
+class TD3:
+    """Driver: noisy rollouts -> replay -> delayed twin-critic updates."""
+
+    def __init__(self, config: TD3Config):
+        import gymnasium as gym
+        import jax
+        import optax
+
+        import ray_tpu
+
+        from .replay_buffer import ReplayBuffer
+
+        self.config = config
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = int(np.prod(probe.action_space.shape))
+        probe.close()
+        hidden = tuple(config.model["hidden"])
+        self.spec = dict(obs_dim=obs_dim, action_dim=action_dim,
+                         hidden=hidden)
+        self.policy = DeterministicPolicy(**self.spec)
+        self.q1 = QNetworkSA(obs_dim, action_dim, hidden)
+        self.q2 = QNetworkSA(obs_dim, action_dim, hidden)
+        k = jax.random.split(jax.random.PRNGKey(config.seed), 3)
+        self.state = {
+            "pi": self.policy.init(k[0]),
+            "q1": self.q1.init(k[1]),
+            "q2": self.q2.init(k[2]),
+        }
+        for name in ("pi", "q1", "q2"):
+            self.state[f"{name}_t"] = jax.tree_util.tree_map(
+                lambda x: x, self.state[name])
+        t = config.train
+        self.opt = {"pi": optax.adam(t["actor_lr"]),
+                    "q": optax.adam(t["critic_lr"])}
+        self.opt_state = {
+            "pi": self.opt["pi"].init(self.state["pi"]),
+            "q": self.opt["q"].init((self.state["q1"], self.state["q2"])),
+        }
+        self._update = self._build_update()
+        self.buffer = ReplayBuffer(config.replay["capacity"],
+                                   seed=config.seed)
+        runner_cls = ray_tpu.remote(TD3Runner)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, self.spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 1000 * i,
+                env_config=config.env_config,
+                explore_noise=t["explore_noise"])
+            for i in range(config.num_env_runners)]
+        self._iteration = 0
+        self._env_steps = 0
+        self._updates = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config.train
+        gamma, tau = cfg["gamma"], cfg["tau"]
+        pnoise, nclip = cfg["policy_noise"], cfg["noise_clip"]
+        policy, q1, q2 = self.policy, self.q1, self.q2
+        opt = self.opt
+
+        def update(state, opt_state, batch, key, do_actor: bool):
+            # --- clipped double-Q target with target policy smoothing
+            noise = jnp.clip(
+                pnoise * jax.random.normal(key, batch["actions"].shape),
+                -nclip, nclip)
+            next_a = jnp.clip(
+                policy.apply(state["pi_t"], batch["next_obs"]) + noise,
+                -1.0, 1.0)
+            q_next = jnp.minimum(
+                q1.apply(state["q1_t"], batch["next_obs"], next_a),
+                q2.apply(state["q2_t"], batch["next_obs"], next_a))
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1 - batch["dones"]) * q_next)
+
+            def critic_loss(qs):
+                p1, p2 = qs
+                e1 = q1.apply(p1, batch["obs"], batch["actions"]) - target
+                e2 = q2.apply(p2, batch["obs"], batch["actions"]) - target
+                return (e1 ** 2).mean() + (e2 ** 2).mean()
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                (state["q1"], state["q2"]))
+            cup, q_opt = opt["q"].update(cgrads, opt_state["q"],
+                                         (state["q1"], state["q2"]))
+            new_q1, new_q2 = jax.tree_util.tree_map(
+                lambda p, u: p + u, (state["q1"], state["q2"]), cup)
+            new_state = dict(state, q1=new_q1, q2=new_q2)
+            new_opt = dict(opt_state, q=q_opt)
+            aloss = jnp.float32(0.0)
+
+            if do_actor:  # python bool -> two compiled variants
+                def actor_loss(pi_params):
+                    a = policy.apply(pi_params, batch["obs"])
+                    return -q1.apply(new_q1, batch["obs"], a).mean()
+
+                aloss, agrads = jax.value_and_grad(actor_loss)(state["pi"])
+                aup, pi_opt = opt["pi"].update(agrads, opt_state["pi"],
+                                               state["pi"])
+                new_pi = jax.tree_util.tree_map(lambda p, u: p + u,
+                                                state["pi"], aup)
+                soft = lambda t_, p: (1 - tau) * t_ + tau * p
+                new_state.update(
+                    pi=new_pi,
+                    pi_t=jax.tree_util.tree_map(soft, state["pi_t"], new_pi),
+                    q1_t=jax.tree_util.tree_map(soft, state["q1_t"], new_q1),
+                    q2_t=jax.tree_util.tree_map(soft, state["q2_t"], new_q2))
+                new_opt["pi"] = pi_opt
+            return new_state, new_opt, closs, aloss
+
+        return jax.jit(update, static_argnames=("do_actor",))
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        t0 = time.time()
+        cfg = self.config
+        warm = (cfg.replay.get("random_warmup", True)
+                and self._env_steps < cfg.replay["learn_starts"])
+        weights_ref = ray_tpu.put(jax.tree_util.tree_map(
+            np.asarray, self.state["pi"]))
+        per_runner = max(1, cfg.rollout_steps // cfg.num_env_runners)
+        batches = ray_tpu.get(
+            [r.sample.remote(weights_ref, per_runner, warm)
+             for r in self.runners], timeout=600)
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += len(b["rewards"])
+
+        closs = aloss = float("nan")
+        delay = cfg.train["policy_delay"]
+        if len(self.buffer) >= cfg.replay["learn_starts"]:
+            for j in range(cfg.train["train_iters"]):
+                s = self.buffer.sample(cfg.train["batch_size"])
+                batch = {k: jnp.asarray(v) for k, v in s.items()
+                         if not k.startswith("_")}
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(cfg.seed),
+                    self._iteration * 131 + j)
+                self._updates += 1
+                state, opt_state, closs, aloss = self._update(
+                    self.state, self.opt_state, batch, key,
+                    do_actor=(self._updates % delay == 0))
+                self.state, self.opt_state = state, opt_state
+            closs, aloss = float(closs), float(aloss)
+
+        rets = [x for chunk in ray_tpu.get(
+            [r.episode_returns.remote() for r in self.runners], timeout=60)
+            for x in chunk]
+        self._recent_returns.extend(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "episodes_this_iter": len(rets),
+            "num_env_steps_sampled": self._env_steps,
+            "critic_loss": closs, "actor_loss": aloss,
+            "replay_size": len(self.buffer),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def get_weights(self):
+        import jax
+        return jax.tree_util.tree_map(np.asarray, self.state["pi"])

@@ -1,0 +1,130 @@
+"""Mongo datasource parity (reference: ``ray.data.read_mongo`` /
+``Dataset.write_mongo`` over pymongo).  pymongo is not in this image, so
+the tests inject a file-backed fake client through the plugin's
+``client_factory`` seam — the same offline pattern as the fake conda /
+fake podman runtime-env tests.  The fake persists to disk because read
+tasks and write blocks execute in WORKER processes."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+class _FakeCollection:
+    def __init__(self, path):
+        self._path = path
+
+    def _docs(self):
+        if not os.path.exists(self._path):
+            return []
+        with open(self._path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def count_documents(self, _filter):
+        return len(self._docs())
+
+    def aggregate(self, pipeline):
+        docs = [dict(d, _id=i) for i, d in enumerate(self._docs())]
+        for stage in pipeline:
+            if "$match" in stage:
+                docs = [d for d in docs
+                        if all(d.get(k) == v
+                               for k, v in stage["$match"].items())]
+            elif "$sort" in stage:
+                for k, direction in reversed(list(stage["$sort"].items())):
+                    docs.sort(key=lambda d: d.get(k),
+                              reverse=direction < 0)
+            elif "$skip" in stage:
+                docs = docs[stage["$skip"]:]
+            elif "$limit" in stage:
+                if stage["$limit"] <= 0:  # real MongoDB rejects limit<=0
+                    raise ValueError("the limit must be a positive number")
+                docs = docs[:stage["$limit"]]
+            else:
+                raise ValueError(f"fake mongo: unsupported stage {stage}")
+        return iter(docs)
+
+    def insert_many(self, docs):
+        import fcntl
+        with open(self._path, "a") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            for d in docs:
+                f.write(json.dumps(d) + "\n")
+
+
+class _FakeMongoClient:
+    def __init__(self, root):
+        self._root = root
+
+    def __getitem__(self, db):
+        root = self._root
+
+        class _DB:
+            def __getitem__(self, coll):
+                return _FakeCollection(os.path.join(root, f"{db}.{coll}.jsonl"))
+        return _DB()
+
+    def close(self):
+        pass
+
+
+def _factory(root):
+    return lambda: _FakeMongoClient(root)
+
+
+def _seed(root, db, coll, docs):
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, f"{db}.{coll}.jsonl"), "w") as f:
+        for d in docs:
+            f.write(json.dumps(d) + "\n")
+
+
+@pytest.mark.timeout(180)
+def test_read_mongo_partitions_and_pipeline(ray_start_regular, tmp_path):
+    root = str(tmp_path)
+    _seed(root, "shop", "orders",
+          [{"sku": f"s{i}", "qty": i % 4} for i in range(20)])
+
+    ds = data.read_mongo("mongodb://fake", "shop", "orders",
+                         client_factory=_factory(root), parallelism=4)
+    rows = ds.take_all()
+    assert len(rows) == 20
+    assert {r["sku"] for r in rows} == {f"s{i}" for i in range(20)}
+    assert all("_id" not in r for r in rows)  # _id dropped like reference
+
+    # an aggregation pipeline reads as ONE partition (cardinality-safe)
+    ds = data.read_mongo("mongodb://fake", "shop", "orders",
+                         pipeline=[{"$match": {"qty": 2}}],
+                         client_factory=_factory(root), parallelism=2)
+    rows = ds.take_all()
+    assert len(rows) == 5
+    assert all(r["qty"] == 2 for r in rows)
+
+    # empty collection -> empty dataset, no {"$limit": 0} sent
+    _seed(root, "shop", "nothing", [])
+    empty = data.read_mongo("mongodb://fake", "shop", "nothing",
+                            client_factory=_factory(root), parallelism=4)
+    assert empty.take_all() == []
+
+
+@pytest.mark.timeout(180)
+def test_write_mongo_roundtrip(ray_start_regular, tmp_path):
+    root = str(tmp_path)
+    ds = data.from_items([{"k": i, "v": i * i} for i in range(12)])
+    n = ds.write_mongo("mongodb://fake", "shop", "out",
+                       client_factory=_factory(root))
+    assert n == 12
+    back = data.read_mongo("mongodb://fake", "shop", "out",
+                           client_factory=_factory(root), parallelism=3)
+    rows = sorted(back.take_all(), key=lambda r: r["k"])
+    assert [r["v"] for r in rows] == [i * i for i in range(12)]
+
+
+def test_read_mongo_without_pymongo_errors_clearly(ray_start_regular):
+    ds = data.read_mongo("mongodb://real", "db", "coll")
+    with pytest.raises(Exception, match="pymongo"):
+        ds.take_all()
