@@ -921,7 +921,13 @@ class NodeAgent:
             # Same-host fast path: attach the source's pool slice instead of
             # copying bytes through a socket — the source pins the object for
             # us until we free our proxy (zero-copy same-host broadcast).
-            for node_id, addr in candidates:
+            # RAYTPU_DISABLE_ZERO_COPY=1 forces the chunked byte path — the
+            # bench/test seam for exercising what distinct hosts do.
+            if os.environ.get("RAYTPU_DISABLE_ZERO_COPY") == "1":
+                candidates_zc = []
+            else:
+                candidates_zc = candidates
+            for node_id, addr in candidates_zc:
                 client = self.agent_clients.get(addr)
                 try:
                     info = await client.call("object_info",

@@ -356,14 +356,24 @@ class Dataset:
                     client_factory=None) -> int:
         """Insert every row as a document; returns documents written
         (reference: ``Dataset.write_mongo``; client_factory injects the
-        pymongo client on this no-pymongo image)."""
-        from .datasource import write_mongo_block
+        pymongo client on this no-pymongo image).  One client serves the
+        whole write, like write_sql's single connection."""
+        from .datasource import _close_quietly, _default_mongo_client
+        factory = client_factory or _default_mongo_client(uri)
         n = 0
-        for bundle in self._stream():
-            for ref, _ in bundle.blocks:
-                acc = BlockAccessor.for_block(ray_get(ref))
-                n += write_mongo_block(acc, uri, database, collection,
-                                       client_factory=client_factory)
+        client = factory()
+        try:
+            coll = client[database][collection]
+            for bundle in self._stream():
+                for ref, _ in bundle.blocks:
+                    acc = BlockAccessor.for_block(ray_get(ref))
+                    docs = [dict(r) if isinstance(r, dict) else {"value": r}
+                            for r in acc.iter_rows()]
+                    if docs:
+                        coll.insert_many(docs)
+                        n += len(docs)
+        finally:
+            _close_quietly(client)
         return n
 
     def __repr__(self):

@@ -702,19 +702,3 @@ def _close_quietly(client: Any) -> None:
         pass
 
 
-def write_mongo_block(block_acc, uri: str, database: str, collection: str,
-                      client_factory: Optional[Callable[[], Any]] = None
-                      ) -> int:
-    """Write one block's rows as documents; returns the insert count
-    (reference: MongoDatasink.write)."""
-    factory = client_factory or _default_mongo_client(uri)
-    docs = [dict(r) if isinstance(r, dict) else {"value": r}
-            for r in block_acc.iter_rows()]
-    if not docs:
-        return 0
-    client = factory()
-    try:
-        client[database][collection].insert_many(docs)
-    finally:
-        _close_quietly(client)
-    return len(docs)

@@ -54,62 +54,69 @@ class DeterministicPolicy:
 
 
 class TD3Runner:
-    """Rollout actor: deterministic policy + exploration noise."""
+    """Rollout actor: deterministic policy + exploration noise.  Same
+    surface/semantics as SACRunner: ``steps`` is the TOTAL transition
+    budget (T = steps // num_envs), all envs batch through ONE jitted
+    forward per step."""
 
     def __init__(self, env_name: str, spec: Dict[str, Any],
                  num_envs: int = 1, seed: int = 0,
                  env_config: Optional[dict] = None,
                  explore_noise: float = 0.1):
         import gymnasium as gym
+        import jax
 
         self._envs = [gym.make(env_name, **(env_config or {}))
                       for _ in range(num_envs)]
         self._policy = DeterministicPolicy(
             spec["obs_dim"], spec["action_dim"], spec["hidden"])
-        self._obs = [e.reset(seed=seed + i)[0] for i, e in
-                     enumerate(self._envs)]
+        self._apply = jax.jit(self._policy.apply)
+        self.num_envs = num_envs
+        self._obs = np.stack([e.reset(seed=seed + i)[0] for i, e in
+                              enumerate(self._envs)], dtype=np.float32)
         self._rng = np.random.default_rng(seed)
         self._noise = explore_noise
         low = self._envs[0].action_space.low
         high = self._envs[0].action_space.high
         self._mid, self._half = (high + low) / 2.0, (high - low) / 2.0
         self._returns: List[float] = []
-        self._ep_ret = [0.0] * num_envs
+        self._ep_ret = np.zeros(num_envs)
 
     def _scale(self, a: np.ndarray) -> np.ndarray:
         return self._mid + self._half * a
 
     def sample(self, params_blob, steps: int, random_actions: bool = False
                ) -> Dict[str, np.ndarray]:
-        import jax
+        import jax.numpy as jnp
 
         params = params_blob
+        N = self.num_envs
+        T = max(1, steps // N)
+        A = self._policy.action_dim
         obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
-        for _ in range(steps):
+        for _ in range(T):
+            if random_actions:
+                acts = self._rng.uniform(-1.0, 1.0, (N, A)).astype(
+                    np.float32)
+            else:
+                acts = np.asarray(self._apply(params,
+                                              jnp.asarray(self._obs)))
+                acts = np.clip(
+                    acts + self._rng.normal(0.0, self._noise, acts.shape),
+                    -1.0, 1.0).astype(np.float32)
             for i, env in enumerate(self._envs):
-                o = np.asarray(self._obs[i], np.float32).reshape(-1)
-                if random_actions:
-                    a = self._rng.uniform(-1.0, 1.0,
-                                          self._policy.action_dim)
-                else:
-                    a = np.asarray(jax.device_get(
-                        self._policy.apply(params, o[None]))[0])
-                    a = np.clip(
-                        a + self._rng.normal(0.0, self._noise, a.shape),
-                        -1.0, 1.0)
-                nxt, r, term, trunc, _ = env.step(
-                    self._scale(a.astype(np.float32)))
+                nxt, r, term, trunc, _ = env.step(self._scale(acts[i]))
                 self._ep_ret[i] += float(r)
-                obs_l.append(o)
-                act_l.append(a.astype(np.float32))
+                obs_l.append(self._obs[i].copy())
+                act_l.append(acts[i])
                 rew_l.append(float(r))
                 done_l.append(float(term))
                 next_l.append(np.asarray(nxt, np.float32).reshape(-1))
                 if term or trunc:
-                    self._returns.append(self._ep_ret[i])
+                    self._returns.append(float(self._ep_ret[i]))
                     self._ep_ret[i] = 0.0
                     nxt = env.reset()[0]
-                self._obs[i] = nxt
+                self._obs[i] = np.asarray(nxt, np.float32).reshape(-1)
         return {"obs": np.stack(obs_l), "actions": np.stack(act_l),
                 "rewards": np.asarray(rew_l, np.float32),
                 "dones": np.asarray(done_l, np.float32),
