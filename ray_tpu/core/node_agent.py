@@ -345,6 +345,10 @@ class NodeAgent:
         prev_state = w.state
         w.state = "DEAD"
         self.workers.pop(w.worker_id, None)
+        # Wake any _grant_lease waiter parked on registration (a worker that
+        # crashes during boot must fail the grant now, not after the full
+        # register timeout) — same handshake as _kill_worker_proc.
+        w.registered.set()
         if prev_state == "LEASED" and w.lease_id and not w.is_actor:
             if w.blocked:  # resources were already released at block time
                 self._lease_resources.pop(w.lease_id, None)
@@ -393,6 +397,9 @@ class NodeAgent:
                 w.proc.kill()
             except ProcessLookupError:
                 pass
+        # Wake any _grant_lease waiter parked on registration: the grant
+        # must fail NOW (state is DEAD), not after the register timeout.
+        w.registered.set()
         if not was_dead and not self._shutting_down:
             await self._process_lease_queue()
 
@@ -497,6 +504,11 @@ class NodeAgent:
         except asyncio.TimeoutError:
             await self._kill_worker_proc(w)  # releases the lease resources
             raise RuntimeError("worker failed to register in time")
+        if w.state == "DEAD":
+            # A kill path (drain, node stop) reaped this worker while it was
+            # booting and set the event to wake us; the kill already released
+            # the lease resources.  Fail fast so the owner retries at once.
+            raise RuntimeError("worker was killed before registering")
         return {"worker_address": w.address, "worker_id": w.worker_id,
                 "lease_id": lease_id, "node_id": self.node_id.hex()}
 
@@ -1119,7 +1131,14 @@ class NodeAgent:
                 pass
 
     def _pick_oom_victim(self):
-        leased = [w for w in self.workers.values() if w.state == "LEASED"]
+        # Only REGISTERED leased workers are candidates: a worker that has
+        # not called back yet is still booting — its task body is not
+        # running, so killing it frees no task memory, and the owner's
+        # lease-grant RPC is still parked in _grant_lease's registered.wait
+        # (the typed death cause could only reach the owner after the full
+        # register timeout, long past any reasonable ray.get deadline).
+        leased = [w for w in self.workers.values()
+                  if w.state == "LEASED" and w.registered.is_set()]
         tasks = [w for w in leased if not w.is_actor]
         pool = tasks or leased
         if not pool:

@@ -9,6 +9,11 @@ gymnasium stepping, policy inference in jax on the worker); the learner
 update is ONE jitted program — GAE, minibatch epochs and the PPO loss all
 inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 (the reference's NCCL learner-group allreduce becomes a compiled psum).
+``learners(num_learners=N)`` scales that same program across N learner
+ACTOR processes on one ``jax.distributed`` mesh (learner_group.py).
+
+Algorithms: PPO (MLP + conv), DQN, SAC, TD3, IMPALA/APPO (V-trace,
+decoupled async sampling), BC/MARWIL offline; multi-agent dict envs.
 """
 
 from .conv import ActorCriticConv

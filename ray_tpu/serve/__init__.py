@@ -3,8 +3,10 @@
 Capabilities of Ray Serve (reference: ``python/ray/serve/``): deployments as
 reconciled replica actor sets, rolling updates, health-driven replacement,
 queue-depth autoscaling, power-of-two-choices routing, dynamic batching,
-streaming responses, and an HTTP ingress — plus a TPU-first continuous-
-batching LLM deployment (``ray_tpu.serve.llm``).
+streaming responses, an HTTP ingress with ASGI-app mounting
+(``@serve.ingress`` — any ASGI-3 callable, routes/middleware/SSE), and a
+gRPC ingress (``grpc_proxy.py``, schema in ``protos/serve.proto``) — plus
+a TPU-first continuous-batching LLM deployment (``ray_tpu.serve.llm``).
 """
 
 from .api import (delete, get_deployment_handle, grpc_config, http_config,

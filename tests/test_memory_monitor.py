@@ -138,6 +138,7 @@ def test_group_by_owner_victim_policy():
                          is_actor=actor)
         w.owner = owner
         w.leased_at = leased_at
+        w.registered.set()  # only registered (task-running) workers qualify
         return w
 
     fanout = [mk(f"a{i}", "owner-A", float(i)) for i in range(3)]
