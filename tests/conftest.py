@@ -32,7 +32,12 @@ def _alarm_guard(item, phase_timeout):
             f"(conftest SIGALRM)")
 
     prev = signal.signal(signal.SIGALRM, _on_alarm)
-    signal.alarm(phase_timeout)
+    # REPEATING timer, not a one-shot alarm: if the first TimeoutError is
+    # swallowed by a broad `except` in a wedged teardown and the code blocks
+    # again, a later fire converts the would-be permanent suite hang into
+    # another raise that eventually propagates (seen once: a contended run
+    # deadlocked for 40+ min after a failure, all threads in futex_wait).
+    signal.setitimer(signal.ITIMER_REAL, phase_timeout, 30.0)
     return prev
 
 
@@ -49,7 +54,7 @@ def pytest_runtest_setup(item):
     try:
         yield
     finally:
-        signal.alarm(0)
+        signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, prev)
 
 
@@ -59,7 +64,7 @@ def pytest_runtest_call(item):
     try:
         yield
     finally:
-        signal.alarm(0)
+        signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, prev)
 
 
@@ -69,7 +74,7 @@ def pytest_runtest_teardown(item):
     try:
         yield
     finally:
-        signal.alarm(0)
+        signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, prev)
 
 
