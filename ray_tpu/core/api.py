@@ -129,6 +129,10 @@ def init(address: Optional[str] = None,
             worker.job_id.hex(), runtime_env)
     if log_to_driver:
         _start_log_subscriber(worker)
+    # Flush library usages buffered before init (reference:
+    # put_pre_init_usage_stats) — recording itself never does I/O.
+    from ray_tpu.util import usage_stats
+    usage_stats.flush()
     atexit.register(shutdown)
     return {"address": gcs_address, "session_dir": session_dir,
             "node_id": worker.node_id}
@@ -193,6 +197,17 @@ def _pick_agent(gcs_address: str) -> Optional[str]:
 def shutdown():
     w = _state.worker
     if w is not None:
+        try:
+            # Persist the usage rollup next to the session logs while the
+            # GCS is still up (reference: UsageStatsToWrite).  Short
+            # timeout: this also runs from atexit against possibly-dead
+            # clusters.  Forget the flushed state — a later init must
+            # re-report even to a cluster reusing this GCS address.
+            from ray_tpu.util import usage_stats
+            usage_stats.write_report(timeout_s=1.5)
+            usage_stats.forget_flushed_state()
+        except Exception:
+            pass
         try:
             run_async(w.gcs.call("finish_job", job_id=w.job_id.hex()), timeout=2)
         except Exception:

@@ -704,7 +704,24 @@ class CoreWorker:
             self.agent = self.agent_clients.get(self.agent_address)
         if get_config().task_events_enabled:
             self._bg.append(asyncio.ensure_future(self._flush_task_events_loop()))
+        from ray_tpu.util.usage_stats import usage_stats_enabled
+        if usage_stats_enabled():
+            self._bg.append(asyncio.ensure_future(self._usage_flush_loop()))
         return self
+
+    async def _usage_flush_loop(self):
+        """Periodically push this process's usage records to the GCS KV —
+        the path by which WORKER-side library imports (a task body's
+        ``import ray_tpu.train``) reach the cluster usage report
+        (reference: usage_lib's worker-side record propagation).  The
+        flush is a no-op unless records changed since the last push."""
+        from ray_tpu.util import usage_stats
+        while not self._shutdown:
+            await asyncio.sleep(30.0)
+            try:
+                await usage_stats.flush_via(self.gcs.call, self.gcs_address)
+            except Exception:
+                pass
 
     def start(self):
         run_async(self._start())

@@ -167,6 +167,15 @@ class DashboardHead:
         return _json(await _off(
             lambda: ev.list_events(severity=severity, source=source)))
 
+    async def usage_stats(self, _req):
+        """The usage rollup the reference would upload (reference:
+        usage_lib.generate_report_data) — served locally instead."""
+        from ray_tpu.util import usage_stats as us
+        if not us.usage_stats_enabled():
+            return _json({"enabled": False})
+        report = await _off(us.generate_report)
+        return _json({"enabled": True, **report})
+
     async def actor_detail(self, req):
         """Per-actor drill-down (reference: dashboard/client/src/pages/
         actor/ActorDetailPage): the actor row + its task events."""
@@ -378,6 +387,7 @@ class DashboardHead:
         r.add_get("/api/logs/{node_id}", self.node_logs)
         r.add_get("/api/logs/{node_id}/{name}", self.node_log_tail)
         r.add_get("/api/events", self.events)
+        r.add_get("/api/usage_stats", self.usage_stats)
         r.add_post("/api/workflow/events/{key}", self.workflow_send_event)
         r.add_get("/api/workflow/events/{key}", self.workflow_event_status)
         # Web UI (reference: dashboard/client React SPA; here a no-build

@@ -144,3 +144,9 @@ __all__ = [
     "read_webdataset", "TFRecordDatasource", "SQLDatasource",
     "ImageDatasource",
 ]
+
+# Usage telemetry: which libraries a cluster actually uses (reference:
+# usage_lib.record_library_usage at import time).  Never raises.
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("data")
+del _rlu

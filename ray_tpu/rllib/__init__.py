@@ -43,3 +43,9 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "DistributedLearnerGroup", "LearnerWorker",
            "ActorCriticMLP", "ActorCriticConv", "build_model",
            "ReplayBuffer", "PrioritizedReplayBuffer"]
+
+# Usage telemetry: which libraries a cluster actually uses (reference:
+# usage_lib.record_library_usage at import time).  Never raises.
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu

@@ -27,3 +27,9 @@ __all__ = [
     "multiplexed", "get_multiplexed_model_id", "DAGDriver",
     "ingress", "ASGIApp", "ASGIRequest", "grpc_config",
 ]
+
+# Usage telemetry: which libraries a cluster actually uses (reference:
+# usage_lib.record_library_usage at import time).  Never raises.
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("serve")
+del _rlu

@@ -30,3 +30,9 @@ __all__ = [
     "TrainingFailedError", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "save_pytree", "load_pytree",
 ]
+
+# Usage telemetry: which libraries a cluster actually uses (reference:
+# usage_lib.record_library_usage at import time).  Never raises.
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("train")
+del _rlu

@@ -36,3 +36,9 @@ __all__ = [
     "get_trial_dir", "get_trial_resources", "report_bridge",
     "ResourceChangingScheduler",
 ]
+
+# Usage telemetry: which libraries a cluster actually uses (reference:
+# usage_lib.record_library_usage at import time).  Never raises.
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("tune")
+del _rlu

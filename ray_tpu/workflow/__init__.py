@@ -17,3 +17,9 @@ from .events import (EventListener, KVEventListener, event_received,
 __all__ = ["step", "run", "run_async", "resume", "get_output", "get_status",
            "list_all", "wait_for_event", "send_event", "event_received",
            "EventListener", "KVEventListener"]
+
+# Usage telemetry: which libraries a cluster actually uses (reference:
+# usage_lib.record_library_usage at import time).  Never raises.
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu

@@ -1,0 +1,140 @@
+"""Usage-stats collection (reference: ``python/ray/_private/usage/usage_lib.py``
+and ``test_usage_stats.py``): library usages + tags record without I/O, flush
+to the cluster KV from driver/worker flush points, the report assembles
+cluster metadata/status, and the rollup is served over the dashboard instead
+of uploaded (offline-first redesign)."""
+
+import json
+import os
+
+import ray_tpu
+from ray_tpu.util import usage_stats
+
+
+def test_enabledness_env(monkeypatch):
+    monkeypatch.delenv("RAYTPU_USAGE_STATS_ENABLED", raising=False)
+    assert usage_stats.usage_stats_enabled()  # default on (local-only report)
+    for off in ("0", "false", "False", "NO", " off "):
+        monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", off)
+        assert not usage_stats.usage_stats_enabled(), off
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    assert usage_stats.usage_stats_enabled()
+
+
+def test_recording_is_local_and_idempotent(monkeypatch):
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.reset_global_state()
+    usage_stats.record_library_usage("bufferlib")
+    usage_stats.record_library_usage("bufferlib")  # idempotent
+    usage_stats.record_extra_usage_tag("k", "v")
+    assert usage_stats._usages == ["bufferlib"]
+    assert usage_stats._tags == {"k": "v"}
+    usage_stats.flush()  # unattached: no-op, records persist
+    assert usage_stats._usages == ["bufferlib"]
+    usage_stats.reset_global_state()
+
+
+def test_report_and_file(ray_start_regular, monkeypatch):
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record_library_usage("data")
+    usage_stats.record_library_usage("train")
+    usage_stats.record_extra_usage_tag("serve_num_deployments", "3")
+
+    report = usage_stats.generate_report()  # flushes records itself
+    assert report["schema_version"] == usage_stats.SCHEMA_VERSION
+    assert report["python_version"].count(".") == 2
+    assert report["jax_version"]  # from package metadata, not import
+    assert set(report["library_usages"]) >= {"data", "train"}
+    assert report["extra_usage_tags"]["serve_num_deployments"] == "3"
+    assert report["cluster_status"]["total_num_nodes"] >= 1
+    assert "CPU" in (report["cluster_status"]["total_resources"] or {})
+
+    path = usage_stats.write_report()
+    assert path and os.path.exists(path)
+    on_disk = json.load(open(path))
+    assert on_disk["library_usages"] == report["library_usages"]
+
+
+def test_kv_namespace_isolation(ray_start_regular, monkeypatch):
+    """Telemetry keys must not leak into the user-facing default KV
+    namespace (internal_kv's isolation invariant)."""
+    from ray_tpu.experimental import internal_kv
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record_library_usage("nsprobe")
+    usage_stats.flush(_raise=True)
+    assert all("nsprobe" not in k for k in internal_kv.internal_kv_keys(""))
+    assert "lib:nsprobe" in internal_kv.internal_kv_keys(
+        "", namespace="usage_stats")
+
+
+def test_flush_is_incremental(ray_start_regular, monkeypatch):
+    """flush_via is a no-op while nothing changed (workers run it on a
+    30s loop — it must not re-put unchanged records every tick)."""
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record_library_usage("ticklib")
+    usage_stats.flush(_raise=True)
+    calls = []
+
+    async def counting_call(method, **kw):
+        calls.append(method)
+
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+    w = global_worker()
+    run_async(usage_stats.flush_via(counting_call, w.gcs_address))
+    assert calls == []  # clean: no RPC
+    usage_stats.record_extra_usage_tag("t", "1")
+    run_async(usage_stats.flush_via(counting_call, w.gcs_address))
+    assert calls  # dirty: re-put
+
+
+def test_disabled_is_inert(ray_start_regular, monkeypatch):
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "0")
+    usage_stats.reset_global_state()
+    usage_stats.record_library_usage("ghostlib")
+    assert usage_stats._usages == []
+    assert usage_stats.write_report() is None
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    report = usage_stats.generate_report()
+    assert "ghostlib" not in report["library_usages"]
+
+
+def test_reinit_rereports(monkeypatch):
+    """Records survive shutdown: a fresh cluster's report still lists the
+    libraries this process imported (the buffer is never consumed)."""
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record_library_usage("reinitlib")
+    ray_tpu.init(num_cpus=2)
+    assert "reinitlib" in usage_stats.generate_report()["library_usages"]
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)  # brand-new GCS, empty KV
+    try:
+        assert "reinitlib" in usage_stats.generate_report()["library_usages"]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_shutdown_writes_report(monkeypatch):
+    """ray_tpu.shutdown persists usage_stats.json into the session dir."""
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    info = ray_tpu.init(num_cpus=2)
+    usage_stats.record_library_usage("shutdownlib")
+    session_dir = info["session_dir"]
+    ray_tpu.shutdown()
+    path = os.path.join(session_dir, "usage_stats.json")
+    assert os.path.exists(path)
+    assert "shutdownlib" in json.load(open(path))["library_usages"]
+
+
+def test_dashboard_route(ray_start_regular, monkeypatch):
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard
+
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record_library_usage("tune")
+    port = start_dashboard()
+    data = requests.get(
+        f"http://127.0.0.1:{port}/api/usage_stats", timeout=10).json()
+    assert data["enabled"] is True
+    assert "tune" in data["library_usages"]
