@@ -197,6 +197,27 @@ const pages = {
           e.source, e.message, JSON.stringify(e.labels || {})])));
   },
 
+  async usage() {
+    const u = await api("usage_stats");
+    if (!u.enabled) {
+      return h("p", { class: "muted" },
+        "usage stats disabled (RAYTPU_USAGE_STATS_ENABLED=0)");
+    }
+    const s = u.cluster_status || {};
+    return h("div", {}, h("h2", {}, "Usage report"),
+      h("p", { class: "muted" },
+        "local rollup only — nothing leaves the cluster"),
+      table(["field", "value"], [
+        ["version", u.ray_tpu_version], ["python", u.python_version],
+        ["jax", u.jax_version], ["os", u.os],
+        ["nodes", s.total_num_nodes],
+        ["resources", JSON.stringify(s.total_resources || {})],
+        ["running jobs", s.total_num_running_jobs],
+        ["libraries", (u.library_usages || []).join(", ") || "(none)"],
+      ].concat(Object.entries(u.extra_usage_tags || {})
+        .map(([k, v]) => ["tag: " + k, v]))));
+  },
+
   async logs() {
     const nodes = await api("nodes");
     const alive = nodes.filter((n) => n.Alive);

@@ -1,10 +1,12 @@
 """ray_tpu.experimental — counterparts of ``ray.experimental``.
 
 Reference surface: ``python/ray/experimental/`` — ``internal_kv`` (GCS KV
-access), distributed array helpers.  Kept deliberately small; stable pieces
-graduate into ``ray_tpu.util``.
+access) and the distributed block-array package (``experimental/array/``,
+here ``darray`` with jitted block kernels + a ``to_jax`` mesh bridge).
+Kept deliberately small; stable pieces graduate into ``ray_tpu.util``.
 """
 
+from . import darray
 from .internal_kv import (
     internal_kv_del,
     internal_kv_exists,
@@ -14,6 +16,7 @@ from .internal_kv import (
 )
 
 __all__ = [
+    "darray",
     "internal_kv_get",
     "internal_kv_put",
     "internal_kv_del",

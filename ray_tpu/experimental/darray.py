@@ -1,0 +1,255 @@
+"""Distributed block arrays over the object store.
+
+Reference: ``python/ray/experimental/array/distributed/core.py`` — a
+``DistArray`` holds a grid of block object refs plus ``zeros/ones/eye/
+dot/assemble`` built from remote tasks per block.
+
+TPU-first redesign:
+
+- Block size is per-array (the reference hardcodes ``BLOCK_SIZE = 10``),
+  chosen so blocks are large enough to keep the MXU busy when a task
+  lands on a TPU worker.
+- Block kernels run through ``jax.jit`` inside the task (``jnp.dot`` et
+  al.), so the same code path is MXU-accelerated on TPU workers and
+  XLA-compiled on CPU workers — the reference's numpy kernels never
+  touch an accelerator.
+- ``to_jax(mesh, spec)`` bridges into the SPMD world: the block grid
+  becomes one ``jax.Array`` laid out by a ``NamedSharding``, so a
+  dataset-scale array built by tasks can feed a ``pjit`` program
+  directly.
+
+Usage::
+
+    from ray_tpu.experimental import darray
+    a = darray.from_numpy(np.arange(1e6).reshape(1000, 1000))
+    b = darray.ones((1000, 1000))
+    c = darray.dot(a, b)            # blockwise matmul, one task per block
+    c_np = c.assemble()             # gather to the driver
+    c_jax = c.to_jax(mesh, P("dp", None))   # or: shard onto a mesh
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DistArray", "from_numpy", "zeros", "ones", "eye", "dot",
+           "map_blocks", "DEFAULT_BLOCK"]
+
+#: default block edge — 512^2 f32 blocks are 1 MiB: big enough to matmul
+#: efficiently, small enough to spread over a cluster
+DEFAULT_BLOCK = 512
+
+
+from ray_tpu.util.remote_util import lazy_remote as _remote
+
+
+# ---------------------------------------------------------------- kernels
+# Each runs inside a worker task; jnp+jit so TPU workers use the MXU.
+
+def _k_fill(shape, dtype, value):
+    return np.full(shape, value, dtype)
+
+
+def _k_eye(shape, dtype, k0, k1):
+    out = np.zeros(shape, dtype)
+    for r in range(shape[0]):
+        c = r + k0 - k1
+        if 0 <= c < shape[1]:
+            out[r, c] = 1
+    return out
+
+
+_matmul_jit = None
+
+
+def _k_matmul_sum(*blocks):
+    """sum_k A_ik @ B_kj for one output block, jitted (MXU on TPU).  The
+    jitted program is module-cached so a worker running many block tasks
+    compiles once per (K, shapes), not once per task."""
+    global _matmul_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _matmul_jit is None:
+        def go(az, bz):
+            acc = jnp.zeros((az[0].shape[0], bz[0].shape[1]), az[0].dtype)
+            for a, b in zip(az, bz):
+                acc = acc + jnp.dot(a, b)
+            return acc
+        _matmul_jit = jax.jit(go)
+    n = len(blocks) // 2
+    return np.asarray(_matmul_jit(list(blocks[:n]), list(blocks[n:])))
+
+
+def _k_map(fn, *blocks):
+    return np.asarray(fn(*blocks))
+
+
+class DistArray:
+    """A dense array stored as a grid of blocks in the object store.
+
+    ``blocks`` is an object-dtype ndarray of ``ObjectRef``s with one entry
+    per block-grid coordinate (reference: ``DistArray.objectids``)."""
+
+    def __init__(self, shape: Sequence[int], blocks: np.ndarray,
+                 block_shape: Sequence[int], dtype=np.float32):
+        self.shape = tuple(int(s) for s in shape)
+        self.block_shape = tuple(int(s) for s in block_shape)
+        self.blocks = blocks
+        self.dtype = np.dtype(dtype)
+        expect = tuple(-(-s // b) for s, b in zip(self.shape,
+                                                  self.block_shape))
+        if blocks.shape != expect:
+            raise ValueError(f"block grid {blocks.shape} != expected {expect}")
+
+    # ------------------------------------------------------------- layout
+
+    @property
+    def num_blocks(self) -> Tuple[int, ...]:
+        return self.blocks.shape
+
+    def _block_bounds(self, index: Tuple[int, ...]):
+        lower = [i * b for i, b in zip(index, self.block_shape)]
+        upper = [min((i + 1) * b, s)
+                 for i, b, s in zip(index, self.block_shape, self.shape)]
+        return lower, upper
+
+    # ------------------------------------------------------------- gather
+
+    def assemble(self) -> np.ndarray:
+        """Fetch every block and stitch the full array on the driver
+        (reference: ``DistArray.assemble``)."""
+        import ray_tpu
+        out = np.zeros(self.shape, self.dtype)
+        flat_refs = list(self.blocks.flat)
+        flat_vals = ray_tpu.get(flat_refs)
+        for index, val in zip(itertools.product(
+                *[range(n) for n in self.num_blocks]), flat_vals):
+            lo, up = self._block_bounds(index)
+            out[tuple(slice(l, u) for l, u in zip(lo, up))] = val
+        return out
+
+    def to_jax(self, mesh=None, spec=None):
+        """Assemble into a ``jax.Array`` — sharded over ``mesh`` by
+        ``spec`` (a ``PartitionSpec``) when given, single-device
+        otherwise.  This is the bridge from task-built data to a pjit
+        program (greenfield vs the reference — its DistArray never meets
+        an accelerator)."""
+        import jax
+
+        host = self.assemble()
+        if mesh is None:
+            return jax.numpy.asarray(host)
+        from jax.sharding import NamedSharding
+        return jax.device_put(host, NamedSharding(mesh, spec))
+
+    # -------------------------------------------------------------- math
+
+    def map_blocks(self, fn) -> "DistArray":
+        """Apply ``fn(block) -> block`` remotely to every block (shape-
+        preserving elementwise ops)."""
+        rt = _remote(_k_map)
+        grid = np.empty(self.num_blocks, dtype=object)
+        for index in itertools.product(*[range(n) for n in self.num_blocks]):
+            grid[index] = rt.remote(fn, self.blocks[index])
+        return DistArray(self.shape, grid, self.block_shape, self.dtype)
+
+    def _binary(self, other: "DistArray", fn) -> "DistArray":
+        if (self.shape != other.shape
+                or self.block_shape != other.block_shape):
+            raise ValueError("shape/block mismatch")
+        rt = _remote(_k_map)
+        grid = np.empty(self.num_blocks, dtype=object)
+        for index in itertools.product(*[range(n) for n in self.num_blocks]):
+            grid[index] = rt.remote(fn, self.blocks[index],
+                                    other.blocks[index])
+        return DistArray(self.shape, grid, self.block_shape, self.dtype)
+
+    def __add__(self, other):
+        return self._binary(other, lambda a, b: a + b)
+
+    def __sub__(self, other):
+        return self._binary(other, lambda a, b: a - b)
+
+    def __mul__(self, other):
+        return self._binary(other, lambda a, b: a * b)
+
+
+# ----------------------------------------------------------- constructors
+
+def _build(shape, block, dtype, make_ref) -> DistArray:
+    shape = tuple(int(s) for s in shape)
+    block_shape = tuple(min(block, s) for s in shape)
+    grid_shape = tuple(-(-s // b) for s, b in zip(shape, block_shape))
+    grid = np.empty(grid_shape, dtype=object)
+    for index in itertools.product(*[range(n) for n in grid_shape]):
+        lower = [i * b for i, b in zip(index, block_shape)]
+        upper = [min((i + 1) * b, s) for i, b, s in zip(index, block_shape,
+                                                        shape)]
+        bshape = tuple(u - l for l, u in zip(lower, upper))
+        grid[index] = make_ref(index, lower, bshape)
+    return DistArray(shape, grid, block_shape, dtype)
+
+
+def from_numpy(a: np.ndarray, block: int = DEFAULT_BLOCK) -> DistArray:
+    """Scatter a host array into the object store block by block
+    (reference: ``numpy_to_dist``)."""
+    import ray_tpu
+    a = np.asarray(a)
+
+    def put_block(index, lower, bshape):
+        sl = tuple(slice(l, l + s) for l, s in zip(lower, bshape))
+        return ray_tpu.put(np.ascontiguousarray(a[sl]))
+
+    return _build(a.shape, block, a.dtype, put_block)
+
+
+def zeros(shape, dtype=np.float32, block: int = DEFAULT_BLOCK) -> DistArray:
+    rt = _remote(_k_fill)
+    return _build(shape, block, dtype,
+                  lambda i, lo, bs: rt.remote(bs, np.dtype(dtype).str, 0))
+
+
+def ones(shape, dtype=np.float32, block: int = DEFAULT_BLOCK) -> DistArray:
+    rt = _remote(_k_fill)
+    return _build(shape, block, dtype,
+                  lambda i, lo, bs: rt.remote(bs, np.dtype(dtype).str, 1))
+
+
+def eye(n: int, dtype=np.float32, block: int = DEFAULT_BLOCK) -> DistArray:
+    rt = _remote(_k_eye)
+    return _build((n, n), block, dtype,
+                  lambda i, lo, bs: rt.remote(bs, np.dtype(dtype).str,
+                                              lo[0], lo[1]))
+
+
+def map_blocks(fn, a: DistArray) -> DistArray:
+    return a.map_blocks(fn)
+
+
+def dot(a: DistArray, b: DistArray) -> DistArray:
+    """Blocked matmul: one task per OUTPUT block computes
+    ``sum_k A[i,k] @ B[k,j]`` with a jitted kernel (reference:
+    ``distributed/core.py:192`` dot — its per-block tasks run numpy)."""
+    if len(a.shape) != 2 or len(b.shape) != 2:
+        raise ValueError("dot needs 2-D arrays")
+    if a.shape[1] != b.shape[0] or a.block_shape[1] != b.block_shape[0]:
+        raise ValueError(
+            f"inner dims/blocks must match: {a.shape}x{b.shape}, "
+            f"blocks {a.block_shape}x{b.block_shape}")
+    rt = _remote(_k_matmul_sum)
+    out_shape = (a.shape[0], b.shape[1])
+    out_block = (a.block_shape[0], b.block_shape[1])
+    grid_shape = tuple(-(-s // bl) for s, bl in zip(out_shape, out_block))
+    grid = np.empty(grid_shape, dtype=object)
+    K = a.num_blocks[1]
+    for i in range(grid_shape[0]):
+        for j in range(grid_shape[1]):
+            a_refs = [a.blocks[i, k] for k in range(K)]
+            b_refs = [b.blocks[k, j] for k in range(K)]
+            grid[i, j] = rt.remote(*a_refs, *b_refs)
+    return DistArray(out_shape, grid, out_block,
+                     np.result_type(a.dtype, b.dtype))

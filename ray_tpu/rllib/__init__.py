@@ -19,6 +19,7 @@ decoupled async sampling), BC/MARWIL offline; multi-agent dict envs.
 from .conv import ActorCriticConv
 from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
+from .external import PolicyClient, PolicyServerInput
 from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner, LearnerGroup
 from .learner_group import DistributedLearnerGroup, LearnerWorker

@@ -32,10 +32,33 @@ class PPOConfig:
         self.model: Dict[str, Any] = dict(hidden=(64, 64))
         self.seed = 0
         self.worker_env: Optional[Dict[str, str]] = None
+        self.observation_space = None
+        self.action_space = None
+        self.external_port: Optional[int] = None
+        self.external_address = "127.0.0.1"
+        self.external_fragment_len = 64
 
-    def environment(self, env: str, *, env_config: Optional[dict] = None):
+    def environment(self, env: Optional[str] = None, *,
+                    env_config: Optional[dict] = None,
+                    observation_space=None, action_space=None):
+        """``env=None`` with explicit spaces is the external-env mode —
+        there is no in-cluster simulator to probe (reference:
+        AlgorithmConfig.environment(env=None, observation_space=...,
+        action_space=...) for policy-server setups)."""
         self.env_name = env
         self.env_config = env_config or {}
+        self.observation_space = observation_space
+        self.action_space = action_space
+        return self
+
+    def external(self, port: int = 9900, address: str = "127.0.0.1",
+                 fragment_len: int = 64):
+        """Serve the policy to external simulators instead of running
+        in-cluster env runners (reference: policy_server_input.py wired
+        via ``config.offline_data(input_=...)``)."""
+        self.external_port = port
+        self.external_address = address
+        self.external_fragment_len = fragment_len
         return self
 
     def env_runners(self, num_env_runners: int = 2,
@@ -71,8 +94,9 @@ class PPOConfig:
         return self
 
     def build(self) -> "PPO":
-        if not self.env_name:
-            raise ValueError("call .environment(env_name) first")
+        if not self.env_name and self.external_port is None:
+            raise ValueError("call .environment(env_name) first "
+                             "(or .external(port) with explicit spaces)")
         return PPO(self)
 
 
@@ -93,12 +117,21 @@ class PPO:
         from .models import build_model
 
         self.config = config
-        probe = gym.make(config.env_name, **config.env_config)
-        obs_shape = probe.observation_space.shape
-        continuous = not hasattr(probe.action_space, "n")
-        action_dim = (probe.action_space.shape[0] if continuous
-                      else int(probe.action_space.n))
-        probe.close()
+        if config.env_name is not None:
+            probe = gym.make(config.env_name, **config.env_config)
+            obs_space, act_space = probe.observation_space, probe.action_space
+            probe.close()
+        else:  # external-env mode: spaces come from the config
+            obs_space, act_space = (config.observation_space,
+                                    config.action_space)
+            if obs_space is None or act_space is None:
+                raise ValueError(
+                    "external mode needs .environment(observation_space=..., "
+                    "action_space=...) — there is no env to probe")
+        obs_shape = obs_space.shape
+        continuous = not hasattr(act_space, "n")
+        action_dim = (act_space.shape[0] if continuous
+                      else int(act_space.n))
         if config.model.get("conv") or len(obs_shape) == 3:
             # pixel obs: Nature-CNN torso (Atari-class envs); filters /
             # torso width overridable for small test grids
@@ -130,14 +163,28 @@ class PPO:
                 model, config.train,
                 num_learners=max(1, config.num_devices_per_learner),
                 seed=config.seed)
-        runner_cls = ray_tpu.remote(_ER)
-        self.runners = [
-            runner_cls.options(num_cpus=1).remote(
-                config.env_name, self.model_spec,
-                num_envs=config.num_envs_per_runner,
-                seed=config.seed + 1000 * i,
-                env_config=config.env_config)
-            for i in range(config.num_env_runners)]
+        self.policy_server = None
+        if config.external_port is not None:
+            # external-env mode: no in-cluster runners — samples arrive
+            # over the policy server (external.py)
+            from .external import PolicyServerInput
+            from .models import build_model
+            self.policy_server = PolicyServerInput(
+                build_model(self.model_spec),
+                self.learner_group.get_weights(),
+                address=config.external_address, port=config.external_port,
+                gamma=config.train.get("gamma", 0.99),
+                fragment_len=config.external_fragment_len)
+            self.runners = []
+        else:
+            runner_cls = ray_tpu.remote(_ER)
+            self.runners = [
+                runner_cls.options(num_cpus=1).remote(
+                    config.env_name, self.model_spec,
+                    num_envs=config.num_envs_per_runner,
+                    seed=config.seed + 1000 * i,
+                    env_config=config.env_config)
+                for i in range(config.num_env_runners)]
         self._iteration = 0
         self._recent_returns: List[float] = []
 
@@ -146,24 +193,32 @@ class PPO:
         import ray_tpu
 
         t0 = time.time()
-        weights = self.learner_group.get_weights()
-        weights_ref = ray_tpu.put(weights)
-        batches = ray_tpu.get(
-            [r.sample.remote(weights_ref, self.config.rollout_len)
-             for r in self.runners], timeout=600)
-        # concat along the env axis: [T, sum(B_i), ...]
-        rollout = {
-            k: np.concatenate([b[k] for b in batches],
-                              axis=0 if k == "last_values" else 1)
-            for k in batches[0]}
-        metrics = self.learner_group.update(rollout)
-        rets = [x for r in self.runners
-                for x in ray_tpu.get(r.episode_returns.remote(), timeout=60)]
+        if self.policy_server is not None:
+            rollout = self.policy_server.next(self.config.rollout_len)
+            metrics = self.learner_group.update(rollout)
+            self.policy_server.set_weights(self.learner_group.get_weights())
+            rets = self.policy_server.episode_returns()
+            steps = self.config.rollout_len
+        else:
+            weights = self.learner_group.get_weights()
+            weights_ref = ray_tpu.put(weights)
+            batches = ray_tpu.get(
+                [r.sample.remote(weights_ref, self.config.rollout_len)
+                 for r in self.runners], timeout=600)
+            # concat along the env axis: [T, sum(B_i), ...]
+            rollout = {
+                k: np.concatenate([b[k] for b in batches],
+                                  axis=0 if k == "last_values" else 1)
+                for k in batches[0]}
+            metrics = self.learner_group.update(rollout)
+            rets = [x for r in self.runners
+                    for x in ray_tpu.get(r.episode_returns.remote(),
+                                         timeout=60)]
+            steps = (self.config.rollout_len * self.config.num_env_runners
+                     * self.config.num_envs_per_runner)
         self._recent_returns.extend(rets)
         self._recent_returns = self._recent_returns[-100:]
         self._iteration += 1
-        steps = (self.config.rollout_len * self.config.num_env_runners
-                 * self.config.num_envs_per_runner)
         out = {
             "training_iteration": self._iteration,
             "episode_return_mean": (float(np.mean(self._recent_returns))
@@ -178,6 +233,8 @@ class PPO:
     def stop(self):
         import ray_tpu
 
+        if self.policy_server is not None:
+            self.policy_server.stop()
         for r in self.runners:
             try:
                 ray_tpu.kill(r)

@@ -95,8 +95,11 @@ def test_oom_victim_policy():
     from ray_tpu.core.node_agent import NodeAgent, WorkerHandle
 
     agent = NodeAgent.__new__(NodeAgent)  # policy is pure over .workers
-    mk = lambda wid, state, actor, t: WorkerHandle(
-        worker_id=wid, proc=None, state=state, is_actor=actor)
+
+    def mk(wid, state, actor, t):
+        w = WorkerHandle(worker_id=wid, proc=None, state=state, is_actor=actor)
+        w.registered.set()  # only registered (task-running) workers qualify
+        return w
     agent.workers = {}
     assert agent._pick_oom_victim() is None
 
