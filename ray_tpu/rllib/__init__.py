@@ -12,11 +12,13 @@ inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 ``learners(num_learners=N)`` scales that same program across N learner
 ACTOR processes on one ``jax.distributed`` mesh (learner_group.py).
 
-Algorithms: PPO (MLP + conv), DQN, SAC, TD3, IMPALA/APPO (V-trace,
-decoupled async sampling), BC/MARWIL offline; multi-agent dict envs.
+Algorithms: PPO (MLP + conv), DQN, SAC, DDPG, TD3, IMPALA/APPO (V-trace,
+decoupled async sampling), BC/MARWIL offline; multi-agent dict envs;
+external-env protocol (PolicyServerInput/PolicyClient over HTTP).
 """
 
 from .conv import ActorCriticConv
+from .ddpg import DDPG, DDPGConfig
 from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
 from .external import PolicyClient, PolicyServerInput

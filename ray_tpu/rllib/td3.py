@@ -144,6 +144,7 @@ class TD3Config:
             "tau": 0.005, "policy_noise": 0.2, "noise_clip": 0.5,
             "policy_delay": 2, "explore_noise": 0.1,
             "batch_size": 256, "train_iters": 32,
+            "twin_q": True,  # False = single-critic DDPG semantics
         }
         self.replay: Dict[str, Any] = {
             "capacity": 100_000, "learn_starts": 1000,
@@ -176,10 +177,13 @@ class TD3Config:
         self.seed = seed
         return self
 
+    #: algorithm class this config builds — subclasses (DDPGConfig) override
+    _algo_cls: Optional[type] = None
+
     def build(self) -> "TD3":
         if not self.env_name:
             raise ValueError("call .environment(env_name) first")
-        return TD3(self)
+        return (self._algo_cls or TD3)(self)
 
 
 class TD3:
@@ -245,26 +249,31 @@ class TD3:
         cfg = self.config.train
         gamma, tau = cfg["gamma"], cfg["tau"]
         pnoise, nclip = cfg["policy_noise"], cfg["noise_clip"]
+        twin = bool(cfg.get("twin_q", True))
         policy, q1, q2 = self.policy, self.q1, self.q2
         opt = self.opt
 
         def update(state, opt_state, batch, key, do_actor: bool):
-            # --- clipped double-Q target with target policy smoothing
+            # --- Q target with target policy smoothing; twin_q=False is
+            # plain DDPG (single critic, no clipped double-Q)
             noise = jnp.clip(
                 pnoise * jax.random.normal(key, batch["actions"].shape),
                 -nclip, nclip)
             next_a = jnp.clip(
                 policy.apply(state["pi_t"], batch["next_obs"]) + noise,
                 -1.0, 1.0)
-            q_next = jnp.minimum(
-                q1.apply(state["q1_t"], batch["next_obs"], next_a),
-                q2.apply(state["q2_t"], batch["next_obs"], next_a))
+            q1_next = q1.apply(state["q1_t"], batch["next_obs"], next_a)
+            q_next = (jnp.minimum(
+                q1_next, q2.apply(state["q2_t"], batch["next_obs"], next_a))
+                if twin else q1_next)
             target = jax.lax.stop_gradient(
                 batch["rewards"] + gamma * (1 - batch["dones"]) * q_next)
 
             def critic_loss(qs):
                 p1, p2 = qs
                 e1 = q1.apply(p1, batch["obs"], batch["actions"]) - target
+                if not twin:
+                    return (e1 ** 2).mean()
                 e2 = q2.apply(p2, batch["obs"], batch["actions"]) - target
                 return (e1 ** 2).mean() + (e2 ** 2).mean()
 
