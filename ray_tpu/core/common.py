@@ -233,6 +233,14 @@ class ResourceSet:
     def get(self, k: str) -> float:
         return self._r.get(k, 0.0)
 
+    def set(self, k: str, v: float):
+        """Set one resource's amount; 0 removes the key (dynamic-resource
+        deletion semantics)."""
+        if v:
+            self._r[k] = float(v)
+        else:
+            self._r.pop(k, None)
+
     def can_fit(self, demand: Dict[str, float]) -> bool:
         return all(self._r.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)
 

@@ -156,13 +156,32 @@ class GcsServer:
         self._publish("nodes", {"event": "alive", "node_id": node_id, "address": address})
         return {"node_id": node_id, "cluster_view": self._view_payload()}
 
+    async def handle_update_node_resources(self, node_id: str,
+                                           total: Dict[str, float],
+                                           available: Dict[str, float]):
+        """A node's resource CAPACITY changed at runtime (reference:
+        experimental/dynamic_resources.py -> NodeManager resource-set
+        path): refresh the view totals so the scheduler and autoscaler
+        see the new shape immediately instead of at the next heartbeat."""
+        n = self.nodes.get(node_id)
+        if n is None:
+            return {"unknown": True}
+        n.total = dict(total)
+        n.available = dict(available)
+        self._publish("nodes", {"event": "resources", "node_id": node_id,
+                                "total": n.total})
+        return {"ok": True}
+
     async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
                                queue_len: int = 0, store_stats: dict | None = None,
-                               queued_demands: List[Dict[str, float]] | None = None):
+                               queued_demands: List[Dict[str, float]] | None = None,
+                               total: Dict[str, float] | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             return {"unknown": True}  # agent should re-register
         n.available = dict(available)
+        if total is not None:
+            n.total = dict(total)
         n.queue_len = queue_len
         # resource shapes queued behind this node's leases — the autoscaler's
         # scale-up signal (reference: cluster load reported to the monitor,

@@ -190,6 +190,10 @@ class NodeAgent:
                 res = await self.gcs.call(
                     "heartbeat", node_id=self.node_id.hex(),
                     available=self.available.to_dict(),
+                    # total rides every heartbeat so a lost
+                    # update_node_resources push self-heals (dynamic
+                    # set_resource changes capacity at runtime)
+                    total=self.total.to_dict(),
                     queue_len=len(self.lease_queue),
                     queued_demands=self._aggregate_demands(),
                     store_stats=self.store.stats())
@@ -559,6 +563,32 @@ class NodeAgent:
             self.available.force_acquire(res)
         return True
 
+    async def handle_set_resource(self, name: str, capacity: float):
+        """Adjust this node's capacity for one resource at runtime
+        (reference: ``experimental/dynamic_resources.py`` set_resource —
+        capacity 0 deletes the resource).  Available shifts by the same
+        delta (it may go transiently negative while leases drain, exactly
+        like the reference's resource deletion under load)."""
+        name = str(name)
+        capacity = float(capacity)
+        if capacity < 0:
+            raise ValueError("capacity must be >= 0")
+        delta = capacity - self.total.get(name)
+        self.total.set(name, capacity)
+        # ALWAYS shift available by delta — deleting while leases hold the
+        # resource must leave available negative so the eventual lease
+        # returns settle back to zero, never to phantom capacity.
+        self.available.set(name, self.available.get(name) + delta)
+        try:
+            await self.gcs.call("update_node_resources",
+                                node_id=self.node_id.hex(),
+                                total=self.total.to_dict(),
+                                available=self.available.to_dict())
+        except Exception:
+            pass  # the next heartbeat carries available; view self-heals
+        await self._process_lease_queue()
+        return {"total": self.total.to_dict()}
+
     async def handle_return_worker_lease(self, lease_id: str, worker_id: str,
                                          worker_alive: bool = True):
         # Surface the death cause to the owner: an OOM-killed worker's task
@@ -594,6 +624,16 @@ class NodeAgent:
                 self.lease_queue.pop(i)
                 if not req.future.done():
                     req.future.set_exception(ValueError(f"bundle {req.bundle} removed"))
+                continue
+            if req.bundle is None and not ResourceSet(
+                    self.total.to_dict()).can_fit(req.resources):
+                # capacity shrank below the demand after admission
+                # (dynamic set_resource): answer infeasible NOW — same
+                # response the admission check would give a fresh request —
+                # so the owner re-routes instead of waiting forever.
+                self.lease_queue.pop(i)
+                if not req.future.done():
+                    req.future.set_result({"infeasible": True})
                 continue
             if pool.can_fit(req.resources):
                 self.lease_queue.pop(i)

@@ -7,6 +7,7 @@ Kept deliberately small; stable pieces graduate into ``ray_tpu.util``.
 """
 
 from . import darray
+from .dynamic_resources import set_resource
 from .internal_kv import (
     internal_kv_del,
     internal_kv_exists,
@@ -17,6 +18,7 @@ from .internal_kv import (
 
 __all__ = [
     "darray",
+    "set_resource",
     "internal_kv_get",
     "internal_kv_put",
     "internal_kv_del",

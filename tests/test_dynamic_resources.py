@@ -1,0 +1,118 @@
+"""Dynamic custom resources (reference:
+``python/ray/experimental/dynamic_resources.py`` + its tests): create,
+consume, resize, and delete a custom resource at runtime."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import set_resource
+
+
+def test_set_resource_lifecycle(ray_start_regular):
+    # create
+    set_resource("widget", 2.0)
+    deadline = time.monotonic() + 10
+    while ray_tpu.cluster_resources().get("widget") != 2.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+
+    # consume: a task demanding the new resource schedules immediately
+    @ray_tpu.remote(resources={"widget": 1.0})
+    def uses_widget():
+        return "ok"
+
+    assert ray_tpu.get(uses_widget.remote(), timeout=60) == "ok"
+
+    # resize
+    set_resource("widget", 5.0)
+    deadline = time.monotonic() + 10
+    while ray_tpu.cluster_resources().get("widget") != 5.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+
+    # delete: capacity 0 removes the key from the view
+    set_resource("widget", 0)
+    deadline = time.monotonic() + 10
+    while "widget" in ray_tpu.cluster_resources():
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+
+
+def test_pending_task_dispatches_on_set(ray_start_regular):
+    """A task queued on a not-yet-existing resource dispatches the moment
+    set_resource creates it (the agent re-pumps its lease queue)."""
+    @ray_tpu.remote(resources={"gadget": 1.0})
+    def uses_gadget():
+        return 42
+
+    ref = uses_gadget.remote()
+    time.sleep(0.5)  # infeasible for now
+    set_resource("gadget", 1.0)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    set_resource("gadget", 0)
+
+
+def test_builtin_resources_rejected(ray_start_regular):
+    with pytest.raises(ValueError, match="built-in"):
+        set_resource("CPU", 8)
+
+
+def test_unknown_node_rejected(ray_start_regular):
+    with pytest.raises(ValueError, match="no live node"):
+        set_resource("widget", 1.0, node_id="deadbeef" * 4)
+
+
+def test_delete_while_leased_no_phantom_capacity(ray_start_regular):
+    """Deleting a resource while a task holds it must not resurrect
+    phantom availability when the lease returns (available goes
+    transiently negative and settles at zero)."""
+    set_resource("bolt", 1.0)
+
+    @ray_tpu.remote(resources={"bolt": 1.0})
+    def hold():
+        time.sleep(2.0)
+        return "done"
+
+    ref = hold.remote()
+    from ray_tpu.core import api
+    agent = api._state.node_agent
+    deadline = time.monotonic() + 20
+    while agent.available.get("bolt") != 0.0:
+        assert time.monotonic() < deadline, "task never acquired bolt"
+        time.sleep(0.05)
+    set_resource("bolt", 0)  # delete while leased
+    assert agent.available.get("bolt") == -1.0  # drains, not phantom
+    assert ray_tpu.get(ref, timeout=60) == "done"
+    deadline = time.monotonic() + 10
+    while agent.available.get("bolt") != 0.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert "bolt" not in ray_tpu.cluster_resources()
+
+
+def test_shrink_below_queued_demand_answers_infeasible(ray_start_regular):
+    """A lease queued behind in-use capacity gets an infeasible answer
+    (not a silent hang) when set_resource shrinks total below its demand,
+    and recovers once capacity returns."""
+    set_resource("gear", 2.0)
+
+    @ray_tpu.remote(resources={"gear": 2.0})
+    def hold():
+        time.sleep(3.0)
+        return "a"
+
+    @ray_tpu.remote(resources={"gear": 2.0})
+    def wants():
+        return "b"
+
+    ref_a = hold.remote()
+    time.sleep(1.0)          # a holds both gears
+    ref_b = wants.remote()   # queues at the agent (fits total, not avail)
+    time.sleep(0.5)
+    set_resource("gear", 1.0)   # b now infeasible HERE; it must re-route
+    time.sleep(1.0)
+    set_resource("gear", 2.0)   # capacity restored
+    assert ray_tpu.get([ref_a, ref_b], timeout=90) == ["a", "b"]
+    set_resource("gear", 0)

@@ -134,6 +134,15 @@ def test_external_ppo_trains(ray_start_regular):
     def simulator():
         env = gym.make("CartPole-v1")
         client = PolicyClient(algo.policy_server.address)
+        try:
+            _run_episodes(env, client)
+        except Exception:
+            if not stop.is_set():  # only teardown races are expected
+                raise
+        finally:
+            env.close()
+
+    def _run_episodes(env, client):
         while not stop.is_set():
             eid = client.start_episode()
             obs, _ = env.reset()
@@ -145,7 +154,6 @@ def test_external_ppo_trains(ray_start_regular):
                 client.log_returns(eid, reward)
                 done = term or trunc
             client.end_episode(eid, obs, truncated=trunc and not term)
-        env.close()
 
     sim = threading.Thread(target=simulator, daemon=True)
     sim.start()
