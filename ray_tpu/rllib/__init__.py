@@ -12,11 +12,12 @@ inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 ``learners(num_learners=N)`` scales that same program across N learner
 ACTOR processes on one ``jax.distributed`` mesh (learner_group.py).
 
-Algorithms: PPO (MLP + conv), DQN, SAC, DDPG, TD3, IMPALA/APPO (V-trace,
+Algorithms: PPO and A2C (MLP + conv), DQN, SAC, DDPG, TD3, IMPALA/APPO (V-trace,
 decoupled async sampling), BC/MARWIL offline; multi-agent dict envs;
 external-env protocol (PolicyServerInput/PolicyClient over HTTP).
 """
 
+from .a2c import A2C, A2CConfig
 from .conv import ActorCriticConv
 from .ddpg import DDPG, DDPGConfig
 from .dqn import DQN, DQNConfig, QNetwork
@@ -35,9 +36,10 @@ from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .sac import SAC, SACConfig
 from .td3 import TD3, TD3Config
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
-           "TD3", "TD3Config",
+__all__ = ["PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
+           "SAC", "SACConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
            "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+           "PolicyClient", "PolicyServerInput",
            "BCConfig", "MARWIL", "MARWILConfig", "OfflineDataset",
            "collect_episodes", "write_episodes",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",

@@ -1,0 +1,36 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference: ``rllib/algorithms/a2c/a2c.py`` (A2CConfig, the synchronous
+A3C variant).  A2C is the PPO driver degenerated to one on-policy pass:
+with ``num_epochs=1`` and one minibatch the importance ratio is
+identically 1 at the update point, so PPO's clipped surrogate's gradient
+reduces EXACTLY to the vanilla policy gradient ``adv * grad(logp)`` —
+one jitted program serves both algorithms (learner.py), the config is
+the axis between them (same inversion as DDPG/TD3 in td3.py).
+"""
+
+from __future__ import annotations
+
+from .ppo import PPO, PPOConfig
+
+__all__ = ["A2C", "A2CConfig"]
+
+
+class A2C(PPO):
+    """Driver: synchronous rollout fan-out -> one policy-gradient pass."""
+
+
+class A2CConfig(PPOConfig):
+    """PPOConfig pinned to the single-pass on-policy regime (reference
+    defaults: entropy bonus on, one SGD pass per batch)."""
+
+    _algo_cls = A2C
+
+    def __init__(self):
+        super().__init__()
+        self.train.update(
+            num_epochs=1,        # one on-policy pass: ratio == 1
+            num_minibatches=1,   # whole-batch gradient
+            clip_param=1e9,      # clipping never binds at ratio 1
+            entropy_coeff=0.01,  # A2C's exploration bonus (reference default)
+        )

@@ -93,11 +93,14 @@ class PPOConfig:
         self.worker_env = worker_env
         return self
 
+    #: algorithm class this config builds — subclasses (A2CConfig) override
+    _algo_cls: Optional[type] = None
+
     def build(self) -> "PPO":
         if not self.env_name and self.external_port is None:
             raise ValueError("call .environment(env_name) first "
                              "(or .external(port) with explicit spaces)")
-        return PPO(self)
+        return (self._algo_cls or PPO)(self)
 
 
 class PPO:

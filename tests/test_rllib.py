@@ -145,3 +145,31 @@ def test_ppo_learns_cartpole(ray_start_regular):
         assert best >= 100.0, f"PPO failed to learn CartPole: best {best}"
     finally:
         algo.stop()
+
+
+@pytest.mark.slow
+def test_a2c_learns_cartpole(ray_start_regular):
+    """A2C (reference: rllib/algorithms/a2c — the single-pass on-policy
+    regime of the PPO program) clears a CartPole gate; looser than PPO's
+    since vanilla PG is less sample-efficient."""
+    from ray_tpu.rllib import A2CConfig
+
+    config = (A2CConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=128)
+              .training(lr=1e-3, model={"hidden": (64, 64)})
+              .debugging(seed=0))
+    assert config.train["num_epochs"] == 1
+    assert config.train["num_minibatches"] == 1
+    algo = config.build()
+    best = 0.0
+    try:
+        for i in range(40):
+            res = algo.train()
+            best = max(best, res["episode_return_mean"])
+            if best >= 80.0:
+                break
+        assert best >= 80.0, f"A2C failed to learn CartPole: best {best}"
+    finally:
+        algo.stop()
