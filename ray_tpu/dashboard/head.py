@@ -75,6 +75,31 @@ class DashboardHead:
         import ray_tpu
         return _json(await _off(ray_tpu.nodes))
 
+    async def node_detail(self, req):
+        """Per-node drill-down (reference: dashboard/client/src/pages/
+        node/NodeDetailPage): the GCS view row + the agent's live
+        node_info (workers, store stats, OOM kills)."""
+        import ray_tpu
+        from ray_tpu.core.core_worker import global_worker
+        nid = req.match_info["node_id"]
+        rows = await _off(ray_tpu.nodes)
+        row = next((n for n in rows
+                    if (n.get("NodeID") or "").startswith(nid)), None)
+        if row is None:
+            return _json({"error": f"no node {nid!r}"}, status=404)
+        info = {}
+        if row.get("Alive"):
+            w = global_worker()
+            try:
+                # this handler runs on the worker's IO loop, so await the
+                # pooled client directly — no executor bounce
+                info = await asyncio.wait_for(
+                    w.agent_clients.get(row["AgentAddress"]).call(
+                        "node_info", _timeout=10.0), 15)
+            except Exception as e:
+                info = {"error": str(e)}
+        return _json({"node": row, "info": info})
+
     async def actors(self, req):
         from ray_tpu.util import state
         filters = self._filters(req)
@@ -367,6 +392,7 @@ class DashboardHead:
         r.add_get("/api/healthz", self.healthz)
         r.add_get("/api/cluster", self.cluster)
         r.add_get("/api/nodes", self.nodes)
+        r.add_get("/api/nodes/{node_id:[0-9a-f]{8,}}", self.node_detail)
         r.add_get("/api/actors", self.actors)
         r.add_get("/api/actors/{actor_id}", self.actor_detail)
         r.add_get("/api/tasks", self.tasks)

@@ -72,7 +72,9 @@ const pages = {
     return h("div", {}, h("h2", {}, "Nodes"),
       table(["node id", "state", "address", "total", "available", "labels"],
         nodes.map((n) => [
-          (n.NodeID || "").slice(0, 12), badge(n.Alive ? "ALIVE" : "DEAD"),
+          h("a", { class: "plain", href: `#node/${n.NodeID || ""}` },
+            (n.NodeID || "").slice(0, 12)),
+          badge(n.Alive ? "ALIVE" : "DEAD"),
           n.AgentAddress || "", fmtRes(n.Resources), fmtRes(n.Available),
           JSON.stringify(n.Labels || {})])));
   },
@@ -316,6 +318,37 @@ function sparkline(vals, w = 180, ht = 28) {
   return svg;
 }
 
+async function nodeDetail(nodeId) {
+  const d = await api(`nodes/${nodeId}`);
+  const n = d.node || {};
+  const info = d.info || {};
+  const store = info.store || {};
+  const workers = Object.entries(info.workers || {});
+  return h("div", {},
+    h("h2", {}, `Node ${(n.NodeID || nodeId).slice(0, 12)}`),
+    h("div", { class: "cards" },
+      card("state", badge(n.Alive ? "ALIVE" : "DEAD")),
+      card("address", n.AgentAddress || "?"),
+      card("workers", info.num_workers ?? "?"),
+      card("oom kills", info.oom_kills ?? "?"),
+      card("total", fmtRes(n.Resources)),
+      card("available", fmtRes(n.Available))),
+    info.error ? h("p", { class: "err mono" },
+      `agent unreachable: ${info.error}`) : "",
+    h("p", {}, h("a", { class: "plain", href: `#logs/${n.NodeID}` },
+      "» node logs")),
+    Object.keys(store).length
+      ? h("div", {}, h("h2", {}, "Object store"),
+          table(["field", "value"],
+            Object.entries(store).map(([k, v]) => [k, JSON.stringify(v)])))
+      : "",
+    h("h2", {}, `Workers (${workers.length})`),
+    table(["worker id", "state", "pid", "actor"],
+      workers.map(([wid, w]) => [
+        wid.slice(0, 12), badge(w.state), w.pid || "",
+        (w.actor_id || "").slice(0, 12)])));
+}
+
 async function actorDetail(actorId) {
   const d = await api(`actors/${actorId}`);
   const a = d.actor || {};
@@ -380,6 +413,7 @@ async function render() {
     if (hash.startsWith("job/")) view = await jobDetail(hash.slice(4));
     else if (hash.startsWith("actor/")) view = await actorDetail(hash.slice(6));
     else if (hash.startsWith("task/")) view = await taskDetail(hash.slice(5));
+    else if (hash.startsWith("node/")) view = await nodeDetail(hash.slice(5));
     else view = await (pages[hash] || pages.overview)();
     $("#refresh-state").textContent = "updated " + new Date().toLocaleTimeString();
   } catch (e) {

@@ -158,3 +158,36 @@ def test_dashboard_drilldowns_and_metrics(ray_start_regular):
         assert any("raytpu" in k or "_" in k for k in samples)
     finally:
         stop_dashboard()
+
+
+def test_dashboard_node_drilldown(ray_start_regular):
+    """Per-node detail: GCS view row + the agent's live node_info
+    (workers, store stats) behind the SPA's #node/<id> page."""
+    import ray_tpu
+    from ray_tpu.dashboard.head import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    assert ray_tpu.get(warm.remote(), timeout=60) == 1
+    port = start_dashboard(port=0)
+    try:
+        status, body = _get(port, "/api/nodes")
+        nodes = json.loads(body)
+        nid = nodes[0]["NodeID"]
+        status, body = _get(port, f"/api/nodes/{nid}")
+        assert status == 200
+        d = json.loads(body)
+        assert d["node"]["NodeID"] == nid and d["node"]["Alive"]
+        assert d["info"]["node_id"] == nid
+        assert "store" in d["info"] and "workers" in d["info"]
+        # unknown node 404s
+        import urllib.error
+        try:
+            _get(port, "/api/nodes/" + "0" * 32)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        stop_dashboard()
