@@ -1755,8 +1755,14 @@ class CoreWorker:
         else:
             fn = self._load_function(spec.fn_id, spec.job_id)
         args, kwargs = self._resolve_args(spec)
-        token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,
-                                   "actor_id": spec.actor_id, "name": spec.name})
+        ctx = {"task_id": spec.task_id, "job_id": spec.job_id,
+               "actor_id": spec.actor_id, "name": spec.name}
+        if spec.resources:
+            # actor METHOD specs carry no resources — leaving the key out
+            # lets get_assigned_resources fall through to the actor's
+            # creation spec instead of reporting a bogus default
+            ctx["resources"] = dict(spec.resources)
+        token = _task_context.set(ctx)
         # Execution joins the submitter's trace: spans opened by the task and
         # any remote calls it makes chain under the task's span id.
         from ray_tpu.util import tracing as _tracing
@@ -1900,8 +1906,14 @@ class CoreWorker:
         cls = self._load_function(spec.fn_id, spec.job_id)
         args, kwargs = self._resolve_args(spec)
         from .runtime_context import _task_context
-        token = _task_context.set({"task_id": spec.task_id, "job_id": spec.job_id,
-                                   "actor_id": spec.actor_id, "name": spec.name})
+        ctx = {"task_id": spec.task_id, "job_id": spec.job_id,
+               "actor_id": spec.actor_id, "name": spec.name}
+        if spec.resources:
+            # actor METHOD specs carry no resources — leaving the key out
+            # lets get_assigned_resources fall through to the actor's
+            # creation spec instead of reporting a bogus default
+            ctx["resources"] = dict(spec.resources)
+        token = _task_context.set(ctx)
         try:
             self.actor_instance = cls(*args, **kwargs)
         finally:

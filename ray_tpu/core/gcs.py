@@ -396,6 +396,19 @@ class GcsServer:
             await self._on_actor_failure(actor_id, reason)
         return True
 
+    async def handle_list_named_actors(self, namespace: str = "default",
+                                       all_namespaces: bool = False):
+        """Live named actors (reference: ``GcsActorManager::ListNamedActors``
+        behind ``ray.util.list_named_actors``)."""
+        out = []
+        for (ns, name), aid in self.named_actors.items():
+            info = self.actors.get(aid)
+            if info is None or info.get("state") == "DEAD":
+                continue
+            if all_namespaces or ns == namespace:
+                out.append({"namespace": ns, "name": name})
+        return out
+
     async def handle_get_actor_info(self, actor_id: Optional[str] = None,
                                     name: Optional[str] = None,
                                     namespace: str = "default"):

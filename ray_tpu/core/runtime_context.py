@@ -42,6 +42,25 @@ class RuntimeContext:
     def get_worker_id(self) -> str:
         return self._worker.worker_id.hex()
 
+    def get_assigned_resources(self) -> dict:
+        """The resource amounts this task/actor was scheduled with
+        (reference: ``runtime_context.get_assigned_resources``)."""
+        ctx = _task_context.get()
+        if ctx and "resources" in ctx:
+            return dict(ctx["resources"]) or {"CPU": 1.0}
+        w = self._worker
+        if w.actor_spec is not None:
+            return dict(w.actor_spec.resources or {}) or {"CPU": 1.0}
+        return {}
+
+    def get_accelerator_ids(self) -> dict:
+        """Accelerator ids visible to this worker (reference:
+        ``get_accelerator_ids``/``get_gpu_ids`` — here the TPU chips the
+        scheduler granted, from TPU_VISIBLE_CHIPS)."""
+        import os
+        raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
+        return {"TPU": [c for c in raw.split(",") if c]}
+
     @property
     def was_current_actor_reconstructed(self) -> bool:
         return False

@@ -5,6 +5,7 @@ controller event loop → trials as actors; search spaces; ASHA / median /
 PBT schedulers; per-trial checkpoints; experiment state snapshots.
 """
 
+from .helpers import with_parameters, with_resources
 from .search import (BasicVariantGenerator, BayesOptSearcher, BOHBSearcher,
                      Categorical, Domain, Float, GridSearch, Integer,
                      Searcher, TPESearcher, choice, grid_search, lograndint,

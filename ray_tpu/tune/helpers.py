@@ -1,0 +1,41 @@
+"""Trainable wrappers (reference: ``python/ray/tune/trainable/util.py`` —
+``tune.with_resources`` and ``tune.with_parameters``)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict
+
+
+def with_resources(trainable: Callable, resources: Dict[str, float]):
+    """Attach a per-trial resource request to a trainable (reference:
+    ``tune.with_resources``).  The Tuner reads the annotation instead of
+    needing ``resources_per_trial`` threaded through.  Always returns a
+    FRESH wrapper — annotating the argument in place would alias every
+    earlier wrapping of the same trainable."""
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        return trainable(config)
+
+    if hasattr(trainable, "_raytpu_params"):
+        wrapped._raytpu_params = trainable._raytpu_params
+    wrapped._raytpu_resources = dict(resources)
+    return wrapped
+
+
+def with_parameters(trainable: Callable, **parameters: Any):
+    """Partially apply large/constant objects OUTSIDE the config dict
+    (reference: ``tune.with_parameters`` — the reference stores them in
+    the object store once; here the wrapper ships by value with the
+    function, which the function registry already stores once per
+    cluster)."""
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        return trainable(config, **parameters)
+
+    wrapped._raytpu_params = dict(parameters)
+    if hasattr(trainable, "_raytpu_resources"):
+        wrapped._raytpu_resources = trainable._raytpu_resources
+    return wrapped

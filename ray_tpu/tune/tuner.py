@@ -99,6 +99,10 @@ class Tuner:
         if isinstance(trainable, BaseTrainer):
             trainable = trainable.as_trainable()
         self.trainable = trainable
+        if self.resources_per_trial is None:
+            # tune.with_resources annotation on the trainable
+            self.resources_per_trial = getattr(
+                trainable, "_raytpu_resources", None)
         self._restored: Optional[tuple] = None  # (experiment_dir, trials, searcher)
 
     @classmethod

@@ -6,7 +6,9 @@ scheduling strategies (those live in ray_tpu.core), state API
 """
 
 from .actor_pool import ActorPool
+from .misc import inspect_serializability, list_named_actors
 from .pubsub import Subscriber, publish
 from .queue import Empty, Full, Queue
 
-__all__ = ["ActorPool", "Queue", "Empty", "Full", "Subscriber", "publish"]
+__all__ = ["ActorPool", "Queue", "Empty", "Full", "Subscriber", "publish",
+           "list_named_actors", "inspect_serializability"]

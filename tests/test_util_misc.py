@@ -1,0 +1,130 @@
+"""Small ray.util / tune / runtime-context parity APIs (reference:
+``ray.util.list_named_actors``, ``ray.util.inspect_serializability``,
+``tune.with_resources``/``with_parameters``,
+``runtime_context.get_assigned_resources``)."""
+
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import inspect_serializability, list_named_actors
+
+
+def test_list_named_actors(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="alpha").remote()
+    b = A.options(name="beta", namespace="other").remote()
+    anon = A.remote()
+    ray_tpu.get([a.ping.remote(), b.ping.remote(), anon.ping.remote()],
+                timeout=60)
+    assert sorted(list_named_actors()) == ["alpha"]
+    both = list_named_actors(all_namespaces=True)
+    assert {(r["namespace"], r["name"]) for r in both} == {
+        ("default", "alpha"), ("other", "beta")}
+    ray_tpu.kill(a)
+    # dead actors drop from the listing
+    import time
+    deadline = time.monotonic() + 15
+    while "alpha" in list_named_actors():
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+
+
+def test_inspect_serializability(capsys):
+    ok, failed = inspect_serializability(lambda x: x + 1)
+    assert ok and not failed
+
+    lock = threading.Lock()
+
+    def poisoned():
+        return lock  # closure over an unpicklable lock
+
+    ok, failed = inspect_serializability(poisoned, name="poisoned")
+    assert not ok
+    assert any("lock" in f for f in failed), failed
+    out = capsys.readouterr().out
+    assert "closure var 'lock'" in out
+
+
+def test_with_resources_and_parameters(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+
+    big = list(range(1000))  # a "large" constant shipped outside config
+
+    def trainable(config, data=None):
+        tune.report({"score": config["x"] + len(data)})
+
+    wrapped = tune.with_resources(
+        tune.with_parameters(trainable, data=big), {"CPU": 1})
+    assert wrapped._raytpu_resources == {"CPU": 1}
+    tuner = tune.Tuner(
+        wrapped,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    assert tuner.resources_per_trial == {"CPU": 1}
+    results = tuner.fit()
+    assert sorted(r.metrics["score"] for r in results) == [1001, 1002]
+
+
+def test_assigned_resources_and_accelerators(ray_start_regular):
+    @ray_tpu.remote(num_cpus=2, resources={"slot": 1.0})
+    def what_do_i_have():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_assigned_resources(), ctx.get_accelerator_ids()
+
+    from ray_tpu.experimental import set_resource
+    set_resource("slot", 2.0)
+    res, acc = ray_tpu.get(what_do_i_have.remote(), timeout=60)
+    assert res == {"CPU": 2.0, "slot": 1.0}
+    assert acc == {"TPU": []}  # no chips granted on the CPU test box
+    set_resource("slot", 0)
+
+
+def test_assigned_resources_in_actor_method(ray_start_regular):
+    """Actor METHODS report the actor's creation resources (method specs
+    carry none; the context falls through to the actor spec)."""
+    @ray_tpu.remote(num_cpus=2, resources={"slot": 1.0})
+    class Holder:
+        def mine(self):
+            return ray_tpu.get_runtime_context().get_assigned_resources()
+
+    from ray_tpu.experimental import set_resource
+    set_resource("slot", 1.0)
+    h = Holder.remote()
+    assert ray_tpu.get(h.mine.remote(), timeout=60) == {
+        "CPU": 2.0, "slot": 1.0}
+    ray_tpu.kill(h)
+    set_resource("slot", 0)
+
+
+def test_with_resources_returns_fresh_wrapper():
+    from ray_tpu import tune
+
+    def trainable(config, data=None):
+        return None
+
+    w = tune.with_parameters(trainable, data=[1])
+    t1 = tune.with_resources(w, {"CPU": 1})
+    t2 = tune.with_resources(w, {"CPU": 4})
+    assert t1 is not t2 and t1 is not w
+    assert t1._raytpu_resources == {"CPU": 1}
+    assert t2._raytpu_resources == {"CPU": 4}
+
+
+def test_inspect_serializability_cycle():
+    import threading
+
+    lock = threading.Lock()
+
+    def poisoned():
+        return lock
+
+    poisoned.ref = poisoned  # self-reference must not blow the stack
+    ok, failed = inspect_serializability(poisoned, name="cyclic")
+    assert not ok
