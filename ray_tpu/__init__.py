@@ -15,7 +15,7 @@ from .core import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                    PlacementGroup,
                    PlacementGroupSchedulingStrategy, RayTpuError, TaskError,
                    WorkerCrashedError, as_future, available_resources, cancel,
-                   cluster_resources, get, get_actor, get_async, get_runtime_context,
+                   cluster_resources, exit_actor, get, get_actor, get_async, get_runtime_context,
                    init, is_initialized, kill, method, nodes, placement_group,
                    placement_group_table, put, remote, remove_placement_group,
                    shutdown, timeline, wait)
@@ -24,7 +24,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "get_async", "as_future", "nodes",
+    "kill", "cancel", "get_actor", "exit_actor", "get_async", "as_future", "nodes",
     "cluster_resources", "available_resources", "timeline", "ObjectRef",
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroup", "ObjectRefGenerator", "get_runtime_context", "TaskError", "RayTpuError",

@@ -5,8 +5,9 @@ Layering mirrors SURVEY.md §1 L1-L3: rpc/object_store/gcs/node_agent are the
 public verb surface.  Import stays light (no jax) so worker startup is fast.
 """
 
-from .api import (as_future, available_resources, cancel, cluster_resources, get,
-                  get_actor, get_async, init, is_initialized, kill, method, nodes,
+from .api import (as_future, available_resources, cancel, cluster_resources,
+                  exit_actor, get, get_actor, get_async, init, is_initialized,
+                  kill, method, nodes,
                   put, remote, shutdown, timeline, wait)
 from .common import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                      NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
@@ -21,7 +22,7 @@ from .runtime_context import get_runtime_context
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "get_async", "as_future", "nodes",
+    "kill", "cancel", "get_actor", "exit_actor", "get_async", "as_future", "nodes",
     "cluster_resources", "available_resources", "timeline", "ObjectRef",
     "ObjectRefGenerator", "OutOfMemoryError",
     "placement_group", "remove_placement_group", "placement_group_table",

@@ -201,6 +201,25 @@ class ActorClass:
                         f"directly; use {self.__name__}.remote()")
 
 
+class ActorExitRequest(BaseException):
+    """Raised by ``exit_actor()``; recognized by the executor as an
+    INTENDED termination (BaseException so a method's broad ``except
+    Exception`` cannot swallow the exit — same reasoning as SystemExit)."""
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods
+    (reference: ``ray.actor.exit_actor``).  The in-flight call fails with
+    a typed intended-exit ActorDiedError, the actor is marked DEAD with
+    no restart (even with ``max_restarts``), and the worker process
+    exits."""
+    from .core_worker import global_worker_or_none
+    w = global_worker_or_none()
+    if w is None or w.actor_instance is None:
+        raise RuntimeError("exit_actor() called outside an actor method")
+    raise ActorExitRequest()
+
+
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     from .core_worker import global_worker
     w = global_worker()

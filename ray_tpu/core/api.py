@@ -18,7 +18,8 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
-from .actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from .actor import (ActorClass, ActorHandle, exit_actor,  # noqa: F401
+                    get_actor)
 from .common import GetTimeoutError, TaskError  # noqa: F401
 from .config import Config, get_config, set_config
 from .core_worker import CoreWorker, global_worker, global_worker_or_none

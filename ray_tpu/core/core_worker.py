@@ -244,7 +244,11 @@ class TaskManager:
             if st is not None:
                 self._w.memory_store.put(
                     ObjectID.for_task_return(task_id, st.available),
-                    ErrorRecord(results[0][1]))
+                    # third element marks runtime-recorded faults (e.g. an
+                    # exit_actor inside a generator) — keep them typed
+                    ErrorRecord(results[0][1],
+                                results[0][2] if len(results[0]) > 2
+                                else False))
                 st.available += 1
                 st.total = st.available
                 st.signal()
@@ -833,7 +837,10 @@ class CoreWorker:
         elif kind == "plasma":
             self.memory_store.put(oid, PlasmaRecord(res[1], res[2]))
         elif kind == "error":
-            self.memory_store.put(oid, ErrorRecord(res[1]))
+            # optional third element marks a RUNTIME-recorded fault (e.g.
+            # exit_actor's intended-death record) so get raises it typed
+            self.memory_store.put(oid, ErrorRecord(
+                res[1], res[2] if len(res) > 2 else False))
         else:
             raise ValueError(f"bad result kind {kind}")
 
@@ -1209,8 +1216,11 @@ class CoreWorker:
                 tgt.address = None
                 info = await self.gcs.call("get_actor_info", actor_id=actor_id)
                 if info is None or info["state"] == "DEAD":
-                    err = ActorDiedError(actor_id,
-                                         f"actor {actor_id[:12]} died")
+                    cause = (info or {}).get("death_cause")
+                    err = ActorDiedError(
+                        actor_id,
+                        f"actor {actor_id[:12]} died"
+                        + (f": {cause}" if cause else ""))
                     for s in specs:
                         self.task_manager.fail(s.task_id, err)
                     return
@@ -1696,9 +1706,47 @@ class CoreWorker:
                 return self._execute_actor_creation(spec)
             return self._execute_task(spec)
         except BaseException as e:  # noqa: BLE001
+            from .actor import ActorExitRequest
+            if isinstance(e, ActorExitRequest) and spec.is_actor_task:
+                # exit_actor(): intended termination — pre-report the
+                # expected death (GCS marks DEAD, no restart burn), answer
+                # the in-flight call with a typed intended-exit error, and
+                # leave the process once the reply flushes.
+                self._begin_intended_exit(spec)
+                err = ActorDiedError(
+                    spec.actor_id.hex(),
+                    f"actor {spec.actor_id.hex()[:12]} exited via "
+                    "exit_actor() (intended)")
+                return [("error", pickle.dumps((err, "")), True)
+                        for _ in range(max(1, spec.num_returns))]
             tb = traceback.format_exc()
             return [("error", pickle.dumps((_strip_exc(e), tb)))
                     for _ in range(max(1, spec.num_returns))]
+
+    def _begin_intended_exit(self, spec: TaskSpec):
+        # Mark the exit intended at BOTH authorities: the agent flag makes
+        # the process-exit backstop report expected=True (so a lost GCS
+        # report cannot burn a restart), the direct GCS report makes the
+        # death visible before the process is even gone.
+        try:
+            run_async(self.agent.call("worker_intended_exit",
+                                      worker_id=self.worker_id.hex()),
+                      timeout=5)
+        except Exception:
+            pass
+        try:
+            run_async(self.gcs.call(
+                "report_actor_death", actor_id=spec.actor_id.hex(),
+                reason="exit_actor() (intended)", expected=True), timeout=10)
+        except Exception:
+            pass
+        # Exit AFTER the typed reply has had time to flush.  Timers must be
+        # armed from the loop thread (call_later off-thread races the
+        # selector); 2s covers a loaded box's coalesced-write backlog, and
+        # a dropped reply still surfaces typed via the caller's
+        # ConnectionLost -> GCS death_cause fallback.
+        loop = get_loop()
+        loop.call_soon_threadsafe(lambda: loop.call_later(2.0, os._exit, 0))
 
     def _execute_and_reply(self, spec: TaskSpec, fut, loop):
         results = self._execute_one(spec)

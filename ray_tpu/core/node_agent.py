@@ -63,6 +63,9 @@ class WorkerHandle:
     #: (runtime_path, container_name) for containerized workers — killing
     #: the `run` client does not stop the container; teardown must `rm -f`.
     container_ref: Optional[tuple] = None
+    #: exit_actor(): the coming process exit is INTENDED — the exit
+    #: backstop must report expected=True, never burn a restart
+    intended_exit: bool = False
 
 
 @dataclass
@@ -360,8 +363,16 @@ class NodeAgent:
                 self._release_lease_resources(w.lease_id)
         if w.is_actor and w.actor_id and not self._shutting_down:
             try:
-                await self.gcs.call("report_actor_death", actor_id=w.actor_id,
-                                    reason=reason)
+                if w.intended_exit:
+                    # exit_actor(): the worker announced the exit before
+                    # dying — even if its own GCS report was lost, this
+                    # backstop must not trigger a restart
+                    await self.gcs.call(
+                        "report_actor_death", actor_id=w.actor_id,
+                        reason="exit_actor() (intended)", expected=True)
+                else:
+                    await self.gcs.call("report_actor_death",
+                                        actor_id=w.actor_id, reason=reason)
             except Exception:
                 pass
             if w.lease_id:
@@ -561,6 +572,15 @@ class NodeAgent:
             w.blocked = False
             res = self._lease_resources.get(w.lease_id or "", {})
             self.available.force_acquire(res)
+        return True
+
+    async def handle_worker_intended_exit(self, worker_id: str):
+        """A worker announces its coming exit is deliberate (exit_actor):
+        the process-exit backstop reports expected=True so no restart is
+        burned even if the worker's own GCS report was lost."""
+        w = self.workers.get(worker_id)
+        if w is not None:
+            w.intended_exit = True
         return True
 
     async def handle_set_resource(self, name: str, capacity: float):

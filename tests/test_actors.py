@@ -204,3 +204,37 @@ def test_get_if_exists_concurrent_race(ray_start_regular):
                           timeout=120)
     # all four bumped ONE counter: the results are 1..4 in some order
     assert sorted(results) == [1, 2, 3, 4], results
+
+
+def test_exit_actor_intended_termination(ray_start_regular):
+    """exit_actor() inside a method (reference: ray.actor.exit_actor):
+    the in-flight call fails with a typed intended-exit error, the actor
+    dies WITHOUT burning restarts (even with max_restarts), and
+    exit_actor outside an actor is rejected."""
+    import time
+
+    @ray_tpu.remote(max_restarts=3)
+    class Quitter:
+        def ping(self):
+            return "pong"
+
+        def leave(self):
+            ray_tpu.exit_actor()
+            return "never"  # unreachable
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.ping.remote(), timeout=60) == "pong"
+    with pytest.raises(ray_tpu.ActorDiedError, match="intended"):
+        ray_tpu.get(q.leave.remote(), timeout=60)
+    # DEAD for good: max_restarts must NOT resurrect it
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            ray_tpu.get(q.ping.remote(), timeout=10)
+        except (ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError):
+            break
+        assert time.monotonic() < deadline, "actor still alive after exit"
+        time.sleep(0.2)
+
+    with pytest.raises(RuntimeError, match="outside an actor"):
+        ray_tpu.exit_actor()
