@@ -13,8 +13,9 @@ inside jit, data-parallel over a ``jax.sharding.Mesh`` with XLA allreduce
 ACTOR processes on one ``jax.distributed`` mesh (learner_group.py).
 
 Algorithms: PPO and A2C (MLP + conv), DQN, SAC, DDPG, TD3, IMPALA/APPO (V-trace,
-decoupled async sampling), BC/MARWIL offline; multi-agent dict envs;
-external-env protocol (PolicyServerInput/PolicyClient over HTTP).
+decoupled async sampling), ES/ARS (derivative-free, seed-replicated noise),
+BC/MARWIL/CQL offline; multi-agent dict envs; external-env protocol
+(PolicyServerInput/PolicyClient over HTTP).
 """
 
 from .a2c import A2C, A2CConfig
@@ -22,6 +23,7 @@ from .conv import ActorCriticConv
 from .ddpg import DDPG, DDPGConfig
 from .dqn import DQN, DQNConfig, QNetwork
 from .env_runner import EnvRunner
+from .es import ARS, ARSConfig, ES, ESConfig
 from .external import PolicyClient, PolicyServerInput
 from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner, LearnerGroup
@@ -29,7 +31,8 @@ from .learner_group import DistributedLearnerGroup, LearnerWorker
 from .models import ActorCriticMLP, build_model
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
                           RockPaperScissors)
-from .offline import (BCConfig, MARWIL, MARWILConfig, OfflineDataset,
+from .offline import (BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig,
+                      OfflineDataset, TransitionDataset,
                       collect_episodes, write_episodes)
 from .ppo import PPO, PPOConfig
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
@@ -39,8 +42,10 @@ from .td3 import TD3, TD3Config
 __all__ = ["PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
            "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+           "ES", "ESConfig", "ARS", "ARSConfig",
            "PolicyClient", "PolicyServerInput",
-           "BCConfig", "MARWIL", "MARWILConfig", "OfflineDataset",
+           "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
+           "OfflineDataset", "TransitionDataset",
            "collect_episodes", "write_episodes",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
            "RockPaperScissors",

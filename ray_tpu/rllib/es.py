@@ -1,0 +1,389 @@
+"""ES + ARS: derivative-free policy search over an actor fan-out.
+
+Reference: ``rllib/algorithms/es/es.py`` (Salimans et al. evolution
+strategies: antithetic gaussian perturbations, centered-rank fitness
+shaping, SharedNoiseTable workers) and ``rllib/algorithms/ars/ars.py``
+(Augmented Random Search: top-k direction selection, reward-std step
+scaling, MeanStdFilter observation normalization).
+
+Design here: instead of shipping a 250MB shared noise table to every
+worker (the reference's SharedNoiseTable), workers regenerate each
+perturbation from a 64-bit seed — the wire cost per direction is ONE
+int + two floats back, and the driver reconstructs the same noise for
+the update. The update itself is a single jitted rank-weighted matvec
+``theta += lr/(n*sigma) * w @ eps`` on device; evaluation is
+embarrassingly parallel over ``num_env_runners`` actors, which is the
+whole point of running ES on a cluster.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ES", "ESConfig", "ARS", "ARSConfig"]
+
+
+def _noise(seed: int, dim: int) -> np.ndarray:
+    """The perturbation for a seed — identical on worker and driver."""
+    return np.random.default_rng(seed).standard_normal(dim).astype(np.float32)
+
+
+def centered_rank(x: np.ndarray) -> np.ndarray:
+    """Map fitnesses to centered ranks in [-0.5, 0.5] (fitness shaping:
+    makes the update invariant to reward scale and outliers)."""
+    flat = x.ravel()
+    ranks = np.empty(len(flat), dtype=np.float32)
+    ranks[flat.argsort()] = np.arange(len(flat), dtype=np.float32)
+    ranks = ranks / (len(flat) - 1) - 0.5
+    return ranks.reshape(x.shape)
+
+
+class _RunningStat:
+    """Chan-merge running mean/std for observation filtering (reference:
+    ray/rllib/utils/filter.py MeanStdFilter semantics)."""
+
+    def __init__(self, dim: int):
+        self.count = 0.0
+        self.mean = np.zeros(dim, np.float64)
+        self.m2 = np.zeros(dim, np.float64)
+
+    def merge(self, count: float, mean: np.ndarray, m2: np.ndarray):
+        if count == 0:
+            return
+        delta = mean - self.mean
+        tot = self.count + count
+        self.mean += delta * (count / tot)
+        self.m2 += m2 + delta * delta * (self.count * count / tot)
+        self.count = tot
+
+    def stats(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self.count < 2:
+            return self.mean.astype(np.float32), np.ones_like(
+                self.mean, dtype=np.float32)
+        std = np.sqrt(np.maximum(self.m2 / (self.count - 1), 1e-8))
+        return self.mean.astype(np.float32), std.astype(np.float32)
+
+
+class ESPolicy:
+    """Deterministic MLP policy. ES perturbs the flat parameter vector, so
+    the policy carries its own flatten/unflatten mapping (ravel_pytree)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(64, 64),
+                 continuous: bool = False, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        self.continuous = continuous
+        key = jax.random.PRNGKey(seed)
+        sizes = (obs_dim,) + tuple(hidden) + (action_dim,)
+        params = []
+        for i in range(len(sizes) - 1):
+            key, sub = jax.random.split(key)
+            w = jax.random.normal(sub, (sizes[i], sizes[i + 1])) \
+                / np.sqrt(sizes[i])
+            params.append({"w": w, "b": jnp.zeros(sizes[i + 1])})
+        flat, self._unravel = ravel_pytree(params)
+        self.dim = int(flat.shape[0])
+        self.theta0 = np.asarray(flat, np.float32)
+
+        def forward(flat_theta, obs):
+            layers = self._unravel(flat_theta)
+            h = obs
+            for i, lyr in enumerate(layers):
+                h = h @ lyr["w"] + lyr["b"]
+                if i < len(layers) - 1:
+                    h = jnp.tanh(h)
+            return h
+
+        self._forward = jax.jit(forward)
+
+    def act(self, theta: np.ndarray, obs: np.ndarray):
+        out = np.asarray(self._forward(theta, obs.astype(np.float32)))
+        if self.continuous:
+            return np.tanh(out)
+        return int(out.argmax())
+
+
+class ESWorker:
+    """Evaluation actor: regenerates each direction's noise from its seed,
+    rolls the antithetic pair, returns (ret+, ret-, len+, len-) per seed
+    plus batched observation statistics for the driver's filter merge."""
+
+    def __init__(self, env_name: str, spec: Dict[str, Any], seed: int = 0,
+                 env_config: Optional[dict] = None,
+                 episode_horizon: int = 1000):
+        import gymnasium as gym
+
+        from . import examples_env  # noqa: F401 — registers Catch-v0
+        self.env = gym.make(env_name, **(env_config or {}))
+        self.policy = ESPolicy(**spec, seed=seed)
+        self.horizon = episode_horizon
+        self._ep_seed = seed
+
+    def _rollout(self, theta: np.ndarray, mean: np.ndarray,
+                 std: np.ndarray, collect) -> Tuple[float, int]:
+        obs, _ = self.env.reset(seed=self._ep_seed)
+        self._ep_seed += 1
+        total, steps = 0.0, 0
+        for _ in range(self.horizon):
+            flat = np.asarray(obs, np.float32).ravel()
+            if collect is not None:
+                collect.append(flat)
+            a = self.policy.act(theta, (flat - mean) / std)
+            obs, r, term, trunc, _ = self.env.step(a)
+            total += float(r)
+            steps += 1
+            if term or trunc:
+                break
+        return total, steps
+
+    def evaluate(self, theta_blob, seeds: List[int], sigma: float,
+                 mean: np.ndarray, std: np.ndarray) -> Dict[str, Any]:
+        theta = np.asarray(theta_blob, np.float32)
+        rets, lens, obs_acc = [], [], []
+        for s in seeds:
+            eps = _noise(int(s), self.policy.dim)
+            rp, lp = self._rollout(theta + sigma * eps, mean, std, obs_acc)
+            rn, ln = self._rollout(theta - sigma * eps, mean, std, obs_acc)
+            rets.append((rp, rn))
+            lens.append((lp, ln))
+        if obs_acc:
+            batch = np.stack(obs_acc).astype(np.float64)
+            stats = (float(len(batch)), batch.mean(0),
+                     ((batch - batch.mean(0)) ** 2).sum(0))
+        else:
+            stats = (0.0, 0.0, 0.0)
+        return {"returns": np.asarray(rets, np.float32),
+                "lengths": np.asarray(lens, np.int64),
+                "obs_stats": stats}
+
+    def rollout_current(self, theta_blob, mean, std) -> float:
+        """Unperturbed evaluation episode (reference: eval_prob rollouts)."""
+        ret, _ = self._rollout(np.asarray(theta_blob, np.float32),
+                               mean, std, None)
+        return ret
+
+    def ping(self) -> bool:
+        return True
+
+
+class ESConfig:
+    """Builder (reference: ESConfig fluent API)."""
+
+    _algo_cls: Optional[type] = None
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.cfg: Dict[str, Any] = dict(
+            num_perturbations=32,   # antithetic pairs per iteration
+            sigma=0.02,             # noise stddev (reference: noise_stdev)
+            lr=0.01,                # step size
+            l2_coeff=0.005,         # weight decay toward 0
+            hidden=(64, 64),
+            episode_horizon=1000,
+            observation_filter="MeanStdFilter",
+            eval_episodes=4,        # unperturbed rollouts per iteration
+        )
+        self.seed = 0
+
+    def environment(self, env: str, *, env_config: Optional[dict] = None):
+        self.env_name = env
+        self.env_config = env_config or {}
+        return self
+
+    def env_runners(self, num_env_runners: int = 2, **_):
+        self.num_env_runners = num_env_runners
+        return self
+
+    def training(self, **kwargs):
+        self.cfg.update(kwargs)
+        return self
+
+    def debugging(self, seed: int = 0):
+        self.seed = seed
+        return self
+
+    def build(self) -> "ES":
+        if not self.env_name:
+            raise ValueError("call .environment(env_name) first")
+        return (self._algo_cls or ES)(self)
+
+
+class ES:
+    """Driver: seed fan-out -> antithetic evaluation -> jitted rank update.
+
+    ``train()`` returns the usual result dict (episode_return_mean, ...)
+    so ES drops into Tune like every other algorithm here.
+    """
+
+    def __init__(self, config: ESConfig):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        from . import examples_env  # noqa: F401
+
+        self.config = config
+        cfg = config.cfg
+        probe = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        continuous = not hasattr(probe.action_space, "n")
+        action_dim = (probe.action_space.shape[0] if continuous
+                      else int(probe.action_space.n))
+        probe.close()
+        self.spec = dict(obs_dim=obs_dim, action_dim=action_dim,
+                         hidden=tuple(cfg["hidden"]), continuous=continuous)
+        policy = ESPolicy(**self.spec, seed=config.seed)
+        self.dim = policy.dim
+        self.theta = policy.theta0.copy()
+        self._policy = policy
+        self.filter = _RunningStat(obs_dim)
+        self._use_filter = cfg["observation_filter"] == "MeanStdFilter"
+        self._seed_seq = np.random.SeedSequence(config.seed)
+        worker_cls = ray_tpu.remote(ESWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=1).remote(
+                config.env_name, self.spec, seed=config.seed + 1000 * i,
+                env_config=config.env_config,
+                episode_horizon=cfg["episode_horizon"])
+            for i in range(config.num_env_runners)]
+        self._iteration = 0
+        self._timesteps = 0
+
+        lr, l2 = float(cfg["lr"]), float(cfg["l2_coeff"])
+
+        def apply_update(theta, eps, w, denom):
+            # rank-weighted matvec + weight decay, one fused XLA program
+            g = (w @ eps) / denom
+            return theta + lr * g - lr * l2 * theta
+
+        self._apply_update = jax.jit(apply_update)
+        self._jnp = jnp
+
+    # -- one iteration -----------------------------------------------------
+    def _direction_weights(self, rets: np.ndarray) -> Tuple[np.ndarray,
+                                                            np.ndarray,
+                                                            float]:
+        """ES weighting: centered-rank-shape all 2n returns, weight each
+        direction by rank(ret+) - rank(ret-). Returns (weights, used-return
+        mask over directions, denominator)."""
+        shaped = centered_rank(rets)
+        w = shaped[:, 0] - shaped[:, 1]
+        n = float(len(rets))
+        return w, np.ones(len(rets), bool), n * float(self.config.cfg["sigma"])
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config.cfg
+        t0 = time.time()
+        n = int(cfg["num_perturbations"])
+        seeds = [int(s.generate_state(1)[0]) for s in
+                 self._seed_seq.spawn(n)]
+        mean, std = (self.filter.stats() if self._use_filter else
+                     (np.zeros(self.spec["obs_dim"], np.float32),
+                      np.ones(self.spec["obs_dim"], np.float32)))
+        theta_ref = ray_tpu.put(self.theta)
+        chunks = np.array_split(np.asarray(seeds), len(self.workers))
+        futs = [w.evaluate.remote(theta_ref, [int(x) for x in chunk],
+                                  float(cfg["sigma"]), mean, std)
+                for w, chunk in zip(self.workers, chunks) if len(chunk)]
+        outs = ray_tpu.get(futs, timeout=600)
+        rets = np.concatenate([o["returns"] for o in outs])     # [n, 2]
+        lens = np.concatenate([o["lengths"] for o in outs])
+        if self._use_filter:
+            for o in outs:
+                c, m, m2 = o["obs_stats"]
+                if c:
+                    self.filter.merge(c, m, m2)
+
+        w, used, denom = self._direction_weights(rets)
+        idx = np.flatnonzero(used)
+        eps = np.stack([_noise(seeds[i], self.dim) for i in idx])
+        self.theta = np.asarray(self._apply_update(
+            self.theta, eps, w[idx].astype(np.float32), float(denom)),
+            np.float32)
+
+        # unperturbed evaluation rollouts for the reported return
+        eval_rets = ray_tpu.get(
+            [self.workers[i % len(self.workers)].rollout_current.remote(
+                self.theta, mean, std)
+             for i in range(int(cfg["eval_episodes"]))], timeout=600)
+        self._iteration += 1
+        self._timesteps += int(lens.sum())
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": float(np.mean(eval_rets)),
+            "perturbed_return_mean": float(rets.mean()),
+            "timesteps_total": self._timesteps,
+            "num_perturbations": n,
+            "theta_norm": float(np.linalg.norm(self.theta)),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    # -- checkpoint surface (Tune trainable protocol) ----------------------
+    def get_weights(self) -> Dict[str, Any]:
+        return {"theta": self.theta.copy(),
+                "filter": (self.filter.count, self.filter.mean.copy(),
+                           self.filter.m2.copy())}
+
+    def set_weights(self, blob: Dict[str, Any]):
+        self.theta = np.asarray(blob["theta"], np.float32).copy()
+        c, m, m2 = blob["filter"]
+        self.filter.count = c
+        self.filter.mean = np.asarray(m, np.float64).copy()
+        self.filter.m2 = np.asarray(m2, np.float64).copy()
+
+    def compute_single_action(self, obs: np.ndarray):
+        mean, std = (self.filter.stats() if self._use_filter else
+                     (0.0, 1.0))
+        flat = np.asarray(obs, np.float32).ravel()
+        return self._policy.act(self.theta, (flat - mean) / std)
+
+    def stop(self):
+        import ray_tpu
+        for w in self.workers:
+            ray_tpu.kill(w)
+
+
+class ARSConfig(ESConfig):
+    """ARS (reference: ARSConfig): fewer, bigger steps — top-k direction
+    selection and reward-std scaling instead of rank shaping."""
+
+    def __init__(self):
+        super().__init__()
+        self.cfg.update(
+            num_perturbations=16,
+            top_k=8,            # reference: num_top_directions
+            sigma=0.03,
+            lr=0.02,
+            l2_coeff=0.0,       # ARS does not regularize
+        )
+
+
+class ARS(ES):
+    """ARS-v2: keep the top-k directions by best-of-pair return, step by
+    the raw return difference scaled by the std of the used returns."""
+
+    def _direction_weights(self, rets: np.ndarray):
+        cfg = self.config.cfg
+        k = min(int(cfg.get("top_k", len(rets))), len(rets))
+        order = np.argsort(rets.max(axis=1))[::-1][:k]
+        used = np.zeros(len(rets), bool)
+        used[order] = True
+        sigma_r = float(rets[order].std()) + 1e-8
+        w = np.zeros(len(rets), np.float32)
+        w[order] = rets[order, 0] - rets[order, 1]
+        return w, used, k * sigma_r
+
+
+ESConfig._algo_cls = ES
+ARSConfig._algo_cls = ARS

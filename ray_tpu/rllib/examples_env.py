@@ -58,3 +58,31 @@ if gym is not None:
             return self._obs(), reward, done, False, {}
 
     gym.register(id="Catch-v0", entry_point=CatchEnv)
+
+    class BiasBanditEnv(gym.Env):
+        """8-step two-armed bandit with a constant observation: reward
+        equals the chosen action. The smallest env whose optimum a policy
+        must LEARN (bias toward arm 1) — CI smoke target for the
+        derivative-free algorithms (es.py), where a few iterations must
+        visibly move the policy."""
+
+        HORIZON = 8
+
+        def __init__(self, render_mode=None):
+            self.observation_space = spaces.Box(-1.0, 1.0, (2,), np.float32)
+            self.action_space = spaces.Discrete(2)
+            self._t = 0
+
+        def _obs(self):
+            return np.array([1.0, -1.0], np.float32)
+
+        def reset(self, *, seed=None, options=None):
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, action):
+            self._t += 1
+            return (self._obs(), float(action), self._t >= self.HORIZON,
+                    False, {})
+
+    gym.register(id="Bandit-v0", entry_point=BiasBanditEnv)
