@@ -245,5 +245,176 @@ class MARWIL:
         return float(np.mean([sum(ep["rewards"]) for ep in eps]))
 
 
+# ---------------------------------------------------------------------------
+# CQL — conservative Q-learning from the same JSONL corpora
+# ---------------------------------------------------------------------------
+
+class TransitionDataset:
+    """(obs, action, reward, next_obs, done) tuples for Q-learning.
+
+    Same JSONL wire shape as OfflineDataset; the episode's last transition
+    bootstraps to a terminal next state (done=1)."""
+
+    def __init__(self, obs, actions, rewards, next_obs, dones):
+        self.obs = obs
+        self.actions = actions
+        self.rewards = rewards
+        self.next_obs = next_obs
+        self.dones = dones
+
+    def __len__(self):
+        return len(self.obs)
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> "TransitionDataset":
+        obs, acts, rews, nxt, dones = [], [], [], [], []
+        with open(path) as f:
+            for line in f:
+                ep = json.loads(line)
+                o = np.asarray(ep["obs"], np.float32)
+                if len(o) == 0:
+                    continue
+                obs.append(o)
+                acts.append(np.asarray(ep["actions"]))
+                rews.append(np.asarray(ep["rewards"], np.float32))
+                # next_obs: shift; last step re-uses its own obs but is
+                # masked by done=1 so the bootstrap term vanishes
+                nxt.append(np.concatenate([o[1:], o[-1:]], axis=0))
+                d = np.zeros(len(o), np.float32)
+                d[-1] = 1.0
+                dones.append(d)
+        return cls(np.concatenate(obs), np.concatenate(acts),
+                   np.concatenate(rews), np.concatenate(nxt),
+                   np.concatenate(dones))
+
+
+class CQLConfig(MARWILConfig):
+    """Conservative Q-Learning (reference: ``rllib/algorithms/cql/cql.py``
+    — there SAC-based for continuous control; here the discrete-action
+    CQL(H) regime, which is the right regime for the JSONL corpora the
+    offline stack ships: the conservative penalty
+    ``logsumexp_a Q(s,a) - Q(s, a_data)`` needs no action sampling when
+    the action set is enumerable — it's one reduction on the Q head."""
+
+    def __init__(self):
+        super().__init__()
+        self.cfg.update(
+            lr=3e-4, cql_alpha=1.0, target_update_every=100,
+            train_batch_size=256, updates_per_iter=100, gamma=0.99,
+        )
+
+    def build(self) -> "CQL":
+        assert self.env_name and self.input_path, \
+            "need .environment(...) and .offline_data(...)"
+        return CQL(self)
+
+
+class CQL:
+    """Offline Q-learner: jitted double-Q TD update + conservative penalty."""
+
+    def __init__(self, config: CQLConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import gymnasium as gym
+
+        from .dqn import QNetwork
+
+        self.config = config
+        cfg = config.cfg
+        env = gym.make(config.env_name, **config.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(env.action_space.n)
+        env.close()
+        self.model = QNetwork(obs_dim, act_dim, hidden=tuple(cfg["hidden"]))
+        self.params = self.model.init(jax.random.PRNGKey(cfg["seed"]))
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.opt = optax.chain(optax.clip_by_global_norm(cfg["grad_clip"]),
+                               optax.adam(cfg["lr"]))
+        self.opt_state = self.opt.init(self.params)
+        self.data = TransitionDataset.from_jsonl(config.input_path)
+        self._rng = np.random.default_rng(cfg["seed"])
+        self.iteration = 0
+        self._updates = 0
+
+        gamma = float(cfg["gamma"])
+        alpha = float(cfg["cql_alpha"])
+        model = self.model
+
+        def loss_fn(params, target_params, obs, actions, rewards,
+                    next_obs, dones):
+            q = model.apply(params, obs)                        # [B, A]
+            q_data = jnp.take_along_axis(q, actions[:, None], 1)[:, 0]
+            # double-Q target: select with the online net, evaluate with
+            # the target net (overestimation control matters doubly
+            # offline — there is no fresh data to correct optimism)
+            sel = jnp.argmax(jax.lax.stop_gradient(
+                model.apply(params, next_obs)), axis=1)
+            next_q = model.apply(target_params, next_obs)
+            boot = jnp.take_along_axis(next_q, sel[:, None], 1)[:, 0]
+            target = rewards + gamma * (1.0 - dones) * boot
+            td = ((q_data - jax.lax.stop_gradient(target)) ** 2).mean()
+            # the conservative term: push down the policy's value estimate
+            # everywhere, push up only on dataset actions
+            gap = (jax.scipy.special.logsumexp(q, axis=1) - q_data).mean()
+            return td + alpha * gap, (td, gap)
+
+        @jax.jit
+        def update(params, target_params, opt_state, obs, actions,
+                   rewards, next_obs, dones):
+            (loss, (td, gap)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, obs,
+                                       actions, rewards, next_obs, dones)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td, gap
+
+        self._update = update
+        self._jnp = jnp
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config.cfg
+        bs = min(cfg["train_batch_size"], len(self.data))
+        losses, tds, gaps = [], [], []
+        for _ in range(cfg["updates_per_iter"]):
+            idx = self._rng.integers(0, len(self.data), bs)
+            (self.params, self.opt_state, loss, td, gap) = self._update(
+                self.params, self.target_params, self.opt_state,
+                self._jnp.asarray(self.data.obs[idx]),
+                self._jnp.asarray(self.data.actions[idx]),
+                self._jnp.asarray(self.data.rewards[idx]),
+                self._jnp.asarray(self.data.next_obs[idx]),
+                self._jnp.asarray(self.data.dones[idx]))
+            self._updates += 1
+            if self._updates % int(cfg["target_update_every"]) == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    lambda x: x, self.params)
+            losses.append(float(loss))
+            tds.append(float(td))
+            gaps.append(float(gap))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "loss": float(np.mean(losses)),
+                "td_loss": float(np.mean(tds)),
+                "cql_gap": float(np.mean(gaps)),
+                "num_transitions": len(self.data)}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        q = self.model.apply(self.params,
+                             self._jnp.asarray(obs, self._jnp.float32)[None])
+        return int(np.argmax(np.asarray(q)[0]))
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 10_000) -> float:
+        eps = collect_episodes(self.config.env_name, self.compute_action,
+                               num_episodes,
+                               env_config=self.config.env_config, seed=seed)
+        return float(np.mean([sum(ep["rewards"]) for ep in eps]))
+
+
 __all__ = ["BCConfig", "MARWIL", "MARWILConfig", "OfflineDataset",
-           "collect_episodes", "write_episodes"]
+           "collect_episodes", "write_episodes",
+           "CQL", "CQLConfig", "TransitionDataset"]
