@@ -129,6 +129,15 @@ class Config:
     memory_monitor_interval_s: float = 1.0
     memory_usage_threshold: float = 0.95
 
+    # -- race / stall detection -------------------------------------------
+    #: Opt-in event-loop stall detector (util/loop_monitor.py): a sibling
+    #: thread heartbeats each runtime process's IO loop and records a
+    #: WARNING event with the blocking stack when an echo is overdue —
+    #: the asyncio analogue of the reference's TSAN/sanitizer CI builds
+    #: (SURVEY §5.2).
+    loop_monitor_enabled: bool = False
+    loop_monitor_threshold_s: float = 0.5
+
     # -- metrics -----------------------------------------------------------
     metrics_export_enabled: bool = True
     task_events_enabled: bool = True

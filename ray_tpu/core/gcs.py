@@ -68,6 +68,14 @@ class GcsServer:
         self._maybe_restore()
         await self.server.start()
         self._bg.append(asyncio.ensure_future(self._health_check_loop()))
+
+        async def _self_call(method, **kw):
+            # the GCS writes its own distress events straight into its KV
+            return await getattr(self, f"handle_{method}")(**kw)
+
+        from ray_tpu.util.loop_monitor import install as _install_loop_mon
+        self._loop_monitor = _install_loop_mon(
+            asyncio.get_event_loop(), "gcs", gcs_call=_self_call)
         return self
 
     @property
@@ -75,6 +83,8 @@ class GcsServer:
         return self.server.address
 
     async def stop(self):
+        if getattr(self, "_loop_monitor", None):
+            self._loop_monitor.stop()
         for t in self._bg:
             t.cancel()
         await self.agent_clients.close_all()

@@ -150,6 +150,10 @@ class NodeAgent:
         cfg = get_config()
         for _ in range(cfg.prestart_workers):
             asyncio.ensure_future(self._spawn_worker())
+        from ray_tpu.util.loop_monitor import install as _install_loop_mon
+        self._loop_monitor = _install_loop_mon(
+            asyncio.get_event_loop(), f"node_agent:{self.node_id.hex()[:12]}",
+            gcs_call=self.gcs.call)
         return self
 
     @property
@@ -158,6 +162,8 @@ class NodeAgent:
 
     async def stop(self):
         self._shutting_down = True
+        if getattr(self, "_loop_monitor", None):
+            self._loop_monitor.stop()
         for t in self._bg:
             t.cancel()
         for w in list(self.workers.values()):
@@ -1275,6 +1281,18 @@ class NodeAgent:
                 f'resource="{k}"}} {avail}',
                 f'raytpu_resource_total{{node="{self.node_id.hex()[:12]}",'
                 f'resource="{k}"}} {total}',
+            ]
+        mon = getattr(self, "_loop_monitor", None)
+        if mon is not None:
+            s = mon.stats()
+            lines += [
+                "# TYPE raytpu_loop_stalls_total counter",
+                f'raytpu_loop_stalls_total{{node="{self.node_id.hex()[:12]}"}} '
+                f"{s['stall_count']}",
+                "# TYPE raytpu_loop_worst_stall_seconds gauge",
+                f'raytpu_loop_worst_stall_seconds'
+                f'{{node="{self.node_id.hex()[:12]}"}} '
+                f"{s['worst_stall_s']:.3f}",
             ]
         return "\n".join(lines) + "\n"
 

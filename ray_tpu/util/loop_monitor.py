@@ -1,0 +1,156 @@
+"""Event-loop stall detector — the asyncio analogue of the reference's
+race/deadlock tooling (SURVEY §5.2: TSAN builds + ``RAY_CHECK``-style
+watchdogs in ``src/ray/util``).
+
+The whole control plane here rides ONE asyncio loop per process
+(core/rpc.py); the failure mode that discipline invites is a callback
+that blocks the loop — a synchronous file read, a pickle of a huge
+object, an accidental ``time.sleep`` — freezing every RPC the process
+serves. C++ Ray catches its analogues with sanitizer builds; a Python
+runtime can do better at runtime: a sibling thread heartbeats the loop
+with ``call_soon_threadsafe`` and, when an echo is overdue, captures the
+loop thread's CURRENT stack (``sys._current_frames``) — naming the
+exact frame that is blocking, not just the fact of the stall.
+
+Enable per-process via config ``loop_monitor_enabled`` (the node agent
+and GCS turn it on when set) or directly::
+
+    mon = LoopMonitor(loop, threshold_s=0.5, on_stall=print)
+    mon.start()
+
+Each stall invokes ``on_stall(stall_s, stack_str)`` once (re-armed after
+the loop recovers) — the runtime wires this to a WARNING structured
+event (util/events.py) tagged ``source=loop_monitor``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+__all__ = ["LoopMonitor", "install", "format_loop_stack"]
+
+
+def format_loop_stack(thread_id: Optional[int]) -> str:
+    """Render the current stack of one thread (the loop's) — the
+    blocking frame is the deepest application frame."""
+    frames = sys._current_frames()
+    frame = frames.get(thread_id) if thread_id is not None else None
+    if frame is None:
+        return "<loop thread stack unavailable>"
+    return "".join(traceback.format_stack(frame))
+
+
+class LoopMonitor:
+    """Heartbeat the loop from a daemon thread; report overdue echoes.
+
+    The probe is O(1) per interval (one threadsafe callback), cheap
+    enough to leave on in production — the reference pays for its race
+    coverage with separate sanitizer CI builds; this rides along.
+    """
+
+    def __init__(self, loop, threshold_s: float = 0.5,
+                 interval_s: float = 0.1,
+                 on_stall: Optional[Callable[[float, str], None]] = None):
+        self.loop = loop
+        self.threshold_s = float(threshold_s)
+        self.interval_s = float(interval_s)
+        self.on_stall = on_stall
+        self.stall_count = 0
+        self.worst_stall_s = 0.0
+        self._last_echo = time.monotonic()
+        self._loop_thread_id: Optional[int] = None
+        self._reported_current = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- loop side ---------------------------------------------------------
+    def _echo(self):
+        self._last_echo = time.monotonic()
+        self._loop_thread_id = threading.get_ident()
+        self._reported_current = False
+
+    # -- monitor thread ----------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.loop.call_soon_threadsafe(self._echo)
+            except RuntimeError:  # loop closed
+                return
+            self._stop.wait(self.interval_s)
+            overdue = time.monotonic() - self._last_echo
+            if overdue > self.threshold_s:
+                # worst-stall tracks the FULL duration (it keeps growing
+                # while the episode lasts); the report fires once per
+                # episode, re-armed by the next echo
+                self.worst_stall_s = max(self.worst_stall_s, overdue)
+            if overdue > self.threshold_s and not self._reported_current:
+                self._reported_current = True
+                self.stall_count += 1
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(
+                            overdue, format_loop_stack(self._loop_thread_id))
+                    except Exception:
+                        pass
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="raytpu-loop-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def stats(self) -> dict:
+        return {"stall_count": self.stall_count,
+                "worst_stall_s": self.worst_stall_s,
+                "threshold_s": self.threshold_s}
+
+
+def install(loop, source: str, gcs_call=None) -> Optional[LoopMonitor]:
+    """Config-gated install used by the runtime processes (node agent,
+    GCS). Off by default — like the reference's sanitizer builds, the
+    race tooling is opt-in (``loop_monitor_enabled`` system config).
+
+    The stall handler runs on the MONITOR thread while the loop is
+    wedged, so it must never await; the distress event is enqueued via
+    ``call_soon_threadsafe`` and flushes once the loop recovers — late,
+    but carrying the stack captured DURING the stall, which is the part
+    that matters."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    if not getattr(cfg, "loop_monitor_enabled", False):
+        return None
+
+    def on_stall(stall_s: float, stack: str):
+        if gcs_call is None:
+            return
+        from ray_tpu.util import events
+
+        def enqueue():
+            import asyncio
+            asyncio.ensure_future(events.record_via(
+                gcs_call, "WARNING", "loop_monitor",
+                f"{source}: event loop blocked {stall_s * 1e3:.0f}ms",
+                process=source, stall_ms=f"{stall_s * 1e3:.0f}",
+                stack=stack[-2000:]))
+
+        try:
+            loop.call_soon_threadsafe(enqueue)
+        except RuntimeError:
+            pass
+
+    mon = LoopMonitor(loop, threshold_s=cfg.loop_monitor_threshold_s,
+                      on_stall=on_stall)
+    return mon.start()
