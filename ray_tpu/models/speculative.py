@@ -1,0 +1,226 @@
+"""Speculative decoding: draft-model lookahead + one-shot target verify.
+
+Beyond-reference TPU-native addition (the reference serves LLMs by
+pairing with an external engine; our serve stack owns its engine —
+serve/llm.py — so the classic latency lever is implementable natively).
+
+Why this is a TPU win: autoregressive decode is HBM-bound — every step
+streams all weights for ONE matvec per slot. Speculation turns k of
+those matvecs into ONE [slots, k]-token matmul (`verify_window`): same
+weight traffic, k× the useful FLOPs, which is exactly the regime the
+MXU wants. The draft model is small enough that its k sequential steps
+cost less than the saved target steps whenever acceptance is decent.
+
+Greedy acceptance keeps the output EXACTLY equal to vanilla greedy
+decode (tests pin this): accept draft tokens while they match the
+target's argmax at the same position, then emit the target's own token
+at the first mismatch — ≥1 token per verify call, so worst case equals
+vanilla decode plus the (cheap) draft work.
+
+Everything is fixed-shape and jittable: the multi-round driver is a
+``lax.scan`` whose carry holds both caches, per-slot emit buffers and
+lengths — no host round-trip between rounds (cf. decode.py's
+``decode_loop``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import TransformerConfig
+from .decode import (KVCache, Params, _mlp, _norm, _proj_out, _qkv,
+                     decode_step, lm_head_weight)
+
+__all__ = ["verify_window", "speculative_round", "speculative_decode_loop"]
+
+
+def verify_window(params: Params, cache: KVCache, tokens: jnp.ndarray,
+                  active: jnp.ndarray, cfg: TransformerConfig,
+                  compute_dtype=jnp.bfloat16
+                  ) -> Tuple[KVCache, jnp.ndarray]:
+    """Process a k-token window per slot in one forward.
+
+    tokens: [slots, k] int32 — token j sits at cache position length+j
+    active: [slots] bool
+    Returns (cache, logits [slots, k, V] f32); K/V for all k positions
+    are appended and ``length`` advances by k for active slots (callers
+    roll length back to the accepted prefix afterwards — the garbage
+    tail beyond ``length`` is never read, same contract as prefill's
+    padded tail).
+
+    This is ``decode_step`` generalized from window 1 to window k; with
+    k=1 it computes identical math.
+    """
+    n_slots, k = tokens.shape
+    max_len = cache["k"].shape[2]
+    cast = compute_dtype
+    lengths = cache["length"]                                   # [slots]
+    x = params["embed"]["tokens"][tokens].astype(cast)          # [S,k,H]
+    positions = lengths[:, None] + jnp.arange(k)[None]          # [S,k]
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][
+            jnp.minimum(positions, cfg.max_seq_len - 1)].astype(cast)
+    scale = cfg.head_dim ** -0.5
+    reps = cfg.num_heads // cfg.num_kv_heads
+    # query j may see cache positions <= length+j (its own position)
+    pos_mask = (jnp.arange(max_len)[None, None]
+                <= positions[:, :, None])          # [slots, k, max_len]
+    row = jnp.arange(n_slots)[:, None]                          # [S,1]
+
+    def body(x, layer):
+        lp, k_lay, v_lay = layer
+        y = _norm(x, lp["attn_norm"], cfg)
+        q, kk, vv = _qkv(y, lp["attn"], cfg, positions)  # [S,k,N*,D]
+        # append the whole window's K/V rows (scatter at length..length+k-1)
+        k_lay = k_lay.at[row, positions].set(kk.astype(k_lay.dtype))
+        v_lay = v_lay.at[row, positions].set(vv.astype(v_lay.dtype))
+        qh = q.reshape(n_slots, k, cfg.num_kv_heads, reps, cfg.head_dim)
+        scores = jnp.einsum("skgrd,smgd->skgrm", qh.astype(jnp.float32),
+                            k_lay.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
+        scores = jnp.where(pos_mask[:, :, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("skgrm,smgd->skgrd", probs,
+                          v_lay.astype(jnp.float32))
+        attn = attn.reshape(n_slots, k, cfg.num_heads * cfg.head_dim)
+        x = x + _proj_out(attn.astype(cast), lp["attn"], cast)
+        x = x + _mlp(_norm(x, lp["mlp_norm"], cfg), lp, cfg)
+        return x, (k_lay, v_lay)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    logits = (x @ lm_head_weight(params, cfg, cast)).astype(jnp.float32)
+    cache = {
+        "k": k_new, "v": v_new,
+        "length": jnp.where(active, jnp.minimum(lengths + k, max_len),
+                            lengths),
+    }
+    return cache, logits
+
+
+def speculative_round(target_params: Params, target_cache: KVCache,
+                      draft_params: Params, draft_cache: KVCache,
+                      last_tokens: jnp.ndarray, active: jnp.ndarray,
+                      k: int, target_cfg: TransformerConfig,
+                      draft_cfg: TransformerConfig,
+                      ) -> Tuple[KVCache, KVCache, jnp.ndarray,
+                                 jnp.ndarray, jnp.ndarray]:
+    """One draft→verify→accept round for every slot.
+
+    Returns (target_cache, draft_cache, emitted [slots, k] int32,
+    emit_count [slots] int32 in 1..k, new_last [slots]).  Emitted slots
+    beyond emit_count hold garbage; inactive slots emit 0 tokens.
+
+    Greedy acceptance: with drafts d_1..d_{k-1} and target logits
+    l_0..l_{k-1} over window [last, d_1..d_{k-1}], accept d_{j+1} while
+    d_{j+1} == argmax(l_j); then emit argmax(l_a) at the first mismatch
+    (the "free" correction) — output identical to vanilla greedy.
+    """
+    n_slots = last_tokens.shape[0]
+
+    # -- draft rollout: k-1 small-model steps ------------------------------
+    def draft_body(carry, _):
+        dc, tok = carry
+        dc, logits = decode_step(draft_params, dc, tok, active, draft_cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (dc, nxt), nxt
+
+    (draft_cache, last_d), drafts = jax.lax.scan(
+        draft_body, (draft_cache, last_tokens), None, length=k - 1)
+    drafts = drafts.T                                   # [slots, k-1]
+    # one extra KV-only draft step: when every draft is accepted the next
+    # round needs d_{k-1}'s row in the draft cache too (its logits are
+    # discarded — this is the fixed price of fixed shapes)
+    draft_cache, _ = decode_step(draft_params, draft_cache, last_d,
+                                 active, draft_cfg)
+
+    # -- target verify: ONE k-token window ---------------------------------
+    window = jnp.concatenate([last_tokens[:, None], drafts], axis=1)
+    t_len0 = target_cache["length"]
+    target_cache, logits = verify_window(target_params, target_cache,
+                                         window, active, target_cfg)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [slots, k]
+
+    # -- acceptance --------------------------------------------------------
+    match = (drafts == greedy[:, :-1])                       # [slots, k-1]
+    accepted = jnp.argmin(
+        jnp.concatenate([match, jnp.zeros((n_slots, 1), bool)], 1), axis=1)
+    # ^ index of first False; all-True gives k-1 (argmin of all-False tail
+    #   trick: appended False guarantees a minimum exists)
+    emit_count = jnp.where(active, accepted + 1, 0)          # drafts + fix
+    # emitted tokens: d_1..d_a then greedy[a] at position a
+    emitted = jnp.where(
+        jnp.arange(k)[None] < accepted[:, None],
+        jnp.concatenate([drafts, jnp.zeros((n_slots, 1), jnp.int32)], 1),
+        jnp.take_along_axis(greedy, accepted[:, None], 1))   # [slots, k]
+    new_last = jnp.take_along_axis(greedy, accepted[:, None], 1)[:, 0]
+    new_last = jnp.where(active, new_last, last_tokens)
+
+    # -- roll both caches back to the verified prefix ----------------------
+    # context now ends with ...last, d_1..d_a; the correction token is
+    # fed next round, so length = len0 + 1 + accepted
+    new_len = t_len0 + 1 + accepted
+    target_cache = dict(target_cache,
+                        length=jnp.where(active, new_len, t_len0))
+    # the draft ingested the same prefix (its rows cover last..d_{k-1})
+    draft_cache = dict(draft_cache,
+                       length=jnp.where(active, new_len,
+                                        draft_cache["length"]))
+    return target_cache, draft_cache, emitted, emit_count, new_last
+
+
+@partial(jax.jit, static_argnames=("k", "num_rounds", "target_cfg",
+                                   "draft_cfg", "eos_id"))
+def speculative_decode_loop(target_params: Params, target_cache: KVCache,
+                            draft_params: Params, draft_cache: KVCache,
+                            last_tokens: jnp.ndarray, active: jnp.ndarray,
+                            k: int, num_rounds: int,
+                            target_cfg: TransformerConfig,
+                            draft_cfg: TransformerConfig,
+                            eos_id: int = -1,
+                            ) -> Dict[str, Any]:
+    """Fixed-shape multi-round driver: ``num_rounds`` spec rounds under
+    one ``lax.scan`` — no host sync between rounds.
+
+    Returns {tokens: [slots, num_rounds*k], counts: [slots],
+    target_cache, draft_cache, last_tokens, rounds_accepted: [slots,
+    num_rounds]} — tokens beyond counts are garbage; a slot that emits
+    ``eos_id`` (if >= 0) deactivates for the remaining rounds.
+    """
+    n_slots = last_tokens.shape[0]
+    out = jnp.zeros((n_slots, num_rounds * k), jnp.int32)
+    counts = jnp.zeros((n_slots,), jnp.int32)
+
+    def round_body(carry, _):
+        tc, dc, last, act, out, counts = carry
+        tc, dc, emitted, n_emit, last = speculative_round(
+            target_params, tc, draft_params, dc, last, act,
+            k, target_cfg, draft_cfg)
+        # scatter emitted[0:n_emit] at out[counts:counts+n_emit]
+        idx = counts[:, None] + jnp.arange(k)[None]          # [slots, k]
+        keep = jnp.arange(k)[None] < n_emit[:, None]
+        out = out.at[jnp.arange(n_slots)[:, None],
+                     jnp.minimum(idx, out.shape[1] - 1)].set(
+            jnp.where(keep, emitted, out[jnp.arange(n_slots)[:, None],
+                                         jnp.minimum(idx, out.shape[1] - 1)]))
+        counts = counts + n_emit
+        if eos_id >= 0:
+            hit_eos = (jnp.where(keep, emitted, -1) == eos_id).any(axis=1)
+            act = act & ~hit_eos
+        return (tc, dc, last, act, out, counts), n_emit
+
+    (target_cache, draft_cache, last_tokens, active, out, counts), accs = \
+        jax.lax.scan(round_body,
+                     (target_cache, draft_cache, last_tokens, active,
+                      out, counts), None, length=num_rounds)
+    return {"tokens": out, "counts": counts,
+            "target_cache": target_cache, "draft_cache": draft_cache,
+            "last_tokens": last_tokens, "active": active,
+            "rounds_accepted": accs.T}

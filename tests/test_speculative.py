@@ -1,0 +1,143 @@
+"""Speculative decoding: verify-window math + exact greedy equivalence
+(models/speculative.py — beyond-reference TPU-native serve addition)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import decode, speculative  # noqa: E402
+from ray_tpu.models.config import TransformerConfig  # noqa: E402
+from ray_tpu.models.transformer import init_params  # noqa: E402
+
+TARGET_CFG = TransformerConfig(vocab_size=96, num_layers=2, hidden_size=64,
+                               num_heads=4, num_kv_heads=2, mlp_size=128,
+                               max_seq_len=96)
+DRAFT_CFG = TransformerConfig(vocab_size=96, num_layers=1, hidden_size=32,
+                              num_heads=2, num_kv_heads=2, mlp_size=64,
+                              max_seq_len=96)
+PROMPT = np.array([3, 14, 15, 92, 6], np.int32)
+
+
+def _prefilled(cfg, params, num_slots=2):
+    cache = decode.init_kv_cache(cfg, num_slots=num_slots,
+                                 max_len=cfg.max_seq_len,
+                                 dtype=jnp.float32)
+    toks = np.zeros((1, 8), np.int32)
+    toks[0, :len(PROMPT)] = PROMPT
+    cache, logits = decode.prefill(
+        params, cache, jnp.asarray(toks),
+        jnp.array([len(PROMPT)], jnp.int32), jnp.array([0], jnp.int32),
+        cfg, compute_dtype=jnp.float32)
+    return cache, int(jnp.argmax(logits[0]))
+
+
+def _vanilla_greedy(params, cache, first, cfg, n_steps):
+    slot_tok = jnp.zeros((2,), jnp.int32).at[0].set(first)
+    active = jnp.array([True, False])
+    cache, _, emitted = decode.decode_loop(
+        params, cache, slot_tok, active, jnp.zeros((2,), jnp.float32),
+        jax.random.PRNGKey(0), n_steps, cfg, compute_dtype=jnp.float32)
+    return [first] + [int(t) for t in np.asarray(emitted)[:, 0]]
+
+
+def test_verify_window_matches_sequential_decode_steps():
+    """verify_window(k) is decode_step generalized: same logits, same
+    cache contents as k sequential single-token steps."""
+    params = init_params(jax.random.PRNGKey(0), TARGET_CFG,
+                         dtype=jnp.float32)
+    cache_a, first = _prefilled(TARGET_CFG, params)
+    cache_b = jax.tree_util.tree_map(lambda x: x, cache_a)
+    window = jnp.array([[first, 7, 21, 3], [0, 0, 0, 0]], jnp.int32)
+    active = jnp.array([True, False])
+
+    cache_a, wlogits = speculative.verify_window(
+        params, cache_a, window, active, TARGET_CFG,
+        compute_dtype=jnp.float32)
+
+    step_logits = []
+    for j in range(4):
+        cache_b, lg = decode.decode_step(
+            params, cache_b, window[:, j], active, TARGET_CFG,
+            compute_dtype=jnp.float32)
+        step_logits.append(np.asarray(lg))
+    np.testing.assert_allclose(np.asarray(wlogits)[0],
+                               np.stack(step_logits)[:, 0], rtol=2e-4,
+                               atol=2e-4)
+    assert int(cache_a["length"][0]) == int(cache_b["length"][0])
+    np.testing.assert_allclose(
+        np.asarray(cache_a["k"])[:, 0, :int(cache_a["length"][0])],
+        np.asarray(cache_b["k"])[:, 0, :int(cache_b["length"][0])],
+        rtol=2e-4, atol=2e-4)
+
+
+def test_spec_decode_equals_vanilla_greedy():
+    """The whole point: with a DIFFERENT (weaker) draft model, greedy
+    speculative output is token-identical to vanilla greedy decode."""
+    tparams = init_params(jax.random.PRNGKey(0), TARGET_CFG,
+                          dtype=jnp.float32)
+    dparams = init_params(jax.random.PRNGKey(7), DRAFT_CFG,
+                          dtype=jnp.float32)
+    tcache, first = _prefilled(TARGET_CFG, tparams)
+    dcache, _ = _prefilled(DRAFT_CFG, dparams)
+    vcache, vfirst = _prefilled(TARGET_CFG, tparams)
+    assert vfirst == first
+    vanilla = _vanilla_greedy(tparams, vcache, first, TARGET_CFG, 24)
+
+    k, rounds = 4, 6
+    last = jnp.zeros((2,), jnp.int32).at[0].set(first)
+    active = jnp.array([True, False])
+    out = speculative.speculative_decode_loop(
+        tparams, tcache, dparams, dcache, last, active, k, rounds,
+        TARGET_CFG, DRAFT_CFG)
+    n = int(out["counts"][0])
+    assert rounds <= n <= rounds * k   # >=1 token per round, <=k
+    spec_seq = [first] + [int(t) for t in np.asarray(out["tokens"])[0, :n]]
+    assert spec_seq == vanilla[:len(spec_seq)], (spec_seq, vanilla)
+    # inactive slot untouched
+    assert int(out["counts"][1]) == 0
+    # per-round emission accounting is consistent
+    assert int(out["rounds_accepted"][0].sum()) == n
+
+
+def test_self_draft_accepts_every_token():
+    """Draft == target: every draft token matches the target argmax, so
+    each round emits the maximum k tokens ((k-1 drafts + bonus))."""
+    params = init_params(jax.random.PRNGKey(0), TARGET_CFG,
+                         dtype=jnp.float32)
+    tcache, first = _prefilled(TARGET_CFG, params)
+    dcache, _ = _prefilled(TARGET_CFG, params)
+    last = jnp.zeros((2,), jnp.int32).at[0].set(first)
+    active = jnp.array([True, False])
+    out = speculative.speculative_decode_loop(
+        params, tcache, params, dcache, last, active, 4, 3,
+        TARGET_CFG, TARGET_CFG)
+    assert [int(x) for x in out["rounds_accepted"][0]] == [4, 4, 4]
+
+
+def test_eos_deactivates_slot():
+    tparams = init_params(jax.random.PRNGKey(0), TARGET_CFG,
+                          dtype=jnp.float32)
+    dparams = init_params(jax.random.PRNGKey(7), DRAFT_CFG,
+                          dtype=jnp.float32)
+    tcache, first = _prefilled(TARGET_CFG, tparams)
+    dcache, _ = _prefilled(DRAFT_CFG, dparams)
+    vcache, _ = _prefilled(TARGET_CFG, tparams)
+    vanilla = _vanilla_greedy(tparams, vcache, first, TARGET_CFG, 24)
+    eos = vanilla[3]  # force an eos hit a few tokens in
+
+    last = jnp.zeros((2,), jnp.int32).at[0].set(first)
+    active = jnp.array([True, False])
+    out = speculative.speculative_decode_loop(
+        tparams, tcache, dparams, dcache, last, active, 4, 6,
+        TARGET_CFG, DRAFT_CFG, eos_id=eos)
+    assert not bool(out["active"][0])
+    n = int(out["counts"][0])
+    emitted = [int(t) for t in np.asarray(out["tokens"])[0, :n]]
+    assert eos in emitted
+    # rounds after the eos round emit nothing
+    accs = [int(x) for x in out["rounds_accepted"][0]]
+    eos_round = next(i for i, _ in enumerate(accs)
+                     if eos in emitted[:sum(accs[:i + 1])])
+    assert all(a == 0 for a in accs[eos_round + 1:])
