@@ -8,6 +8,7 @@ Kept deliberately small; stable pieces graduate into ``ray_tpu.util``.
 
 from . import darray
 from .dynamic_resources import set_resource
+from .shuffle import simple_shuffle
 from .internal_kv import (
     internal_kv_del,
     internal_kv_exists,
@@ -19,6 +20,7 @@ from .internal_kv import (
 __all__ = [
     "darray",
     "set_resource",
+    "simple_shuffle",
     "internal_kv_get",
     "internal_kv_put",
     "internal_kv_del",

@@ -1,0 +1,25 @@
+"""experimental.simple_shuffle (reference: python/ray/experimental/shuffle.py)."""
+
+import numpy as np
+
+from ray_tpu.experimental import simple_shuffle
+
+
+def test_hash_shuffle_repartitions_all_rows(ray_start_regular):
+    rng = np.random.default_rng(0)
+    parts = [np.stack([rng.integers(0, 100, 50),
+                       rng.normal(size=50)], axis=1) for _ in range(4)]
+    out = simple_shuffle(parts, num_reducers=3)
+    assert len(out) == 3
+    # every row lands in the bucket its key hashes to, none lost
+    assert sum(len(o) for o in out) == 200
+    for i, o in enumerate(out):
+        if len(o):
+            assert (o[:, 0].astype(np.int64) % 3 == i).all()
+
+
+def test_shuffle_single_reducer_and_key_fn(ray_start_regular):
+    parts = [np.arange(10, dtype=np.float64) for _ in range(3)]
+    out = simple_shuffle(parts, num_reducers=1,
+                         key_fn=lambda rows: np.zeros(len(rows)))
+    assert len(out) == 1 and len(out[0]) == 30
