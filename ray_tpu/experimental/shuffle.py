@@ -26,13 +26,17 @@ def simple_shuffle(partitions: Sequence[Any],
     """Shuffle numpy-array partitions into ``num_reducers`` hash buckets.
 
     partitions: sequence of arrays (rows = records) or object refs to them.
-    key_fn: rows -> int64 keys (default: hash of the first column).
+    key_fn: rows -> int64 keys (default: the first column — or the value
+    itself for 1-D blocks — cast to int64; supply key_fn for real
+    hashing when keys are structured/strided).
     Returns the reduced partitions (list of arrays, one per reducer),
     where every row lands in bucket ``key % num_reducers``.
     """
     import ray_tpu
 
-    r = num_reducers or len(partitions)
+    if num_reducers is not None and num_reducers < 1:
+        raise ValueError(f"num_reducers must be >= 1, got {num_reducers}")
+    r = len(partitions) if num_reducers is None else num_reducers
 
     @ray_tpu.remote(num_returns=r)
     def shuffle_map(block):

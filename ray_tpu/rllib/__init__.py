@@ -19,6 +19,7 @@ BC/MARWIL/CQL offline; multi-agent dict envs; external-env protocol
 """
 
 from .a2c import A2C, A2CConfig
+from .apex import APEX, APEXConfig, ReplayShard
 from .conv import ActorCriticConv
 from .ddpg import DDPG, DDPGConfig
 from .dqn import DQN, DQNConfig, QNetwork
@@ -42,6 +43,7 @@ from .td3 import TD3, TD3Config
 __all__ = ["PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
            "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
+           "APEX", "APEXConfig", "ReplayShard",
            "ES", "ESConfig", "ARS", "ARSConfig",
            "PolicyClient", "PolicyServerInput",
            "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",

@@ -1,0 +1,258 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Reference: ``rllib/algorithms/apex_dqn/apex_dqn.py`` (APEX — Horgan et
+al.: many samplers with a per-actor exploration ladder, replay sharded
+across dedicated actors, a learner that consumes shard samples
+asynchronously and pushes refreshed priorities back).
+
+Reuse map: the jitted double-DQN update and the n-step env runner come
+straight from dqn.py; the decoupled resubmit-on-arrival pattern is the
+one IMPALA proved (impala.py) — here applied to replay inserts instead
+of on-policy batches. Replay shards are ordinary actors wrapping
+PrioritizedReplayBuffer, so the replay tier scales (and fails) like any
+other actor pool: a shard lost to a node failure costs only its slice
+of the buffer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .dqn import DQN, DQNConfig
+
+__all__ = ["APEX", "APEXConfig", "ReplayShard"]
+
+
+class ReplayShard:
+    """One slice of the distributed replay tier (reference:
+    ApexDQN's replay actors over utils/replay_buffers)."""
+
+    def __init__(self, capacity: int, alpha: float, beta: float,
+                 seed: int = 0):
+        from .replay_buffer import PrioritizedReplayBuffer
+        self.buf = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                           beta=beta, seed=seed)
+
+    def add(self, batch: Dict[str, np.ndarray],
+            priorities: Optional[np.ndarray] = None) -> int:
+        idx = self.buf.add(batch)
+        if priorities is not None:
+            # replace the default max-priority with the caller's |TD|
+            self.buf.update_priorities(idx, priorities)
+        return len(self.buf)
+
+    def sample(self, batch_size: int):
+        """Returns the sampled dict (fields + _indices/_weights) or None
+        when the shard is still shallower than one batch."""
+        if len(self.buf) < batch_size:
+            return None
+        return self.buf.sample(batch_size)
+
+    def update_priorities(self, indices: np.ndarray,
+                          priorities: np.ndarray) -> bool:
+        self.buf.update_priorities(indices, priorities)
+        return True
+
+    def size(self) -> int:
+        return len(self.buf)
+
+
+class APEXConfig(DQNConfig):
+    """DQNConfig plus the Ape-X distribution knobs."""
+
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 4
+        self.num_replay_shards = 2
+        # per-actor exploration ladder: eps_i = base ** (1 + i/(N-1)*alpha)
+        # (the paper's schedule — a spread of exploration temperaments
+        # replacing the single annealed epsilon)
+        self.epsilon_base = 0.4
+        self.epsilon_alpha = 7.0
+        self.replay.update(prioritized=True, learn_starts=500)
+
+    def env_runners(self, num_env_runners: int = 4, **kw):
+        return super().env_runners(num_env_runners, **kw)
+
+    def sharding(self, num_replay_shards: int = 2,
+                 epsilon_base: float = 0.4, epsilon_alpha: float = 7.0):
+        self.num_replay_shards = num_replay_shards
+        self.epsilon_base = epsilon_base
+        self.epsilon_alpha = epsilon_alpha
+        return self
+
+    def build(self) -> "APEX":
+        if not self.env_name:
+            raise ValueError("call .environment(env_name) first")
+        return APEX(self)
+
+
+class APEX(DQN):
+    """Driver: sampler fleet -> sharded prioritized replay -> learner.
+
+    One ``train()`` iteration: harvest whichever sampler batches have
+    arrived (resubmitting each sampler immediately — samplers never wait
+    on the learner), insert with fresh TD priorities into a
+    round-robin shard, then run ``train_iters`` learner updates pulling
+    from random shards and pushing refreshed priorities back.
+    """
+
+    def __init__(self, config: APEXConfig):
+        import jax
+
+        import ray_tpu
+
+        super().__init__(config)
+        # the single annealed-epsilon buffer of DQN is unused — replay
+        # lives in shard actors, one per slice
+        self.buffer = None
+        shard_cls = ray_tpu.remote(ReplayShard)
+        r = config.replay
+        per_shard = max(1, r["capacity"] // config.num_replay_shards)
+        self.shards = [
+            shard_cls.options(num_cpus=0.5).remote(
+                per_shard, r["alpha"], r["beta"], seed=config.seed + i)
+            for i in range(config.num_replay_shards)]
+        n = max(2, config.num_env_runners)
+        self._actor_eps = [
+            float(config.epsilon_base
+                  ** (1.0 + i / (n - 1) * config.epsilon_alpha))
+            for i in range(config.num_env_runners)]
+        self._inflight: Dict[Any, int] = {}   # sample ref -> runner index
+        self._next_shard = 0
+        self._rng = np.random.default_rng(config.seed)
+
+        # jitted initial-priority pass: |TD error| under current params
+        # (the paper computes these actor-side; with the learner one hop
+        # away we spend one forward here instead of shipping weights to
+        # every sampler every rollout)
+        import jax.numpy as jnp
+        model = self.model
+        double_q = bool(config.train["double_q"])
+
+        def td_abs(params, target_params, batch):
+            # must mirror the learner's target (dqn.py loss_fn) including
+            # the double_q branch — a priority computed against a
+            # different target than training optimizes skews PER
+            q = model.apply(params, batch["obs"])
+            qa = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), -1)[:, 0]
+            nq_t = model.apply(target_params, batch["next_obs"])
+            if double_q:
+                sel = model.apply(params, batch["next_obs"]).argmax(axis=-1)
+                boot = jnp.take_along_axis(nq_t, sel[:, None], -1)[:, 0]
+            else:
+                boot = nq_t.max(axis=-1)
+            target = batch["rewards"] + batch["discounts"] * boot
+            return jnp.abs(qa - target)
+
+        self._td_abs = jax.jit(td_abs)
+
+    def _harvest_and_insert(self, timeout: float) -> int:
+        """Collect arrived sampler batches, resubmit samplers, insert
+        into shards with fresh priorities. Returns env steps inserted."""
+        import ray_tpu
+
+        cfg = self.config
+        per_runner = max(1, cfg.rollout_steps // cfg.num_env_runners)
+        weights_ref = ray_tpu.put(
+            {k: np.asarray(v) for k, v in self.params.items()})
+        if not self._inflight:
+            for i, r in enumerate(self.runners):
+                ref = r.sample.remote(weights_ref, per_runner,
+                                      self._actor_eps[i],
+                                      cfg.train["n_step"],
+                                      cfg.train["gamma"])
+                self._inflight[ref] = i
+        ready, _ = ray_tpu.wait(list(self._inflight),
+                                num_returns=len(self._inflight),
+                                timeout=timeout)
+        if not ready and self._inflight:
+            # decoupled tier may lag the learner; block for one batch so
+            # an iteration always makes replay progress
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=600)
+        steps = 0
+        add_refs = []
+        for ref in ready:
+            i = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref, timeout=60)
+            prios = np.asarray(self._td_abs(self.params,
+                                            self.target_params, batch))
+            shard = self.shards[self._next_shard]
+            self._next_shard = ((self._next_shard + 1)
+                                % len(self.shards))
+            add_refs.append(shard.add.remote(batch, prios + 1e-6))
+            steps += len(batch["rewards"])
+            # resubmit immediately — the sampler never idles
+            nref = self.runners[i].sample.remote(
+                weights_ref, per_runner, self._actor_eps[i],
+                cfg.train["n_step"], cfg.train["gamma"])
+            self._inflight[nref] = i
+        if add_refs:
+            ray_tpu.get(add_refs, timeout=60)
+        return steps
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        t0 = time.time()
+        cfg = self.config
+        self._env_steps += self._harvest_and_insert(timeout=0.05)
+
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards],
+                            timeout=60)
+        losses: List[float] = []
+        if sum(sizes) >= cfg.replay["learn_starts"]:
+            for _ in range(cfg.train["train_iters"]):
+                order = self._rng.permutation(len(self.shards))
+                picked = None
+                for j in order:  # first shard deep enough this pull
+                    picked = ray_tpu.get(
+                        self.shards[j].sample.remote(
+                            cfg.train["batch_size"]), timeout=60)
+                    if picked is not None:
+                        break
+                if picked is None:
+                    break
+                indices = picked.pop("_indices")
+                batch = dict(picked, weights=picked.pop("_weights"))
+                (self.params, self.target_params, self.opt_state, loss,
+                 td) = self._update(self.params, self.target_params,
+                                    self.opt_state, batch)
+                losses.append(float(loss))
+                self.shards[int(j)].update_priorities.remote(
+                    indices, np.abs(np.asarray(td)) + 1e-6)
+
+        rets = [x for r in self.runners
+                for x in ray_tpu.get(r.episode_returns.remote(),
+                                     timeout=60)]
+        self._recent_returns.extend(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else
+                                    float("nan")),
+            "episodes_this_iter": len(rets),
+            "timesteps_total": self._env_steps,
+            "replay_shard_sizes": sizes,
+            "actor_epsilons": self._actor_eps,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "num_updates": len(losses),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        super().stop()
+        for a in self.shards:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

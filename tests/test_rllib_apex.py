@@ -1,0 +1,41 @@
+"""Ape-X DQN: distributed prioritized replay (reference:
+rllib/algorithms/apex_dqn)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import APEXConfig
+
+
+def test_apex_learns_bandit(ray_start_regular):
+    """Mechanics + learning gate on Bandit-v0: the sampler fleet feeds
+    sharded replay, the learner consumes and pushes priorities back, and
+    the greedy policy converges to the better arm."""
+    algo = (APEXConfig()
+            .environment("ray_tpu.rllib.examples_env:Bandit-v0")
+            .env_runners(num_env_runners=2, rollout_steps=128)
+            .sharding(num_replay_shards=2)
+            .training(lr=5e-3, batch_size=64, train_iters=8, n_step=1,
+                      replay=dict(learn_starts=64, capacity=4096))
+            .debugging(seed=0)
+            .build())
+    # exploration ladder: distinct per-actor epsilons, highest first
+    eps = algo._actor_eps
+    assert len(eps) == 2 and eps[0] > eps[1] > 0.0
+
+    best = -np.inf
+    result = None
+    for _ in range(25):
+        result = algo.train()
+        if np.isfinite(result["episode_return_mean"]):
+            best = max(best, result["episode_return_mean"])
+        if (best >= 6.0 and result["num_updates"] > 0
+                and all(s > 0 for s in result["replay_shard_sizes"])):
+            break
+    # optimum 8.0; the ladder's greediest actor should be near it while
+    # the explorer drags the mean — 6.0 is the pass bar
+    assert best >= 6.0, result
+    # both shards actually hold data
+    assert all(s > 0 for s in result["replay_shard_sizes"]), result
+    assert result["num_updates"] > 0
+    algo.stop()
