@@ -66,6 +66,51 @@ def from_huggingface(hf_dataset) -> Dataset:
     return from_arrow(table.combine_chunks())
 
 
+def from_torch(torch_dataset, *, block_size: int = 1000) -> Dataset:
+    """Materialize a torch ``Dataset`` (map- or iterable-style) into
+    blocks (reference: ``read_api.py`` ``from_torch``). A single-value
+    item becomes a row ``{"item": value}``; a tuple item (the
+    ``(features, label)`` convention) becomes ``{"item_0": ...,
+    "item_1": ...}`` columns. Tensors convert to numpy so the blocks
+    stay framework-neutral."""
+
+    def to_np(v):
+        return v.numpy() if hasattr(v, "numpy") else v
+
+    def to_row(x):
+        if isinstance(x, (tuple, list)):
+            # mixed-type tuples (tensor, int-label) cannot share one
+            # Arrow column — split into item_i fields
+            return {f"item_{i}": to_np(v) for i, v in enumerate(x)}
+        return {"item": to_np(x)}
+
+    from builtins import range as _range  # this module shadows range()
+
+    if hasattr(torch_dataset, "__len__") and hasattr(torch_dataset,
+                                                     "__getitem__"):
+        # map-style: index explicitly — plain iteration would fall back
+        # to the sequence protocol, which never terminates on datasets
+        # that don't raise IndexError
+        items = (torch_dataset[i] for i in _range(len(torch_dataset)))
+    elif hasattr(torch_dataset, "__iter__"):
+        items = iter(torch_dataset)
+    else:
+        raise ValueError(
+            "from_torch needs an iterable-style dataset (__iter__) or a "
+            "map-style one with BOTH __len__ and __getitem__ — a bare "
+            "__getitem__ would be iterated via the sequence protocol, "
+            "which never terminates when IndexError is never raised")
+    blocks, cur = [], []
+    for item in items:
+        cur.append(to_row(item))
+        if len(cur) >= block_size:
+            blocks.append(BlockAccessor.for_block(cur).to_arrow())
+            cur = []
+    if cur or not blocks:
+        blocks.append(BlockAccessor.for_block(cur).to_arrow())
+    return read_datasource(BlocksDatasource(blocks))
+
+
 def read_parquet(paths, *, parallelism: int = -1, columns=None) -> Dataset:
     return read_datasource(ParquetDatasource(paths, columns=columns),
                            parallelism=parallelism)
@@ -138,7 +183,7 @@ __all__ = [
     "ReadTask", "Block", "BlockAccessor", "BlockMetadata",
     "AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std", "AbsMax",
     "read_datasource", "range", "range_tensor", "from_items", "from_pandas",
-    "from_arrow", "from_numpy", "from_huggingface", "read_parquet", "read_csv",
+    "from_arrow", "from_numpy", "from_huggingface", "from_torch", "read_parquet", "read_csv",
     "read_json", "read_numpy", "read_binary_files", "read_text",
     "read_tfrecords", "read_sql", "read_images", "read_orc", "read_mongo",
     "read_webdataset", "TFRecordDatasource", "SQLDatasource",

@@ -36,6 +36,7 @@ from .offline import (BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig,
                       OfflineDataset, TransitionDataset,
                       collect_episodes, write_episodes)
 from .ppo import PPO, PPOConfig
+from .rainbow import DistQNetwork, Rainbow, RainbowConfig
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .sac import SAC, SACConfig
 from .td3 import TD3, TD3Config
@@ -44,6 +45,7 @@ __all__ = ["PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
            "IMPALA", "IMPALAConfig", "APPO", "APPOConfig",
            "APEX", "APEXConfig", "ReplayShard",
+           "Rainbow", "RainbowConfig", "DistQNetwork",
            "ES", "ESConfig", "ARS", "ARSConfig",
            "PolicyClient", "PolicyServerInput",
            "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",

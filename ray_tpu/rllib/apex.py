@@ -100,15 +100,15 @@ class APEX(DQN):
     from random shards and pushing refreshed priorities back.
     """
 
+    def _make_buffer(self):
+        return None  # replay lives in the shard actors
+
     def __init__(self, config: APEXConfig):
         import jax
 
         import ray_tpu
 
         super().__init__(config)
-        # the single annealed-epsilon buffer of DQN is unused — replay
-        # lives in shard actors, one per slice
-        self.buffer = None
         shard_cls = ray_tpu.remote(ReplayShard)
         r = config.replay
         per_shard = max(1, r["capacity"] // config.num_replay_shards)

@@ -48,6 +48,18 @@ class QNetwork:
         return x @ params[f"w{n}"] + params[f"b{n}"]
 
 
+def build_q_model(spec: Dict[str, Any]):
+    """Factory over the Q-head family: any distributional/dueling knob
+    (``num_atoms``/``v_min``/``v_max``/``dueling``) selects the C51 head
+    (rainbow.py, which defaults the others), else the plain QNetwork.
+    Both expose ``apply(params, obs) -> [B, A]`` expected-Q, so rollout
+    action selection is head-agnostic."""
+    if any(k in spec for k in ("num_atoms", "v_min", "v_max", "dueling")):
+        from .rainbow import DistQNetwork
+        return DistQNetwork(**spec)
+    return QNetwork(**spec)
+
+
 class DQNRunner:
     """Epsilon-greedy rollout actor producing replay transitions."""
 
@@ -59,7 +71,7 @@ class DQNRunner:
 
         self.envs = [gym.make(env_name, **(env_config or {}))
                      for _ in range(num_envs)]
-        self.model = QNetwork(**model_spec)
+        self.model = build_q_model(model_spec)
         self._apply = jax.jit(self.model.apply)
         self.num_envs = num_envs
         self._rng = np.random.default_rng(seed)
@@ -220,8 +232,6 @@ class DQN:
 
         import ray_tpu
 
-        from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
-
         self.config = config
         probe = gym.make(config.env_name, **config.env_config)
         obs_dim = int(np.prod(probe.observation_space.shape))
@@ -229,7 +239,10 @@ class DQN:
         probe.close()
         self.model_spec = dict(obs_dim=obs_dim, action_dim=action_dim,
                                hidden=tuple(config.model["hidden"]))
-        self.model = QNetwork(**self.model_spec)
+        for k in ("num_atoms", "v_min", "v_max", "dueling"):
+            if k in config.model:  # distributional/dueling heads (rainbow)
+                self.model_spec[k] = config.model[k]
+        self.model = build_q_model(self.model_spec)
         self.params = self.model.init(jax.random.PRNGKey(config.seed))
         self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
 
@@ -238,13 +251,7 @@ class DQN:
         self.opt_state = self.opt.init(self.params)
         self._update = self._build_update()
 
-        r = config.replay
-        if r.get("prioritized"):
-            self.buffer = PrioritizedReplayBuffer(
-                r["capacity"], alpha=r["alpha"], beta=r["beta"],
-                seed=config.seed)
-        else:
-            self.buffer = ReplayBuffer(r["capacity"], seed=config.seed)
+        self.buffer = self._make_buffer()
 
         runner_cls = ray_tpu.remote(DQNRunner)
         self.runners = [
@@ -257,6 +264,18 @@ class DQN:
         self._iteration = 0
         self._env_steps = 0
         self._recent_returns: List[float] = []
+
+    def _make_buffer(self):
+        """Driver-side replay; APEX overrides to None (its replay tier
+        lives in shard actors — allocating here would be wasted)."""
+        from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+        r = self.config.replay
+        if r.get("prioritized"):
+            return PrioritizedReplayBuffer(r["capacity"], alpha=r["alpha"],
+                                           beta=r["beta"],
+                                           seed=self.config.seed)
+        return ReplayBuffer(r["capacity"], seed=self.config.seed)
 
     def _build_update(self):
         import jax

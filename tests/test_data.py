@@ -318,3 +318,42 @@ def test_streaming_generator_backpressure(ray_data):
         assert [rt.get(r) for r in g] == list(range(10))
     finally:
         ctx.generator_backpressure = old
+
+
+def test_from_torch(ray_data):
+    torch = pytest.importorskip("torch")
+
+    class SquareDataset(torch.utils.data.Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return torch.tensor([i, i * i])
+
+    ds = rd.from_torch(SquareDataset(), block_size=5)
+    rows = ds.take_all()
+    assert len(rows) == 12
+    # single-'item' blocks unwrap to bare values on take (same
+    # convention as from_items of plain values)
+    assert list(rows[3]) == [3, 9]
+
+    class PairDataset(torch.utils.data.Dataset):
+        """The canonical (features, label) shape."""
+
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return torch.tensor([float(i), float(i) / 2]), i % 3
+
+    rows = rd.from_torch(PairDataset(), block_size=4).take_all()
+    assert len(rows) == 6
+    assert list(rows[4]["item_0"]) == [4.0, 2.0]
+    assert rows[4]["item_1"] == 1
+
+    class NoLen:
+        def __getitem__(self, i):
+            return i
+
+    with pytest.raises(ValueError, match="__len__"):
+        rd.from_torch(NoLen())
