@@ -20,7 +20,37 @@ from .core import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                    placement_group_table, put, remote, remove_placement_group,
                    shutdown, timeline, wait)
 
+from .core.ids import (ActorID, JobID, NodeID, ObjectID, PlacementGroupID,
+                       TaskID, WorkerID)
+
 __version__ = "0.1.0"
+
+
+def get_gpu_ids():
+    """Accelerator ids granted to this worker (reference:
+    ``ray.get_gpu_ids`` — here the TPU chips from TPU_VISIBLE_CHIPS;
+    the name is kept for drop-in parity, ``get_tpu_ids`` is the honest
+    alias)."""
+    return get_runtime_context().get_accelerator_ids().get("TPU", [])
+
+
+get_tpu_ids = get_gpu_ids
+
+#: Library submodules resolve lazily (PEP 562) so ``import ray_tpu``
+#: stays light but ``ray_tpu.data`` etc. work as attributes, matching
+#: the reference's top-level module surface.
+_LAZY_SUBMODULES = ("data", "train", "tune", "serve", "rllib", "workflow",
+                    "util", "dag", "autoscaler", "experimental", "job")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put", "wait",
@@ -31,5 +61,7 @@ __all__ = [
     "ActorDiedError", "ActorUnavailableError", "GetTimeoutError", "ObjectLostError",
     "OutOfMemoryError",
     "WorkerCrashedError", "NodeAffinitySchedulingStrategy",
-    "NodeLabelSchedulingStrategy", "PlacementGroupSchedulingStrategy", "__version__",
+    "NodeLabelSchedulingStrategy", "PlacementGroupSchedulingStrategy",
+    "ActorID", "TaskID", "NodeID", "JobID", "ObjectID", "PlacementGroupID",
+    "WorkerID", "get_gpu_ids", "get_tpu_ids", "__version__",
 ]

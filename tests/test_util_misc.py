@@ -128,3 +128,19 @@ def test_inspect_serializability_cycle():
     poisoned.ref = poisoned  # self-reference must not blow the stack
     ok, failed = inspect_serializability(poisoned, name="cyclic")
     assert not ok
+
+
+def test_top_level_api_parity_surface():
+    """Reference ray.__all__ names resolve (or are documented cuts)."""
+    import ray_tpu
+
+    # ID types exported at top level
+    for n in ("ActorID", "TaskID", "NodeID", "JobID", "ObjectID",
+              "PlacementGroupID", "WorkerID"):
+        assert hasattr(ray_tpu, n), n
+    # lazy submodule attributes after a bare `import ray_tpu`
+    assert ray_tpu.data.__name__ == "ray_tpu.data"
+    assert ray_tpu.workflow.__name__ == "ray_tpu.workflow"
+    assert ray_tpu.util.__name__ == "ray_tpu.util"
+    # accelerator-id accessor pair
+    assert ray_tpu.get_tpu_ids is ray_tpu.get_gpu_ids
