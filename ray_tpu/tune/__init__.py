@@ -7,10 +7,10 @@ PBT schedulers; per-trial checkpoints; experiment state snapshots.
 
 from .helpers import with_parameters, with_resources
 from .search import (BasicVariantGenerator, BayesOptSearcher, BOHBSearcher,
-                     Categorical, Domain, Float, GridSearch, Integer,
-                     Searcher, TPESearcher, choice, grid_search, lograndint,
-                     loguniform, qloguniform, quniform, randint, randn,
-                     sample_from, uniform)
+                     Categorical, CMAESSearcher, Domain, Float, GridSearch,
+                     Integer, Searcher, TPESearcher, choice, grid_search,
+                     lograndint, loguniform, qloguniform, quniform, randint,
+                     randn, sample_from, uniform)
 from .schedulers import (PB2, AsyncHyperBandScheduler, FIFOScheduler,
                          HyperBandScheduler, MedianStoppingRule,
                          PopulationBasedTraining, ResourceChangingScheduler,
@@ -32,7 +32,7 @@ __all__ = [
     "grid_search", "Domain", "Float", "Integer", "Categorical", "GridSearch",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "PB2", "BOHBSearcher",
+    "PopulationBasedTraining", "PB2", "BOHBSearcher", "CMAESSearcher",
     "report", "get_checkpoint", "get_session", "get_trial_id",
     "get_trial_dir", "get_trial_resources", "report_bridge",
     "ResourceChangingScheduler",

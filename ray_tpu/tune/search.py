@@ -576,3 +576,122 @@ class BayesOptSearcher(Searcher):
                         for p in self.domains])
         self._y.append(score)
         self._flats.append(flat)
+
+
+class CMAESSearcher(BayesOptSearcher):
+    """CMA-ES over the unit cube (reference parity target:
+    ``python/ray/tune/search``'s external CMA wrappers, e.g. nevergrad/
+    optuna CmaEs samplers; self-contained here — no optimizer packages
+    in the image).
+
+    Shares BayesOptSearcher's domain encoding (numeric -> unit interval,
+    log-aware, categoricals by index) but replaces the GP surrogate with
+    the standard (mu/mu_w, lambda) covariance-matrix adaptation: rank-one
+    + rank-mu covariance updates and CSA step-size control, batched into
+    generations of ``popsize`` completed trials (asynchronous trials
+    simply fill the generation as they finish)."""
+
+    def __init__(self, space: Dict[str, Any], metric: Optional[str] = None,
+                 mode: str = "max", *, popsize: Optional[int] = None,
+                 sigma0: float = 0.3, seed: Optional[int] = None):
+        import numpy as np
+
+        super().__init__(space, metric, mode, seed=seed)
+        d = max(len(self.domains), 1)
+        self.popsize = popsize or (4 + int(3 * math.log(d)))
+        if self.popsize < 2:
+            raise ValueError(
+                f"popsize must be >= 2 (got {self.popsize}): the "
+                "recombination weights need at least one parent")
+        mu = self.popsize // 2
+        w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+        self._w = w / w.sum()
+        self._mueff = 1.0 / (self._w ** 2).sum()
+        self._cc = (4 + self._mueff / d) / (d + 4 + 2 * self._mueff / d)
+        self._cs = (self._mueff + 2) / (d + self._mueff + 5)
+        self._c1 = 2 / ((d + 1.3) ** 2 + self._mueff)
+        self._cmu = min(1 - self._c1,
+                        2 * (self._mueff - 2 + 1 / self._mueff)
+                        / ((d + 2) ** 2 + self._mueff))
+        self._damps = (1 + 2 * max(0.0, math.sqrt(
+            (self._mueff - 1) / (d + 1)) - 1) + self._cs)
+        self._chi = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d * d))
+        self._mean = np.full(d, 0.5)
+        self._sigma = sigma0
+        self._C = np.eye(d)
+        self._pc = np.zeros(d)
+        self._ps = np.zeros(d)
+        self._gen: List[Tuple[float, Any]] = []   # (score, x)
+        self._pending_x: Dict[str, Any] = {}
+        self._np_rng = np.random.default_rng(seed)
+        self._eig = None  # cached (B, D) of C, invalidated per generation
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        import numpy as np
+
+        d = len(self._mean)
+        if self._eig is None:
+            vals, B = np.linalg.eigh(self._C)
+            self._eig = (B, np.sqrt(np.clip(vals, 1e-20, None)))
+        B, D = self._eig
+        z = self._np_rng.standard_normal(d)
+        y = B @ (D * z)
+        x = np.clip(self._mean + self._sigma * y, 0.0, 1.0)
+        params = list(self.domains)
+        flat = {p: self._decode_dim(self.domains[p], x[i])
+                for i, p in enumerate(params)}
+        self._live[trial_id] = flat
+        self._pending_x[trial_id] = x
+        return _build_config(self.space, flat, self.rng)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        import numpy as np
+
+        x = self._pending_x.pop(trial_id, None)
+        super().on_trial_complete(trial_id, result=result, error=error)
+        if x is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._gen.append((score, x))
+        if len(self._gen) < self.popsize:
+            return
+        # ---- one CMA generation (maximization: best first) -------------
+        self._gen.sort(key=lambda t: -t[0])
+        mu = len(self._w)
+        X = np.stack([g[1] for g in self._gen[:mu]])
+        old_mean = self._mean
+        self._gen = []
+        d = len(old_mean)
+        self._mean = self._w @ X
+        y_w = (self._mean - old_mean) / max(self._sigma, 1e-12)
+        if self._eig is None:
+            vals_, B_ = np.linalg.eigh(self._C)
+            self._eig = (B_, np.sqrt(np.clip(vals_, 1e-20, None)))
+        B, D = self._eig
+        C_inv_sqrt = B @ np.diag(1.0 / D) @ B.T
+        self._ps = ((1 - self._cs) * self._ps
+                    + math.sqrt(self._cs * (2 - self._cs) * self._mueff)
+                    * (C_inv_sqrt @ y_w))
+        hsig = (np.linalg.norm(self._ps)
+                / math.sqrt(1 - (1 - self._cs) ** (2 * (len(self._y) + 1)))
+                < (1.4 + 2 / (d + 1)) * self._chi)
+        self._pc = ((1 - self._cc) * self._pc
+                    + (math.sqrt(self._cc * (2 - self._cc) * self._mueff)
+                       * y_w if hsig else 0.0))
+        Y = (X - old_mean) / max(self._sigma, 1e-12)
+        rank_mu = (self._w[:, None, None]
+                   * (Y[:, :, None] @ Y[:, None, :])).sum(0)
+        self._C = ((1 - self._c1 - self._cmu) * self._C
+                   + self._c1 * (np.outer(self._pc, self._pc)
+                                 + (0.0 if hsig else
+                                    self._cc * (2 - self._cc)) * self._C)
+                   + self._cmu * rank_mu)
+        self._sigma *= math.exp(
+            (self._cs / self._damps)
+            * (np.linalg.norm(self._ps) / self._chi - 1))
+        self._sigma = float(np.clip(self._sigma, 1e-8, 1.0))
+        self._eig = None  # C changed: re-decompose lazily next suggest

@@ -311,3 +311,33 @@ def test_experiment_resume(ray_start_regular, tmp_path):
     assert all(t.status == "TERMINATED" for t in results.trials)
     best = results.get_best_result()
     assert best.metrics["obj"] == pytest.approx(23.0)  # x=20 + iter 3
+
+
+def test_cmaes_searcher_converges():
+    """CMA-ES adapts mean/step-size toward the optimum across
+    generations (seeded, offline — parity target: the CMA samplers tune
+    wraps via nevergrad/optuna)."""
+    from ray_tpu.tune import CMAESSearcher
+
+    space = {"x": tune.uniform(0.0, 1.0),
+             "y": tune.uniform(-2.0, 2.0),
+             "k": tune.choice(["a", "b"])}
+
+    def score(cfg):
+        return (-(cfg["x"] - 0.7) ** 2 - (cfg["y"] - 0.4) ** 2
+                - 0.05 * (cfg["k"] != "b"))
+
+    s = CMAESSearcher(space, metric="obj", mode="max", seed=0)
+    sigma0 = s._sigma
+    best = -1e9
+    for i in range(120):
+        cfg = s.suggest(f"t{i}")
+        assert 0.0 <= cfg["x"] <= 1.0 and -2.0 <= cfg["y"] <= 2.0
+        val = score(cfg)
+        best = max(best, val)
+        s.on_trial_complete(f"t{i}", {"obj": val})
+    assert best > -0.02, best
+    # step size annealed as the distribution concentrated
+    assert s._sigma < sigma0
+    with pytest.raises(ValueError, match="popsize"):
+        CMAESSearcher(space, metric="obj", popsize=1)
