@@ -443,12 +443,23 @@ except ImportError:
     except ImportError:
         _crc32c_ext = None
 
+_native_crc_state = "unloaded"  # -> callable | "failed"
+
 
 def _crc32c(data: bytes) -> int:
     """CRC-32C (Castagnoli, poly 0x1EDC6F41 reflected = 0x82F63B78).
-    Uses a C extension when available; the stdlib only ships CRC-32."""
+    Prefers a pypi C extension, then our own native component
+    (native/crc32c.cpp: SSE4.2 / slice-by-8, compiled lazily on the
+    FIRST checksum so importing this module never spawns g++), then the
+    pure-Python table loop."""
+    global _native_crc_state
     if _crc32c_ext is not None:
         return _crc32c_ext.crc32c(data) & 0xFFFFFFFF
+    if _native_crc_state == "unloaded":
+        from ray_tpu.native import load_crc32c
+        _native_crc_state = load_crc32c() or "failed"
+    if _native_crc_state != "failed":
+        return _native_crc_state(data) & 0xFFFFFFFF
     global _CRC32C_TABLE
     if _CRC32C_TABLE is None:
         table = []

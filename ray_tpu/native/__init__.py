@@ -27,16 +27,17 @@ _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "shm_pool.cpp")
 
 
-def _build_lib() -> Optional[str]:
-    """Compile the .so next to the source (cached by mtime)."""
-    out = os.path.join(os.path.dirname(_SRC), "libshmpool.so")
+def _build_lib(src: str = _SRC, name: str = "libshmpool.so"
+               ) -> Optional[str]:
+    """Compile a .so next to its source (cached by mtime)."""
+    out = os.path.join(os.path.dirname(src), name)
     try:
         if (os.path.exists(out)
-                and os.path.getmtime(out) >= os.path.getmtime(_SRC)):
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
             return out
         tmp = out + f".tmp{os.getpid()}"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
         return out
@@ -75,6 +76,43 @@ def load_shm_pool() -> Optional[ctypes.CDLL]:
         lib.rt_pool_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
         _LIB = lib
         return _LIB
+
+
+_CRC_LIB: Optional[ctypes.CDLL] = None
+_CRC_FAILED = False
+
+
+def load_crc32c():
+    """Native CRC-32C ``fn(data: bytes) -> int``, or None (callers fall
+    back to the pure-Python table loop). SSE4.2 hardware CRC when the
+    CPU has it — the TFRecord/TensorBoard write paths checksum every
+    payload, where ~10 MB/s pure Python is the bottleneck."""
+    global _CRC_LIB, _CRC_FAILED
+    if _CRC_LIB is not None or _CRC_FAILED:
+        return _crc_fn if _CRC_LIB is not None else None
+    with _BUILD_LOCK:
+        if _CRC_LIB is not None or _CRC_FAILED:
+            return _crc_fn if _CRC_LIB is not None else None
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "crc32c.cpp")
+        path = _build_lib(src, "libcrc32c.so")
+        if path is None:
+            _CRC_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.rt_crc32c.restype = ctypes.c_uint32
+            lib.rt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_uint32]
+        except (OSError, AttributeError):
+            _CRC_FAILED = True
+            return None
+        _CRC_LIB = lib
+        return _crc_fn
+
+
+def _crc_fn(data: bytes, seed: int = 0) -> int:
+    return _CRC_LIB.rt_crc32c(data, len(data), seed)
 
 
 class ShmPool:

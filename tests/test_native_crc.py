@@ -1,0 +1,84 @@
+"""Native CRC-32C component (ray_tpu/native/crc32c.cpp — the data-path
+checksum behind TFRecord framing and the TensorBoard event writer)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.native import load_crc32c
+
+
+@pytest.fixture(scope="module")
+def crc():
+    fn = load_crc32c()
+    if fn is None:
+        pytest.skip("native crc32c unavailable (no g++)")
+    return fn
+
+
+def test_known_vectors(crc):
+    # RFC 3720 / crc32c reference vectors
+    assert crc(b"123456789") == 0xE3069283
+    assert crc(b"") == 0x00000000
+    assert crc(b"\x00" * 32) == 0x8A9136AA
+    assert crc(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_matches_pure_python(crc):
+    from ray_tpu.data.datasource import _CRC32C_TABLE  # noqa: F401
+    # force the pure-python path for comparison
+    import ray_tpu.data.datasource as ds
+
+    def pure(data):
+        saved = ds._crc32c_ext, ds._native_crc_state
+        ds._crc32c_ext, ds._native_crc_state = None, "failed"
+        try:
+            return ds._crc32c(data)
+        finally:
+            ds._crc32c_ext, ds._native_crc_state = saved
+
+    rng = np.random.default_rng(0)
+    for n in (1, 7, 8, 9, 63, 64, 1000, 4096):
+        buf = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert crc(buf) == pure(buf), n
+
+
+def test_tfrecord_roundtrip_uses_native(tmp_path, crc):
+    """The framing written through the (now native) masked CRC parses
+    back — and matches what TF's reader would verify."""
+    from ray_tpu.data.datasource import (_masked_crc32c, _tfrecord_frame)
+    import struct
+
+    payload = b"hello tfrecord"
+    frame = _tfrecord_frame(payload)
+    length = struct.unpack("<Q", frame[:8])[0]
+    assert length == len(payload)
+    (len_crc,) = struct.unpack("<I", frame[8:12])
+    assert len_crc == _masked_crc32c(frame[:8])
+    data = frame[12:12 + length]
+    (data_crc,) = struct.unpack("<I", frame[12 + length:16 + length])
+    assert data == payload
+    assert data_crc == _masked_crc32c(payload)
+
+
+def test_throughput_sanity(crc):
+    """Native path must beat the pure-python loop by a wide margin —
+    this is the reason the component exists (soft gate: 10x)."""
+    import time
+
+    import ray_tpu.data.datasource as ds
+
+    buf = bytes(1_000_000)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        crc(buf)
+    native_s = time.perf_counter() - t0
+
+    saved = ds._crc32c_ext, ds._native_crc_state
+    ds._crc32c_ext, ds._native_crc_state = None, "failed"
+    try:
+        t0 = time.perf_counter()
+        ds._crc32c(buf[:100_000])
+        pure_s = (time.perf_counter() - t0) * 10  # scale to 1MB
+    finally:
+        ds._crc32c_ext, ds._native_crc_state = saved
+    assert native_s / 5 < pure_s / 10, (native_s, pure_s)
