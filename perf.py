@@ -33,18 +33,31 @@ BASELINE = {
 }
 
 
-def timeit(fn, n: int, warmup: int = 1) -> float:
-    """ops/s of fn() called n times (fn itself may batch internally)."""
+_REPS = 3  # per-metric repetitions inside one suite pass (see --reps)
+
+
+def timeit(fn, n: int, warmup: int = 1) -> list:
+    """Per-rep ops/s samples of fn() called n times (fn may batch internally).
+
+    Repeating the timed region _REPS times per suite pass is what stabilizes
+    the headline multipliers: single-shot samples on this 1-core box swing
+    +/-40% (e.g. PERF_r05 get_small IQR 52k on a 94k median), and the
+    aggregator needs several samples per metric to quote a meaningful
+    median + IQR + min."""
     for _ in range(warmup):
         fn()
-    t0 = time.perf_counter()
-    fn()
-    dt = time.perf_counter() - t0
-    return n / dt
+    samples = []
+    for _ in range(max(_REPS, 1)):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        samples.append(n / dt)
+    return samples
 
 
 def run_suite(S: float, with_serve: bool) -> dict:
-    """One full pass over the microbench suite on a fresh cluster."""
+    """One full pass over the microbench suite on a fresh cluster.
+    Every metric maps to a LIST of per-rep ops/s samples."""
     import numpy as np
 
     import ray_tpu
@@ -119,8 +132,8 @@ def run_suite(S: float, with_serve: bool) -> dict:
             for _ in range(n):
                 ray_tpu.put(big)
 
-        ops = timeit(put_big, n)
-        results["put_gbps"] = ops * big.nbytes / 1e9
+        results["put_gbps"] = [ops * big.nbytes / 1e9
+                               for ops in timeit(put_big, n)]
 
         refs = [noop.remote() for _ in range(1000)]
         ray_tpu.get(refs)
@@ -162,18 +175,21 @@ def run_suite(S: float, with_serve: bool) -> dict:
 
 
 def main():
+    global _REPS
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None)
     p.add_argument("--scale", type=float, default=1.0,
                    help="shrink/grow iteration counts")
     p.add_argument("--serve", action="store_true",
                    help="include the Serve noop benchmark (slower)")
-    p.add_argument("--runs", type=int, default=1,
+    p.add_argument("--runs", type=int, default=3,
                    help="repeat the whole suite N times (fresh cluster "
-                        "each) and report per-metric median + IQR — "
-                        "single runs on this 1-core box swing +/-40%%, so "
-                        "perf claims need --runs >= 5")
+                        "each); with --reps samples per metric per run the "
+                        "aggregate reports median + IQR + min per metric")
+    p.add_argument("--reps", type=int, default=_REPS,
+                   help="timed repetitions per metric within one suite pass")
     args = p.parse_args()
+    _REPS = max(args.reps, 1)
 
     all_runs = []
     for r in range(args.runs):
@@ -181,7 +197,7 @@ def main():
         all_runs.append(res)
         if args.runs > 1:
             print(f"# run {r + 1}/{args.runs}: "
-                  f"{json.dumps({k: round(v, 1) for k, v in res.items()})}",
+                  f"{json.dumps({k: [round(x, 1) for x in v] for k, v in res.items()})}",
                   flush=True)
 
     def quantile(xs, q):
@@ -191,13 +207,19 @@ def main():
         return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
 
     metrics = list(all_runs[0])
-    med = {k: quantile([r[k] for r in all_runs], 0.5) for k in metrics}
-    iqr = {k: quantile([r[k] for r in all_runs], 0.75)
-           - quantile([r[k] for r in all_runs], 0.25) for k in metrics}
+    samples = {k: [x for r in all_runs for x in r[k]] for k in metrics}
+    med = {k: quantile(samples[k], 0.5) for k in metrics}
+    iqr = {k: quantile(samples[k], 0.75) - quantile(samples[k], 0.25)
+           for k in metrics}
+    # Schema note: "results"/"iqr"/"vs_baseline" keep their PERF_r0X.json
+    # meaning (median ops/s per metric); "min"/"samples_per_metric" are
+    # additive so older rounds still diff cleanly.
     out = {"metric": "core_microbench", "unit": "ops/s",
            "runs": args.runs,
+           "samples_per_metric": args.runs * max(args.reps, 1),
            "results": {k: round(v, 1) for k, v in med.items()},
            "iqr": {k: round(v, 1) for k, v in iqr.items()},
+           "min": {k: round(min(samples[k]), 1) for k in metrics},
            "vs_baseline": {k: round(med[k] / BASELINE[k], 3)
                            for k in metrics if k in BASELINE}}
     line = json.dumps(out)

@@ -51,6 +51,21 @@ SchedulingStrategy = Any  # "DEFAULT" | "SPREAD" | one of the dataclasses above
 # return count is dynamic; yields become owner-owned objects as they arrive.
 STREAMING_RETURNS = -1
 
+#: inlined-args blobs at least this large ship as pickle-5 out-of-band
+#: buffers.  Tied to the RPC layer's vectored-frame threshold: a
+#: PickleBuffer below rpc._VEC_MIN_BUF would be wrapped but still
+#: serialized in-band, silently defeating the point.
+from .rpc import _VEC_MIN_BUF as _VECTORED_ARGS_MIN
+
+
+def _rebuild_task_spec(kw: dict, args_buf) -> "TaskSpec":
+    # Out-of-band receive hands us the transport's bytes object directly
+    # (zero-copy); in-band protocol-5 decodes to bytes as well.  Coerce any
+    # other buffer type so later re-pickles (lineage copies at protocol 4)
+    # keep working.
+    kw["args"] = args_buf if isinstance(args_buf, bytes) else bytes(args_buf)
+    return TaskSpec(**kw)
+
 
 @dataclass
 class TaskSpec:
@@ -107,6 +122,21 @@ class TaskSpec:
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def __reduce_ex__(self, protocol):
+        # Large inlined args ride out-of-band at protocol 5+ so a
+        # push_task_batch carrying a big serialized argument blob never
+        # concatenates it through the frame's pickle stream (see the RPC
+        # layer's vectored frames).  Protocol < 5 (lineage deep-copies via
+        # pickle.dumps default) keeps the plain dataclass reduce.
+        if protocol >= 5 and isinstance(self.args, bytes) \
+                and len(self.args) >= _VECTORED_ARGS_MIN:
+            import dataclasses
+            import pickle as _pickle
+            kw = {f.name: getattr(self, f.name)
+                  for f in dataclasses.fields(self) if f.name != "args"}
+            return (_rebuild_task_spec, (kw, _pickle.PickleBuffer(self.args)))
+        return super().__reduce_ex__(protocol)
 
 
 @dataclass

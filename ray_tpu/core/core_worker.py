@@ -55,6 +55,34 @@ _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 
 
+class _ReadPin:
+    """Consumer-side half of the store's pin/release protocol: one pin taken
+    by ``fetch_object(pin=True)``, released when the LAST zero-copy buffer
+    view deserialized over the pinned mapping is garbage-collected (the
+    lease-carrying buffer exporters in ``serialization._attach_lease`` hold
+    the only other references).  Release is idempotent and GC-safe: it only
+    schedules a fire-and-forget notify onto the IO loop."""
+
+    __slots__ = ("_worker", "_oid", "_released")
+
+    def __init__(self, worker: "CoreWorker", oid: ObjectID):
+        self._worker = worker
+        self._oid = oid
+        self._released = False
+
+    def release(self):
+        if self._released:
+            return
+        self._released = True
+        self._worker.release_read_pin(self._oid)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
 def global_worker() -> "CoreWorker":
     if _global_worker is None:
         raise RuntimeError("ray_tpu.init() has not been called")
@@ -150,6 +178,16 @@ class ReferenceCounter:
         with self._lock:
             return (self.local.get(oid, 0) > 0 or self.submitted.get(oid, 0) > 0
                     or self.borrowers.get(oid, 0) > 0)
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-object refcount snapshot (the ``raytpu memory`` data source):
+        {object_id_hex: {local, submitted, borrowers}}."""
+        with self._lock:
+            oids = set(self.local) | set(self.submitted) | set(self.borrowers)
+            return {oid.hex(): {"local": self.local.get(oid, 0),
+                                "submitted": self.submitted.get(oid, 0),
+                                "borrowers": self.borrowers.get(oid, 0)}
+                    for oid in oids}
 
 
 # ---------------------------------------------------------------------------
@@ -928,44 +966,105 @@ class CoreWorker:
 
     async def _record_to_value(self, ref: ObjectRef, record) -> Any:
         if isinstance(record, PlasmaRecord):
-            data = await self._fetch_plasma(ref, record)
+            data, pin = await self._fetch_plasma(ref, record)
             so = serialization.SerializedObject.from_buffer(data)
-            return serialization.deserialize(so)
+            return serialization.deserialize(so, pin_lease=pin)
         return self._inline_record_to_value(ref, record)
 
     async def _fetch_plasma(self, ref: ObjectRef, record: PlasmaRecord):
+        """-> (buffer, pin | None): the flattened object bytes, zero-copy
+        over the pinned store mapping when the agent granted a read pin."""
         if self.agent is None:
             # Driver without an agent (shouldn't happen) — pull chunks directly.
             node_id, addr = record.locations[0]
             client = self.agent_clients.get(addr)
-            return await client.call("read_chunk", object_id=ref.id, offset=0,
+            data = await client.call("read_chunk", object_id=ref.id, offset=0,
                                      length=record.size)
+            return data, None
         try:
             res = await self.agent.call("fetch_object", object_id=ref.id,
                                         size=record.size,
                                         locations=record.locations,
-                                        owner=ref.owner or self.address)
+                                        owner=ref.owner or self.address,
+                                        pin=True,
+                                        pinner=self.address)
             return await self._read_fetched(ref.id, res)
         except (RemoteError, ConnectionLost):
             return await self._try_reconstruct(ref, record)
 
     async def _read_fetched(self, object_id: ObjectID, res: dict):
-        """Read a fetched object from the local store, re-validating arena
-        slices: the arena recycles offsets on eviction, so after copying the
-        bytes we confirm with the agent (whose loop serializes with
-        eviction) that the object still lives at that path; a recycled slot
-        re-fetches instead of returning another object's bytes."""
+        """Read a fetched object from the local store -> (buffer, pin|None).
+
+        Pinned fast path (the plasma-client protocol): the agent pinned the
+        object before replying, so the mapping cannot be evicted or its
+        arena offset recycled under us — attach a ZERO-COPY readonly view
+        and hand back a pin lease that the deserialized buffers release on
+        GC.  Unpinned fallback: copy out, then re-validate with the agent
+        (whose loop serializes with eviction) that the object still lives
+        at that path; a recycled slot re-fetches instead of returning
+        another object's bytes."""
         for _ in range(3):
-            data = self.shm_reader.read(res["path"], res["size"])
-            if "#" not in res["path"]:
-                return data  # file-backed: unlink semantics keep views safe
-            ok = await self.agent.call("store_verify", object_id=object_id,
-                                       path=res["path"])
+            if res.get("pinned"):
+                # Construct the pin guard BEFORE attaching: if view() fails
+                # (pool unlinked across an agent restart, mmap error), the
+                # agent-side pin must still be released or the object stays
+                # unevictable forever.  On failure, fall through to the
+                # copy+verify path.
+                pin = _ReadPin(self, object_id)
+                try:
+                    view = self.shm_reader.view(res["path"], res["size"])
+                except OSError:
+                    pin.release()
+                else:
+                    return view, pin
+            try:
+                data = self.shm_reader.read(res["path"], res["size"])
+            except OSError:
+                # Stale path — e.g. the pool file was unlinked across an
+                # agent restart.  The same OSError that broke view() above
+                # breaks this read too; treat it like a failed verify and
+                # refetch rather than leaking a raw FileNotFoundError.
+                ok = False
+            else:
+                if "#" not in res["path"]:
+                    return data, None  # file-backed: unlink keeps views safe
+                ok = await self.agent.call("store_verify",
+                                           object_id=object_id,
+                                           path=res["path"])
             if ok:
-                return data
+                return data, None
             res = await self.agent.call("fetch_object", object_id=object_id,
-                                        size=res["size"], locations=[])
+                                        size=res["size"], locations=[],
+                                        pin=True,
+                                        pinner=self.address)
+        # Retries exhausted: the FINAL refetch above may have granted a pin
+        # nothing will ever view — release it or the object (and the agent's
+        # ledger entry) stays pinned until this whole process exits.
+        if res.get("pinned"):
+            self.release_read_pin(object_id)
         raise ObjectLostError(object_id)
+
+    def release_read_pin(self, oid: ObjectID):
+        """Fire-and-forget ``store_unpin_read`` to our agent (called from
+        ``_ReadPin``, possibly on a GC/finalizer thread)."""
+        if self._shutdown or self.agent is None:
+            return
+        try:
+            loop = get_loop()
+        except Exception:
+            return
+
+        async def _send():
+            try:
+                await self.agent.notify("store_unpin_read", object_id=oid,
+                                        pinner=self.address)
+            except Exception:
+                pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(_send(), loop)
+        except Exception:
+            pass
 
     async def _try_reconstruct(self, ref: ObjectRef, record: PlasmaRecord):
         """Lineage reconstruction (reference: object_recovery_manager.h:41)."""
@@ -980,7 +1079,9 @@ class CoreWorker:
                 ObjectRef(ref.id, owner=ref.owner, _register=False), None)
             if isinstance(rec, PlasmaRecord):
                 res = await self.agent.call("fetch_object", object_id=ref.id,
-                                            size=rec.size, locations=rec.locations)
+                                            size=rec.size,
+                                            locations=rec.locations, pin=True,
+                                        pinner=self.address)
                 return await self._read_fetched(ref.id, res)
             raise ObjectLostError(ref.id)
         spec = self.task_manager.lineage.get(ref.id.task_id())
@@ -997,12 +1098,14 @@ class CoreWorker:
             ObjectRef(ref.id, owner=self.address, _register=False), None)
         if isinstance(rec, PlasmaRecord):
             res = await self.agent.call("fetch_object", object_id=ref.id,
-                                        size=rec.size, locations=rec.locations)
+                                        size=rec.size, locations=rec.locations,
+                                        pin=True,
+                                        pinner=self.address)
             return await self._read_fetched(ref.id, res)
         if isinstance(rec, ErrorRecord):
             exc, tb = pickle.loads(rec.error)
             raise TaskError(exc, "reconstruction", tb)
-        return rec  # inline bytes — caller deserializes? handled below
+        return rec, None  # inline flat bytes — caller deserializes
 
     # ------------------------------------------------------------------ wait
 

@@ -122,6 +122,15 @@ class NodeAgent:
         except OSError:
             shm_dev = 0
         self.host_key = f"{_socket.gethostname()}:{shm_dev}"
+        # Read-pin bookkeeping by CONSUMER address (the plasma analogue of
+        # releasing a client's pins on socket disconnect): a worker that
+        # dies with live zero-copy views — OOM kill, crash — never sends
+        # its store_unpin_read, so _on_worker_exit drains its pins here
+        # instead of leaking the objects unevictable forever.  Each grant
+        # records the store-record KIND it pinned ("local"/"proxy", from
+        # pin_for_read) so the release decrements the same record:
+        # {consumer_addr: {object_id: {kind: count}}}.
+        self._read_pins: Dict[str, Dict[ObjectID, Dict[str, int]]] = {}
         # worker_id -> memory-monitor kill cause, consumed by the lease
         # return so the owner raises a typed OutOfMemoryError.
         self._oom_kills: Dict[str, str] = {}
@@ -145,6 +154,7 @@ class NodeAgent:
         self._apply_view(res["cluster_view"])
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._idle_reaper_loop()))
+        self._bg.append(asyncio.ensure_future(self._pin_sweep_loop()))
         self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         cfg = get_config()
@@ -358,6 +368,7 @@ class NodeAgent:
         prev_state = w.state
         w.state = "DEAD"
         self.workers.pop(w.worker_id, None)
+        await self._drain_read_pins(w.address)
         # Wake any _grant_lease waiter parked on registration (a worker that
         # crashes during boot must fail the grant now, not after the full
         # register timeout) — same handshake as _kill_worker_proc.
@@ -392,6 +403,8 @@ class NodeAgent:
         was_dead = w.state == "DEAD"
         w.state = "DEAD"
         self.workers.pop(w.worker_id, None)
+        if not was_dead:
+            await self._drain_read_pins(w.address)
         # Release any lease the victim held (kill paths bypass _on_worker_exit,
         # which early-returns once the state is DEAD).
         if not was_dead and w.lease_id:
@@ -846,7 +859,10 @@ class NodeAgent:
             ok = await self.store.wait_sealed(object_id, timeout)
             if not ok:
                 return None
-        path, size = self.store.get_path(object_id)
+        located = self.store.get_path(object_id)
+        if located is None:
+            return None  # freed-deferred (sealed but deleted) or evicted
+        path, size = located
         return {"path": path, "size": size}
 
     async def handle_store_verify(self, object_id: ObjectID,
@@ -857,13 +873,16 @@ class NodeAgent:
         interleaved with the caller's copy (the file-per-object store never
         needed this: an unlinked file cannot alias a new object)."""
         e = self.store._entries.get(object_id)
-        if e is not None and e.sealed and e.segment.path == path:
+        if e is not None and e.sealed and not e.freed \
+                and e.segment.path == path:
             return True
         # Same-host proxy: the pin we hold on the source's real entry keeps
         # that slice from being evicted (and its offset from being reused)
         # for as long as the proxy exists, so presence-at-path IS validity.
+        # A freed-deferred proxy fails verification: its slice outlives only
+        # the current pin holders, not this caller's copy.
         p = self.store._proxies.get(object_id)
-        if p is not None and p.path == path:
+        if p is not None and not p.freed and p.path == path:
             return True
         # evicted-but-spilled (or restored elsewhere): not at `path` anymore
         return False
@@ -877,12 +896,15 @@ class NodeAgent:
         than being restored from disk just to satisfy a probe from a puller
         that may pick a different source (the byte-pull path restores on
         read_chunk when this node is actually chosen)."""
+        # freed-deferred records are deleted, just not yet reclaimed: they
+        # must be invisible to prospective pullers (same invariant as
+        # contains/get_path/store_verify).
         e = self.store._entries.get(object_id)
-        if e is not None and e.sealed:
+        if e is not None and e.sealed and not e.freed:
             return {"path": e.segment.path, "size": e.size,
                     "host_key": self.host_key, "proxy": False}
         p = self.store._proxies.get(object_id)
-        if p is not None:
+        if p is not None and not p.freed:
             return {"path": p.path, "size": p.size,
                     "host_key": self.host_key, "proxy": True}
         return None
@@ -891,25 +913,113 @@ class NodeAgent:
         """Pin a REAL local entry for a same-host proxy holder (proxies can't
         be pinned — the second-level puller falls back to the true origin)."""
         e = self.store._entries.get(object_id)
-        if e is None or not e.sealed:
+        if e is None or not e.sealed or e.freed:
             return False
         self.store.pin(object_id)
         return True
 
     async def handle_unpin_object(self, object_id: ObjectID):
-        self.store.unpin(object_id)
+        await self._unpin_and_chain(object_id)
+
+    async def handle_store_unpin_read(self, object_id: ObjectID,
+                                      pinner: Optional[str] = None):
+        """A consumer's last zero-copy view over ``object_id`` died: drop
+        the read pin taken by ``fetch_object(pin=True)``.  May complete a
+        deferred free — and for proxies, forward the release to the source
+        agent whose slice backed the view.
+
+        A release with no matching ledger record is STALE — the consumer's
+        pins were already drained on its death/disconnect and this notify
+        was in flight — and must be ignored, not applied: the store counter
+        it would decrement now belongs to another consumer's pin."""
+        if pinner:
+            per = self._read_pins.get(pinner)
+            kinds = per.get(object_id) if per is not None else None
+            if not kinds:
+                return True
+            kind = next(iter(kinds))
+            kinds[kind] -= 1
+            if kinds[kind] <= 0:
+                del kinds[kind]
+            if not kinds:
+                per.pop(object_id, None)
+                if not per:
+                    self._read_pins.pop(pinner, None)
+            await self._unpin_and_chain(object_id, kind)
+        else:
+            await self._unpin_and_chain(object_id)
+        return True
+
+    async def _pin_sweep_loop(self):
+        """Liveness sweep for read-pin holders the worker monitor does not
+        cover — chiefly the DRIVER, which is a consumer but not a spawned
+        worker.  A consumer that vanishes without its exit drain (SIGKILL,
+        or leases GC'd after the worker's shutdown flag suppressed the
+        release notify) would otherwise leave its objects pinned —
+        unevictable, frees deferred — for the agent's whole lifetime.
+        Every consumer runs an RPC server with a ``ping`` handler, so a
+        repeatedly unreachable pinner address means the process is gone.
+        Draining on confirmed death only: a TIMEOUT means alive-but-busy,
+        and a single connect failure can be transient (fd exhaustion, one
+        dropped pooled connection) — releasing a LIVE consumer's pins
+        would let the arena recycle slices under its views, so death takes
+        three consecutive failed sweeps (~30 s) to declare."""
+        strikes: Dict[str, int] = {}
+        while not self._shutting_down:
+            await asyncio.sleep(10.0)
+            managed = {w.address for w in self.workers.values()}
+            for addr in [a for a in list(self._read_pins)
+                         if a not in managed]:
+                try:
+                    await asyncio.wait_for(
+                        self.worker_clients.get(addr).call("ping"), 5.0)
+                    strikes.pop(addr, None)
+                except asyncio.TimeoutError:
+                    continue
+                except Exception:
+                    # drop the pooled (possibly wedged) connection so the
+                    # next strike probes with a fresh connect
+                    await self.worker_clients.close(addr)
+                    strikes[addr] = strikes.get(addr, 0) + 1
+                    if strikes[addr] >= 3:
+                        strikes.pop(addr, None)
+                        if addr in self._read_pins:
+                            await self._drain_read_pins(addr)
+            for a in list(strikes):
+                if a not in self._read_pins:
+                    strikes.pop(a)
+
+    async def _drain_read_pins(self, consumer_addr: Optional[str]):
+        """Release every read pin a dead consumer still held (the plasma
+        disconnect-releases-pins contract); completes deferred frees."""
+        if not consumer_addr:
+            return
+        for oid, kinds in self._read_pins.pop(consumer_addr, {}).items():
+            for kind, count in kinds.items():
+                for _ in range(count):
+                    await self._unpin_and_chain(oid, kind)
+
+    async def _unpin_and_chain(self, object_id: ObjectID,
+                               kind: Optional[str] = None):
+        await self._notify_source_unpin(self.store.unpin(object_id, kind),
+                                        object_id)
+
+    async def _notify_source_unpin(self, source: Optional[str],
+                                   object_id: ObjectID):
+        """A completed free of a same-host proxy returns the SOURCE agent's
+        address: release the transfer pin we hold on its real entry so the
+        origin slice becomes evictable again."""
+        if not source:
+            return
+        try:
+            await self.agent_clients.get(source).notify(
+                "unpin_object", object_id=object_id)
+        except Exception:
+            pass
 
     async def handle_store_free(self, object_ids: List[ObjectID]):
         for oid in object_ids:
-            source = self.store.free(oid)
-            if source:
-                # Freed a same-host proxy: release the pin we hold on the
-                # source store so the origin becomes evictable again.
-                try:
-                    await self.agent_clients.get(source).notify(
-                        "unpin_object", object_id=oid)
-                except Exception:
-                    pass
+            await self._notify_source_unpin(self.store.free(oid), oid)
         return True
 
     async def handle_store_contains(self, object_id: ObjectID) -> bool:
@@ -918,34 +1028,79 @@ class NodeAgent:
     async def handle_store_stats(self):
         return self.store.stats()
 
+    async def handle_store_objects(self):
+        """Per-object refcount/size/location rows for ``raytpu memory``."""
+        rows = self.store.objects()
+        for r in rows:
+            r["node_id"] = self.node_id.hex()
+        return rows
+
     # -------------------------------------------------------- object transfer
 
     async def handle_read_chunk(self, object_id: ObjectID, offset: int, length: int):
         """Serve a chunk of a sealed local object to a remote agent
-        (reference: chunked object push/pull, object_manager.proto:61)."""
-        return self.store.read_chunk(object_id, offset, length)
+        (reference: chunked object push/pull, object_manager.proto:61).
+
+        The copy out of the store is deliberate (the reply flushes a loop
+        tick later, and eviction must not be able to mutate in-flight
+        bytes); the PickleBuffer wrapper makes that copy the LAST one on
+        this side — the RPC layer ships it as an out-of-band vectored
+        frame instead of re-copying it through the pickle stream."""
+        import pickle as _pickle
+        return _pickle.PickleBuffer(
+            self.store.read_chunk(object_id, offset, length))
 
     async def handle_fetch_object(self, object_id: ObjectID, size: int,
                                   locations: List[Tuple[str, str]],
-                                  owner: Optional[str] = None):
+                                  owner: Optional[str] = None,
+                                  pin: bool = False,
+                                  pinner: Optional[str] = None):
         """Ensure `object_id` is in the local store, pulling from a remote node
-        if needed. Returns {path, size} (reference: PullManager admission-
-        controlled prioritized pulls + PushManager chunked transfer).
+        if needed. Returns {path, size, pinned} (reference: PullManager
+        admission-controlled prioritized pulls + PushManager chunked
+        transfer).
+
+        ``pin=True`` atomically pins the located object for the caller
+        before replying (no await between locate and pin, and this loop is
+        the only evictor — so a ``pinned: True`` reply guarantees the path
+        stays valid until the caller's ``store_unpin_read``).  Followers of
+        a deduped pull pin independently: the shared in-flight future
+        carries only {path, size}.
 
         Broadcast shape: the source location is picked at RANDOM from the
         owner's list, and a completed pull REPORTS this node back to the
         owner — so an N-node broadcast fans out over a doubling set of
         sources (tree propagation) instead of hammering the origin."""
+        res = await self._locate_or_pull(object_id, size, locations, owner)
+        res = dict(res)
+        # A pin needs a ledger entry or it can never be drained: grant only
+        # when the caller identifies itself.
+        kind = self.store.pin_for_read(object_id) if (pin and pinner) else None
+        res["pinned"] = kind is not None
+        if kind and pinner:
+            kinds = self._read_pins.setdefault(pinner, {}).setdefault(
+                object_id, {})
+            kinds[kind] = kinds.get(kind, 0) + 1
+        return res
+
+    async def _locate_or_pull(self, object_id: ObjectID, size: int,
+                              locations: List[Tuple[str, str]],
+                              owner: Optional[str]):
         if self.store.contains(object_id):
             path, sz = self.store.get_path(object_id)
             return {"path": path, "size": sz}
-        if object_id in self.store._entries:
+        e = self.store._entries.get(object_id)
+        if e is not None and not e.freed:
             # Created locally but not sealed yet: the writer's one-way seal
             # (or its in-progress copy) is still in flight — park on it
-            # rather than treating a local object as remote.
+            # rather than treating a local object as remote.  (A freed-
+            # deferred entry is sealed but DELETED: fall through to the
+            # remote pull instead of serving it.)
             if await self.store.wait_sealed(object_id, 30.0):
-                path, sz = self.store.get_path(object_id)
-                return {"path": path, "size": sz}
+                located = self.store.get_path(object_id)
+                if located is not None:
+                    path, sz = located
+                    return {"path": path, "size": sz}
         # Dedup concurrent pulls of the same object: followers await the
         # leader's transfer instead of pulling a second copy.
         inflight = self._inflight_pulls.get(object_id)
@@ -1087,7 +1242,12 @@ class NodeAgent:
                                 address=self.server.address)
                         except Exception:
                             pass
-                    path, sz = self.store.get_path(object_id)
+                    located = self.store.get_path(object_id)
+                    if located is None:
+                        # freed/evicted while the pull's awaits ran
+                        raise RuntimeError(
+                            f"object {object_id} vanished during pull")
+                    path, sz = located
                     return {"path": path, "size": sz}
                 except Exception as e:  # noqa: BLE001 — try next location
                     last_err = e

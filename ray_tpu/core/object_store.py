@@ -32,7 +32,6 @@ from .ids import ObjectID
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
 
-
 class ObjectStoreFullError(Exception):
     pass
 
@@ -166,7 +165,8 @@ class _Entry:
     segment: ShmSegment
     size: int
     sealed: bool = False
-    pinned: int = 0          # pin count from in-flight gets/pending transfers
+    pinned: int = 0          # pin count: live reader views + peer transfers
+    freed: bool = False      # owner freed it while pins were live (deferred)
     last_access: float = field(default_factory=time.monotonic)
 
 
@@ -183,6 +183,8 @@ class _ProxyEntry:
     path: str
     size: int
     source_addr: str
+    pinned: int = 0          # reader pins on the proxy itself
+    freed: bool = False      # free deferred until the pins release
 
 
 class NodeObjectStore:
@@ -338,10 +340,15 @@ class NodeObjectStore:
         OR spilled to this node's disk (get_path restores spilled entries
         transparently — without this, fetch_object would declare a
         spilled-but-local object lost)."""
+        # freed-deferred records (owner freed them; only live reader pins
+        # keep the bytes around) are NOT retrievable: serving them would
+        # hand new fetchers a deleted object whose slice is reclaimed the
+        # moment the last pin releases.
         e = self._entries.get(object_id)
-        if e is not None and e.sealed:
+        if e is not None and e.sealed and not e.freed:
             return True
-        if object_id in self._proxies:
+        p = self._proxies.get(object_id)
+        if p is not None and not p.freed:
             return True
         return object_id in self._spilled
 
@@ -362,9 +369,11 @@ class NodeObjectStore:
 
     def get_path(self, object_id: ObjectID) -> Optional[tuple[str, int]]:
         p = self._proxies.get(object_id)
-        if p is not None:
+        if p is not None and not p.freed:
             return p.path, p.size
         e = self._entries.get(object_id)
+        if e is not None and e.freed:
+            return None  # freed-deferred: not servable (see contains())
         if e is None or not e.sealed:
             if e is None:
                 self._maybe_restore(object_id)
@@ -381,6 +390,10 @@ class NodeObjectStore:
         if e is None:
             self._maybe_restore(object_id)
             e = self._entries[object_id]
+        if e.freed:
+            # deleted, just not yet reclaimed (reader pins live): a remote
+            # puller must try another source, not copy a freed object
+            raise KeyError(f"object {object_id} is freed")
         e.last_access = time.monotonic()
         return bytes(e.segment.view()[offset:offset + length])
 
@@ -389,20 +402,96 @@ class NodeObjectStore:
         return e.size if e else None
 
     # -- lifetime ---------------------------------------------------------
+    #
+    # Pin/release protocol (the plasma-client contract the round-1 reader
+    # deferred with a defensive copy per read): a consumer pins BEFORE
+    # taking a zero-copy view over an arena slice, and releases when its
+    # last view is garbage-collected.  While any pin is live the slice's
+    # offset cannot be recycled: eviction skips pinned entries, and an
+    # owner-initiated free is DEFERRED — marked ``freed`` and completed by
+    # the final unpin.  All transitions run on the agent's IO loop, so
+    # pin-after-locate cannot race an eviction.
 
     def pin(self, object_id: ObjectID):
         e = self._entries.get(object_id)
         if e:
             e.pinned += 1
 
-    def unpin(self, object_id: ObjectID):
-        e = self._entries.get(object_id)
-        if e and e.pinned > 0:
-            e.pinned -= 1
+    def pin_for_read(self, object_id: ObjectID) -> Optional[str]:
+        """Pin a same-host proxy OR a sealed entry for a reader's view.
 
-    def free(self, object_id: ObjectID) -> Optional[str]:
+        Returns the KIND of record pinned ("proxy" / "local", truthy) or
+        None.  Priority mirrors :meth:`get_path` — the record pinned must
+        be the one whose path the reader was handed, or the pin protects
+        the wrong mapping.  The caller keeps the kind and passes it back
+        to :meth:`unpin` so a release can never decrement the twin record
+        (entry and proxy can coexist with independent pin counts)."""
+        p = self._proxies.get(object_id)
+        if p is not None and not p.freed:
+            p.pinned += 1
+            return "proxy"
+        e = self._entries.get(object_id)
+        if e is not None and e.sealed and not e.freed:
+            e.pinned += 1
+            return "local"
+        return None
+
+    def unpin(self, object_id: ObjectID, kind: Optional[str] = None) -> Optional[str]:
+        """Drop one pin; completes a deferred free when the last pin goes.
+        Returns the proxy SOURCE address if the completed free was a proxy
+        (the caller owes the source an unpin notify).
+
+        ``kind`` ("local" / "proxy", from :meth:`pin_for_read`) targets the
+        record the pin was granted on.  Without it (transfer pins via
+        :meth:`pin`, legacy callers) the release lands on whichever record
+        actually holds pins — never on a zero-pin twin, which would leak
+        the real pin and prematurely release another reader's."""
+        e = self._entries.get(object_id)
+        p = self._proxies.get(object_id)
+        te = e if kind != "proxy" else None
+        tp = p if kind != "local" else None
+        if te is not None and (te.pinned > 0 or tp is None or tp.pinned == 0):
+            if te.pinned > 0:
+                te.pinned -= 1
+        elif tp is not None and tp.pinned > 0:
+            tp.pinned -= 1
+        # A deferred free completes only once NO pins remain on EITHER
+        # record — free() defers when either is pinned, so completion must
+        # mirror that or a proxy reader's slice is reclaimed under it.
+        freed = (e is not None and e.freed) or (p is not None and p.freed)
+        live = ((e.pinned if e is not None else 0)
+                + (p.pinned if p is not None else 0))
+        if freed and live == 0:
+            return self._complete_free(object_id)
+        return None
+
+    def free(self, object_id: ObjectID, force: bool = False) -> Optional[str]:
         """Free a local object.  Returns the SOURCE agent address when the
-        freed entry was a same-host proxy — the caller must send the unpin."""
+        freed entry was a same-host proxy — the caller must send the unpin.
+
+        A free that lands while reader pins are live is deferred (the
+        segment must not be unlinked — or its arena offset recycled — under
+        a live zero-copy view); the last unpin completes it.  ``force``
+        (shutdown) skips the deferral."""
+        e = self._entries.get(object_id)
+        p = self._proxies.get(object_id)
+        if not force and ((e is not None and e.pinned > 0)
+                          or (p is not None and p.pinned > 0)):
+            if e is not None:
+                e.freed = True
+            if p is not None:
+                p.freed = True
+            # The spilled copy has no readers — reclaim it now.
+            spilled = self._spilled.pop(object_id, None)
+            if spilled:
+                try:
+                    os.unlink(spilled)
+                except OSError:
+                    pass
+            return None
+        return self._complete_free(object_id)
+
+    def _complete_free(self, object_id: ObjectID) -> Optional[str]:
         proxy = self._proxies.pop(object_id, None)
         # A freed object may live in shm, on the spill disk, or both.
         spilled = self._spilled.pop(object_id, None)
@@ -421,8 +510,12 @@ class NodeObjectStore:
 
     def _evict(self, need_bytes: int):
         """LRU-evict sealed unpinned entries; spill them first if configured."""
+        # A freed-deferred entry (only its proxy twin is pinned) must not be
+        # spilled/evicted as if live: it would gain a spill copy nothing
+        # cleans up and _maybe_restore could resurrect a freed object.
         victims = sorted(
-            (e for oid, e in self._entries.items() if e.sealed and e.pinned == 0),
+            (e for oid, e in self._entries.items()
+             if e.sealed and e.pinned == 0 and not e.freed),
             key=lambda e: e.last_access)
         freed = 0
         for e in victims:
@@ -465,18 +558,50 @@ class NodeObjectStore:
         os.unlink(path)
 
     def stats(self) -> dict:
+        largest_free = 0
+        if self.pool is not None:
+            try:
+                largest_free = self.pool.largest_free
+            except Exception:
+                pass
         return {
             "capacity": self.capacity,
             "used": self.used,
+            "largest_free_block": largest_free,
             "num_objects": len(self._entries),
             "num_proxies": len(self._proxies),
             "num_creates": self.num_creates,
             "num_evictions": self.num_evictions,
+            "num_pinned": sum(1 for e in self._entries.values()
+                              if e.pinned > 0)
+            + sum(1 for p in self._proxies.values() if p.pinned > 0),
+            "num_deferred_frees": sum(1 for e in self._entries.values()
+                                      if e.freed)
+            + sum(1 for p in self._proxies.values() if p.freed),
         }
+
+    def objects(self) -> list:
+        """Per-object report rows (the ``raytpu memory`` data source)."""
+        rows = []
+        for oid, e in self._entries.items():
+            rows.append({"object_id": oid.hex(), "size": e.size,
+                         "sealed": e.sealed, "pinned": e.pinned,
+                         "freed": e.freed, "kind": "local",
+                         "path": e.segment.path})
+        for oid, p in self._proxies.items():
+            rows.append({"object_id": oid.hex(), "size": p.size,
+                         "sealed": True, "pinned": p.pinned,
+                         "freed": p.freed, "kind": "proxy",
+                         "path": p.path, "source": p.source_addr})
+        for oid, path in self._spilled.items():
+            rows.append({"object_id": oid.hex(), "size": None,
+                         "sealed": True, "pinned": 0, "freed": False,
+                         "kind": "spilled", "path": path})
+        return rows
 
     def shutdown(self):
         for oid in list(self._entries):
-            self.free(oid)
+            self.free(oid, force=True)
         # spill files of still-referenced-but-evicted objects would otherwise
         # outlive the session and accumulate under the shared default dir
         for oid in list(self._spilled):
@@ -499,19 +624,47 @@ class ShmReader:
 
     File-per-object segments are cached and returned zero-copy (an unlinked
     file stays valid for existing mmaps, so eviction cannot invalidate a
-    reader's view).  Pool slices are **copied out**: the arena recycles
-    offsets immediately after eviction, so neither the `{pool}#{offset}`
-    path nor the mapping bytes are stable identities — a cached or zero-copy
-    view could silently alias a different object.  (The upgrade path is the
-    plasma client pin/release protocol; a copy per read is the correct-first
-    behavior.)"""
+    reader's view).  Pool slices have two read modes:
+
+    * :meth:`view` — ZERO-COPY readonly view, valid only while the caller
+      holds a store pin on the object (the pin/release protocol: the agent
+      pinned the entry at fetch time, and the pin blocks eviction and
+      defers frees until the consumer's views die).
+    * :meth:`read` — the unpinned fallback: copy out and let the caller
+      re-validate with ``store_verify`` (the arena recycles offsets, so an
+      unpinned view is not a stable identity).  Records a ``get_copy``
+      event so the copy-discipline tests can pin the pinned path at zero.
+    """
 
     def __init__(self):
         self._maps: Dict[str, ShmSegment] = {}
 
+    def _stats(self):
+        from .serialization import _stats  # the one lazy cycle-break shim
+        return _stats()
+
+    def view(self, path: str, size: int) -> memoryview:
+        """Zero-copy view; caller must hold a pin for pool slices.
+
+        Returned WRITABLE (ctypes ``from_buffer`` in the lease-attach step
+        needs it); the deserializer wraps every slice readonly before any
+        user code can touch it."""
+        if "#" in path:
+            pool_path, off = path.rsplit("#", 1)
+            mv = _pool_attach.view(pool_path, int(off), size)
+        else:
+            seg = self._maps.get(path)
+            if seg is None:
+                seg = ShmSegment(path, size, create=False)
+                self._maps[path] = seg
+            mv = seg.view()[:size]
+        self._stats().record("get_zero_copy", size)
+        return mv
+
     def read(self, path: str, size: int):
         if "#" in path:
             pool_path, off = path.rsplit("#", 1)
+            self._stats().record("get_copy", size)
             return bytes(_pool_attach.view(pool_path, int(off), size))
         seg = self._maps.get(path)
         if seg is None:

@@ -60,7 +60,56 @@ def run_async(coro, timeout: float | None = None):
 
 def _encode(msg) -> bytes:
     payload = pickle.dumps(msg, protocol=5)
+    if len(payload) >= 0x8000_0000:
+        # The length word's top bit is the vectored-frame flag (_VEC_FLAG):
+        # a >=2 GiB in-band payload would alias it and desync the stream.
+        # Fail loudly — payloads that large must ship out-of-band.
+        raise ValueError(f"frame payload too large ({len(payload)} B >= 2 GiB)")
     return len(payload).to_bytes(4, "big") + payload
+
+
+# Vectored large-frame protocol: a frame whose length word has the top bit
+# set carries out-of-band buffers after the pickle stream —
+#
+#   [4B  VEC_FLAG | len(payload)] [payload] [4B nbufs] [8B size]*nbufs [buf]*
+#
+# Large buffer-protocol payloads (object chunks, big inlined task args) ride
+# as raw bytes instead of being re-copied through the pickle stream: the
+# sender writes each buffer straight from its source memory (writev-style —
+# see _flush_writer's large-part handling), and the receiver reads each into
+# its own contiguous allocation and hands it to pickle out-of-band.  That
+# removes one full-payload copy per side versus in-band pickling.
+_VEC_FLAG = 0x8000_0000
+#: buffers below this stay in-band (framing + syscall overhead dominates)
+_VEC_MIN_BUF = 256 * 1024
+#: flush-queue parts at least this large are written individually (no join)
+_LARGE_PART = 128 * 1024
+
+
+def _encode_parts(msg) -> list:
+    """Encode ``msg``, extracting large contiguous buffers out-of-band.
+    Returns a list of wire parts (length 1 == a regular frame)."""
+    bufs: list = []
+
+    def _cb(pb: pickle.PickleBuffer):
+        try:
+            raw = pb.raw()
+        except Exception:
+            return True  # non-contiguous: serialize in-band
+        if raw.nbytes < _VEC_MIN_BUF:
+            return True
+        bufs.append(raw)
+        return False
+
+    payload = pickle.dumps(msg, protocol=5, buffer_callback=_cb)
+    if len(payload) >= _VEC_FLAG:
+        raise ValueError(f"frame payload too large ({len(payload)} B >= 2 GiB)")
+    if not bufs:
+        return [len(payload).to_bytes(4, "big") + payload]
+    head = ((_VEC_FLAG | len(payload)).to_bytes(4, "big") + payload
+            + len(bufs).to_bytes(4, "big")
+            + b"".join(b.nbytes.to_bytes(8, "big") for b in bufs))
+    return [head] + bufs
 
 
 def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
@@ -86,16 +135,52 @@ def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
         asyncio.get_event_loop().call_soon(_flush_writer, writer)
 
 
+def coalesced_write_frame(writer: "asyncio.StreamWriter", msg) -> None:
+    """Encode + queue one message, using the vectored wire format when the
+    payload carries large buffers.  Vectored frames flush IMMEDIATELY (in
+    FIFO order with everything already queued): their out-of-band parts are
+    views over caller memory that must not dangle across a loop tick, and a
+    multi-MB frame gains nothing from coalescing anyway."""
+    parts = _encode_parts(msg)
+    if len(parts) == 1:
+        coalesced_write(writer, parts[0])
+        return
+    buf = getattr(writer, "_raytpu_buf", None)
+    if buf is None:
+        buf = writer._raytpu_buf = []
+        writer._raytpu_buf_bytes = 0
+    buf.extend(parts)
+    writer._raytpu_buf_bytes += sum(len(p) for p in parts)
+    _flush_writer(writer)
+
+
 def _flush_writer(writer: "asyncio.StreamWriter") -> None:
     writer._raytpu_flush_scheduled = False
     buf = getattr(writer, "_raytpu_buf", None)
     if not buf:
         return
-    data = b"".join(buf) if len(buf) > 1 else buf[0]
+    parts = list(buf)
     buf.clear()
     writer._raytpu_buf_bytes = 0
     try:
-        writer.write(data)
+        if len(parts) == 1:
+            writer.write(parts[0])
+            return
+        # Small frames coalesce into one write; large parts (vectored
+        # buffers) are written individually so a multi-MB payload never
+        # pays a user-space concatenation — the socket layer copies it
+        # straight from the source view into the kernel.
+        run: list = []
+        for p in parts:
+            if len(p) >= _LARGE_PART:
+                if run:
+                    writer.write(b"".join(run))
+                    run = []
+                writer.write(p)
+            else:
+                run.append(p)
+        if run:
+            writer.write(b"".join(run) if len(run) > 1 else run[0])
     except Exception:
         pass  # connection died; the read loop surfaces it
 
@@ -120,7 +205,20 @@ async def drain_if_needed(writer: "asyncio.StreamWriter",
 async def _read_msg(reader: asyncio.StreamReader):
     hdr = await reader.readexactly(4)
     n = int.from_bytes(hdr, "big")
-    return pickle.loads(await reader.readexactly(n))
+    if not n & _VEC_FLAG:
+        return pickle.loads(await reader.readexactly(n))
+    # Vectored frame: pickle stream + out-of-band buffers.  Each buffer is
+    # read into its own allocation and handed to pickle out-of-band — the
+    # receive path's only copy; in-band pickling would pay a second one
+    # materializing the bytes out of the stream.
+    payload = await reader.readexactly(n & (_VEC_FLAG - 1))
+    nbufs = int.from_bytes(await reader.readexactly(4), "big")
+    sizes_raw = await reader.readexactly(8 * nbufs)
+    bufs = []
+    for i in range(nbufs):
+        size = int.from_bytes(sizes_raw[8 * i:8 * i + 8], "big")
+        bufs.append(await reader.readexactly(size))
+    return pickle.loads(payload, buffers=bufs)
 
 
 class RpcError(Exception):
@@ -213,16 +311,17 @@ class RpcServer:
             ok = False
         if req_id >= 0:
             try:
-                payload = _encode((req_id, ok, result))
-            except Exception:
-                # Unpicklable result/exception: degrade to a picklable error so
-                # the caller fails fast instead of timing out.
-                err = RuntimeError(f"handler {method!r} produced an unpicklable "
-                                   f"{'result' if ok else 'exception'}: "
-                                   f"{result!r:.500}")
-                payload = _encode((req_id, False, (err, "")))
-            try:
-                coalesced_write(writer, payload)
+                try:
+                    coalesced_write_frame(writer, (req_id, ok, result))
+                except (ConnectionResetError, BrokenPipeError):
+                    return
+                except Exception:
+                    # Unpicklable result/exception: degrade to a picklable
+                    # error so the caller fails fast instead of timing out.
+                    err = RuntimeError(
+                        f"handler {method!r} produced an unpicklable "
+                        f"{'result' if ok else 'exception'}: {result!r:.500}")
+                    coalesced_write_frame(writer, (req_id, False, (err, "")))
                 await drain_if_needed(writer)
             except (ConnectionResetError, BrokenPipeError):
                 pass
@@ -327,7 +426,7 @@ class RpcClient:
         req_id = next(self._req_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
-        coalesced_write(self._writer, _encode((req_id, method, kwargs)))
+        coalesced_write_frame(self._writer, (req_id, method, kwargs))
         await drain_if_needed(self._writer)
         return fut
 
@@ -344,7 +443,7 @@ class RpcClient:
         await self._ensure_connected()
         if self._chaos_delay_s > 0.0:
             await asyncio.sleep(self._chaos_delay_s)
-        coalesced_write(self._writer, _encode((-1, method, kwargs)))
+        coalesced_write_frame(self._writer, (-1, method, kwargs))
         await drain_if_needed(self._writer)
 
     def call_sync(self, method: str, _timeout: float | None = None, **kwargs) -> Any:

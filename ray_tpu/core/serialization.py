@@ -25,6 +25,18 @@ from typing import Any, List, Tuple
 import cloudpickle
 from cloudpickle.cloudpickle import _dynamic_class_reduce
 
+_copy_stats = None
+
+
+def _stats():
+    """ray_tpu.util.metrics.copy_stats, imported lazily (core <-> util
+    import cycle) and cached."""
+    global _copy_stats
+    if _copy_stats is None:
+        from ray_tpu.util.metrics import copy_stats
+        _copy_stats = copy_stats
+    return _copy_stats
+
 # Roots under which a module is assumed importable on every worker: the
 # interpreter's stdlib + site-packages, and this package itself (workers get
 # the package root on PYTHONPATH — node_agent._spawn_worker).  Functions and
@@ -73,41 +85,65 @@ class _ByValuePickler(cloudpickle.CloudPickler):
 
 
 class SerializedObject:
-    """A picked value split into a metadata stream + zero-copy buffers."""
+    """A picked value split into a metadata stream + zero-copy buffers.
 
-    __slots__ = ("inband", "buffers", "contained_refs")
+    Two-phase layout (the scatter-gather put): phase one is the pickle-5
+    ``buffer_callback`` pass in :func:`serialize`, which produces the inband
+    stream plus out-of-band :class:`pickle.PickleBuffer` views over the
+    ORIGINAL payload memory (no copy); phase two is :meth:`write_into`,
+    which lays header + inband + buffers directly into an arena-allocated
+    store mapping — the payload's single host copy.  :meth:`to_bytes` (a
+    full flatten through an intermediate ``bytes``) exists for small inline
+    values and RPC blobs only; on large payloads it records a
+    ``serialize_flatten`` copy event, which the copy-discipline tests pin
+    at zero for the put path.
+    """
+
+    __slots__ = ("inband", "buffers", "contained_refs", "_header", "_sizes")
 
     def __init__(self, inband: bytes, buffers: List[pickle.PickleBuffer | memoryview | bytes],
                  contained_refs: list):
         self.inband = inband
         self.buffers = buffers
         self.contained_refs = contained_refs
+        self._header: bytes | None = None
+        self._sizes: list[int] | None = None
 
     def total_bytes(self) -> int:
         return len(self.inband) + sum(len(memoryview(b).cast("B")) for b in self.buffers)
 
     def to_bytes(self) -> bytes:
         """Flatten to one contiguous byte string: header + inband + buffers."""
-        parts = [self.inband] + [bytes(memoryview(b).cast("B")) for b in self.buffers]
-        header = pickle.dumps([len(p) for p in parts], protocol=5)
+        header, sizes = self.header_and_sizes()
+        payload = sum(sizes)
+        _stats().record("serialize_flatten", payload)
         out = io.BytesIO()
         out.write(len(header).to_bytes(4, "big"))
         out.write(header)
-        for p in parts:
-            out.write(p)
+        out.write(self.inband)
+        for b in self.buffers:
+            out.write(memoryview(b).cast("B"))
         return out.getvalue()
 
     def header_and_sizes(self) -> tuple[bytes, list[int]]:
-        sizes = [len(self.inband)] + [len(memoryview(b).cast("B")) for b in self.buffers]
-        header = pickle.dumps(sizes, protocol=5)
-        return header, sizes
+        # Cached: flat_size() + write_into() both need it, and the header
+        # must be byte-identical between the sizing and writing phases.
+        if self._header is None:
+            self._sizes = [len(self.inband)] + [
+                len(memoryview(b).cast("B")) for b in self.buffers]
+            self._header = pickle.dumps(self._sizes, protocol=5)
+        return self._header, self._sizes
 
     def flat_size(self) -> int:
         header, sizes = self.header_and_sizes()
         return 4 + len(header) + sum(sizes)
 
     def write_into(self, view: memoryview) -> int:
-        """Serialize directly into a writable buffer (e.g. a store mmap)."""
+        """Serialize directly into a writable buffer (e.g. a store mmap).
+
+        This is the put path's ONE data copy: buffers stream from the
+        caller's memory straight into the arena mapping.  Recorded as a
+        single ``object_write`` copy event regardless of buffer count."""
         header, sizes = self.header_and_sizes()
         off = 0
         view[0:4] = len(header).to_bytes(4, "big")
@@ -118,6 +154,7 @@ class SerializedObject:
             mv = memoryview(part).cast("B")
             view[off:off + len(mv)] = mv
             off += len(mv)
+        _stats().record("object_write", sum(sizes))
         return off
 
     @classmethod
@@ -175,8 +212,49 @@ def serialize(value: Any) -> SerializedObject:
     return SerializedObject(sio.getvalue(), buffers, p.contained)
 
 
-def deserialize(so: SerializedObject) -> Any:
-    return _RefUnpickler(io.BytesIO(so.inband), buffers=so.buffers).load()
+def _attach_lease(buffers: list, lease) -> list:
+    """Wrap raw store views in lease-carrying buffer exporters.
+
+    The exporter must be the object the view chain's ROOT keeps alive, and
+    it must not be an ndarray: numpy collapses ndarray base chains (a view
+    of a view points at the ultimate owner), so a lease hung on an
+    intermediate array is dropped the moment numpy re-wraps the buffer.  A
+    ctypes array ``from_buffer`` over the mapping survives as the root
+    memoryview's ``obj`` for every downstream view, releasing the lease —
+    and with it the store pin — exactly when the LAST deserialized view
+    dies, by plain refcounting.  The array type is built with ``type()``
+    rather than ``c_char * n`` so it dies with the instance instead of
+    accumulating in ctypes' permanent per-length type cache.  Views are
+    handed out READONLY: they alias shared (possibly same-host-broadcast)
+    store pages."""
+    import ctypes
+    wrapped = []
+    for b in buffers:
+        mv = memoryview(b)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        arr_t = type("_LeasedExport", (ctypes.Array,),
+                     {"_type_": ctypes.c_char, "_length_": len(mv)})
+        exporter = arr_t.from_buffer(mv)
+        exporter._pin_lease = lease
+        wrapped.append(memoryview(exporter).toreadonly())
+    return wrapped
+
+
+def deserialize(so: SerializedObject, pin_lease=None) -> Any:
+    """Deserialize; with ``pin_lease`` the out-of-band buffers stay
+    ZERO-COPY views over the (pinned) store mapping, and the pin releases
+    when the last reconstructed view is garbage-collected.  Without a
+    lease, buffers are consumed as-is (inline records, copied fetches)."""
+    buffers = so.buffers
+    if pin_lease is not None:
+        if buffers:
+            buffers = _attach_lease(buffers, pin_lease)
+        else:
+            # Whole value lives in the (copied) inband stream: nothing will
+            # ever reference the mapping — release the pin now.
+            pin_lease.release()
+    return _RefUnpickler(io.BytesIO(so.inband), buffers=buffers).load()
 
 
 def dumps(value: Any) -> bytes:

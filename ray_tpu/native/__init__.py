@@ -74,6 +74,16 @@ def load_shm_pool() -> Optional[ctypes.CDLL]:
         lib.rt_pool_num_blocks.restype = ctypes.c_uint64
         lib.rt_pool_num_blocks.argtypes = [ctypes.c_void_p]
         lib.rt_pool_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        # Introspection (older cached .so builds may predate these)
+        for sym, res, args in (
+                ("rt_pool_block_size", ctypes.c_uint64,
+                 [ctypes.c_void_p, ctypes.c_uint64]),
+                ("rt_pool_largest_free", ctypes.c_uint64,
+                 [ctypes.c_void_p])):
+            fn = getattr(lib, sym, None)
+            if fn is not None:
+                fn.restype = res
+                fn.argtypes = args
         _LIB = lib
         return _LIB
 
@@ -155,6 +165,17 @@ class ShmPool:
     @property
     def num_blocks(self) -> int:
         return self._lib.rt_pool_num_blocks(self._handle)
+
+    def block_size(self, offset: int) -> int:
+        """Size of the live allocation at ``offset`` (0 = not allocated)."""
+        fn = getattr(self._lib, "rt_pool_block_size", None)
+        return int(fn(self._handle, offset)) if fn is not None else 0
+
+    @property
+    def largest_free(self) -> int:
+        """Largest free block — the arena's fragmentation signal."""
+        fn = getattr(self._lib, "rt_pool_largest_free", None)
+        return int(fn(self._handle)) if fn is not None else 0
 
     def close(self, unlink: bool = True):
         if self._handle:

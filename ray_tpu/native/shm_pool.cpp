@@ -130,6 +130,30 @@ uint64_t rt_pool_num_blocks(void* handle) {
     return p ? p->blocks.size() : 0;
 }
 
+// Size of the allocated block at `offset`, or 0 when offset is not the
+// start of a live allocation.  Lets the Python layer sanity-check a
+// deferred (pin-held) free target before completing it.
+uint64_t rt_pool_block_size(void* handle, uint64_t offset) {
+    auto* p = static_cast<Pool*>(handle);
+    if (p == nullptr) return 0;
+    auto it = p->blocks.find(offset);
+    if (it == p->blocks.end() || it->second.free) return 0;
+    return it->second.size;
+}
+
+// Largest free block — the fragmentation signal surfaced by store stats
+// and the `raytpu memory` report (a full-looking arena whose largest free
+// block is tiny is fragmented, not out of capacity).
+uint64_t rt_pool_largest_free(void* handle) {
+    auto* p = static_cast<Pool*>(handle);
+    if (p == nullptr) return 0;
+    uint64_t best = 0;
+    for (const auto& kv : p->blocks) {
+        if (kv.second.free && kv.second.size > best) best = kv.second.size;
+    }
+    return best;
+}
+
 void rt_pool_destroy(void* handle, int unlink_file) {
     auto* p = static_cast<Pool*>(handle);
     if (p == nullptr) return;

@@ -30,6 +30,8 @@ def _read_head() -> dict:
 def _connect():
     import ray_tpu
 
+    if ray_tpu.is_initialized():
+        return ray_tpu  # in-process runtime (tests drive cmd_* directly)
     head = _read_head()
     os.environ["RAYTPU_GCS_ADDRESS"] = head["gcs_address"]
     ray_tpu.init(address="auto", ignore_reinit_error=True)
@@ -114,11 +116,59 @@ def cmd_list(args):
     kind = args.kind
     fns = {"actors": state_api.list_actors, "tasks": state_api.list_tasks,
            "nodes": state_api.list_nodes, "objects": state_api.list_objects,
+           "memory": state_api.list_memory,
            "placement-groups": state_api.list_placement_groups}
     if kind not in fns:
         raise SystemExit(f"unknown kind {kind}; one of {sorted(fns)}")
     rows = fns[kind]()
     print(json.dumps(rows, indent=2, default=str))
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def cmd_memory(args):
+    """Per-object store report (reference: the ``ray memory`` debug command):
+    refcounts, sizes, pin state, and which node holds each copy."""
+    _connect()
+    from ray_tpu.util import state as state_api
+
+    report = state_api.memory_summary()
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+        return
+    for nid, st in report["nodes"].items():
+        line = (f"node {nid[:12]} ({st.get('address', '?')}): "
+                f"{_fmt_bytes(st['used'])}/{_fmt_bytes(st['capacity'])} used, "
+                f"{st['num_objects']} objects, {st['num_proxies']} proxies, "
+                f"{st['num_pinned']} pinned, "
+                f"{st['num_deferred_frees']} deferred frees")
+        if st.get("largest_free_block"):
+            line += f", largest free {_fmt_bytes(st['largest_free_block'])}"
+        print(line)
+    rows = report["objects"]
+    if not rows:
+        print("no tracked objects")
+        return
+    print(f"{'OBJECT_ID':<20} {'KIND':<8} {'SIZE':>10} {'PINS':>4} "
+          f"{'REFS(l/s/b)':>12}  LOCATION")
+    for r in rows:
+        refs = r.get("refs")
+        refstr = (f"{refs['local']}/{refs['submitted']}/{refs['borrowers']}"
+                  if refs else "-")
+        loc = r.get("node_id", "")[:12] or "driver"
+        if r.get("freed"):
+            loc += " (freed:deferred)"
+        print(f"{r['object_id'][:18]:<20} {r.get('kind', '?'):<8} "
+              f"{_fmt_bytes(r.get('size')):>10} {r.get('pinned', 0):>4} "
+              f"{refstr:>12}  {loc}")
 
 
 def cmd_timeline(args):
@@ -245,6 +295,11 @@ def main(argv=None):
     s = sub.add_parser("list", help="state API listings")
     s.add_argument("kind")
     s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("memory", help="per-object store/refcount report")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable full report")
+    s.set_defaults(fn=cmd_memory)
 
     s = sub.add_parser("timeline", help="export chrome-trace timeline json")
     s.add_argument("--output", default=None)

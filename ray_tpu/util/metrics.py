@@ -131,12 +131,85 @@ class Histogram(Metric):
                     "sum": dict(self._sum), "count": dict(self._count)}
 
 
+# ------------------------------------------------- data-plane copy accounting
+
+class CopyStats:
+    """Process-local counters of DATA-PLANE byte copies (object payloads
+    moving through put/get/transfer), keyed by operation.
+
+    This is the instrument behind the object plane's copy discipline: a
+    large ``put`` must record exactly one ``object_write`` (the single
+    serialize-into-arena memcpy), a same-host ``get`` must record zero
+    ``get_copy`` events (pinned zero-copy views), and
+    ``serialize_flatten`` must stay at zero on the put path (it fires when
+    a large payload is materialized through an intermediate contiguous
+    ``bytes`` blob).  Tests assert on these counters directly — they are
+    deterministic, unlike GB/s numbers — and the snapshot is exported
+    through the regular metrics registry as ``raytpu_data_copies`` /
+    ``raytpu_bytes_copied``.
+    """
+
+    #: payloads below this size are not accounted (headers, inline values)
+    ACCOUNT_THRESHOLD = 64 * 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._bytes: Dict[str, int] = {}
+
+    def record(self, op: str, nbytes: int, force: bool = False):
+        if not force and nbytes < self.ACCOUNT_THRESHOLD:
+            return
+        with self._lock:
+            self._counts[op] = self._counts.get(op, 0) + 1
+            self._bytes[op] = self._bytes.get(op, 0) + int(nbytes)
+
+    def count(self, op: str) -> int:
+        with self._lock:
+            return self._counts.get(op, 0)
+
+    def bytes(self, op: str) -> int:
+        with self._lock:
+            return self._bytes.get(op, 0)
+
+    def snapshot(self) -> Dict[str, Tuple[int, int]]:
+        with self._lock:
+            return {op: (self._counts[op], self._bytes.get(op, 0))
+                    for op in self._counts}
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+            self._bytes.clear()
+
+
+#: process-wide instance; hot paths call ``copy_stats.record(...)``
+copy_stats = CopyStats()
+
+
+def _copy_stats_metrics() -> Dict[str, dict]:
+    """Render copy_stats as synthetic counter snapshots for export."""
+    snap = copy_stats.snapshot()
+    if not snap:
+        return {}
+    return {
+        "raytpu_data_copies": {
+            "kind": "counter", "help": "data-plane copy events by op",
+            "values": {(("op", op),): c for op, (c, _b) in snap.items()}},
+        "raytpu_bytes_copied": {
+            "kind": "counter", "help": "data-plane bytes copied by op",
+            "values": {(("op", op),): b for op, (_c, b) in snap.items()}},
+    }
+
+
 # ---------------------------------------------------------------- flushing
 
 def snapshot_registry() -> Dict[str, dict]:
     with _registry_lock:
         metrics = list(_registry.items())
-    return {name: m.snapshot() for name, m in metrics}
+    out = {name: m.snapshot() for name, m in metrics}
+    out.update(_copy_stats_metrics())
+    return out
 
 
 def _flush_once() -> bool:

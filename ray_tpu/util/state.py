@@ -77,6 +77,72 @@ def list_objects(filters: Optional[List[tuple]] = None,
     return _apply_filters(rows, filters)[:limit]
 
 
+def _annotate_memory_rows(w, rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Add this process's ``kind: "owner"`` rows for objects no store
+    reported (inline values, location records) and annotate every row with
+    its refcounts (local/submitted/borrowers) where this worker holds
+    references."""
+    refs = w.reference_counter.summary()
+    seen = {r["object_id"] for r in rows}
+    for oid, rec in list(w.memory_store._values.items()):
+        if oid.hex() in seen:
+            continue
+        rows.append({
+            "object_id": oid.hex(), "kind": "owner",
+            "type": type(rec).__name__,
+            "size": getattr(rec, "size", None) or (
+                len(rec) if isinstance(rec, (bytes, bytearray)) else None),
+        })
+    for r in rows:
+        r["refs"] = refs.get(r["object_id"])
+    return rows
+
+
+def _sweep_cluster_stores(w, with_stats: bool):
+    """ONE pass over every alive node's store: a single GCS view fetch and
+    one (optionally stats+) objects round trip per agent, so stats and rows
+    come from the same snapshot of each node.  Agents racing shutdown are
+    skipped — report what answered.  -> (node_stats, object_rows)."""
+    view = _gcs_call("get_cluster_view")
+    nodes: Dict[str, Any] = {}
+    rows: List[Dict[str, Any]] = []
+    for nid, info in view.items():
+        if not info.get("alive", True):
+            continue
+        client = w.agent_clients.get(info["address"])
+        try:
+            st = run_async(client.call("store_stats")) if with_stats else None
+            rows.extend(run_async(client.call("store_objects")))
+        except Exception:
+            continue
+        if st is not None:
+            st["address"] = info["address"]
+            nodes[nid] = st
+    return nodes, rows
+
+
+def list_memory(filters: Optional[List[tuple]] = None,
+                limit: int = 10000) -> List[Dict[str, Any]]:
+    """Cluster-wide per-object memory rows (the ``ray memory`` equivalent).
+
+    One row per object copy in any node's plasma-equivalent store —
+    size, node, pin count, deferred-free flag, shm path — annotated with
+    this process's refcounts (local/submitted/borrowers) where it holds
+    references.  Objects only this worker knows about (inline values,
+    location records) get a ``kind: "owner"`` row so small objects are
+    not invisible to the report."""
+    w = global_worker()
+    _, rows = _sweep_cluster_stores(w, with_stats=False)
+    return _apply_filters(_annotate_memory_rows(w, rows), filters)[:limit]
+
+
+def memory_summary() -> Dict[str, Any]:
+    """``raytpu memory``'s payload: per-node store stats + object rows."""
+    w = global_worker()
+    nodes, rows = _sweep_cluster_stores(w, with_stats=True)
+    return {"nodes": nodes, "objects": _annotate_memory_rows(w, rows)}
+
+
 def summarize_tasks() -> Dict[str, Any]:
     events = _gcs_call("list_task_events", limit=100_000)
     by_name: Dict[str, collections.Counter] = collections.defaultdict(

@@ -1,0 +1,308 @@
+"""Copy discipline of the zero-copy object plane (metric-asserted, not
+timed): a large ``put`` performs exactly ONE data copy (serialize straight
+into the arena mapping), a same-host ``get`` performs ZERO (pinned
+out-of-band views over the store mmap), and the pin/release protocol defers
+eviction/free while any deserialized view is alive.
+
+These double as the tier-1 regression gate for the put path: the
+``serialize_flatten`` counter fires whenever a large payload is
+materialized through an intermediate contiguous ``bytes`` blob, so a
+reintroduced flatten fails deterministically — no wall-clock involved.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import NodeObjectStore, ObjectStoreFullError
+from ray_tpu.core.rpc import run_async
+from ray_tpu.util.metrics import copy_stats
+from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+MB = 1 << 20
+
+
+# ---------------------------------------------------------------- put path
+
+def test_put_exactly_one_copy_and_no_flatten(ray_start_regular):
+    """Regression gate: a large-array put must write the payload into the
+    arena exactly once (``object_write``) and never materialize it through
+    an intermediate full-payload ``bytes`` (``serialize_flatten``)."""
+    big = np.random.default_rng(0).integers(0, 255, 8 * MB, np.uint8)
+    copy_stats.reset()
+    ref = ray_tpu.put(big)
+    assert copy_stats.count("object_write") == 1
+    assert copy_stats.bytes("object_write") >= big.nbytes
+    assert copy_stats.count("serialize_flatten") == 0, \
+        "put path re-introduced an intermediate bytes materialization"
+    del ref
+
+
+def test_put_structured_payload_still_one_copy(ray_start_regular):
+    """Multiple out-of-band buffers in one value still mean one
+    ``object_write`` event (the scatter-gather lands them all in a single
+    arena slice) and no flatten."""
+    val = {"a": np.zeros(2 * MB, np.uint8), "b": np.ones(MB, np.float32),
+           "meta": list(range(100))}
+    copy_stats.reset()
+    ref = ray_tpu.put(val)
+    assert copy_stats.count("object_write") == 1
+    assert copy_stats.count("serialize_flatten") == 0
+    del ref
+
+
+# ---------------------------------------------------------------- get path
+
+def test_get_same_host_zero_copy(ray_start_regular):
+    big = np.arange(4 * MB, dtype=np.uint8)
+    ref = ray_tpu.put(big)
+    copy_stats.reset()
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, big)
+    # zero data copies: the array is a readonly view over the pinned mmap
+    assert copy_stats.count("get_copy") == 0
+    assert copy_stats.count("get_zero_copy") == 1
+    assert not out.flags.writeable
+    assert not out.flags.owndata
+    del out, ref
+    gc.collect()
+
+
+def test_get_view_survives_owner_free(ray_start_regular):
+    """Deferred free: dropping the last ObjectRef while a deserialized view
+    is alive must NOT invalidate the view — the store defers the free until
+    the pin releases, then completes it."""
+    from ray_tpu.core.core_worker import global_worker
+
+    w = global_worker()
+
+    def agent_stats():
+        return run_async(w.agent.call("store_stats"))
+
+    base = agent_stats()["num_objects"]
+    expect = np.arange(4 * MB, dtype=np.uint8)
+    ref = ray_tpu.put(expect.copy())
+    out = ray_tpu.get(ref)
+    del ref  # owner refcount -> 0: store_free lands while our pin is live
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = agent_stats()
+        if st["num_deferred_frees"] >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("free was not deferred under a live reader pin")
+    # the arena slice must still hold OUR bytes (offset not recycled)
+    np.testing.assert_array_equal(out, expect)
+    del out
+    gc.collect()  # last view dies -> lease releases -> unpin completes free
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = agent_stats()
+        if st["num_deferred_frees"] == 0 and st["num_objects"] <= base:
+            return
+        gc.collect()
+        time.sleep(0.05)
+    pytest.fail(f"deferred free never completed after view release: {st}")
+
+
+def test_freed_deferred_object_invisible_to_new_fetchers(ray_start_regular):
+    """While a free is deferred under a live reader pin, the object is
+    DELETED — new fetchers must get a clean miss (None / error), never the
+    doomed bytes and never an agent-side unpack crash."""
+    from ray_tpu.core.core_worker import global_worker
+
+    w = global_worker()
+    ref = ray_tpu.put(np.arange(4 * MB, dtype=np.uint8))
+    oid = ref.id
+    out = ray_tpu.get(ref)  # live view -> read pin
+    del ref  # owner free lands, deferred under our pin
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = run_async(w.agent.call("store_stats"))
+        if st["num_deferred_frees"] >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("free was not deferred")
+    # store_get: miss, not a TypeError unpack of get_path()'s None
+    assert run_async(w.agent.call("store_get", object_id=oid,
+                                  timeout=0.5)) is None
+    # object_info (peer-puller probe): invisible
+    assert run_async(w.agent.call("object_info", object_id=oid)) is None
+    # pin_object (same-host proxy holder): refused
+    assert run_async(w.agent.call("pin_object", object_id=oid)) is False
+    # fetch_object with no other locations: clean remote error, not a crash
+    with pytest.raises(Exception) as ei:
+        run_async(w.agent.call("fetch_object", object_id=oid,
+                               size=4 * MB, locations=[], pin=True,
+                               pinner=w.address))
+    assert "TypeError" not in str(ei.value)
+    del out
+    gc.collect()
+
+
+def test_dead_consumer_pins_are_drained(ray_start_regular):
+    """A worker killed while holding zero-copy views must not leak its read
+    pins: the agent releases a dead consumer's pins on worker exit (the
+    plasma disconnect-releases-pins contract)."""
+    from ray_tpu.core.core_worker import global_worker
+
+    w = global_worker()
+
+    def agent_stats():
+        return run_async(w.agent.call("store_stats"))
+
+    @ray_tpu.remote
+    class Holder:
+        def grab(self, boxed):
+            self.view = ray_tpu.get(boxed[0])  # pinned zero-copy view
+            return int(self.view[0])
+
+    ref = ray_tpu.put(np.arange(4 * MB, dtype=np.uint8))
+    h = Holder.remote()
+    assert ray_tpu.get(h.grab.remote([ref]), timeout=60) == 0
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if agent_stats()["num_pinned"] >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("worker's read pin never appeared in store stats")
+    ray_tpu.kill(h)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if agent_stats()["num_pinned"] == 0:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail(f"dead consumer's pin leaked: {agent_stats()}")
+    del ref
+
+
+# ------------------------------------------------------- store-level pinning
+
+def _mk_store(capacity):
+    store = NodeObjectStore(f"t{ObjectID.from_random().hex()[:8]}",
+                            capacity=capacity)
+    store.spill_dir = None  # pin semantics, not spill, under test
+    return store
+
+
+def test_store_free_deferred_until_unpin():
+    store = _mk_store(4 * MB)
+    try:
+        oid = ObjectID.from_random()
+        store.create(oid, 1000)
+        store.seal(oid)
+        assert store.pin_for_read(oid)
+        store.free(oid)
+        # deferred: entry still present, bytes still addressable
+        assert oid in store._entries
+        assert store._entries[oid].freed
+        # a reader that shows up after the free must NOT get a pin — nor
+        # even locate the object: it is deleted, just not yet reclaimed
+        assert not store.pin_for_read(oid)
+        assert not store.contains(oid)
+        assert store.get_path(oid) is None
+        store.unpin(oid)
+        assert oid not in store._entries
+    finally:
+        store.shutdown()
+
+
+def test_store_eviction_skips_pinned_entries():
+    store = _mk_store(4 * MB)
+    try:
+        pinned_oid = ObjectID.from_random()
+        store.create(pinned_oid, 2 * MB)
+        store.seal(pinned_oid)
+        assert store.pin_for_read(pinned_oid)
+        filler = ObjectID.from_random()
+        store.create(filler, MB)
+        store.seal(filler)
+        # needs ~2MB freed; only the unpinned filler is evictable, so the
+        # pinned entry must survive and the create must fail loudly
+        with pytest.raises(ObjectStoreFullError):
+            store.create(ObjectID.from_random(), int(3.5 * MB))
+        assert pinned_oid in store._entries
+        store.unpin(pinned_oid)
+        # now evictable: the same create succeeds
+        store.create(ObjectID.from_random(), int(3.5 * MB))
+        assert pinned_oid not in store._entries
+    finally:
+        store.shutdown()
+
+
+def test_store_unpin_kind_targets_pinned_record():
+    """When a local entry and a same-host proxy coexist, a release must
+    decrement the record the pin was granted on — never the twin (which
+    would leak one pin and prematurely release another reader's)."""
+    store = _mk_store(4 * MB)
+    try:
+        oid = ObjectID.from_random()
+        store.create(oid, 1000)
+        store.seal(oid)
+        assert store.pin_for_read(oid) == "local"
+        store.add_proxy(oid, "peer-pool#0", 1000, "src:1")
+        # proxy now shadows the entry (mirrors get_path priority)
+        assert store.pin_for_read(oid) == "proxy"
+        assert store._entries[oid].pinned == 1
+        assert store._proxies[oid].pinned == 1
+        store.unpin(oid, "proxy")
+        assert store._entries[oid].pinned == 1, "proxy release consumed the entry pin"
+        assert store._proxies[oid].pinned == 0
+        # re-pin the proxy; a free under pins on BOTH records must defer
+        # until BOTH release, regardless of release order
+        assert store.pin_for_read(oid) == "proxy"
+        store.free(oid)
+        assert store._entries[oid].freed and store._proxies[oid].freed
+        assert store.unpin(oid, "local") is None
+        assert oid in store._entries, "free completed under a live proxy pin"
+        assert store.unpin(oid, "proxy") == "src:1"
+        assert oid not in store._entries and oid not in store._proxies
+    finally:
+        store.shutdown()
+
+
+def test_stale_unpin_notify_is_ignored(ray_start_regular):
+    """A store_unpin_read carrying a pinner with no ledger record (its pins
+    were already drained on death, or never granted) must be dropped — the
+    store counter it would decrement belongs to another consumer's pin."""
+    from ray_tpu.core.core_worker import global_worker
+
+    w = global_worker()
+
+    def num_pinned():
+        return run_async(w.agent.call("store_stats"))["num_pinned"]
+
+    ref = ray_tpu.put(np.arange(4 * MB, dtype=np.uint8))
+    out = ray_tpu.get(ref)  # live zero-copy view -> one read pin
+    base = num_pinned()
+    assert base >= 1
+    run_async(w.agent.call("store_unpin_read", object_id=ref.id,
+                           pinner="ghost:0"))
+    assert num_pinned() == base, "stale release consumed a live reader's pin"
+    del out, ref
+    gc.collect()
+
+
+def test_store_double_free_and_unpin_idempotent():
+    store = _mk_store(4 * MB)
+    try:
+        oid = ObjectID.from_random()
+        store.create(oid, 1000)
+        store.seal(oid)
+        assert store.pin_for_read(oid)
+        store.free(oid)
+        store.free(oid)  # second free while deferred: still deferred
+        assert oid in store._entries
+        store.unpin(oid)
+        store.unpin(oid)  # spurious unpin after completion: no-op
+        assert oid not in store._entries
+    finally:
+        store.shutdown()
