@@ -140,7 +140,22 @@ class Config:
 
     # -- metrics -----------------------------------------------------------
     metrics_export_enabled: bool = True
+    #: Per-method RPC client/server latency histograms + byte counters
+    #: (core/rpc.py).  Cheap (one histogram observe per call) but the hot
+    #: path can shed it entirely for A/B overhead measurement.
+    rpc_metrics_enabled: bool = True
     task_events_enabled: bool = True
+    #: Per-task lifecycle stage breakdown (queue/dep_fetch/arg_deser/
+    #: execute/result_put stamps + STAGES events + the stage histogram).
+    #: Rides the task-event stream, so task_events_enabled=False also
+    #: disables it; this knob sheds ONLY the breakdown.
+    task_stage_breakdown_enabled: bool = True
+    #: Cap on per-task STAGES events emitted per second per executor.  The
+    #: stage HISTOGRAM observes every task regardless (percentiles stay
+    #: exact); only the per-task timeline payload is sampled beyond this
+    #: rate, bounding the event-pipeline overhead under small-task floods
+    #: (reference: task event buffer sampling).  <= 0 means unlimited.
+    task_stage_events_per_s: int = 200
     #: Ring buffer size for task state-transition events
     #: (reference: TaskEventBuffer, task_event_buffer.h).
     task_events_max_buffer: int = 100_000

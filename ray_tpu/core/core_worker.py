@@ -54,6 +54,44 @@ from .scheduling import NodeView, pick_node
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 
+# Lazy singleton: the task-lifecycle stage histogram (submit->dispatch
+# queueing on the owner side; dep-fetch / arg-deserialize / execute /
+# result-put on the executor side).  Shared by every CoreWorker in the
+# process; the registry flush ships it to the node agent's /metrics.
+_stage_keys: Dict[str, tuple] = {}
+
+
+def _build_stage_hist():
+    from ray_tpu.util.metrics import Histogram
+    return Histogram("raytpu_task_stage_seconds",
+                     "task lifecycle stage wall-clock seconds by stage",
+                     tag_keys=("stage",))
+
+
+_stage_hist_get: Any = None
+
+
+def _task_stage_seconds():
+    global _stage_hist_get
+    if _stage_hist_get is None:
+        # deferred to first call: importing util.metrics at module import
+        # time re-enters the ray_tpu package init (circular import)
+        from ray_tpu.util.metrics import lazy
+        _stage_hist_get = lazy(_build_stage_hist)
+    return _stage_hist_get()
+
+
+def _observe_stage(stage: str, dur: float):
+    """Observe one stage duration with a precomputed tags key — this is on
+    the per-task hot path (several observations per task)."""
+    hist = _task_stage_seconds()
+    if hist is None:
+        return
+    key = _stage_keys.get(stage)
+    if key is None:
+        key = _stage_keys[stage] = (("stage", stage),)
+    hist.observe_key(key, max(0.0, dur))
+
 
 class _ReadPin:
     """Consumer-side half of the store's pin/release protocol: one pin taken
@@ -727,6 +765,12 @@ class CoreWorker:
         self._gen_emitters: Dict[TaskID, "_GenEmitter"] = {}
         self._view_cache: Tuple[float, Dict[str, NodeView]] = (0.0, {})
         self._task_events: List[dict] = []
+        #: owner-side submit timestamps: the "queue" (submit->dispatch) and
+        #: "total" (submit->terminal) stage durations are computed from these
+        self._submit_ts: Dict[TaskID, float] = {}
+        # STAGES-event rate cap bookkeeping (see _record_stages)
+        self._stage_event_window = 0
+        self._stage_event_count = 0
         self._bg: List[asyncio.Task] = []
         # executor state (worker mode)
         self.exec_queue: "_queue.Queue[tuple]" = _queue.Queue()
@@ -749,6 +793,14 @@ class CoreWorker:
         from ray_tpu.util.usage_stats import usage_stats_enabled
         if usage_stats_enabled():
             self._bg.append(asyncio.ensure_future(self._usage_flush_loop()))
+        # Config-gated stall detector on the shared IO loop: driver/worker
+        # asyncio stalls surface as raytpu_event_loop_lag_seconds alongside
+        # the agent's and GCS's (see util/loop_monitor.install).
+        from ray_tpu.util.loop_monitor import install as _install_loop_mon
+        self._loop_monitor = _install_loop_mon(
+            asyncio.get_event_loop(),
+            f"{self.mode}:{self.worker_id.hex()[:12]}",
+            gcs_call=self.gcs.call)
         return self
 
     async def _usage_flush_loop(self):
@@ -776,6 +828,8 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown = True
+        if getattr(self, "_loop_monitor", None):
+            self._loop_monitor.stop()
 
         async def _stop():
             for t in self._bg:
@@ -796,11 +850,33 @@ class CoreWorker:
     # -------------------------------------------------------------- telemetry
 
     def task_event(self, spec: TaskSpec, state: str, **extra):
-        if not get_config().task_events_enabled:
+        cfg = get_config()
+        if not cfg.task_events_enabled:
             return
+        now = time.time()
+        # Owner-side stage stamps: SUBMITTED->RUNNING is the scheduling/
+        # queueing stage (lease acquisition + dispatch), SUBMITTED->terminal
+        # is the task's whole wall clock.  Durations ride the events (the
+        # timeline and summarize_tasks read them there) and feed the stage
+        # histogram (the /metrics percentiles).
+        if cfg.task_stage_breakdown_enabled:
+            if state == "SUBMITTED":
+                self._submit_ts[spec.task_id] = now
+                while len(self._submit_ts) > cfg.task_events_max_buffer:
+                    self._submit_ts.pop(next(iter(self._submit_ts)))
+            elif state == "RUNNING":
+                t0 = self._submit_ts.get(spec.task_id)
+                if t0 is not None:
+                    extra.setdefault("queue_s", now - t0)
+                    _observe_stage("queue", now - t0)
+            elif state in ("FINISHED", "FAILED"):
+                t0 = self._submit_ts.pop(spec.task_id, None)
+                if t0 is not None:
+                    extra.setdefault("total_s", now - t0)
+                    _observe_stage("total", now - t0)
         ev = {
             "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
-            "job_id": spec.job_id.hex(), "ts": time.time(),
+            "job_id": spec.job_id.hex(), "ts": now,
             "actor_id": spec.actor_id.hex() if spec.actor_id else None,
             **extra}
         if spec.trace_ctx:
@@ -810,6 +886,41 @@ class CoreWorker:
             ev.setdefault("parent_id", spec.trace_ctx[1])
             ev.setdefault("span_id", spec.task_id.hex()[:12])
         self._task_events.append(ev)
+
+    def _record_stages(self, spec: TaskSpec, stages: Dict[str, list]):
+        """Executor-side per-stage breakdown of one completed task: appends
+        a STAGES task event (the timeline renders these as nested sub-slices
+        inside the task's slice) and observes each duration into
+        ``raytpu_task_stage_seconds``.  Runs on the executor thread;
+        list.append is atomic under the GIL (same contract as span())."""
+        cfg = get_config()
+        if (not stages or not cfg.task_events_enabled
+                or not cfg.task_stage_breakdown_enabled):
+            return
+        payload: Dict[str, tuple] = {}
+        for name, (t0, t1) in stages.items():
+            dur = max(0.0, t1 - t0)
+            payload[name] = (t0, dur)
+            _observe_stage(name, dur)
+        # Per-task event payloads are rate-capped (histograms above are
+        # not): under a small-task flood the timeline samples, instead of
+        # the event pipeline eating the throughput it is measuring.
+        cap = cfg.task_stage_events_per_s
+        if cap > 0:
+            now_s = int(time.time())
+            if now_s != self._stage_event_window:
+                self._stage_event_window = now_s
+                self._stage_event_count = 0
+            if self._stage_event_count >= cap:
+                return
+            self._stage_event_count += 1
+        # deliberately slim (no job/actor ids): one of these ships per task
+        self._task_events.append({
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "state": "STAGES",
+            "ts": min(t0 for t0, _ in payload.values()),
+            "worker": self.worker_id.hex()[:12],
+            "stages": payload})
 
     async def _flush_task_events_loop(self):
         while not self._shutdown:
@@ -1882,19 +1993,31 @@ class CoreWorker:
             self.fn_cache[fn_id] = fn
         return fn
 
-    def _resolve_args(self, spec: TaskSpec):
+    def _resolve_args(self, spec: TaskSpec,
+                      stages: Optional[Dict[str, list]] = None):
         from .remote_function import serialize_args
         if spec.args == serialize_args((), {})[0]:  # canonical empty blob
+            if stages is not None:
+                now = time.time()
+                stages["arg_deser"] = [now, now]
+                stages["dep_fetch"] = [now, now]
             return [], {}
+        t0 = time.time()
         so = serialization.SerializedObject.from_buffer(spec.args)
         args, kwargs = serialization.deserialize(so)
+        t1 = time.time()
 
         def resolve(x):
             if isinstance(x, _TopLevelRef):
                 return self.get(x.ref)
             return x
 
-        return [resolve(a) for a in args], {k: resolve(v) for k, v in kwargs.items()}
+        out = ([resolve(a) for a in args],
+               {k: resolve(v) for k, v in kwargs.items()})
+        if stages is not None:
+            stages["arg_deser"] = [t0, t1]
+            stages["dep_fetch"] = [t1, time.time()]
+        return out
 
     def _execute_task(self, spec: TaskSpec):
         from .runtime_context import _task_context
@@ -1905,7 +2028,8 @@ class CoreWorker:
             fn = method
         else:
             fn = self._load_function(spec.fn_id, spec.job_id)
-        args, kwargs = self._resolve_args(spec)
+        stages: Dict[str, list] = {}
+        args, kwargs = self._resolve_args(spec, stages)
         ctx = {"task_id": spec.task_id, "job_id": spec.job_id,
                "actor_id": spec.actor_id, "name": spec.name}
         if spec.resources:
@@ -1921,16 +2045,21 @@ class CoreWorker:
                     else spec.task_id.hex()[:12])
         trace_token = _tracing.set_context((trace_id,
                                             spec.task_id.hex()[:12]))
+        t_exec = time.time()
         try:
             out = fn(*args, **kwargs)
         finally:
             _tracing.reset_context(trace_token)
             _task_context.reset(token)
+        t_put = time.time()
+        stages["execute"] = [t_exec, t_put]
         results = self._package_returns(spec, out)
+        stages["result_put"] = [t_put, time.time()]
         # Borrow notes for refs this task deserialized (and may retain, e.g.
         # actor state) must be ACKED before the results ship — the submitter
         # drops its argument pins as soon as it processes them.
         self.flush_borrower_notes()
+        self._record_stages(spec, stages)
         return results
 
     def _package_returns(self, spec: TaskSpec, out) -> List[tuple]:
@@ -2092,7 +2221,9 @@ class CoreWorker:
             # getattr inside the per-spec error scope: a missing method must
             # fail only ITS call, not every call batched with it.
             method = getattr(self.actor_instance, spec.actor_method)
-            args, kwargs = self._resolve_args(spec)
+            stages: Dict[str, list] = {}
+            args, kwargs = self._resolve_args(spec, stages)
+            t_exec = time.time()
             res = method(*args, **kwargs)
             if asyncio.iscoroutine(res):
                 res = await res
@@ -2101,8 +2232,12 @@ class CoreWorker:
                 # backpressure wait is awaitable, so a slow consumer parks
                 # only this task, not the actor's whole event loop.
                 return await self._run_generator_async(spec, res)
+            t_put = time.time()
+            stages["execute"] = [t_exec, t_put]
             results = self._package_returns(spec, res)
+            stages["result_put"] = [t_put, time.time()]
             self.flush_borrower_notes()  # see _execute_task
+            self._record_stages(spec, stages)
             return results
 
         cfut = asyncio.run_coroutine_threadsafe(runner(), self._actor_async_loop)

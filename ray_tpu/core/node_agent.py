@@ -37,6 +37,70 @@ from .object_store import NodeObjectStore, ObjectStoreFullError
 from .rpc import ClientPool, RpcClient, RpcServer
 from .scheduling import NodeView, pick_node
 
+# Lazy singleton: node telemetry gauges (reference: metric_defs.cc core
+# metrics).  Module-level so in-process multi-agent clusters (tests, the
+# driver-embedded head) share one registry entry per name — each agent's
+# samples are separated by the `node` tag.
+def _build_telemetry_gauges():
+    from ray_tpu.util.metrics import Gauge
+    return {
+        "workers": Gauge(
+            "raytpu_node_workers",
+            "worker processes registered to this agent", tag_keys=("node",)),
+        "workers_leased": Gauge(
+            "raytpu_node_workers_leased",
+            "workers currently executing under a lease", tag_keys=("node",)),
+        "lease_queue": Gauge(
+            "raytpu_node_lease_queue_len",
+            "lease requests queued (scheduler backlog)", tag_keys=("node",)),
+        "store_used": Gauge(
+            "raytpu_object_store_bytes",
+            "shm pool bytes in use", tag_keys=("node",)),
+        "store_capacity": Gauge(
+            "raytpu_object_store_capacity_bytes",
+            "shm pool capacity", tag_keys=("node",)),
+        "store_free": Gauge(
+            "raytpu_object_store_free_bytes",
+            "shm pool bytes free", tag_keys=("node",)),
+        "store_largest_free": Gauge(
+            "raytpu_object_store_largest_free_bytes",
+            "largest contiguous free shm block", tag_keys=("node",)),
+        "store_objects": Gauge(
+            "raytpu_object_store_objects",
+            "sealed objects resident in the store", tag_keys=("node",)),
+        "store_pinned": Gauge(
+            "raytpu_object_store_pinned",
+            "store entries with a live pin", tag_keys=("node",)),
+        "read_pins": Gauge(
+            "raytpu_read_pins_outstanding",
+            "zero-copy read pins granted and not yet released",
+            tag_keys=("node",)),
+        "oom_kills": Gauge(
+            "raytpu_node_oom_kills",
+            "memory-monitor worker kills since agent start",
+            tag_keys=("node",)),
+        "resource_available": Gauge(
+            "raytpu_resource_available",
+            "schedulable capacity currently free",
+            tag_keys=("node", "resource")),
+        "resource_total": Gauge(
+            "raytpu_resource_total",
+            "schedulable capacity", tag_keys=("node", "resource")),
+    }
+
+
+_telemetry_gauges_get = None
+
+
+def _telemetry_gauges():
+    global _telemetry_gauges_get
+    if _telemetry_gauges_get is None:
+        # deferred to first call: importing util.metrics at module import
+        # time re-enters the ray_tpu package init (circular import)
+        from ray_tpu.util.metrics import lazy
+        _telemetry_gauges_get = lazy(_build_telemetry_gauges)
+    return _telemetry_gauges_get()
+
 
 @dataclass
 class WorkerHandle:
@@ -153,6 +217,8 @@ class NodeAgent:
                                   resources=self.total.to_dict(), labels=self.labels)
         self._apply_view(res["cluster_view"])
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        if get_config().metrics_export_enabled:
+            self._bg.append(asyncio.ensure_future(self._telemetry_loop()))
         self._bg.append(asyncio.ensure_future(self._idle_reaper_loop()))
         self._bg.append(asyncio.ensure_future(self._pin_sweep_loop()))
         self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
@@ -1402,10 +1468,16 @@ class NodeAgent:
             return
 
         async def metrics_handler(_request):
-            from ray_tpu.util.metrics import render_prometheus
-            body = render_prometheus(getattr(self, "_metrics", {}))
-            body += self._runtime_metrics()
-            return web.Response(text=body,
+            from ray_tpu.util.metrics import (render_prometheus,
+                                              snapshot_registry)
+            # Refresh the node gauges at scrape time (the telemetry loop
+            # keeps them warm between scrapes), then serve the agent's own
+            # registry (node gauges, RPC metrics) merged with every
+            # worker/driver snapshot pushed via report_metrics.
+            self._sample_telemetry()
+            per = dict(getattr(self, "_metrics", {}))
+            per[f"agent-{self.node_id.hex()[:12]}"] = snapshot_registry()
+            return web.Response(text=render_prometheus(per),
                                 content_type="text/plain")
 
         app = web.Application()
@@ -1420,41 +1492,52 @@ class NodeAgent:
         self._metrics_runner = runner
         self.labels["metrics_port"] = str(port)
 
-    def _runtime_metrics(self) -> str:
-        """Built-in node gauges (reference: metric_defs.cc core metrics)."""
+    def _sample_telemetry(self):
+        """One sample of this node's runtime state into the telemetry
+        gauges: shm-pool occupancy (used/free/largest-free, the PR-1
+        introspection), outstanding read pins, scheduler queue depth, live
+        worker count, and resource capacity.  Called by the periodic
+        telemetry loop and again at /metrics scrape time for freshness."""
+        g = _telemetry_gauges()
+        if g is None:
+            return
+        tags = {"node": self.node_id.hex()[:12]}
         st = self.store.stats()
-        lines = [
-            "# TYPE raytpu_node_workers gauge",
-            f'raytpu_node_workers{{node="{self.node_id.hex()[:12]}"}} '
-            f"{len(self.workers)}",
-            "# TYPE raytpu_node_lease_queue_len gauge",
-            f'raytpu_node_lease_queue_len{{node="{self.node_id.hex()[:12]}"}} '
-            f"{len(self.lease_queue)}",
-            "# TYPE raytpu_object_store_bytes gauge",
-            f'raytpu_object_store_bytes{{node="{self.node_id.hex()[:12]}"}} '
-            f"{st.get('used', 0)}",
-        ]
+        g["workers"].set(len(self.workers), tags)
+        g["workers_leased"].set(
+            sum(1 for w in self.workers.values() if w.state == "LEASED"),
+            tags)
+        g["lease_queue"].set(len(self.lease_queue), tags)
+        used = st.get("used", 0)
+        cap = st.get("capacity", 0)
+        g["store_used"].set(used, tags)
+        g["store_capacity"].set(cap, tags)
+        g["store_free"].set(max(0, cap - used), tags)
+        g["store_largest_free"].set(st.get("largest_free_block", 0), tags)
+        g["store_objects"].set(st.get("num_objects", 0), tags)
+        g["store_pinned"].set(st.get("num_pinned", 0), tags)
+        g["read_pins"].set(
+            sum(count for per in self._read_pins.values()
+                for kinds in per.values() for count in kinds.values()),
+            tags)
+        g["oom_kills"].set(self._oom_kill_count, tags)
+        avail = self.available.to_dict()
         for k, total in self.total.to_dict().items():
-            avail = self.available.to_dict().get(k, 0.0)
-            lines += [
-                f'raytpu_resource_available{{node="{self.node_id.hex()[:12]}",'
-                f'resource="{k}"}} {avail}',
-                f'raytpu_resource_total{{node="{self.node_id.hex()[:12]}",'
-                f'resource="{k}"}} {total}',
-            ]
-        mon = getattr(self, "_loop_monitor", None)
-        if mon is not None:
-            s = mon.stats()
-            lines += [
-                "# TYPE raytpu_loop_stalls_total counter",
-                f'raytpu_loop_stalls_total{{node="{self.node_id.hex()[:12]}"}} '
-                f"{s['stall_count']}",
-                "# TYPE raytpu_loop_worst_stall_seconds gauge",
-                f'raytpu_loop_worst_stall_seconds'
-                f'{{node="{self.node_id.hex()[:12]}"}} '
-                f"{s['worst_stall_s']:.3f}",
-            ]
-        return "\n".join(lines) + "\n"
+            rtags = {"node": tags["node"], "resource": k}
+            g["resource_available"].set(avail.get(k, 0.0), rtags)
+            g["resource_total"].set(total, rtags)
+
+    async def _telemetry_loop(self, period_s: float = 2.0):
+        """Periodic node self-measurement (reference: the per-node stats
+        reporters feeding metrics_agent.py) — keeps the gauges live even
+        when nothing scrapes, so a snapshot pulled through report_metrics
+        or a debugger is never minutes stale."""
+        while not self._shutting_down:
+            try:
+                self._sample_telemetry()
+            except Exception:
+                pass
+            await asyncio.sleep(period_s)
 
     async def _log_monitor_loop(self):
         """Tail worker log files and publish new lines to the GCS pubsub

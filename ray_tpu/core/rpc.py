@@ -19,6 +19,7 @@ import asyncio
 import itertools
 import pickle
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, Optional
 
@@ -56,6 +57,84 @@ def run_async(coro, timeout: float | None = None):
         raise RuntimeError("run_async called from the IO loop thread (would deadlock)")
     fut = asyncio.run_coroutine_threadsafe(coro, loop)
     return fut.result(timeout)
+
+
+# --------------------------------------------------------- RPC self-metrics
+#
+# Per-method client/server latency histograms, byte counters, an in-flight
+# gauge and error counters (reference: grpc server/client interceptor stats
+# feeding metric_defs.cc).  One lazy singleton per process — every RpcServer
+# and RpcClient in the process shares it, and the regular registry flush
+# ships it to the node agent's /metrics endpoint.  Disabled (config
+# rpc_metrics_enabled=False) the hot path pays a single None check.
+
+class _RpcMetrics:
+    __slots__ = ("client_seconds", "server_seconds", "bytes_sent",
+                 "bytes_received", "client_inflight", "errors", "reconnects",
+                 "client_inflight_n", "_keys")
+
+    def method_keys(self, method: str) -> tuple:
+        """Precomputed sorted tag-key tuples for one method:
+        (latency, client-bytes, server-bytes).  Built once per method —
+        the hot path then calls the *_key metric fast paths instead of
+        re-sorting a tags dict per frame."""
+        k = self._keys.get(method)
+        if k is None:
+            k = self._keys[method] = (
+                (("method", method),),
+                (("method", method), ("role", "client")),
+                (("method", method), ("role", "server")),
+            )
+        return k
+
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+        self.client_seconds = Histogram(
+            "raytpu_rpc_client_seconds",
+            "RPC client call latency (request sent -> response future done)",
+            tag_keys=("method",))
+        self.server_seconds = Histogram(
+            "raytpu_rpc_server_seconds",
+            "RPC server handler latency by method",
+            tag_keys=("method",))
+        self.bytes_sent = Counter(
+            "raytpu_rpc_bytes_sent_total",
+            "RPC frame bytes written, by method and side",
+            tag_keys=("method", "role"))
+        self.bytes_received = Counter(
+            "raytpu_rpc_bytes_received_total",
+            "RPC frame bytes read, by method and side",
+            tag_keys=("method", "role"))
+        self.client_inflight = Gauge(
+            "raytpu_rpc_client_inflight",
+            "RPC client calls awaiting a response in this process"
+        ).set_fn(lambda: self.client_inflight_n)  # pull-based: zero hot-path cost
+        self.errors = Counter(
+            "raytpu_rpc_errors_total",
+            "RPC failures by method, exception kind and side",
+            tag_keys=("method", "kind", "role"))
+        self.reconnects = Counter(
+            "raytpu_rpc_reconnects_total",
+            "client reconnections after a lost connection")
+        self.client_inflight_n = 0
+        self._keys: Dict[str, tuple] = {}
+
+
+def _build_rpc_metrics():
+    return _RpcMetrics() if get_config().rpc_metrics_enabled else None
+
+
+_rpc_metrics_get: Optional[Callable[[], Optional[_RpcMetrics]]] = None
+
+
+def rpc_metrics() -> Optional[_RpcMetrics]:
+    global _rpc_metrics_get
+    if _rpc_metrics_get is None:
+        # the util.metrics import is deferred to FIRST CALL: at module
+        # import time it would re-enter the ray_tpu package init (circular)
+        from ray_tpu.util.metrics import lazy
+        _rpc_metrics_get = lazy(_build_rpc_metrics)
+    return _rpc_metrics_get()
 
 
 def _encode(msg) -> bytes:
@@ -135,23 +214,26 @@ def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
         asyncio.get_event_loop().call_soon(_flush_writer, writer)
 
 
-def coalesced_write_frame(writer: "asyncio.StreamWriter", msg) -> None:
+def coalesced_write_frame(writer: "asyncio.StreamWriter", msg) -> int:
     """Encode + queue one message, using the vectored wire format when the
     payload carries large buffers.  Vectored frames flush IMMEDIATELY (in
     FIFO order with everything already queued): their out-of-band parts are
     views over caller memory that must not dangle across a loop tick, and a
-    multi-MB frame gains nothing from coalescing anyway."""
+    multi-MB frame gains nothing from coalescing anyway.  Returns the wire
+    bytes queued (the RPC byte counters' data source)."""
     parts = _encode_parts(msg)
     if len(parts) == 1:
         coalesced_write(writer, parts[0])
-        return
+        return len(parts[0])
     buf = getattr(writer, "_raytpu_buf", None)
     if buf is None:
         buf = writer._raytpu_buf = []
         writer._raytpu_buf_bytes = 0
+    nbytes = sum(len(p) for p in parts)
     buf.extend(parts)
-    writer._raytpu_buf_bytes += sum(len(p) for p in parts)
+    writer._raytpu_buf_bytes += nbytes
     _flush_writer(writer)
+    return nbytes
 
 
 def _flush_writer(writer: "asyncio.StreamWriter") -> None:
@@ -203,22 +285,26 @@ async def drain_if_needed(writer: "asyncio.StreamWriter",
 
 
 async def _read_msg(reader: asyncio.StreamReader):
+    """-> (message, wire_bytes) for one frame."""
     hdr = await reader.readexactly(4)
     n = int.from_bytes(hdr, "big")
     if not n & _VEC_FLAG:
-        return pickle.loads(await reader.readexactly(n))
+        return pickle.loads(await reader.readexactly(n)), 4 + n
     # Vectored frame: pickle stream + out-of-band buffers.  Each buffer is
     # read into its own allocation and handed to pickle out-of-band — the
     # receive path's only copy; in-band pickling would pay a second one
     # materializing the bytes out of the stream.
-    payload = await reader.readexactly(n & (_VEC_FLAG - 1))
+    plen = n & (_VEC_FLAG - 1)
+    payload = await reader.readexactly(plen)
     nbufs = int.from_bytes(await reader.readexactly(4), "big")
     sizes_raw = await reader.readexactly(8 * nbufs)
     bufs = []
+    total = 8 + plen + 8 * nbufs
     for i in range(nbufs):
         size = int.from_bytes(sizes_raw[8 * i:8 * i + 8], "big")
         bufs.append(await reader.readexactly(size))
-    return pickle.loads(payload, buffers=bufs)
+        total += size
+    return pickle.loads(payload, buffers=bufs), total
 
 
 class RpcError(Exception):
@@ -278,9 +364,13 @@ class RpcServer:
         try:
             while True:
                 try:
-                    req_id, method, kwargs = await _read_msg(reader)
+                    (req_id, method, kwargs), nbytes = await _read_msg(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break
+                m = rpc_metrics()
+                if m is not None:
+                    m.bytes_received.inc_key(m.method_keys(method)[2],
+                                             nbytes)
                 # Handle each request concurrently so a slow handler (e.g. a
                 # blocking Get) doesn't head-of-line-block the connection.
                 asyncio.ensure_future(self._dispatch(writer, req_id, method, kwargs))
@@ -297,6 +387,8 @@ class RpcServer:
                 pass
 
     async def _dispatch(self, writer, req_id, method, kwargs):
+        m = rpc_metrics()
+        t0 = time.monotonic() if m is not None else 0.0
         try:
             fn = getattr(self.handler, "handle_" + method)
             if getattr(fn, "rpc_pass_writer", False):
@@ -309,10 +401,17 @@ class RpcServer:
         except BaseException as e:  # noqa: BLE001 — errors must travel back
             result = (e, traceback.format_exc())
             ok = False
+            if m is not None:
+                m.errors.inc(tags={"method": method,
+                                   "kind": type(e).__name__,
+                                   "role": "server"})
+        if m is not None:
+            m.server_seconds.observe_key(m.method_keys(method)[0],
+                                         time.monotonic() - t0)
         if req_id >= 0:
             try:
                 try:
-                    coalesced_write_frame(writer, (req_id, ok, result))
+                    n = coalesced_write_frame(writer, (req_id, ok, result))
                 except (ConnectionResetError, BrokenPipeError):
                     return
                 except Exception:
@@ -321,7 +420,9 @@ class RpcServer:
                     err = RuntimeError(
                         f"handler {method!r} produced an unpicklable "
                         f"{'result' if ok else 'exception'}: {result!r:.500}")
-                    coalesced_write_frame(writer, (req_id, False, (err, "")))
+                    n = coalesced_write_frame(writer, (req_id, False, (err, "")))
+                if m is not None:
+                    m.bytes_sent.inc_key(m.method_keys(method)[2], n)
                 await drain_if_needed(writer)
             except (ConnectionResetError, BrokenPipeError):
                 pass
@@ -363,6 +464,7 @@ class RpcClient:
         self._req_ids = itertools.count(1)
         self._connect_lock: asyncio.Lock | None = None
         self._closed = False
+        self._connected_once = False
         self._push_handler: Callable[[str, dict], None] | None = None
         # chaos harness: per-link added latency (config or set_link_delay)
         self._chaos_delay_s = get_config().chaos_rpc_delay_ms / 1000.0
@@ -382,12 +484,17 @@ class RpcClient:
                 asyncio.open_connection(self._host, self._port,
                                         limit=16 << 20),
                 timeout=cfg.rpc_connect_timeout_s)
+            if self._connected_once:
+                m = rpc_metrics()
+                if m is not None:
+                    m.reconnects.inc()
+            self._connected_once = True
             asyncio.ensure_future(self._read_loop(self._reader))
 
     async def _read_loop(self, reader):
         try:
             while True:
-                msg = await _read_msg(reader)
+                msg, nbytes = await _read_msg(reader)
                 req_id, ok, payload = msg
                 if req_id < 0:  # server push
                     if self._push_handler:
@@ -397,12 +504,18 @@ class RpcClient:
                             traceback.print_exc()
                     continue
                 fut = self._pending.pop(req_id, None)
-                if fut is not None and not fut.done():
-                    if ok:
-                        fut.set_result(payload)
-                    else:
-                        cause, tb = payload
-                        fut.set_exception(RemoteError(cause, tb))
+                if fut is not None:
+                    m = rpc_metrics()
+                    if m is not None:
+                        method = getattr(fut, "_raytpu_method", "?")
+                        m.bytes_received.inc_key(m.method_keys(method)[1],
+                                                 nbytes)
+                    if not fut.done():
+                        if ok:
+                            fut.set_result(payload)
+                        else:
+                            cause, tb = payload
+                            fut.set_exception(RemoteError(cause, tb))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -426,7 +539,29 @@ class RpcClient:
         req_id = next(self._req_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
-        coalesced_write_frame(self._writer, (req_id, method, kwargs))
+        nbytes = coalesced_write_frame(self._writer, (req_id, method, kwargs))
+        m = rpc_metrics()
+        if m is not None:
+            keys = m.method_keys(method)
+            fut._raytpu_method = method
+            m.bytes_sent.inc_key(keys[1], nbytes)
+            m.client_inflight_n += 1
+            t0 = time.monotonic()
+
+            def _done(f, _m=m, _method=method, _lat_key=keys[0], _t0=t0):
+                _m.client_inflight_n -= 1
+                _m.client_seconds.observe_key(_lat_key,
+                                              time.monotonic() - _t0)
+                if f.cancelled():
+                    kind = "cancelled"  # usually the caller's timeout
+                else:
+                    exc = f.exception()  # retrieves it: no GC-time warning
+                    kind = type(exc).__name__ if exc is not None else None
+                if kind:
+                    _m.errors.inc(tags={"method": _method, "kind": kind,
+                                        "role": "client"})
+
+            fut.add_done_callback(_done)
         await drain_if_needed(self._writer)
         return fut
 
@@ -443,7 +578,10 @@ class RpcClient:
         await self._ensure_connected()
         if self._chaos_delay_s > 0.0:
             await asyncio.sleep(self._chaos_delay_s)
-        coalesced_write_frame(self._writer, (-1, method, kwargs))
+        nbytes = coalesced_write_frame(self._writer, (-1, method, kwargs))
+        m = rpc_metrics()
+        if m is not None:
+            m.bytes_sent.inc_key(m.method_keys(method)[1], nbytes)
         await drain_if_needed(self._writer)
 
     def call_sync(self, method: str, _timeout: float | None = None, **kwargs) -> Any:

@@ -278,6 +278,37 @@ class DashboardHead:
                 *[scrape(sess, nid, host, port) for nid, host, port in jobs])
         return _json({"ts": time.time(), "nodes": out})
 
+    async def telemetry(self, _req):
+        """Per-node runtime telemetry + task-stage latency percentiles —
+        the self-instrumentation plane's aggregate view (live agent
+        node_info per node, summarize_tasks' stage_latency rollup)."""
+        import ray_tpu
+        from ray_tpu.core.core_worker import global_worker
+        from ray_tpu.util import state
+
+        rows = await _off(ray_tpu.nodes)
+        w = global_worker()
+        nodes: dict = {}
+
+        async def probe(nid: str, address: str):
+            try:
+                nodes[nid] = await asyncio.wait_for(
+                    w.agent_clients.get(address).call(
+                        "node_info", _timeout=10.0), 15)
+            except Exception as e:  # noqa: BLE001 — report what answered
+                nodes[nid] = {"error": str(e)}
+
+        # concurrent like the metrics scrape above: one timeout of wall
+        # clock, not one per wedged node
+        await asyncio.gather(*[
+            probe((row.get("NodeID") or "")[:12], row["AgentAddress"])
+            for row in rows
+            if row.get("Alive") and row.get("AgentAddress")])
+        summary = await _off(state.summarize_tasks)
+        return _json({"ts": time.time(), "nodes": nodes,
+                      "total_tasks": summary.get("total_tasks", 0),
+                      "stage_latency": summary.get("stage_latency", {})})
+
     async def workflow_send_event(self, req):
         """HTTP event provider (reference: workflow/http_event_provider.py):
         external systems POST a JSON payload here to unblock every workflow
@@ -398,6 +429,7 @@ class DashboardHead:
         r.add_get("/api/tasks", self.tasks)
         r.add_get("/api/tasks/{task_id:[0-9a-f]{8,}}", self.task_detail)
         r.add_get("/api/metrics", self.metrics)
+        r.add_get("/api/telemetry", self.telemetry)
         r.add_get("/api/tasks/summarize", self.tasks_summarize)
         r.add_get("/api/objects", self.objects)
         r.add_get("/api/placement_groups", self.placement_groups)

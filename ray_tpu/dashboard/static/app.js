@@ -132,6 +132,32 @@ const pages = {
       })));
   },
 
+  async telemetry() {
+    /* Per-node runtime telemetry + task-stage latency percentiles: the
+       self-instrumentation plane's aggregate view (/api/telemetry). */
+    const data = await api("telemetry");
+    const fmtB = (n) => {
+      if (n == null) return "?";
+      for (const u of ["B", "KiB", "MiB", "GiB"]) {
+        if (n < 1024 || u === "GiB") return `${Math.round(n * 10) / 10}${u}`;
+        n /= 1024;
+      }
+    };
+    const ms = (v) => `${Math.round(v * 1e5) / 100} ms`;
+    const nodes = Object.entries(data.nodes || {});
+    const stages = Object.entries(data.stage_latency || {}).filter(([, s]) => s);
+    return h("div", {},
+      h("h2", {}, "Node telemetry"),
+      table(["node", "workers", "queue", "store used", "capacity", "pinned", "oom kills"],
+        nodes.map(([nid, i]) => [nid,
+          i.num_workers ?? "?", i.queue_len ?? "?",
+          fmtB((i.store || {}).used), fmtB((i.store || {}).capacity),
+          (i.store || {}).num_pinned ?? "?", i.oom_kills ?? 0])),
+      h("h2", {}, `Task stages (${data.total_tasks || 0} tasks)`),
+      table(["stage", "count", "p50", "p90", "p99", "max"],
+        stages.map(([k, s]) => [k, s.count, ms(s.p50), ms(s.p90), ms(s.p99), ms(s.max)])));
+  },
+
   async pgs() {
     const pgs = await api("placement_groups");
     return h("div", {}, h("h2", {}, "Placement groups"),

@@ -107,6 +107,72 @@ def cmd_status(_args):
     print("resources:")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0):.1f}/{total[k]:.1f} available")
+    _print_node_telemetry(rt, nodes)
+    _print_stage_summary()
+
+
+def _print_node_telemetry(rt, nodes):
+    """Per-node runtime telemetry (live worker/queue/store occupancy, from
+    each agent's node_info — same data the gauges on /metrics export).
+    Probes run concurrently so K wedged agents cost ONE timeout of wall
+    clock, not K (same pattern as the dashboard's telemetry handler)."""
+    import asyncio
+
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+
+    w = global_worker()
+    alive = [n for n in nodes if n.get("Alive") and n.get("AgentAddress")]
+
+    async def probe_all():
+        async def one(n):
+            try:
+                return await asyncio.wait_for(
+                    w.agent_clients.get(n["AgentAddress"]).call(
+                        "node_info", _timeout=5.0), 8)
+            except Exception:
+                return None
+        return await asyncio.gather(*[one(n) for n in alive])
+
+    try:
+        infos = run_async(probe_all(), timeout=15)
+    except Exception:
+        return
+    printed_header = False
+    for info in infos:
+        if info is None:
+            continue
+        if not printed_header:
+            print("telemetry:")
+            printed_header = True
+        st = info.get("store", {})
+        print(f"  {info['node_id'][:12]}  workers={info['num_workers']} "
+              f"queue={info.get('queue_len', 0)} "
+              f"store={_fmt_bytes(st.get('used', 0))}"
+              f"/{_fmt_bytes(st.get('capacity', 0))} "
+              f"pinned={st.get('num_pinned', 0)} "
+              f"oom_kills={info.get('oom_kills', 0)}")
+
+
+def _print_stage_summary():
+    """Task-stage latency percentiles (summarize_tasks' stage_latency)."""
+    from ray_tpu.util import state as state_api
+
+    try:
+        summary = state_api.summarize_tasks()
+    except Exception:
+        return
+    stages = {k: v for k, v in (summary.get("stage_latency") or {}).items()
+              if v}
+    if not stages:
+        return
+    print(f"task stages ({summary.get('total_tasks', 0)} tasks):")
+    print(f"  {'STAGE':<12} {'COUNT':>6} {'P50':>9} {'P90':>9} "
+          f"{'P99':>9} {'MAX':>9}")
+    for stage, s in stages.items():
+        print(f"  {stage:<12} {s['count']:>6} {s['p50'] * 1e3:>8.1f}ms "
+              f"{s['p90'] * 1e3:>8.1f}ms {s['p99'] * 1e3:>8.1f}ms "
+              f"{s['max'] * 1e3:>8.1f}ms")
 
 
 def cmd_list(args):
@@ -175,8 +241,11 @@ def cmd_timeline(args):
     _connect()
     from ray_tpu.util.tracing import export_chrome_trace
 
-    out = export_chrome_trace(args.output or "timeline.json")
-    print(f"chrome trace -> {out} (open in chrome://tracing or Perfetto)")
+    out = export_chrome_trace(args.output or "timeline.json",
+                              breakdown=args.breakdown)
+    what = "with per-stage sub-slices " if args.breakdown else ""
+    print(f"chrome trace {what}-> {out} "
+          f"(open in chrome://tracing or Perfetto)")
 
 
 def cmd_dashboard(args):
@@ -289,7 +358,8 @@ def main(argv=None):
     s = sub.add_parser("stop", help="stop local daemons")
     s.set_defaults(fn=cmd_stop)
 
-    s = sub.add_parser("status", help="cluster nodes + resources")
+    s = sub.add_parser("status", help="cluster nodes + resources + per-node "
+                                      "telemetry and task-stage latency")
     s.set_defaults(fn=cmd_status)
 
     s = sub.add_parser("list", help="state API listings")
@@ -303,6 +373,9 @@ def main(argv=None):
 
     s = sub.add_parser("timeline", help="export chrome-trace timeline json")
     s.add_argument("--output", default=None)
+    s.add_argument("--breakdown", action="store_true",
+                   help="nest per-stage sub-slices (queue/dep_fetch/"
+                        "arg_deser/execute/result_put) inside task slices")
     s.set_defaults(fn=cmd_timeline)
 
     s = sub.add_parser("dashboard", help="serve the REST dashboard")

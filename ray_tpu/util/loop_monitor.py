@@ -33,6 +33,39 @@ from typing import Callable, Optional
 
 __all__ = ["LoopMonitor", "install", "format_loop_stack"]
 
+# Lazy gauges shared by every monitor in the process (tag `process`
+# separates the series): current heartbeat lag, stall count and worst
+# stall land in the metrics registry so agent/worker asyncio stalls show
+# up on /metrics next to the runtime metrics, not only as WARNING events.
+def _build_lag_gauges():
+    from ray_tpu.util.metrics import Gauge
+    return (
+        Gauge("raytpu_event_loop_lag_seconds",
+              "event-loop heartbeat lag beyond the probe interval",
+              tag_keys=("process",)),
+        Gauge("raytpu_event_loop_stalls",
+              "stall episodes (lag beyond threshold) since start",
+              tag_keys=("process",)),
+        # keeps the pre-existing series name alive (it used to be rendered
+        # as agent-local text on /metrics; now per-process and registry-fed)
+        Gauge("raytpu_loop_worst_stall_seconds",
+              "longest single stall observed since start",
+              tag_keys=("process",)),
+    )
+
+
+_lag_gauges_get = None
+
+
+def _lag_gauges():
+    global _lag_gauges_get
+    if _lag_gauges_get is None:
+        # deferred to first call: keeps this module import-light (and
+        # consistent with the other lazy metric singletons)
+        from ray_tpu.util.metrics import lazy
+        _lag_gauges_get = lazy(_build_lag_gauges)
+    return _lag_gauges_get()
+
 
 def format_loop_stack(thread_id: Optional[int]) -> str:
     """Render the current stack of one thread (the loop's) — the
@@ -54,11 +87,13 @@ class LoopMonitor:
 
     def __init__(self, loop, threshold_s: float = 0.5,
                  interval_s: float = 0.1,
-                 on_stall: Optional[Callable[[float, str], None]] = None):
+                 on_stall: Optional[Callable[[float, str], None]] = None,
+                 source: str = ""):
         self.loop = loop
         self.threshold_s = float(threshold_s)
         self.interval_s = float(interval_s)
         self.on_stall = on_stall
+        self.source = source
         self.stall_count = 0
         self.worst_stall_s = 0.0
         self._last_echo = time.monotonic()
@@ -82,6 +117,18 @@ class LoopMonitor:
                 return
             self._stop.wait(self.interval_s)
             overdue = time.monotonic() - self._last_echo
+            if self.source:
+                # a healthy loop echoes within ~interval_s of the probe, so
+                # lag is whatever the echo is overdue beyond that
+                g = _lag_gauges()
+                if g is not None:
+                    try:
+                        tags = {"process": self.source}
+                        g[0].set(max(0.0, overdue - self.interval_s), tags)
+                        g[1].set(self.stall_count, tags)
+                        g[2].set(self.worst_stall_s, tags)
+                    except Exception:
+                        pass
             if overdue > self.threshold_s:
                 # worst-stall tracks the FULL duration (it keeps growing
                 # while the episode lasts); the report fires once per
@@ -152,5 +199,5 @@ def install(loop, source: str, gcs_call=None) -> Optional[LoopMonitor]:
             pass
 
     mon = LoopMonitor(loop, threshold_s=cfg.loop_monitor_threshold_s,
-                      on_stall=on_stall)
+                      on_stall=on_stall, source=source)
     return mon.start()

@@ -9,12 +9,19 @@ the Prometheus text endpoint (``node_agent._render_prometheus``).
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 DEFAULT_BOUNDARIES = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                       5.0, 10.0, 30.0, 60.0)
+
+#: Prometheus metric-name grammar (exposition format spec).  The previous
+#: ``name.replace("_","").isalnum()`` check both rejected valid names with
+#: colons and accepted non-ASCII alphanumerics that Prometheus rejects.
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
@@ -30,7 +37,7 @@ class Metric:
 
     def __init__(self, name: str, description: str = "",
                  tag_keys: Sequence[str] = ()):
-        if not name.replace("_", "").isalnum():
+        if not _METRIC_NAME_RE.match(name):
             raise ValueError(f"invalid metric name {name!r}")
         self.name = name
         self.description = description
@@ -75,6 +82,14 @@ class Counter(Metric):
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
+    def inc_key(self, key: tuple, value: float = 1.0):
+        """Hot-path increment with a PRECOMPUTED sorted tags key (the tuple
+        ``_tags_key`` would produce).  RPC/task hot paths cache these keys
+        per method/stage — skipping the per-call dict build + sort is what
+        keeps instrumentation inside its overhead budget."""
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"kind": self.kind, "help": self.description,
@@ -92,7 +107,26 @@ class Gauge(Metric):
         with self._lock:
             self._values[_tags_key(self._merged(tags))] = float(value)
 
+    def set_key(self, key: tuple, value: float):
+        """Hot-path set with a precomputed tags key (see Counter.inc_key)."""
+        with self._lock:
+            self._values[key] = float(value)
+
+    def set_fn(self, fn) -> "Gauge":
+        """Pull-based gauge: ``fn()`` is sampled at snapshot time instead of
+        the instrumented code pushing on every change — the right shape for
+        values that change per hot-path event (e.g. RPC in-flight count),
+        where even a cheap per-event set() is pure overhead."""
+        self._value_fn = fn
+        return self
+
     def snapshot(self) -> dict:
+        fn = getattr(self, "_value_fn", None)
+        if fn is not None:
+            try:
+                self.set_key((), float(fn()))
+            except Exception:
+                pass
         with self._lock:
             return {"kind": self.kind, "help": self.description,
                     "values": dict(self._values)}
@@ -110,16 +144,18 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _tags_key(self._merged(tags))
+        self.observe_key(_tags_key(self._merged(tags)), value)
+
+    def observe_key(self, key: tuple, value: float):
+        """Hot-path observe with a precomputed tags key (see
+        Counter.inc_key)."""
         with self._lock:
-            buckets = self._buckets.setdefault(
-                key, [0] * (len(self.boundaries) + 1))
-            for i, b in enumerate(self.boundaries):
-                if value <= b:
-                    buckets[i] += 1
-                    break
-            else:
-                buckets[-1] += 1
+            buckets = self._buckets.get(key)
+            if buckets is None:
+                buckets = self._buckets[key] = \
+                    [0] * (len(self.boundaries) + 1)
+            i = bisect.bisect_left(self.boundaries, value)
+            buckets[i if i < len(self.boundaries) else -1] += 1
             self._sum[key] = self._sum.get(key, 0.0) + value
             self._count[key] = self._count.get(key, 0) + 1
 
@@ -221,6 +257,18 @@ def _flush_once() -> bool:
         w = global_worker_or_none()
         if w is None or w.agent is None:
             return False
+        try:
+            from ray_tpu.core.api import _state
+            agent = getattr(_state, "node_agent", None)
+            if (agent is not None
+                    and agent.server.address == w.agent_address):
+                # Local mode: the node agent lives in THIS process and its
+                # /metrics handler serves this same process-global registry
+                # directly (reporter "agent-<nid>") — pushing it again would
+                # double every series under a second reporter label.
+                return True
+        except Exception:
+            pass
         snap = snapshot_registry()
         if not snap:
             return True
@@ -249,7 +297,60 @@ def _ensure_flusher(period_s: float = 2.0):
                      name="metrics-flush").start()
 
 
+def lazy(factory):
+    """Memoize a metric-construction factory for hot-path instrumentation:
+    ``lazy(build)()`` builds once on first call and returns the same object
+    after; a construction failure (registry kind conflict, import error
+    mid-teardown) logs ONCE and returns None forever — instrumentation
+    degrades visibly-but-gracefully instead of either crashing the hot path
+    or silently vanishing.  Shared by rpc/core_worker/node_agent/
+    loop_monitor so the pattern lives in one place."""
+    state: list = [None]
+
+    def get():
+        if state[0] is None:
+            try:
+                state[0] = factory() or False
+            except Exception as e:  # noqa: BLE001 — never break the hot path
+                state[0] = False
+                try:
+                    import sys
+                    print(f"[ray_tpu] metrics disabled for "
+                          f"{getattr(factory, '__qualname__', factory)!r}: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+                except Exception:
+                    pass
+        return state[0] or None
+
+    return get
+
+
+def latency_summary(samples: Sequence[float]) -> Optional[dict]:
+    """count/mean/p50/p90/p99/max rollup of raw duration samples — the
+    shape ``state.summarize_tasks`` and ``raytpu status`` report per task
+    stage.  Nearest-rank percentiles: exact on the sorted sample set, no
+    interpolation surprises on tiny n."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    n = len(s)
+
+    def pct(p: float) -> float:
+        return s[min(n - 1, max(0, int(p * n + 0.5) - 1))]
+
+    return {"count": n, "mean": sum(s) / n, "p50": pct(0.50),
+            "p90": pct(0.90), "p99": pct(0.99), "max": s[-1]}
+
+
 # ------------------------------------------------------------- rendering
+
+def escape_label_value(v) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote and
+    newline must be escaped or an arbitrary tag string (an exception repr,
+    a path with quotes) yields malformed output that scrapers reject."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
 
 def render_prometheus(per_reporter: Dict[str, Dict[str, dict]]) -> str:
     """{reporter -> {metric -> snapshot}} -> Prometheus exposition text."""
@@ -261,7 +362,8 @@ def render_prometheus(per_reporter: Dict[str, Dict[str, dict]]) -> str:
         pairs.update(extra)
         if not pairs:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(pairs.items()))
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in sorted(pairs.items()))
         return "{" + inner + "}"
 
     for reporter, metrics in sorted(per_reporter.items()):

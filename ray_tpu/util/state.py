@@ -144,19 +144,48 @@ def memory_summary() -> Dict[str, Any]:
 
 
 def summarize_tasks() -> Dict[str, Any]:
+    """Task-state rollup + per-stage latency percentiles.
+
+    ``stage_latency`` aggregates the lifecycle breakdown: owner-side
+    ``queue`` (submit -> dispatch) and ``total`` (submit -> terminal)
+    durations ride RUNNING/FINISHED events; executor-side ``dep_fetch`` /
+    ``arg_deser`` / ``execute`` / ``result_put`` ride STAGES events
+    (``CoreWorker._record_stages``)."""
+    from ray_tpu.util.metrics import latency_summary
+
     events = _gcs_call("list_task_events", limit=100_000)
     by_name: Dict[str, collections.Counter] = collections.defaultdict(
         collections.Counter)
     latest: Dict[str, Dict[str, Any]] = {}
+    stage_samples: Dict[str, List[float]] = collections.defaultdict(list)
     for ev in events:
         tid = ev.get("task_id")
+        state = ev.get("state")
+        if state == "STAGES":
+            for stage, (_t0, dur) in (ev.get("stages") or {}).items():
+                stage_samples[stage].append(dur)
+            continue  # annotation, not a state transition
+        if state == "SPAN":
+            continue
+        if ev.get("queue_s") is not None:
+            stage_samples["queue"].append(ev["queue_s"])
+        if ev.get("total_s") is not None:
+            stage_samples["total"].append(ev["total_s"])
         if tid is not None:
-            latest[tid] = ev
+            # list_task_events returns newest-first: keep the newest event
+            # per task (first seen wins ties), not whichever iterates last —
+            # the rollup used to count every task under its OLDEST state.
+            prev = latest.get(tid)
+            if prev is None or ev.get("ts", 0.0) > prev.get("ts", 0.0):
+                latest[tid] = ev
     for ev in latest.values():
         by_name[ev.get("name", "?")][ev.get("state", "?")] += 1
     return {"cluster": {name: dict(states)
                         for name, states in sorted(by_name.items())},
-            "total_tasks": len(latest)}
+            "total_tasks": len(latest),
+            "stage_latency": {stage: latency_summary(samples)
+                              for stage, samples
+                              in sorted(stage_samples.items())}}
 
 
 def summarize_actors() -> Dict[str, Any]:

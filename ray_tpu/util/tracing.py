@@ -95,11 +95,29 @@ def _flow_events(out: List[dict], base: dict, ts_us: float, ev: dict):
                     "ts": ts_us + 1, "id": ev["parent_id"]})
 
 
-def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
+def _stage_slices(out: List[dict], base: dict, stages_ev: dict):
+    """Render one STAGES event (executor-side per-stage breakdown, see
+    ``CoreWorker._record_stages``) as nested "X" sub-slices.  They inherit
+    the parent task slice's pid/tid so the viewer nests them inside the
+    task's slice — a Perfetto timeline shows where each task's wall clock
+    went (dep fetch vs deserialization vs execution vs result put)."""
+    for stage, (t0, dur) in sorted((stages_ev.get("stages") or {}).items(),
+                                   key=lambda kv: kv[1][0]):
+        out.append({"pid": base["pid"], "tid": base["tid"],
+                    "name": stage, "ph": "X", "ts": t0 * 1e6,
+                    "dur": max(dur * 1e6, 0.5), "cat": "stage",
+                    "args": {"task_id": stages_ev.get("task_id"),
+                             "task": stages_ev.get("name")}})
+
+
+def chrome_trace(events: Optional[List[dict]] = None,
+                 breakdown: bool = True) -> List[dict]:
     """Task events -> Chrome Trace Event Format (reference: `ray timeline`).
 
     RUNNING->FINISHED/FAILED pairs become complete ("X") slices; other state
     transitions become instant ("i") events; SPAN records map directly.
+    With ``breakdown`` (the ``raytpu timeline --breakdown`` path), STAGES
+    events become per-stage sub-slices nested inside their task's slice.
     """
     if events is None:
         import ray_tpu
@@ -107,7 +125,16 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
 
     out: List[dict] = []
     running: Dict[str, dict] = {}
-    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+    ordered = sorted(events, key=lambda e: e.get("ts", 0.0))  # sort ONCE
+    # task_id -> STAGES event (latest wins: a retry's breakdown replaces
+    # the killed attempt's partial one)
+    stage_evs: Dict[str, dict] = {}
+    if breakdown:
+        for ev in ordered:
+            if ev.get("state") == "STAGES":
+                stage_evs[ev.get("task_id")] = ev
+    rendered_stages: set = set()
+    for ev in ordered:
         state = ev.get("state")
         us = ev.get("ts", 0.0) * 1e6
         base = {"pid": _pid_for(ev), "tid": _pid_for(ev),
@@ -123,38 +150,70 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
             _flow_events(out, base, us, ev)
         elif state == "RUNNING":
             running[ev.get("task_id")] = ev
+        elif state == "STAGES":
+            pass  # rendered as sub-slices of the task slice below
         elif state in ("FINISHED", "FAILED"):
             start = running.pop(ev.get("task_id"), None)
             if start is not None:
                 start_us = start.get("ts", 0.0) * 1e6
+                args = {"state": state,
+                        "task_id": ev.get("task_id"),
+                        **trace_args,
+                        **{k: start[k] for k in
+                           ("trace_id", "span_id", "parent_id")
+                           if start.get(k)}}
+                if ev.get("total_s") is not None:
+                    args["total_s"] = ev["total_s"]
+                if start.get("queue_s") is not None:
+                    args["queue_s"] = start["queue_s"]
                 out.append({**base, "ph": "X",
                             "ts": start_us,
                             "dur": max(us - start_us, 1.0),
                             "cat": "task",
-                            "args": {"state": state,
-                                     "task_id": ev.get("task_id"),
-                                     **trace_args,
-                                     **{k: start[k] for k in
-                                        ("trace_id", "span_id", "parent_id")
-                                        if start.get(k)}}})
+                            "args": args})
                 _flow_events(out, base, start_us, {**ev, **start})
+                st_ev = stage_evs.get(ev.get("task_id"))
+                if breakdown and st_ev is not None:
+                    rendered_stages.add(ev.get("task_id"))
+                    _stage_slices(out, base, st_ev)
             else:
                 out.append({**base, "ph": "i", "ts": us, "s": "t",
                             "cat": "task", "args": {"state": state}})
         else:
             out.append({**base, "ph": "i", "ts": us, "s": "t",
                         "cat": "task", "args": {"state": state}})
-    # still-open slices render as instants so nothing is silently dropped
+    # Still-open slices render as instants so nothing is silently dropped —
+    # WITH their flow arrows (span/parent ids ride the RUNNING event), so an
+    # in-progress trace keeps the parent -> child arrows a finished one has.
     for task_id, start in running.items():
-        out.append({"pid": _pid_for(start), "tid": _pid_for(start),
-                    "name": start.get("name", task_id[:12]), "ph": "i",
-                    "ts": start.get("ts", 0.0) * 1e6, "s": "t",
-                    "cat": "task", "args": {"state": "RUNNING"}})
+        base = {"pid": _pid_for(start), "tid": _pid_for(start),
+                "name": start.get("name") or task_id[:12]}
+        ts_us = start.get("ts", 0.0) * 1e6
+        out.append({**base, "ph": "i", "ts": ts_us, "s": "t",
+                    "cat": "task",
+                    "args": {"state": "RUNNING",
+                             "task_id": task_id,
+                             **{k: start[k] for k in
+                                ("trace_id", "span_id", "parent_id")
+                                if start.get(k)}}})
+        _flow_events(out, base, ts_us, start)
+        st_ev = stage_evs.get(task_id)
+        if breakdown and st_ev is not None:
+            rendered_stages.add(task_id)
+            _stage_slices(out, base, st_ev)
+    if breakdown:
+        # breakdowns whose task slice never formed (e.g. the RUNNING event
+        # was trimmed from the buffer) still render, on the worker's row
+        for task_id, st_ev in stage_evs.items():
+            if task_id not in rendered_stages:
+                base = {"pid": _pid_for(st_ev), "tid": _pid_for(st_ev)}
+                _stage_slices(out, base, st_ev)
     return out
 
 
-def export_chrome_trace(path: str, events: Optional[List[dict]] = None):
+def export_chrome_trace(path: str, events: Optional[List[dict]] = None,
+                        breakdown: bool = True):
     import json
     with open(path, "w") as f:
-        json.dump(chrome_trace(events), f)
+        json.dump(chrome_trace(events, breakdown=breakdown), f)
     return path

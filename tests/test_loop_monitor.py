@@ -58,6 +58,32 @@ def test_monitor_quiet_on_healthy_loop():
     assert stalls == []
 
 
+def test_lag_gauge_lands_in_metrics_registry():
+    """Satellite: a monitor with a source name exports its heartbeat lag
+    (and stall count) through the metrics registry, so agent/worker loop
+    stalls appear on /metrics alongside the runtime metrics."""
+    from ray_tpu.util.metrics import snapshot_registry
+
+    async def main():
+        loop = asyncio.get_event_loop()
+        mon = LoopMonitor(loop, threshold_s=0.2, interval_s=0.05,
+                          source="proc-under-test")
+        mon.start()
+        try:
+            await asyncio.sleep(0.2)     # healthy echoes
+            _blocking_marker_sleep(0.5)  # one stall episode
+            await asyncio.sleep(0.2)
+        finally:
+            mon.stop()
+
+    asyncio.run(main())
+    snap = snapshot_registry()
+    key = (("process", "proc-under-test"),)
+    assert "raytpu_event_loop_lag_seconds" in snap
+    assert key in snap["raytpu_event_loop_lag_seconds"]["values"]
+    assert snap["raytpu_event_loop_stalls"]["values"][key] >= 1
+
+
 def test_format_loop_stack_unknown_thread():
     assert "unavailable" in format_loop_stack(None)
     assert "unavailable" in format_loop_stack(2 ** 61)

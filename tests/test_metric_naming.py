@@ -1,0 +1,96 @@
+"""Static metric-namespace lint (CI tooling satellite of the
+self-instrumentation plane): every Counter/Gauge/Histogram constructed
+inside ``ray_tpu/`` must use the ``raytpu_`` prefix, a Prometheus-legal
+name, and literal (declared) tag keys — so the metric namespace stays
+coherent as instrumentation spreads through the runtime.
+
+The scan is AST-based: it follows ``from ray_tpu.util.metrics import
+Counter`` aliases and ``metrics.Counter``-style attribute calls on modules
+imported from ``ray_tpu.util``, so ``collections.Counter`` and other
+same-named classes are not flagged.
+"""
+
+import ast
+import pathlib
+import re
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent / "ray_tpu"
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+NAME_RE = re.compile(r"^raytpu_[a-z0-9_:]+$")
+
+
+def _collect_aliases(tree):
+    """-> (name aliases {local_name: metric_class},
+           module aliases {local_name} bound to ray_tpu.util.metrics)."""
+    names = {}
+    modules = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("util.metrics") or node.module == ".metrics":
+                for a in node.names:
+                    if a.name in METRIC_CLASSES:
+                        names[a.asname or a.name] = a.name
+            if node.module.endswith("ray_tpu.util") or node.module == "..util":
+                for a in node.names:
+                    if a.name == "metrics":
+                        modules.add(a.asname or "metrics")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("util.metrics"):
+                    modules.add(a.asname or a.name.split(".")[0])
+    return names, modules
+
+
+def _metric_calls(tree):
+    names, modules = _collect_aliases(tree)
+    if not names and not modules:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in names:
+            yield node, names[fn.id]
+        elif (isinstance(fn, ast.Attribute) and fn.attr in METRIC_CLASSES
+              and isinstance(fn.value, ast.Name) and fn.value.id in modules):
+            yield node, fn.attr
+
+
+def _check_call(path, call, cls, problems):
+    where = f"{path.relative_to(PKG_ROOT.parent)}:{call.lineno}"
+    args = call.args
+    name_node = args[0] if args else next(
+        (kw.value for kw in call.keywords if kw.arg == "name"), None)
+    if not isinstance(name_node, ast.Constant) or not isinstance(
+            name_node.value, str):
+        problems.append(f"{where}: {cls} name must be a string literal "
+                        "(the scan cannot vouch for a computed name)")
+        return
+    if not NAME_RE.match(name_node.value):
+        problems.append(f"{where}: {cls} name {name_node.value!r} must "
+                        "match ^raytpu_[a-z0-9_:]+$")
+    for kw in call.keywords:
+        if kw.arg != "tag_keys":
+            continue
+        if not isinstance(kw.value, (ast.Tuple, ast.List)) or not all(
+                isinstance(el, ast.Constant) and isinstance(el.value, str)
+                for el in kw.value.elts):
+            problems.append(f"{where}: {cls} tag_keys must be a literal "
+                            "tuple/list of string literals")
+        # positional tag_keys would be args[2] — nothing in-tree uses it
+
+
+def test_all_runtime_metrics_use_raytpu_namespace():
+    problems = []
+    scanned = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "metrics.py" and path.parent.name == "util":
+            continue  # the metric classes themselves
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for call, cls in _metric_calls(tree):
+            scanned += 1
+            _check_call(path, call, cls, problems)
+    assert not problems, "metric namespace violations:\n" + "\n".join(problems)
+    # the scan must actually see the instrumentation plane's metrics —
+    # zero matches would mean the alias-following logic silently broke
+    assert scanned >= 5, f"scan only found {scanned} metric constructions"

@@ -1,0 +1,215 @@
+"""Task-lifecycle stage breakdown + runtime self-instrumentation tests.
+
+The stage pipeline under test: the executor stamps dep_fetch / arg_deser /
+execute / result_put wall-clock spans into a STAGES task event
+(``CoreWorker._record_stages``), the owner stamps queue (submit->dispatch)
+and total (submit->terminal) durations onto the RUNNING/FINISHED events,
+``state.summarize_tasks`` rolls them into percentiles, the timeline renders
+them as nested sub-slices, and ``raytpu_task_stage_seconds`` plus the RPC
+histograms and node gauges land on the agent's /metrics endpoint.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _events_for(name: str):
+    evs = ray_tpu.timeline()
+    return [e for e in evs if (e.get("name") or "").startswith(name)]
+
+
+def _wait_for_stages(name: str, timeout: float = 20.0):
+    """Wait until the worker's STAGES event and the owner's FINISHED event
+    for `name` both reached the GCS (separate 1 s flush loops)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        evs = _events_for(name)
+        stages = next((e for e in evs if e.get("state") == "STAGES"), None)
+        done = next((e for e in evs if e.get("state") == "FINISHED"), None)
+        if stages is not None and done is not None:
+            return evs, stages, done
+        time.sleep(0.25)
+    raise AssertionError(f"no STAGES+FINISHED events for {name!r} flushed")
+
+
+def test_task_stage_breakdown_round_trip(ray_start_regular):
+    """A round-tripped task yields every lifecycle stage with non-negative
+    durations summing to no more than the driver-observed wall clock."""
+
+    @ray_tpu.remote
+    def consume(x):
+        return len(x)
+
+    payload = ray_tpu.put(b"x" * (1 << 20))  # plasma-sized: real dep fetch
+    t0 = time.time()
+    assert ray_tpu.get(consume.remote(payload), timeout=60) == 1 << 20
+    wall = time.time() - t0
+
+    evs, stages_ev, done_ev = _wait_for_stages("consume")
+    stages = stages_ev["stages"]
+    for stage in ("dep_fetch", "arg_deser", "execute", "result_put"):
+        assert stage in stages, f"missing stage {stage}: {stages}"
+        start, dur = stages[stage]
+        assert start > 0 and dur >= 0.0
+    # executor stages all happen inside the submit->get window
+    assert sum(d for _t, d in stages.values()) <= wall + 0.05
+    # owner-side stamps: queueing rides RUNNING, the whole wall clock rides
+    # the terminal event
+    run_ev = next(e for e in evs if e.get("state") == "RUNNING")
+    assert run_ev.get("queue_s") is not None and run_ev["queue_s"] >= 0.0
+    assert done_ev.get("total_s") is not None
+    assert done_ev["total_s"] <= wall + 0.05
+    assert done_ev["total_s"] >= sum(
+        d for _t, d in stages.values()) - 1e-6
+
+
+def test_summarize_tasks_stage_percentiles(ray_start_regular):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def tick():
+        return 1
+
+    assert ray_tpu.get([tick.remote() for _ in range(4)], timeout=60) == [1] * 4
+    _wait_for_stages("tick")
+    summary = state.summarize_tasks()
+    lat = summary["stage_latency"]
+    for stage in ("queue", "total", "execute", "result_put"):
+        assert stage in lat, f"missing {stage} in {sorted(lat)}"
+        s = lat[stage]
+        assert s["count"] >= 1
+        assert 0.0 <= s["p50"] <= s["p90"] <= s["p99"] <= s["max"]
+    # FINISHED must still be counted as the task state (STAGES events are
+    # annotations, not state transitions)
+    assert summary["cluster"]["tick"].get("FINISHED", 0) >= 4
+
+
+def test_chrome_trace_breakdown_subslices(ray_start_regular, tmp_path):
+    """`raytpu timeline --breakdown` writes task slices containing nested
+    per-stage sub-slices (same pid/tid, within the task slice's bounds)."""
+    import json
+
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get(work.remote(1), timeout=60) == 2
+    _wait_for_stages("work")
+
+    out = tracing.export_chrome_trace(str(tmp_path / "t.json"),
+                                      breakdown=True)
+    trace = json.load(open(out))
+    tasks = [e for e in trace if e.get("cat") == "task" and e.get("ph") == "X"
+             and e.get("name") == "work"]
+    assert tasks, "no task slice for work"
+    task = tasks[0]
+    subs = [e for e in trace if e.get("cat") == "stage"
+            and e.get("args", {}).get("task") == "work"]
+    names = {e["name"] for e in subs}
+    assert {"dep_fetch", "arg_deser", "execute", "result_put"} <= names
+    for e in subs:
+        # nested: same row as the parent slice, inside its time bounds
+        assert e["pid"] == task["pid"] and e["tid"] == task["tid"]
+        assert e["ts"] >= task["ts"] - 1.0
+        assert e["ts"] + e["dur"] <= task["ts"] + task["dur"] + 1e3
+    # without --breakdown the stage sub-slices are absent
+    plain = tracing.chrome_trace(breakdown=False)
+    assert not [e for e in plain if e.get("cat") == "stage"]
+
+
+def test_open_running_slices_keep_flow_arrows():
+    """Satellite regression: a still-open RUNNING slice must emit its flow
+    events (parent arrows) instead of dropping them with the instant
+    fallback."""
+    from ray_tpu.util import tracing
+
+    events = [
+        {"task_id": "aaaa", "name": "parent_span", "state": "SPAN",
+         "ts": 1.0, "dur": 5.0, "worker": "w1",
+         "trace_id": "t1", "span_id": "s-parent"},
+        {"task_id": "bbbb", "name": "child_task", "state": "RUNNING",
+         "ts": 2.0, "node_id": "n1",
+         "trace_id": "t1", "span_id": "s-child", "parent_id": "s-parent"},
+    ]
+    trace = tracing.chrome_trace(events)
+    finishes = [e for e in trace if e.get("ph") == "f"]
+    assert any(e.get("id") == "s-parent" for e in finishes), \
+        "open RUNNING slice dropped its parent flow arrow"
+    starts = [e for e in trace if e.get("ph") == "s"]
+    assert any(e.get("id") == "s-child" for e in starts)
+
+
+def test_metrics_endpoint_serves_stage_rpc_and_node_gauges(ray_start_regular):
+    """curl /metrics on a running node serves raytpu_task_stage_seconds,
+    the RPC client/server histograms, and the shm/queue-depth gauges."""
+    import requests
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    nodes = ray_tpu.nodes()
+    port = next(n["Labels"].get("metrics_port") for n in nodes
+                if n["Labels"].get("metrics_port"))
+    url = f"http://127.0.0.1:{port}/metrics"
+    want = ("raytpu_task_stage_seconds_bucket",
+            "raytpu_rpc_client_seconds_bucket",
+            "raytpu_rpc_server_seconds_bucket",
+            'stage="execute"')  # executor-side: arrives via worker flush
+    deadline = time.monotonic() + 20
+    body = ""
+    while time.monotonic() < deadline:
+        body = requests.get(url, timeout=10).text
+        if all(w in body for w in want):
+            break
+        time.sleep(0.5)  # driver/worker registry flushes run every ~2 s
+    for w in want:
+        assert w in body, body[:3000]
+    # stage series carry the stage tag
+    assert 'stage="execute"' in body
+    assert 'stage="queue"' in body
+    # RPC byte counters and the in-flight gauge
+    assert "raytpu_rpc_bytes_sent_total" in body
+    assert "raytpu_rpc_bytes_received_total" in body
+    assert "raytpu_rpc_client_inflight" in body
+    # node telemetry gauges (agent registry, node-tagged)
+    for g in ("raytpu_node_workers", "raytpu_node_lease_queue_len",
+              "raytpu_object_store_bytes", "raytpu_object_store_free_bytes",
+              "raytpu_object_store_largest_free_bytes",
+              "raytpu_read_pins_outstanding", "raytpu_resource_total"):
+        assert g in body, f"missing {g}"
+
+
+def test_prometheus_label_escaping_regression():
+    """fmt_tags must escape backslash, double-quote and newline in label
+    values — arbitrary tag strings (exception reprs, paths) previously
+    produced malformed exposition output."""
+    from ray_tpu.util import metrics as m
+
+    g = m.Gauge("raytpu_escape_regression_gauge", "x", tag_keys=("err",))
+    g.set(1, tags={"err": 'quote:" backslash:\\ newline:\nEND'})
+    text = m.render_prometheus(
+        {"w": {"raytpu_escape_regression_gauge":
+               g.snapshot()}})
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("raytpu_escape_regression_gauge{"))
+    assert 'quote:\\"' in line
+    assert "backslash:\\\\" in line
+    assert "newline:\\nEND" in line  # literal backslash-n, not a line break
+
+
+def test_metric_name_validation():
+    """Prometheus name grammar: colons are legal, non-ASCII and dashes are
+    not (the old ``isalnum`` check got both wrong)."""
+    from ray_tpu.util import metrics as m
+
+    m.Counter("raytpu_test:colon_total")  # valid per the Prometheus grammar
+    for bad in ("9leading_digit", "has-dash", "häß", "sp ace", ""):
+        with pytest.raises(ValueError):
+            m.Counter(bad)
