@@ -129,8 +129,9 @@ class ActorClass:
             self._fn_id = hashlib.sha1(self._blob).digest()[:16]
         key = id(worker)
         if key not in self._registered_in:
-            run_async(worker.gcs.call("kv_put", ns="funcs", key=self._fn_id.hex(),
-                                      value=self._blob, overwrite=False))
+            run_async(worker.gcs.call_retry(
+                "kv_put", ns="funcs", key=self._fn_id.hex(),
+                value=self._blob, overwrite=False))
             self._registered_in.add(key)
         return self._fn_id
 
@@ -189,10 +190,11 @@ class ActorClass:
         get_if_exists = bool(o.get("get_if_exists") and o.get("name"))
         aid = w.create_actor(spec, get_if_exists=get_if_exists)
         # Stash method names in GCS so get_actor() can rebuild handles.
-        run_async(w.gcs.call("kv_put", ns="actor_meta", key=aid,
-                             value=serialization.dumps(
-                                 {"methods": self._method_names(),
-                                  "max_task_retries": spec.max_task_retries})))
+        run_async(w.gcs.call_retry(
+            "kv_put", ns="actor_meta", key=aid,
+            value=serialization.dumps(
+                {"methods": self._method_names(),
+                 "max_task_retries": spec.max_task_retries})))
         return ActorHandle(aid, self._method_names(), spec.max_task_retries,
                            o.get("name"))
 

@@ -83,6 +83,10 @@ def init(address: Optional[str] = None,
         renv.validate(runtime_env)
     if _system_config:
         set_config(Config.from_env(_system_config))
+    # session boundary: the fault injector re-derives from the (possibly
+    # just-overridden) config/env instead of keeping a stale cached one
+    from .chaos import reset as _reset_chaos
+    _reset_chaos()
     session_dir = os.path.join(
         "/tmp/raytpu", f"session-{int(time.time() * 1000)}-{os.getpid()}")
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
@@ -117,8 +121,8 @@ def init(address: Optional[str] = None,
                         node_id=agent.node_id.hex() if agent else None,
                         session_dir=session_dir)
     worker.start()
-    job_hex = run_async(worker.gcs.call("register_job",
-                                        metadata={"namespace": namespace or "default"}))
+    job_hex = run_async(worker.gcs.call_retry(
+        "register_job", metadata={"namespace": namespace or "default"}))
     worker.job_id = JobID.from_hex(job_hex)
     _state.worker = worker
     if runtime_env:
@@ -186,7 +190,8 @@ def _pick_agent(gcs_address: str) -> Optional[str]:
     agent for object-store access."""
     from .rpc import RpcClient
     client = RpcClient(gcs_address)
-    view = run_async(client.call("get_cluster_view"))
+    view = run_async(client.call_retry("get_cluster_view",
+                                       _idempotent=False))
     run_async(client.close())
     alive = {k: v for k, v in view.items() if v.get("alive", True)}
     if not alive:
@@ -231,8 +236,10 @@ def shutdown():
         atexit.unregister(shutdown)
     except Exception:
         pass
+    from .chaos import reset as reset_chaos
     from .config import reset_config
     reset_config()
+    reset_chaos()  # next init re-derives the injector from config/env
 
 
 # ---------------------------------------------------------------------------

@@ -84,7 +84,12 @@ class Config:
     # -- fault tolerance ---------------------------------------------------
     #: Default task max_retries (reference: ``task_retry_delay_ms`` family).
     default_task_max_retries: int = 3
+    #: Base delay of the task-retry exponential backoff; retry n sleeps
+    #: ~``base * backoff**(n-1)`` capped at ``task_retry_max_delay_s``,
+    #: jittered so retry storms under node loss don't synchronize.
     task_retry_delay_s: float = 0.05
+    task_retry_max_delay_s: float = 2.0
+    task_retry_backoff: float = 2.0
     #: Enable lineage reconstruction of lost objects
     #: (reference: ``lineage_pinning_enabled``, ray_config_def.h:155).
     lineage_reconstruction_enabled: bool = True
@@ -107,10 +112,26 @@ class Config:
     # -- rpc ---------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0
+    #: Retrying idempotent client (``RpcClient.call_retry``): bounded
+    #: attempts with exponential backoff + full jitter under one shared
+    #: per-call deadline (reference: retryable gRPC clients).
+    rpc_retry_max_attempts: int = 5
+    rpc_retry_base_delay_s: float = 0.05
+    rpc_retry_max_delay_s: float = 2.0
+    #: Server-side idempotency-token dedup window: a retried mutating RPC
+    #: carrying the same client-stamped token within this window replays
+    #: the recorded reply instead of re-executing the handler.
+    rpc_dedup_window_s: float = 600.0
     #: Chaos injection (reference: ray's chaos_network_delay.yaml release
-    #: harness): every outbound RPC frame is delayed this many ms before
-    #: hitting the socket.  Set RAYTPU_CHAOS_RPC_DELAY_MS before booting a
-    #: cluster and every process inherits the laggy links; 0 disables.
+    #: harness).  ``chaos_spec`` is a JSON FaultInjector spec (see
+    #: ``core/chaos.py``): per-method/per-link delay, frame drops,
+    #: fail-before/after-commit, partitions, and a seeded worker-kill
+    #: schedule.  Set RAYTPU_CHAOS_SPEC before booting and every process
+    #: inherits it (workers via RAYTPU_CONFIG_JSON); runtime control via
+    #: GCS chaos_set/chaos_clear and `raytpu chaos`.
+    chaos_spec: str = ""
+    #: Legacy single-knob harness: every outbound RPC frame is delayed this
+    #: many ms (now a one-rule spec on the same injector); 0 disables.
     chaos_rpc_delay_ms: float = 0.0
     #: Actor __init__ runs arbitrary user code (model loads, XLA compiles —
     #: an LLM replica warms minutes of prefill buckets): the creation call

@@ -31,6 +31,7 @@ import itertools
 import os
 import pickle
 import queue as _queue
+import random
 import threading
 import time
 import traceback
@@ -48,7 +49,8 @@ from .generator import ObjectRefGenerator, StreamState
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .object_ref import ObjectRef
 from .object_store import ErrorRecord, MemoryStore, PlasmaRecord, ShmReader, ShmSegment
-from .rpc import ClientPool, ConnectionLost, RemoteError, RpcClient, RpcServer, get_loop, run_async
+from .rpc import (ClientPool, ConnectionLost, RemoteError, RpcClient,
+                  RpcError, RpcServer, get_loop, run_async)
 from .scheduling import NodeView, pick_node
 
 _global_worker: Optional["CoreWorker"] = None
@@ -135,6 +137,19 @@ def set_global_worker(w: Optional["CoreWorker"]):
     global _global_worker
     with _global_lock:
         _global_worker = w
+
+
+def _task_retry_delay(retry_count: int) -> float:
+    """Exponential backoff with a cap and jitter for task retries
+    (reference: the ``task_retry_delay_ms`` family).  Retry n sleeps
+    ~``base * backoff**(n-1)`` capped at ``task_retry_max_delay_s``;
+    the 50-100% jitter keeps a node loss from synchronizing every owner's
+    retry storm onto the survivors at the same instant."""
+    cfg = get_config()
+    delay = min(cfg.task_retry_max_delay_s,
+                cfg.task_retry_delay_s
+                * (cfg.task_retry_backoff ** max(0, retry_count - 1)))
+    return delay * random.uniform(0.5, 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -539,18 +554,19 @@ class LeasePool:
                     target_addr = view[nid].address
                 agent = self.w.agent_clients.get(target_addr)
                 try:
-                    grant = await agent.call("request_worker_lease",
-                                             resources=self.resources,
-                                             bundle=self.bundle,
-                                             runtime_env=self.runtime_env,
-                                             allow_spillback=(hops < 4),
-                                             owner=self.w.address,
-                                             task_label=str(self.key[0]),
-                                             _timeout=3600.0)
-                except (ConnectionLost, OSError):
-                    target_addr = None
-                    await asyncio.sleep(0.2)
-                    continue
+                    # Idempotent retrying lease request: a grant whose
+                    # reply was lost comes back from the agent's dedup
+                    # window on retry instead of leasing a SECOND worker
+                    # that nothing would ever return.
+                    grant = await agent.call_retry(
+                        "request_worker_lease",
+                        resources=self.resources,
+                        bundle=self.bundle,
+                        runtime_env=self.runtime_env,
+                        allow_spillback=(hops < 4),
+                        owner=self.w.address,
+                        task_label=str(self.key[0]),
+                        _timeout=3600.0, _attempts=8)
                 except RemoteError as e:
                     from .common import RuntimeEnvSetupError
                     if isinstance(e.cause, RuntimeEnvSetupError):
@@ -568,6 +584,13 @@ class LeasePool:
                     # back off and retry the lease
                     target_addr = None
                     await asyncio.sleep(0.5)
+                    continue
+                except (RpcError, OSError):
+                    # RemoteError (a subclass) is handled above; this
+                    # covers ConnectionLost AND "client closed" from a
+                    # pool entry force-closed under us
+                    target_addr = None
+                    await asyncio.sleep(0.2)
                     continue
                 if "worker_address" in grant:
                     lw = LeasedWorker(grant["worker_address"], grant["worker_id"],
@@ -601,7 +624,10 @@ class LeasePool:
                 results_list = await client.call("push_task_batch",
                                                  specs=specs,
                                                  _timeout=86400.0)
-        except (ConnectionLost, RemoteError, OSError) as e:
+        except (RpcError, RemoteError, OSError) as e:
+            # RpcError covers ConnectionLost AND "client closed" (the
+            # pooled client force-closed by a worker-killed notification
+            # racing this push) — both mean the worker is unusable
             await self._on_worker_failure(lw, specs, e)
             return
         for spec, results in zip(specs, results_list):
@@ -617,8 +643,10 @@ class LeasePool:
         death_cause = None
         try:
             agent = self.w.agent_clients.get(lw.agent_address)
-            res = await agent.call("return_worker_lease", lease_id=lw.lease_id,
-                                   worker_id=lw.worker_id, worker_alive=False)
+            res = await agent.call_retry("return_worker_lease",
+                                         lease_id=lw.lease_id,
+                                         worker_id=lw.worker_id,
+                                         worker_alive=False)
             if isinstance(res, dict):
                 death_cause = res.get("death_cause")
         except Exception:
@@ -669,7 +697,8 @@ class LeasePool:
             # assumes queue order == dependency order (a reversed requeue
             # could batch a consumer ahead of its producer).
             self.queue.extendleft(reversed(retries))
-            await asyncio.sleep(get_config().task_retry_delay_s)
+            await asyncio.sleep(_task_retry_delay(
+                max(s.retry_count for s in retries)))
             self._pump()
 
     async def _maybe_return(self, lw: LeasedWorker):
@@ -682,8 +711,11 @@ class LeasePool:
         self.leased.pop(lw.lease_id, None)
         try:
             agent = self.w.agent_clients.get(lw.agent_address)
-            await agent.call("return_worker_lease", lease_id=lw.lease_id,
-                             worker_id=lw.worker_id, worker_alive=True)
+            # token'd retry: a double-applied return would release the
+            # lease's resources twice and inflate the node's capacity
+            await agent.call_retry("return_worker_lease",
+                                   lease_id=lw.lease_id,
+                                   worker_id=lw.worker_id, worker_alive=True)
         except Exception:
             pass
 
@@ -928,7 +960,9 @@ class CoreWorker:
             if self._task_events and self.gcs:
                 batch, self._task_events = self._task_events, []
                 try:
-                    await self.gcs.call("add_task_events", events=batch)
+                    # token'd retry: a lost reply must not double-record
+                    # the batch (duplicate events skew summarize_tasks)
+                    await self.gcs.call_retry("add_task_events", events=batch)
                 except Exception:
                     pass
 
@@ -939,7 +973,8 @@ class CoreWorker:
         ts, view = self._view_cache
         if now - ts < 0.1 and view:
             return view
-        payload = await self.gcs.call("get_cluster_view")
+        payload = await self.gcs.call_retry("get_cluster_view",
+                                            _idempotent=False)
         view = {nid: NodeView(nid, d["address"], d["total"], d["available"],
                               d.get("labels", {}), d.get("alive", True),
                               d.get("queue_len", 0))
@@ -964,7 +999,8 @@ class CoreWorker:
         if size <= cfg.max_direct_call_object_size or self.agent is None:
             self.memory_store.put(oid, so.to_bytes())
         else:
-            res = await self.agent.call("store_create", object_id=oid, size=size)
+            res = await self.agent.call_retry("store_create", object_id=oid,
+                                              size=size)
             seg = ShmSegment(res["path"], size, create=False)
             try:
                 so.write_into(seg.view())
@@ -1063,10 +1099,18 @@ class CoreWorker:
             if deadline is not None and step <= 0:
                 raise GetTimeoutError(f"timed out waiting for {ref}")
             try:
-                rec = await owner.call("locate_object", object_id=oid,
-                                       timeout=min(step, 30.0) if deadline else 30.0,
-                                       _timeout=(min(step, 30.0) if deadline else 30.0) + 15)
-            except ConnectionLost:
+                # bounded retry first: a transient drop on the owner link
+                # must not masquerade as owner death (ObjectLostError)
+                rec = await owner.call_retry(
+                    "locate_object", object_id=oid,
+                    timeout=min(step, 30.0) if deadline else 30.0,
+                    _timeout=(min(step, 30.0) if deadline else 30.0) + 15,
+                    _attempts=3, _idempotent=False)
+            except asyncio.TimeoutError:
+                # slow-but-alive owner (on 3.11+ TimeoutError is an
+                # OSError subclass — it must NOT read as owner death)
+                raise
+            except (ConnectionLost, ConnectionError, OSError):
                 raise ObjectLostError(oid, f"owner {ref.owner} of {ref} died") from None
             if rec is not None:
                 if rec[0] == "plasma":
@@ -1093,12 +1137,16 @@ class CoreWorker:
                                      length=record.size)
             return data, None
         try:
-            res = await self.agent.call("fetch_object", object_id=ref.id,
-                                        size=record.size,
-                                        locations=record.locations,
-                                        owner=ref.owner or self.address,
-                                        pin=True,
-                                        pinner=self.address)
+            # idempotent retry: a pin GRANTED on an attempt whose reply was
+            # lost must come back as the same grant (one ledger entry), not
+            # a second pin nobody will ever release
+            res = await self.agent.call_retry("fetch_object",
+                                              object_id=ref.id,
+                                              size=record.size,
+                                              locations=record.locations,
+                                              owner=ref.owner or self.address,
+                                              pin=True,
+                                              pinner=self.address)
             return await self._read_fetched(ref.id, res)
         except (RemoteError, ConnectionLost):
             return await self._try_reconstruct(ref, record)
@@ -1139,15 +1187,17 @@ class CoreWorker:
             else:
                 if "#" not in res["path"]:
                     return data, None  # file-backed: unlink keeps views safe
-                ok = await self.agent.call("store_verify",
-                                           object_id=object_id,
-                                           path=res["path"])
+                ok = await self.agent.call_retry("store_verify",
+                                                 object_id=object_id,
+                                                 path=res["path"],
+                                                 _idempotent=False)
             if ok:
                 return data, None
-            res = await self.agent.call("fetch_object", object_id=object_id,
-                                        size=res["size"], locations=[],
-                                        pin=True,
-                                        pinner=self.address)
+            res = await self.agent.call_retry("fetch_object",
+                                              object_id=object_id,
+                                              size=res["size"], locations=[],
+                                              pin=True,
+                                              pinner=self.address)
         # Retries exhausted: the FINAL refetch above may have granted a pin
         # nothing will ever view — release it or the object (and the agent's
         # ledger entry) stays pinned until this whole process exits.
@@ -1183,16 +1233,22 @@ class CoreWorker:
             raise ObjectLostError(ref.id)
         if ref.owner not in ("", self.address):
             owner = self.worker_clients.get(ref.owner)
-            ok = await owner.call("reconstruct_object", object_id=ref.id)
+            # token'd retry: a reconstruct whose reply was lost must not
+            # resubmit the producing task a second time
+            ok = await owner.call_retry("reconstruct_object",
+                                        object_id=ref.id)
             if not ok:
                 raise ObjectLostError(ref.id)
             rec = await self._resolve_record(
                 ObjectRef(ref.id, owner=ref.owner, _register=False), None)
             if isinstance(rec, PlasmaRecord):
-                res = await self.agent.call("fetch_object", object_id=ref.id,
-                                            size=rec.size,
-                                            locations=rec.locations, pin=True,
-                                        pinner=self.address)
+                # owner= so the pull registers this node as a NEW location:
+                # without it the owner's view omits post-reconstruction
+                # holders and a later loss can't find the live copy
+                res = await self.agent.call_retry(
+                    "fetch_object", object_id=ref.id, size=rec.size,
+                    locations=rec.locations, owner=ref.owner,
+                    pin=True, pinner=self.address)
                 return await self._read_fetched(ref.id, res)
             raise ObjectLostError(ref.id)
         spec = self.task_manager.lineage.get(ref.id.task_id())
@@ -1208,10 +1264,10 @@ class CoreWorker:
         rec = await self._resolve_record(
             ObjectRef(ref.id, owner=self.address, _register=False), None)
         if isinstance(rec, PlasmaRecord):
-            res = await self.agent.call("fetch_object", object_id=ref.id,
-                                        size=rec.size, locations=rec.locations,
-                                        pin=True,
-                                        pinner=self.address)
+            res = await self.agent.call_retry(
+                "fetch_object", object_id=ref.id, size=rec.size,
+                locations=rec.locations, owner=self.address,
+                pin=True, pinner=self.address)
             return await self._read_fetched(ref.id, res)
         if isinstance(rec, ErrorRecord):
             exc, tb = pickle.loads(rec.error)
@@ -1242,7 +1298,9 @@ class CoreWorker:
                 return False
             try:
                 owner = self.worker_clients.get(r.owner)
-                rec = await owner.call("locate_object", object_id=r.id, timeout=0)
+                rec = await owner.call_retry("locate_object", object_id=r.id,
+                                             timeout=0, _attempts=3,
+                                             _idempotent=False)
                 if rec is not None:
                     return True
             except Exception:
@@ -1344,8 +1402,11 @@ class CoreWorker:
 
     async def _create_actor_async(self, spec: TaskSpec,
                                   get_if_exists: bool = False) -> str:
-        aid = await self.gcs.call("register_actor", spec=spec,
-                                  get_if_exists=get_if_exists)
+        # Exactly-once registration: the idempotency token dedups a retry
+        # whose original reply was lost, so a flaky GCS link can never
+        # register (and schedule) the same actor twice.
+        aid = await self.gcs.call_retry("register_actor", spec=spec,
+                                        get_if_exists=get_if_exists)
         self.actor_targets.setdefault(aid, ActorTarget(aid))
         return aid
 
@@ -1386,17 +1447,37 @@ class CoreWorker:
         tgt = self.actor_targets.setdefault(actor_id, ActorTarget(actor_id))
         if tgt.state == "ALIVE" and tgt.address:
             return tgt
-        info = await self.gcs.call("wait_actor_alive", actor_id=actor_id,
-                                   timeout=timeout, _timeout=timeout + 10)
-        if info is None or info.get("state") in ("DEAD",):
-            tgt.state = "DEAD"
-            raise ActorDiedError(actor_id, f"actor {actor_id[:12]} is dead: "
-                                           f"{(info or {}).get('death_cause')}")
-        if info.get("state") == "TIMEOUT":
-            raise ActorDiedError(actor_id, f"timed out resolving actor {actor_id[:12]}")
-        tgt.address = info["address"]
-        tgt.state = "ALIVE"
-        return tgt
+        # Poll in SHORT long-poll chunks under one deadline: a single
+        # timeout-length park on the shared GCS connection loses the whole
+        # wait whenever any unrelated frame on that link dies (chaos drop,
+        # GCS restart) — short chunks bound the loss to one chunk and the
+        # loop absorbs transport faults until the deadline.
+        deadline = time.monotonic() + timeout
+        while True:
+            step = min(10.0, max(0.5, deadline - time.monotonic()))
+            try:
+                info = await self.gcs.call_retry(
+                    "wait_actor_alive", actor_id=actor_id, timeout=step,
+                    _timeout=step + 10, _idempotent=False)
+            except (ConnectionLost, ConnectionError, OSError,
+                    asyncio.TimeoutError):
+                info = {"state": "TIMEOUT"}  # transport fault: keep waiting
+            if info is None or info.get("state") in ("DEAD",):
+                # authoritative answer: unknown or dead
+                tgt.state = "DEAD"
+                raise ActorDiedError(
+                    actor_id, f"actor {actor_id[:12]} is dead: "
+                              f"{(info or {}).get('death_cause')}")
+            if info.get("state") == "TIMEOUT":
+                if time.monotonic() >= deadline:
+                    raise ActorDiedError(
+                        actor_id,
+                        f"timed out resolving actor {actor_id[:12]}")
+                await asyncio.sleep(0.2)
+                continue
+            tgt.address = info["address"]
+            tgt.state = "ALIVE"
+            return tgt
 
     async def _run_actor_batch(self, actor_id: str, tgt: ActorTarget,
                                specs: List[TaskSpec]):
@@ -1418,17 +1499,48 @@ class CoreWorker:
             try:
                 if (len(specs) == 1
                         and specs[0].num_returns != STREAMING_RETURNS):
-                    results_list = [await client.call(
-                        "actor_task", spec=specs[0], _timeout=86400.0)]
+                    # Single non-streaming call: token'd retry.  A reply
+                    # lost to a transport fault replays the COMMITTED
+                    # result from the worker's dedup window — the method
+                    # runs exactly once and no actor-task retry budget is
+                    # burned.  (Batches can't retry this way: their
+                    # results stream as side-channel pushes that a dedup
+                    # replay would not re-emit.)
+                    results_list = [await client.call_retry(
+                        "actor_task", spec=specs[0], _timeout=86400.0,
+                        _attempts=3)]
                 else:
                     # Batch RPC even for one call when it streams: only the
                     # batch handler holds the writer yield frames ride on.
                     results_list = await client.call(
                         "actor_task_batch", specs=specs, _timeout=86400.0)
-            except (ConnectionLost, OSError):
+            except (RpcError, OSError) as e:
+                from .chaos import ChaosFault
+                from .rpc import TransientServerError
+                if (isinstance(e, RemoteError)
+                        and not isinstance(e.cause, (ChaosFault,
+                                                     TransientServerError))):
+                    # app-level failure raised by the actor method
+                    for s in specs:
+                        self.task_manager.fail(s.task_id, e.cause,
+                                               e.remote_traceback)
+                    return
+                # Transport-level failure — ConnectionLost, "client closed"
+                # (pool entry force-closed under us), or a chaos-injected
+                # fault (retryable by the harness contract, same
+                # at-most-once budget as a lost connection).
                 tgt.state = "RESTARTING"
                 tgt.address = None
-                info = await self.gcs.call("get_actor_info", actor_id=actor_id)
+                try:
+                    info = await self.gcs.call_retry("get_actor_info",
+                                                     actor_id=actor_id,
+                                                     _idempotent=False)
+                except (ConnectionLost, ConnectionError, OSError,
+                        asyncio.TimeoutError):
+                    # GCS unreachable (blip/restart): don't let the pump
+                    # die — treat as maybe-restarting and retry the batch
+                    await asyncio.sleep(0.5)
+                    continue
                 if info is None or info["state"] == "DEAD":
                     cause = (info or {}).get("death_cause")
                     err = ActorDiedError(
@@ -1451,21 +1563,18 @@ class CoreWorker:
                                 f"actor {actor_id[:12]} died while running "
                                 f"{s.name} (set max_task_retries to retry)"))
                 specs = retry
-                await asyncio.sleep(0.1)
+                if specs:
+                    await asyncio.sleep(max(0.1, _task_retry_delay(
+                        max(s.retry_count for s in specs))))
                 continue
-            except RemoteError as e:
-                for s in specs:
-                    self.task_manager.fail(s.task_id, e.cause,
-                                           e.remote_traceback)
-                return
             for s, results in zip(specs, results_list):
                 if results != "__streamed__":  # else completed via push
                     self.task_manager.complete(s.task_id, results)
             return
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
-        return run_async(self.gcs.call("kill_actor", actor_id=actor_id,
-                                       no_restart=no_restart))
+        return run_async(self.gcs.call_retry("kill_actor", actor_id=actor_id,
+                                             no_restart=no_restart))
 
     # ----------------------------------------------------------- ref counting
 
@@ -1557,7 +1666,9 @@ class CoreWorker:
         async def _notify():
             try:
                 if add:
-                    await self.worker_clients.get(owner).call(
+                    # token'd retry: a double-applied ADD note would leave
+                    # a phantom borrower that pins the object forever
+                    await self.worker_clients.get(owner).call_retry(
                         "add_borrower_note", object_id=oid, _timeout=30.0)
                 else:
                     await self.worker_clients.get(owner).notify(
@@ -1630,8 +1741,8 @@ class CoreWorker:
         if isinstance(rec, PlasmaRecord):
             for node_id, addr in rec.locations:
                 try:
-                    await self.agent_clients.get(addr).call("store_free",
-                                                            object_ids=[oid])
+                    await self.agent_clients.get(addr).call_retry(
+                        "store_free", object_ids=[oid])
                 except Exception:
                     pass
 
@@ -1680,6 +1791,13 @@ class CoreWorker:
     async def handle_dump_stacks(self) -> str:
         from ray_tpu.util.debug import dump_all_stacks
         return dump_all_stacks()
+
+    async def handle_chaos_update(self, spec: Optional[dict] = None):
+        """Runtime chaos-spec propagation: the node agent forwards GCS
+        chaos_set/chaos_clear broadcasts to every worker it manages."""
+        from . import chaos
+        chaos.install(spec)
+        return True
 
     async def handle_ping(self):
         return "pong"
@@ -1943,15 +2061,16 @@ class CoreWorker:
         # report cannot burn a restart), the direct GCS report makes the
         # death visible before the process is even gone.
         try:
-            run_async(self.agent.call("worker_intended_exit",
-                                      worker_id=self.worker_id.hex()),
-                      timeout=5)
+            run_async(self.agent.call_retry("worker_intended_exit",
+                                            worker_id=self.worker_id.hex(),
+                                            _timeout=4), timeout=5)
         except Exception:
             pass
         try:
-            run_async(self.gcs.call(
+            run_async(self.gcs.call_retry(
                 "report_actor_death", actor_id=spec.actor_id.hex(),
-                reason="exit_actor() (intended)", expected=True), timeout=10)
+                reason="exit_actor() (intended)", expected=True,
+                _timeout=8), timeout=10)
         except Exception:
             pass
         # Exit AFTER the typed reply has had time to flush.  Timers must be
@@ -1986,7 +2105,8 @@ class CoreWorker:
                     f"{job_id.hex()[:12]}: {e!r}") from e
         fn = self.fn_cache.get(fn_id)
         if fn is None:
-            blob = run_async(self.gcs.call("kv_get", ns="funcs", key=fn_id.hex()))
+            blob = run_async(self.gcs.call_retry(
+                "kv_get", ns="funcs", key=fn_id.hex(), _idempotent=False))
             if blob is None:
                 raise RuntimeError(f"function {fn_id.hex()[:12]} not found in registry")
             fn = serialization.loads_function(blob)
@@ -2099,7 +2219,7 @@ class CoreWorker:
                     + get_config().escrow_hold_expiry_s)
             else:
                 try:
-                    run_async(self.worker_clients.get(r_owner).call(
+                    run_async(self.worker_clients.get(r_owner).call_retry(
                         "escrow_hold", object_id=r.id, hold_id=hold_id))
                 except Exception:
                     hold_id = None  # owner gone: nothing to protect
@@ -2108,8 +2228,8 @@ class CoreWorker:
         if size <= cfg.max_direct_call_object_size or self.agent is None:
             return ("inline", so.to_bytes(), contained)
         oid = ObjectID.for_task_return(spec.task_id, index)
-        res = run_async(self.agent.call("store_create", object_id=oid,
-                                        size=size))
+        res = run_async(self.agent.call_retry("store_create", object_id=oid,
+                                              size=size))
         seg = ShmSegment(res["path"], size, create=False)
         try:
             so.write_into(seg.view())

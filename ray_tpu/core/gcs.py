@@ -57,6 +57,11 @@ class GcsServer:
         self.jobs: Dict[str, dict] = {}
         self.agent_clients = ClientPool()
         self.task_events: deque = deque(maxlen=get_config().task_events_max_buffer)
+        # Runtime chaos control (core/chaos.py): the cluster-wide spec and
+        # its version; agents learn of changes via heartbeat piggyback
+        # (and anyone else via the "chaos" pubsub topic).
+        self._chaos_spec: Optional[dict] = None
+        self._chaos_version = 0
         self._job_counter = 0
         self._bg: List[asyncio.Task] = []
         self.persistence_path = persistence_path
@@ -155,6 +160,35 @@ class GcsServer:
                 self._event_waiters.remove(ev)
         return self._event_seq, pending()
 
+    # ---------------------------------------------------------------- chaos
+    #
+    # Runtime control of the fault-injection plane (core/chaos.py).  A
+    # chaos_set installs the spec in THIS process, bumps the version, and
+    # broadcasts on the "chaos" pubsub topic; agents additionally converge
+    # via heartbeat piggyback (handle_heartbeat) and forward to their
+    # workers — so one call degrades every link in the cluster.
+
+    async def handle_chaos_set(self, spec: dict | str | None):
+        from . import chaos as _chaos
+        if isinstance(spec, str):
+            import json as _json
+            spec = _json.loads(spec) if spec.strip() else {}
+        self._chaos_version += 1
+        self._chaos_spec = spec or None
+        _chaos.install(spec)
+        self._publish("chaos", {"version": self._chaos_version,
+                                "spec": self._chaos_spec})
+        return self._chaos_version
+
+    async def handle_chaos_clear(self):
+        return await self.handle_chaos_set(None)
+
+    async def handle_chaos_get(self):
+        from . import chaos as _chaos
+        inj = _chaos.injector()
+        return {"version": self._chaos_version, "spec": self._chaos_spec,
+                "injected": inj.injected_counts() if inj else {}}
+
     # ---------------------------------------------------------------- nodes
 
     async def handle_register_node(self, node_id: str, address: str,
@@ -185,7 +219,8 @@ class GcsServer:
     async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
                                queue_len: int = 0, store_stats: dict | None = None,
                                queued_demands: List[Dict[str, float]] | None = None,
-                               total: Dict[str, float] | None = None):
+                               total: Dict[str, float] | None = None,
+                               chaos_version: int | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             return {"unknown": True}  # agent should re-register
@@ -204,7 +239,13 @@ class GcsServer:
         if store_stats:
             n.labels["_store"] = store_stats
         self.node_last_seen[node_id] = time.monotonic()
-        return {"view": self._view_payload()}
+        res = {"view": self._view_payload()}
+        if chaos_version is not None and chaos_version != self._chaos_version:
+            # piggyback the runtime chaos spec on the reply so agents that
+            # missed the pubsub broadcast (or restarted) converge anyway
+            res["chaos"] = {"version": self._chaos_version,
+                            "spec": self._chaos_spec}
+        return res
 
     async def handle_drain_node(self, node_id: str):
         await self._mark_node_dead(node_id, reason="drained")
@@ -352,16 +393,19 @@ class GcsServer:
             if nid is not None:
                 agent = self.agent_clients.get(self.nodes[nid].address)
                 try:
-                    res = await agent.call(
+                    # Idempotent retry: a creation whose REPLY was lost must
+                    # hand back the same worker on retry, not lease a second
+                    # one (the agent's dedup window holds the grant).
+                    res = await agent.call_retry(
                         "create_actor", spec=spec,
                         _timeout=get_config().actor_creation_timeout_s + 30)
                     if self.actors.get(aid) is not info or info["state"] == "DEAD":
                         # Killed while the creation RPC was in flight: reap the
                         # freshly created worker instead of resurrecting.
                         try:
-                            await agent.call("kill_worker",
-                                             worker_id=res["worker_id"],
-                                             reason="actor killed during creation")
+                            await agent.call_retry(
+                                "kill_worker", worker_id=res["worker_id"],
+                                reason="actor killed during creation")
                         except Exception:
                             pass
                         return
@@ -455,8 +499,9 @@ class GcsServer:
         if addr and nid and nid in self.nodes:
             agent = self.agent_clients.get(self.nodes[nid].address)
             try:
-                await agent.call("kill_worker", worker_id=info.get("worker_id"),
-                                 reason="ray.kill")
+                await agent.call_retry("kill_worker",
+                                       worker_id=info.get("worker_id"),
+                                       reason="ray.kill")
             except Exception:
                 pass
         if no_restart:
@@ -522,7 +567,9 @@ class GcsServer:
                 async def _phase(method: str, nid: str, payload) -> bool:
                     agent = self.agent_clients.get(self.nodes[nid].address)
                     try:
-                        return bool(await agent.call(
+                        # retried prepares/commits carry an idempotency
+                        # token: a lost reply must not double-reserve
+                        return bool(await agent.call_retry(
                             method, pg_id=pg_id, **payload))
                     except Exception:
                         return False
@@ -604,7 +651,7 @@ class GcsServer:
 
             async def _return(addr: str, indices: list):
                 try:
-                    await self.agent_clients.get(addr).call(
+                    await self.agent_clients.get(addr).call_retry(
                         "return_bundles", pg_id=pg_id, indices=indices)
                 except Exception:
                     pass

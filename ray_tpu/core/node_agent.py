@@ -30,11 +30,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from . import chaos
 from .common import ResourceSet, TaskSpec, detect_node_resources
 from .config import get_config
 from .ids import NodeID, ObjectID, WorkerID
 from .object_store import NodeObjectStore, ObjectStoreFullError
-from .rpc import ClientPool, RpcClient, RpcServer
+from .rpc import (ClientPool, ConnectionLost, RpcClient, RpcServer,
+                  TransientServerError)
 from .scheduling import NodeView, pick_node
 
 # Lazy singleton: node telemetry gauges (reference: metric_defs.cc core
@@ -142,6 +144,10 @@ class LeaseRequest:
     allow_spillback: bool = True
     owner: Optional[str] = None
     task_label: str = ""
+    #: the connection the request arrived on: a queued request whose
+    #: requester disconnected must NOT be granted a worker nobody will
+    #: ever use (the grant would leak the node's capacity forever)
+    writer: Optional[object] = None
 
 
 class NodeAgent:
@@ -195,6 +201,14 @@ class NodeAgent:
         # pin_for_read) so the release decrements the same record:
         # {consumer_addr: {object_id: {kind: count}}}.
         self._read_pins: Dict[str, Dict[ObjectID, Dict[str, int]]] = {}
+        # chaos plane: last runtime spec version applied from the GCS, the
+        # kill-schedule task driven by the installed injector, and the
+        # runtime spec itself — forwarded to workers spawned AFTER a
+        # chaos_set (their RAYTPU_CONFIG_JSON predates it)
+        self._chaos_version = 0
+        self._chaos_kill_task: Optional[asyncio.Task] = None
+        self._chaos_runtime_spec: Optional[dict] = None
+        self._chaos_runtime_applied = False
         # worker_id -> memory-monitor kill cause, consumed by the lease
         # return so the owner raises a typed OutOfMemoryError.
         self._oom_kills: Dict[str, str] = {}
@@ -212,10 +226,15 @@ class NodeAgent:
             # before registration: the endpoint port rides the node labels
             await self._start_metrics_endpoint()
         self.gcs = RpcClient(self.gcs_address)
-        res = await self.gcs.call("register_node", node_id=self.node_id.hex(),
-                                  address=self.server.address,
-                                  resources=self.total.to_dict(), labels=self.labels)
+        # retried registration with an idempotency token: a lost reply (GCS
+        # blip, chaos drop) must not register this node twice
+        res = await self.gcs.call_retry(
+            "register_node", node_id=self.node_id.hex(),
+            address=self.server.address,
+            resources=self.total.to_dict(), labels=self.labels)
         self._apply_view(res["cluster_view"])
+        # config/env chaos spec: arm the kill schedule (if any) at boot
+        self._arm_chaos_schedule()
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         if get_config().metrics_export_enabled:
             self._bg.append(asyncio.ensure_future(self._telemetry_loop()))
@@ -240,6 +259,8 @@ class NodeAgent:
         self._shutting_down = True
         if getattr(self, "_loop_monitor", None):
             self._loop_monitor.stop()
+        if self._chaos_kill_task is not None:
+            self._chaos_kill_task.cancel()
         for t in self._bg:
             t.cancel()
         for w in list(self.workers.values()):
@@ -281,15 +302,21 @@ class NodeAgent:
                     total=self.total.to_dict(),
                     queue_len=len(self.lease_queue),
                     queued_demands=self._aggregate_demands(),
-                    store_stats=self.store.stats())
+                    store_stats=self.store.stats(),
+                    chaos_version=self._chaos_version)
                 if res.get("unknown"):
-                    res2 = await self.gcs.call(
+                    res2 = await self.gcs.call_retry(
                         "register_node", node_id=self.node_id.hex(),
                         address=self.server.address,
                         resources=self.total.to_dict(), labels=self.labels)
                     self._apply_view(res2["cluster_view"])
                 elif "view" in res:
                     self._apply_view(res["view"])
+                if "chaos" in res:
+                    # runtime chaos spec changed at the GCS (chaos_set /
+                    # chaos_clear): converge via the heartbeat piggyback
+                    await self._apply_chaos(res["chaos"]["spec"],
+                                            res["chaos"]["version"])
                 if self.lease_queue:
                     await self._process_lease_queue()
             except Exception:
@@ -446,16 +473,19 @@ class NodeAgent:
                 self._release_lease_resources(w.lease_id)
         if w.is_actor and w.actor_id and not self._shutting_down:
             try:
+                # retried + idempotency token: a lost reply must not burn
+                # TWO restarts for one death
                 if w.intended_exit:
                     # exit_actor(): the worker announced the exit before
                     # dying — even if its own GCS report was lost, this
                     # backstop must not trigger a restart
-                    await self.gcs.call(
+                    await self.gcs.call_retry(
                         "report_actor_death", actor_id=w.actor_id,
                         reason="exit_actor() (intended)", expected=True)
                 else:
-                    await self.gcs.call("report_actor_death",
-                                        actor_id=w.actor_id, reason=reason)
+                    await self.gcs.call_retry("report_actor_death",
+                                              actor_id=w.actor_id,
+                                              reason=reason)
             except Exception:
                 pass
             if w.lease_id:
@@ -513,6 +543,14 @@ class NodeAgent:
             w.state = "IDLE"
             w.idle_since = time.monotonic()
         w.registered.set()
+        if self._chaos_runtime_applied:
+            # a runtime chaos_set happened before this worker existed: its
+            # serialized config predates the spec, so hand it over now
+            try:
+                await self.worker_clients.get(address).notify(
+                    "chaos_update", spec=self._chaos_runtime_spec)
+            except Exception:
+                pass
         await self._process_lease_queue()
         return {"node_id": self.node_id.hex(), "store_name": self.store.name}
 
@@ -541,8 +579,36 @@ class NodeAgent:
                                           runtime_env: Optional[dict] = None,
                                           allow_spillback: bool = True,
                                           owner: Optional[str] = None,
-                                          task_label: str = ""):
-        """Grant {worker_address, worker_id, lease_id} | {spillback: node} | queue."""
+                                          task_label: str = "",
+                                          _writer=None):
+        """Grant {worker_address, worker_id, lease_id} | {spillback: node} | queue.
+
+        Grants are tied to the REQUESTING CONNECTION: a grant that
+        completes after the requester's connection died is undeliverable —
+        returning it as a reply would vanish into a closed socket while
+        the lease pins the node's resources forever.  Reclaim the worker
+        and raise instead; the error lands in the idempotency cache, so a
+        same-token retry re-requests cleanly (and a requester that truly
+        gave up leaks nothing)."""
+        grant = await self._request_worker_lease(
+            resources, bundle, runtime_env, allow_spillback, owner,
+            task_label, _writer)
+        if (_writer is not None and _writer.is_closing()
+                and isinstance(grant, dict) and "lease_id" in grant):
+            await self.handle_return_worker_lease(
+                grant["lease_id"], grant["worker_id"], worker_alive=True)
+            # TransientServerError: dropped from the dedup cache, so a
+            # same-token retry on a LIVE connection re-executes and gets a
+            # fresh grant instead of replaying this stale error
+            raise TransientServerError(
+                "lease grant undeliverable: requester connection closed")
+        return grant
+
+    handle_request_worker_lease.rpc_pass_writer = True
+
+    async def _request_worker_lease(self, resources, bundle, runtime_env,
+                                    allow_spillback, owner, task_label,
+                                    writer=None):
         pool = self._resource_pool_for(bundle)
         if bundle is None and not ResourceSet(self.total.to_dict()).can_fit(resources):
             return {"infeasible": True}
@@ -558,9 +624,23 @@ class NodeAgent:
         req = LeaseRequest(self._next_lease_id(), resources,
                            tuple(bundle) if bundle else None, fut, runtime_env,
                            allow_spillback=allow_spillback,
-                           owner=owner, task_label=task_label)
+                           owner=owner, task_label=task_label,
+                           writer=writer)
         self.lease_queue.append(req)
         return await fut
+
+    async def on_disconnect(self, peer, writer):
+        """A client connection died: fail its queued lease requests NOW.
+        Leaving them queued would eventually grant workers to a requester
+        that cannot hear the reply — each such grant permanently leaks a
+        slice of this node's capacity (the wedge the chaos harness hits
+        within seconds at a 5% frame-drop rate)."""
+        stale = [r for r in self.lease_queue if r.writer is writer]
+        for req in stale:
+            self.lease_queue.remove(req)
+            if not req.future.done():
+                req.future.set_exception(TransientServerError(
+                    "requester disconnected before lease grant"))
 
     def _spillback_target(self, resources: Dict[str, float]) -> Optional[dict]:
         others = {nid: v for nid, v in self.cluster_view.items()
@@ -723,6 +803,16 @@ class NodeAgent:
         i = 0
         while i < len(self.lease_queue):
             req = self.lease_queue[i]
+            if req.writer is not None and req.writer.is_closing():
+                # requester's connection died while queued (see
+                # on_disconnect; this catches the race where the writer
+                # closed without the disconnect callback yet): granting
+                # would leak the worker
+                self.lease_queue.pop(i)
+                if not req.future.done():
+                    req.future.set_exception(TransientServerError(
+                        "requester disconnected before lease grant"))
+                continue
             try:
                 pool = self._resource_pool_for(req.bundle)
             except ValueError:
@@ -789,6 +879,92 @@ class NodeAgent:
         await self._kill_worker_proc(w)
         return True
 
+    # ---------------------------------------------------------------- chaos
+
+    async def handle_chaos_update(self, spec: Optional[dict],
+                                  version: int | None = None):
+        """Runtime chaos control reached this node (GCS chaos_set via
+        pubsub/heartbeat, or a direct call): install the spec locally,
+        re-arm the kill schedule, and forward to every registered worker."""
+        await self._apply_chaos(spec, version)
+        return True
+
+    async def _apply_chaos(self, spec: Optional[dict],
+                           version: int | None = None):
+        chaos.install(spec)
+        self._chaos_runtime_spec = spec
+        self._chaos_runtime_applied = True
+        if version is not None:
+            self._chaos_version = version
+        self._arm_chaos_schedule()
+        for w in list(self.workers.values()):
+            if not w.address:
+                continue
+            try:
+                await self.worker_clients.get(w.address).notify(
+                    "chaos_update", spec=spec)
+            except Exception:
+                pass
+
+    def _arm_chaos_schedule(self):
+        """(Re)start the seeded kill-schedule loop for the installed
+        injector (the NodeKillerActor analogue, reference:
+        test_utils.py:1401 — here at worker granularity: agent/node kills
+        stay with Cluster.kill_node)."""
+        if self._chaos_kill_task is not None:
+            self._chaos_kill_task.cancel()
+            self._chaos_kill_task = None
+        inj = chaos.injector()
+        if inj is None or not inj.kills:
+            return
+        self._chaos_kill_task = asyncio.ensure_future(
+            self._chaos_kill_loop(inj))
+
+    async def _chaos_kill_loop(self, inj):
+        t0 = time.monotonic()
+        my_id = self.node_id.hex()
+        for entry in sorted(inj.kills, key=lambda k: float(k.get("after_s", 0))):
+            node_sel = entry.get("node")
+            if node_sel and not my_id.startswith(str(node_sel)):
+                continue
+            if entry.get("target", "worker") != "worker":
+                continue
+            delay = t0 + float(entry.get("after_s", 0)) - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            # A scheduled kill with no victim yet (workers still booting)
+            # waits briefly so "1 scheduled kill" reliably means 1 kill.
+            victim = None
+            for _ in range(100):
+                if self._shutting_down:
+                    return
+                victim = self._pick_chaos_victim()
+                if victim is not None:
+                    break
+                await asyncio.sleep(0.1)
+            if victim is None:
+                continue
+            inj.record("worker_kill")
+            try:
+                print(f"[chaos] killing worker {victim.worker_id[:12]} "
+                      f"(seeded schedule, node {my_id[:12]})", flush=True)
+            except Exception:
+                pass
+            await self._kill_worker_proc(victim)
+
+    def _pick_chaos_victim(self):
+        """Deterministic victim: the first registered NON-ACTOR worker by
+        worker id (leased preferred — killing it exercises the task-retry
+        path; actors are spared so a kill never burns an actor restart
+        the workload did not budget for)."""
+        live = sorted((w for w in self.workers.values()
+                       if w.registered.is_set() and not w.is_actor
+                       and w.state in ("IDLE", "LEASED")),
+                      key=lambda w: w.worker_id)
+        leased = [w for w in live if w.state == "LEASED"]
+        pool = leased or live
+        return pool[0] if pool else None
+
     # --------------------------------------------------------------- actors
 
     async def handle_create_actor(self, spec: TaskSpec):
@@ -812,8 +988,13 @@ class NodeAgent:
         w.actor_id = spec.actor_id.hex()
         client = self.worker_clients.get(grant["worker_address"])
         try:
-            await client.call("create_actor", spec=spec,
-                              _timeout=get_config().actor_creation_timeout_s)
+            # Idempotent retry: a creation reply lost to a flaky link (a
+            # chaos drop deterministically hits the FIRST reply of every
+            # fresh worker for some seeds) replays from the worker's dedup
+            # window instead of failing placement forever.
+            await client.call_retry(
+                "create_actor", spec=spec,
+                _timeout=get_config().actor_creation_timeout_s)
         except Exception:
             await self._kill_worker_proc(w)
             self._release_lease_resources(grant["lease_id"])
@@ -1384,7 +1565,7 @@ class NodeAgent:
                     # no lease return to consume _oom_kills, so thread the
                     # typed cause straight into the death reason instead.
                     try:
-                        await self.gcs.call(
+                        await self.gcs.call_retry(
                             "report_actor_death", actor_id=victim.actor_id,
                             reason=f"OutOfMemoryError: {cause}")
                     except Exception:

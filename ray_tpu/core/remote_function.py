@@ -70,8 +70,9 @@ class RemoteFunction:
             self._fn_id = hashlib.sha1(self._blob).digest()[:16]
         key = id(worker)
         if key not in self._registered_in:
-            run_async(worker.gcs.call("kv_put", ns="funcs", key=self._fn_id.hex(),
-                                      value=self._blob, overwrite=False))
+            run_async(worker.gcs.call_retry(
+                "kv_put", ns="funcs", key=self._fn_id.hex(),
+                value=self._blob, overwrite=False))
             self._registered_in.add(key)
         return self._fn_id
 

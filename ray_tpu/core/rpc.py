@@ -18,11 +18,15 @@ from __future__ import annotations
 import asyncio
 import itertools
 import pickle
+import random
 import threading
 import time
 import traceback
+import uuid
 from typing import Any, Callable, Dict, Optional
 
+from . import chaos
+from .chaos import ChaosFault
 from .config import get_config
 
 _loop_lock = threading.Lock()
@@ -315,6 +319,16 @@ class ConnectionLost(RpcError):
     pass
 
 
+class TransientServerError(RpcError):
+    """Handler-raised transient failure with DROP-from-cache semantics:
+    the reply is an error, but the idempotency entry for the call's token
+    is removed instead of recorded — a same-token retry RE-EXECUTES the
+    handler rather than replaying a stale error (used e.g. for lease
+    grants that completed after the requester's connection died; the
+    retry arrives on a live connection and deserves a fresh grant).
+    ``call_retry`` treats it as retryable."""
+
+
 class RemoteError(RpcError):
     """Handler raised; carries the remote traceback string."""
 
@@ -333,12 +347,25 @@ class RemoteError(RpcError):
 class RpcServer:
     """Dispatches ``(req_id, method, kwargs)`` to ``handler.handle_<method>`` coroutines."""
 
+    #: idempotency-cache ceilings (entries AND approximate bytes — large
+    #: cached replies, e.g. token'd actor_task inline results, must not
+    #: pool hundreds of MB for the whole dedup window)
+    IDEM_CACHE_MAX = 4096
+    IDEM_CACHE_MAX_BYTES = 64 << 20
+
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
         self.handler = handler
         self.host = host
         self.port = port
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
+        # Idempotency dedup window (reference: exactly-once semantics for
+        # retried mutating RPCs): token -> (expiry, in-flight future |
+        # (ok, result), approx_bytes).  A retry carrying a token already
+        # seen replays the recorded reply — or awaits the original
+        # execution still in flight — instead of re-running the handler.
+        self._idem: Dict[str, tuple] = {}
+        self._idem_bytes = 0
 
     @property
     def address(self) -> str:
@@ -386,29 +413,140 @@ class RpcServer:
             except Exception:
                 pass
 
+    @classmethod
+    def _approx_result_bytes(cls, result, _depth: int = 3) -> int:
+        """Cheap size estimate for a cached reply: count bytes-like
+        payloads (the only members that can be large) a few levels deep —
+        actor replies are LISTS of ('inline', bytes, ...) tuples, so one
+        level would miss every inline payload."""
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            return len(result)
+        n = 64
+        if _depth > 0 and isinstance(result, (tuple, list)):
+            for el in result:
+                n += cls._approx_result_bytes(el, _depth - 1)
+        return n
+
+    def _idem_pop(self, tok: str):
+        ent = self._idem.pop(tok, None)
+        if ent is not None:
+            self._idem_bytes -= ent[2]
+
+    def _idem_store(self, tok: str, entry, nbytes: int):
+        old = self._idem.get(tok)
+        if old is not None:
+            self._idem_bytes -= old[2]
+        self._idem[tok] = (
+            time.monotonic() + get_config().rpc_dedup_window_s, entry, nbytes)
+        self._idem_bytes += nbytes
+
+    def _prune_idem(self):
+        # Amortized front-of-dict expiry: insertion order == arrival order
+        # (value replacement keeps a key's position), so expired entries
+        # cluster at the front.  Keeps the cache sized to the live window
+        # instead of letting big cached results pool until the ceiling.
+        now = time.monotonic()
+        while self._idem:
+            tok = next(iter(self._idem))
+            exp, entry, _n = self._idem[tok]
+            if exp < now and not isinstance(entry, asyncio.Future):
+                self._idem_pop(tok)
+            else:
+                break
+        # Hard ceilings (entries and bytes) regardless of expiry — but
+        # never evict an IN-FLIGHT future: a same-token retry racing the
+        # evicted original would re-execute the mutating handler
+        # concurrently, the exact double-apply this cache prevents.
+        if (len(self._idem) > self.IDEM_CACHE_MAX
+                or self._idem_bytes > self.IDEM_CACHE_MAX_BYTES):
+            for tok in list(self._idem):
+                if (len(self._idem) <= self.IDEM_CACHE_MAX
+                        and self._idem_bytes <= self.IDEM_CACHE_MAX_BYTES):
+                    break
+                if not isinstance(self._idem[tok][1], asyncio.Future):
+                    self._idem_pop(tok)
+
     async def _dispatch(self, writer, req_id, method, kwargs):
         m = rpc_metrics()
         t0 = time.monotonic() if m is not None else 0.0
-        try:
-            fn = getattr(self.handler, "handle_" + method)
-            if getattr(fn, "rpc_pass_writer", False):
-                # Handler streams interim server->client pushes on this
-                # connection (req_id -1 frames; the client routes them to
-                # its on_push handler) before the final reply.
-                kwargs["_writer"] = writer
-            result = await fn(**kwargs)
-            ok = True
-        except BaseException as e:  # noqa: BLE001 — errors must travel back
-            result = (e, traceback.format_exc())
-            ok = False
-            if m is not None:
-                m.errors.inc(tags={"method": method,
-                                   "kind": type(e).__name__,
-                                   "role": "server"})
+        inj = chaos.injector()
+        token = kwargs.pop("_idem", None)
+        cached = False
+        inflight = None
+        if token is not None:
+            hit = self._idem.get(token)
+            if hit is not None:
+                entry = hit[1]
+                if isinstance(entry, asyncio.Future):
+                    # original execution still in flight (its reply was
+                    # lost): piggyback on it — the handler runs ONCE
+                    ok, result = await asyncio.shield(entry)
+                else:
+                    ok, result = entry
+                cached = True
+        if not cached:
+            if (inj is not None and req_id >= 0
+                    and inj.should("fail_before", method)):
+                # fail-before-commit: the handler never ran; blind retry
+                # is safe, so no dedup entry is recorded
+                ok = False
+                result = (ChaosFault(f"chaos: {method} failed before "
+                                     "execution"), "")
+            else:
+                if token is not None:
+                    inflight = asyncio.get_event_loop().create_future()
+                    self._idem_store(token, inflight, 256)
+                    self._prune_idem()
+                try:
+                    fn = getattr(self.handler, "handle_" + method)
+                    if getattr(fn, "rpc_pass_writer", False):
+                        # Handler streams interim server->client pushes on
+                        # this connection (req_id -1 frames; the client
+                        # routes them to its on_push handler) before the
+                        # final reply.
+                        kwargs["_writer"] = writer
+                    result = await fn(**kwargs)
+                    ok = True
+                except BaseException as e:  # noqa: BLE001 — errors travel back
+                    result = (e, traceback.format_exc())
+                    ok = False
+                    if m is not None:
+                        m.errors.inc(tags={"method": method,
+                                           "kind": type(e).__name__,
+                                           "role": "server"})
+                if inflight is not None:
+                    if not ok and isinstance(result[0], TransientServerError):
+                        # drop-from-cache semantics: waiters piggybacked on
+                        # THIS execution see the error once, but a later
+                        # same-token retry re-executes instead of
+                        # replaying a stale transient failure
+                        self._idem_pop(token)
+                    else:
+                        # the COMMITTED outcome — recorded before any
+                        # chaos mangles the reply, so a retry observes it
+                        self._idem_store(token, (ok, result),
+                                         self._approx_result_bytes(result))
+                    inflight.set_result((ok, result))
+                if (inj is not None and ok and req_id >= 0
+                        and inj.should("fail_after", method)):
+                    # fail-after-commit: state changed, reply replaced by
+                    # an error — only an idempotent retry survives this
+                    ok = False
+                    result = (ChaosFault(f"chaos: {method} failed after "
+                                         "execution"), "")
         if m is not None:
             m.server_seconds.observe_key(m.method_keys(method)[0],
                                          time.monotonic() - t0)
         if req_id >= 0:
+            if (inj is not None and not cached
+                    and inj.should("drop_reply", method)):
+                # a lost reply on a live TCP stream == the link dying:
+                # abort so the client fails fast and retries
+                try:
+                    writer.transport.abort()
+                except Exception:
+                    pass
+                return
             try:
                 try:
                     n = coalesced_write_frame(writer, (req_id, ok, result))
@@ -460,14 +598,18 @@ class RpcClient:
         self._host, self._port = host, int(port)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
+        # Pending futures are PER CONNECTION: each connection gets a fresh
+        # dict whose read loop is the only popper, and whose teardown fails
+        # exactly the futures that rode that connection.  A process-wide
+        # dict had a race: _read_loop's finally cleared it while a
+        # call_start parked at an await (chaos delay) could still insert —
+        # that call then hung to its full timeout instead of failing fast.
         self._pending: Dict[int, asyncio.Future] = {}
         self._req_ids = itertools.count(1)
         self._connect_lock: asyncio.Lock | None = None
         self._closed = False
         self._connected_once = False
         self._push_handler: Callable[[str, dict], None] | None = None
-        # chaos harness: per-link added latency (config or set_link_delay)
-        self._chaos_delay_s = get_config().chaos_rpc_delay_ms / 1000.0
 
     def on_push(self, fn: Callable[[str, dict], None]):
         """Register a callback for server-initiated one-way messages."""
@@ -484,14 +626,16 @@ class RpcClient:
                 asyncio.open_connection(self._host, self._port,
                                         limit=16 << 20),
                 timeout=cfg.rpc_connect_timeout_s)
+            self._pending = {}
             if self._connected_once:
                 m = rpc_metrics()
                 if m is not None:
                     m.reconnects.inc()
             self._connected_once = True
-            asyncio.ensure_future(self._read_loop(self._reader))
+            asyncio.ensure_future(
+                self._read_loop(self._reader, self._writer, self._pending))
 
-    async def _read_loop(self, reader):
+    async def _read_loop(self, reader, writer, pending):
         try:
             while True:
                 msg, nbytes = await _read_msg(reader)
@@ -503,7 +647,7 @@ class RpcClient:
                         except Exception:
                             traceback.print_exc()
                     continue
-                fut = self._pending.pop(req_id, None)
+                fut = pending.pop(req_id, None)
                 if fut is not None:
                     m = rpc_metrics()
                     if m is not None:
@@ -519,12 +663,43 @@ class RpcClient:
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         finally:
-            self._writer = None
+            # Tear down only THIS connection's state: a reconnect may
+            # already have installed a fresh writer/pending pair.
+            if self._writer is writer:
+                self._writer = None
+            try:
+                writer.close()
+            except Exception:
+                pass
             err = ConnectionLost(f"connection to {self.address} lost")
-            for fut in self._pending.values():
+            for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(err)
-            self._pending.clear()
+            pending.clear()
+
+    def _chaos_pre(self, method: str):
+        """Client-side chaos consultation for one outbound frame:
+        -> (injector, added delay).  Raises ConnectionLost on partition."""
+        inj = chaos.injector()
+        d = 0.0
+        if inj is not None:
+            if inj.should("partition", method, self.address):
+                raise ConnectionLost(
+                    f"chaos: link to {self.address} partitioned")
+            d = inj.delay_s(method, self.address)
+        return inj, d
+
+    def _chaos_drop_frame(self, writer):
+        """A chaos-dropped frame on a live TCP stream is indistinguishable
+        from the link dying: abort the connection so every pending call on
+        it fails fast with ConnectionLost instead of hanging to timeout."""
+        try:
+            writer.transport.abort()
+        except Exception:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     async def call_start(self, method: str, **kwargs) -> "asyncio.Future":
         """Issue the request and return its response future without awaiting it.
@@ -533,13 +708,25 @@ class RpcClient:
         in CoreWorkerDirectActorTaskSubmitter)."""
         if self._closed:
             raise RpcError("client closed")
+        inj, delay = self._chaos_pre(method)
         await self._ensure_connected()
-        if self._chaos_delay_s > 0.0:
-            await asyncio.sleep(self._chaos_delay_s)
+        writer, pending = self._writer, self._pending
+        if delay > 0.0:
+            await asyncio.sleep(delay)
+            # the connection may have died (or been replaced) during the
+            # sleep — fail fast rather than enqueueing on a dead link
+            if self._writer is not writer or writer is None \
+                    or writer.is_closing():
+                raise ConnectionLost(
+                    f"connection to {self.address} lost before send")
         req_id = next(self._req_ids)
         fut = asyncio.get_event_loop().create_future()
-        self._pending[req_id] = fut
-        nbytes = coalesced_write_frame(self._writer, (req_id, method, kwargs))
+        pending[req_id] = fut
+        if inj is not None and inj.should("drop_request", method,
+                                          self.address):
+            nbytes = 0
+        else:
+            nbytes = coalesced_write_frame(writer, (req_id, method, kwargs))
         m = rpc_metrics()
         if m is not None:
             keys = m.method_keys(method)
@@ -562,27 +749,93 @@ class RpcClient:
                                         "role": "client"})
 
             fut.add_done_callback(_done)
-        await drain_if_needed(self._writer)
+        if nbytes == 0:
+            # dropped frame: kill the link so this (and every pending)
+            # call surfaces ConnectionLost promptly
+            self._chaos_drop_frame(writer)
+            return fut
+        await drain_if_needed(writer)
         return fut
-
-    def set_link_delay(self, delay_s: float):
-        """Chaos harness: add one-way latency to every frame on this link."""
-        self._chaos_delay_s = float(delay_s)
 
     async def call(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
         fut = await self.call_start(method, **kwargs)
         timeout = _timeout if _timeout is not None else get_config().rpc_call_timeout_s
         return await asyncio.wait_for(fut, timeout)
 
+    async def call_retry(self, method: str, _timeout: float | None = None,
+                         _attempts: int | None = None,
+                         _idempotent: bool = True, **kwargs) -> Any:
+        """Retrying call for transient transport faults (reference:
+        retryable gRPC clients).  Bounded attempts with exponential backoff
+        + full jitter, all under ONE shared deadline (`_timeout`, default
+        ``rpc_call_timeout_s``) that propagates into each attempt's
+        per-call timeout.
+
+        With ``_idempotent=True`` (the default) a client-stamped
+        idempotency token rides every attempt: the server's dedup window
+        replays the committed reply for a retry instead of re-executing
+        the handler, so retried MUTATING RPCs (register_actor, kv_put,
+        lease grants/returns, pin grants) apply exactly once.  Pass
+        ``_idempotent=False`` for read-only calls to skip the server-side
+        cache entry (re-executing a read is free).
+
+        Retries on: ConnectionLost / OSError (link died), TimeoutError
+        with deadline remaining, and ChaosFault RemoteErrors (injected
+        failures are retryable by definition).  Application errors
+        propagate immediately."""
+        cfg = get_config()
+        attempts = (_attempts if _attempts is not None
+                    else cfg.rpc_retry_max_attempts)
+        total = _timeout if _timeout is not None else cfg.rpc_call_timeout_s
+        deadline = time.monotonic() + total
+        if _idempotent:
+            kwargs["_idem"] = uuid.uuid4().hex
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, attempts)):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                return await self.call(method, _timeout=remaining, **kwargs)
+            except (ConnectionLost, ConnectionError, OSError,
+                    asyncio.TimeoutError) as e:
+                last = e
+            except RemoteError as e:
+                if not isinstance(e.cause, (ChaosFault, TransientServerError)):
+                    raise
+                last = e
+            if self._closed or attempt >= attempts - 1:
+                break  # no backoff after the FINAL attempt — nothing follows
+            step = min(cfg.rpc_retry_max_delay_s,
+                       cfg.rpc_retry_base_delay_s * (2 ** attempt))
+            sleep = min(random.uniform(0, step),
+                        max(0.0, deadline - time.monotonic()))
+            if sleep > 0:
+                await asyncio.sleep(sleep)
+        if last is not None:
+            raise last
+        raise asyncio.TimeoutError(
+            f"{method}: deadline exhausted before first attempt")
+
     async def notify(self, method: str, **kwargs):
+        inj, delay = self._chaos_pre(method)
         await self._ensure_connected()
-        if self._chaos_delay_s > 0.0:
-            await asyncio.sleep(self._chaos_delay_s)
-        nbytes = coalesced_write_frame(self._writer, (-1, method, kwargs))
+        writer = self._writer
+        if delay > 0.0:
+            await asyncio.sleep(delay)
+            if self._writer is not writer or writer is None \
+                    or writer.is_closing():
+                raise ConnectionLost(
+                    f"connection to {self.address} lost before send")
+        if inj is not None and inj.should("drop_request", method,
+                                          self.address):
+            self._chaos_drop_frame(writer)
+            return
+        nbytes = coalesced_write_frame(writer, (-1, method, kwargs))
         m = rpc_metrics()
         if m is not None:
             m.bytes_sent.inc_key(m.method_keys(method)[1], nbytes)
-        await drain_if_needed(self._writer)
+        await drain_if_needed(writer)
 
     def call_sync(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
         return run_async(self.call(method, _timeout=_timeout, **kwargs),

@@ -404,7 +404,8 @@ def ensure(worker, job_id_hex: str):
         return
     from .rpc import run_async
 
-    raw = run_async(worker.gcs.call("kv_get", ns=NS, key=job_id_hex))
+    raw = run_async(worker.gcs.call_retry("kv_get", ns=NS, key=job_id_hex,
+                                          _idempotent=False))
     if raw is None:
         _materialized.add(job_id_hex)
         _applied_state[job_id_hex] = None

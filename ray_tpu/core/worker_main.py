@@ -47,8 +47,12 @@ def main():
     api._state.worker = w
     api._state.gcs_address = gcs_address
     api._state.session_dir = session_dir
-    res = run_async(w.agent.call("register_worker", worker_id=worker_id,
-                                 address=w.address, pid=os.getpid()))
+    # retried + token'd: a registration reply lost to a flaky link must
+    # not leave the worker unregistered (the agent would reap it) nor
+    # register it twice
+    res = run_async(w.agent.call_retry("register_worker",
+                                       worker_id=worker_id,
+                                       address=w.address, pid=os.getpid()))
     if res.get("shutdown"):
         sys.exit(0)
 

@@ -262,6 +262,45 @@ def cmd_dashboard(args):
         pass
 
 
+# ------------------------------------------------------------------ chaos
+
+def cmd_chaos(args):
+    """Runtime control of the fault-injection plane (core/chaos.py):
+    ``raytpu chaos set '<spec json>'`` broadcasts a FaultInjector spec
+    through the GCS to every agent and worker; ``clear`` removes it;
+    ``status`` prints the active spec, its version, and the GCS-side
+    injected-fault counts (``raytpu_chaos_injected_total``)."""
+    _connect()
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+
+    gcs = global_worker().gcs
+    if args.action == "set":
+        spec_text = args.spec
+        if args.file:
+            with open(args.file) as f:
+                spec_text = f.read()
+        if not spec_text:
+            raise SystemExit("usage: raytpu chaos set '<spec json>' "
+                             "(or --file spec.json)")
+        spec = json.loads(spec_text)
+        version = run_async(gcs.call("chaos_set", spec=spec))
+        # the CLI's own driver process participates too
+        from ray_tpu.core import chaos as chaos_mod
+        chaos_mod.install(spec)
+        print(f"chaos spec v{version} installed (seed="
+              f"{spec.get('seed', 0)}, {len(spec.get('rules', []))} rule(s),"
+              f" {len(spec.get('kills', []))} kill(s))")
+    elif args.action == "clear":
+        version = run_async(gcs.call("chaos_clear"))
+        from ray_tpu.core import chaos as chaos_mod
+        chaos_mod.install(None)
+        print(f"chaos cleared (v{version})")
+    else:  # status
+        print(json.dumps(run_async(gcs.call("chaos_get")), indent=2,
+                         default=str))
+
+
 # ------------------------------------------------------------------- jobs
 
 def cmd_submit(args):
@@ -381,6 +420,13 @@ def main(argv=None):
     s = sub.add_parser("dashboard", help="serve the REST dashboard")
     s.add_argument("--port", type=int, default=8265)
     s.set_defaults(fn=cmd_dashboard)
+
+    s = sub.add_parser("chaos", help="fault-injection control "
+                                     "(set/clear/status a chaos spec)")
+    s.add_argument("action", choices=["set", "clear", "status"])
+    s.add_argument("spec", nargs="?", help="FaultInjector spec JSON (set)")
+    s.add_argument("--file", default=None, help="read the spec from a file")
+    s.set_defaults(fn=cmd_chaos)
 
     s = sub.add_parser("submit", help="submit a job (entrypoint after --)")
     s.add_argument("--working-dir", default=None)

@@ -240,6 +240,15 @@ def _copy_stats_metrics() -> Dict[str, dict]:
 
 # ---------------------------------------------------------------- flushing
 
+def get_metric(name: str) -> Optional["Metric"]:
+    """Look up a registered metric by name (None if never constructed) —
+    the introspection seam tests and the chaos harness use to read
+    counters like ``raytpu_chaos_injected_total`` without re-registering
+    them."""
+    with _registry_lock:
+        return _registry.get(name)
+
+
 def snapshot_registry() -> Dict[str, dict]:
     with _registry_lock:
         metrics = list(_registry.items())

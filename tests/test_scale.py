@@ -121,22 +121,27 @@ def test_100_placement_groups_cycle(ray_start_regular):
 
 @pytest.mark.timeout(300)
 def test_network_delay_chaos(ray_start_cluster):
-    """200 ms on every RPC link (driver AND the agent subprocess inherit
-    RAYTPU_CHAOS_RPC_DELAY_MS): tasks, actors, and cross-node health
-    checking all survive — the chaos_network_delay.yaml analogue."""
+    """200 ms on every RPC link via the seeded fault-injection plane
+    (RAYTPU_CHAOS_SPEC — the driver AND the agent subprocesses inherit
+    it): tasks, actors, and cross-node health checking all survive — the
+    chaos_network_delay.yaml analogue, now on core/chaos.py's injector."""
+    import json
+
     from ray_tpu.utils.testing import CPU_WORKER_ENV
     from ray_tpu.util.state import list_nodes
 
     cluster = ray_start_cluster
-    os.environ["RAYTPU_CHAOS_RPC_DELAY_MS"] = "200"
+    spec = json.dumps({"seed": 0,
+                       "rules": [{"kind": "delay", "ms": 200}]})
+    os.environ["RAYTPU_CHAOS_SPEC"] = spec
     try:
         cluster.add_node(num_cpus=2)
         cluster.add_node(num_cpus=2)
         cluster.wait_for_nodes(2, timeout=60)
         env = dict(CPU_WORKER_ENV)
-        env["RAYTPU_CHAOS_RPC_DELAY_MS"] = "200"
+        env["RAYTPU_CHAOS_SPEC"] = spec
         ray_tpu.init(address=cluster.address, worker_env=env,
-                     _system_config={"chaos_rpc_delay_ms": 200.0})
+                     _system_config={"chaos_spec": spec})
 
         @ray_tpu.remote
         def f(x):
@@ -162,5 +167,10 @@ def test_network_delay_chaos(ray_start_cluster):
         time.sleep(8)
         nodes = list_nodes()
         assert sum(1 for n in nodes if n.get("alive")) == 2, nodes
+        # the injector observably carried the delays in this process
+        from ray_tpu.core import chaos
+        inj = chaos.injector()
+        assert inj is not None
+        assert inj.injected_counts().get("delay", 0) > 0
     finally:
-        os.environ.pop("RAYTPU_CHAOS_RPC_DELAY_MS", None)
+        os.environ.pop("RAYTPU_CHAOS_SPEC", None)
