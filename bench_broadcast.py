@@ -13,9 +13,13 @@ TWO modes run back to back, each with a per-transfer timeline
   /dev/shm arena slice; ZERO bytes move, so "bandwidth" is control-plane
   RPC latency and the evidence is attaches == pullers, ~ms each.
 * chunked  — RAYTPU_DISABLE_ZERO_COPY=1 forces the byte path distinct
-  HOSTS use: windowed chunk pulls with tree relay; the evidence is
-  relay_fraction > 0 (later pullers drew from non-origin sources) and
-  peak_concurrent_transfers > 1 (chunk windows overlap).
+  HOSTS use: the chunk-ledger stripe (core/transfer.py) pulls each
+  object's chunks from EVERY known source concurrently, with partial
+  holders relaying ranges they already landed; the evidence is
+  relay_fraction > 0.5 (most chunk bytes came off non-origin sources),
+  len(sources_used) >= 3, per_source throughput rows, and the ledger
+  breakdown (chunks_done / retried / stolen / short) from the
+  pull_summary events.
 
 Run: ``python bench_broadcast.py [--nodes 8] [--mb 100]`` — prints ONE
 JSON line; full event timelines land in BENCH_BROADCAST_TIMELINE.json.
@@ -45,21 +49,50 @@ def _collect_timeline(trace_dir: str, origin: str) -> tuple:
     events.sort(key=lambda e: e["t0"])
     chunks = [e for e in events if e["kind"] == "chunk"]
     attaches = [e for e in events if e["kind"] == "proxy_attach"]
+    pulls = [e for e in events if e["kind"] == "pull_summary"]
+    transfers = chunks + attaches     # byte-moving spans only
     relay_bytes = sum(e["bytes"] for e in chunks if e["source"] != origin)
-    edges = sorted([(e["t0"], 1) for e in events]
-                   + [(e["t1"], -1) for e in events])
+    edges = sorted([(e["t0"], 1) for e in transfers]
+                   + [(e["t1"], -1) for e in transfers])
     cur = peak = 0
     for _, d in edges:
         cur += d
         peak = max(peak, cur)
+    # per-source throughput: bytes each source SERVED over its busy span
+    # (the multi-source stripe's evidence — who actually carried the
+    # broadcast, at what rate)
+    per_source = {}
+    for e in chunks:
+        row = per_source.setdefault(
+            e["source"], {"bytes": 0, "chunks": 0, "stolen": 0,
+                          "t0": e["t0"], "t1": e["t1"]})
+        row["bytes"] += e["bytes"]
+        row["chunks"] += 1
+        row["stolen"] += 1 if e.get("stolen") else 0
+        row["t0"] = min(row["t0"], e["t0"])
+        row["t1"] = max(row["t1"], e["t1"])
+    for row in per_source.values():
+        span = max(row.pop("t1") - row.pop("t0"), 1e-9)
+        row["gbps"] = round(row["bytes"] / span / 1e9, 3)
+    # ledger-state breakdown aggregated over every pull_summary event
+    ledger = {"pulls": len(pulls), "chunks_done": 0, "retried": 0,
+              "stolen": 0, "short": 0,
+              "mean_sources_per_pull": round(float(np.mean(
+                  [len(p.get("sources_used", [])) for p in pulls])), 2)
+              if pulls else None}
+    for p in pulls:
+        for k in ("chunks_done", "retried", "stolen", "short"):
+            ledger[k] += p.get(k, 0)
     summary = {
         "events": len(events),
         "chunk_pulls": len(chunks),
         "zero_copy_attaches": len(attaches),
         "relay_fraction_of_chunk_bytes": round(
             relay_bytes / max(sum(e["bytes"] for e in chunks), 1), 3),
-        "sources_used": sorted({e["source"] for e in events}),
+        "sources_used": sorted({e["source"] for e in transfers}),
         "peak_concurrent_transfers": peak,
+        "per_source": per_source,
+        "ledger": ledger,
         "mean_attach_ms": round(1000 * float(np.mean(
             [e["t1"] - e["t0"] for e in attaches])), 2) if attaches else None,
         "mean_chunk_ms": round(1000 * float(np.mean(

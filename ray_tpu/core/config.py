@@ -29,8 +29,36 @@ class Config:
     object_store_memory: int = 0
     #: Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
-    #: In-flight chunks per object pull (windowed parallel transfer).
-    object_transfer_parallelism: int = 4
+    #: TOTAL in-flight chunks per object pull, across all sources (the
+    #: chunk-ledger stripe's global window).
+    object_transfer_parallelism: int = 16
+    #: In-flight chunks per SOURCE within one pull (per-source window of
+    #: the multi-source stripe).
+    object_transfer_per_source_window: int = 4
+    #: Per-chunk RPC deadline: a chunk slower than this is failed and
+    #: re-striped onto another source (the generic rpc_call_timeout_s is
+    #: far too patient for an 8 MB read).
+    object_transfer_chunk_timeout_s: float = 30.0
+    #: Hedge (work-steal) an in-flight chunk held by another source longer
+    #: than this many seconds; 0 = adaptive (2x the median completed-chunk
+    #: time, floored at 0.25 s).
+    object_transfer_steal_after_s: float = 0.0
+    #: Chunk-fetch failures before a source is dropped from the stripe.
+    object_transfer_max_source_failures: int = 3
+    #: Mid-pull source refresh period: re-poll the owner's location view
+    #: and re-probe partial sources' advertised ranges this often.
+    object_transfer_source_refresh_s: float = 0.25
+    #: Fail a pull that lands NO chunk for this long (all sources dead /
+    #: unreachable and the owner offers nothing new).
+    object_transfer_stall_timeout_s: float = 60.0
+    #: Optional per-chunk checksum on the byte path (native CRC-32C when
+    #: the extension builds, zlib.crc32 otherwise): a mismatched chunk is
+    #: rejected and re-pulled instead of sealing a corrupt object.
+    object_transfer_checksum: bool = False
+    #: Partial-object serving: a puller advertises + serves the chunk
+    #: ranges it already holds, so an N-node broadcast pipelines through
+    #: in-progress pullers instead of waiting for full copies.
+    object_transfer_partial_serving: bool = True
     #: Max concurrent inbound object pulls admitted per node.
     object_pull_max_concurrency: int = 8
     #: Use the native C++ shm arena allocator for the store (falls back to

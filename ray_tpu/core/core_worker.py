@@ -1130,12 +1130,28 @@ class CoreWorker:
         """-> (buffer, pin | None): the flattened object bytes, zero-copy
         over the pinned store mapping when the agent granted a read pin."""
         if self.agent is None:
-            # Driver without an agent (shouldn't happen) — pull chunks directly.
-            node_id, addr = record.locations[0]
-            client = self.agent_clients.get(addr)
-            data = await client.call("read_chunk", object_id=ref.id, offset=0,
-                                     length=record.size)
-            return data, None
+            # Driver without an agent (shouldn't happen) — pull directly.
+            # The location list may now contain PARTIAL holders (they
+            # register after their first chunk) and can shrink (failed
+            # pulls deregister): try every location, skip the unusable,
+            # reject short replies (silent corruption otherwise).
+            last: Optional[BaseException] = None
+            for node_id, addr in list(record.locations):
+                client = self.agent_clients.get(addr)
+                try:
+                    data = await client.call("read_chunk", object_id=ref.id,
+                                             offset=0, length=record.size)
+                except Exception as e:  # noqa: BLE001 — try next holder
+                    last = e
+                    continue
+                if len(data) != record.size:
+                    last = ObjectLostError(
+                        ref.id, f"short read_chunk reply: {len(data)} of "
+                                f"{record.size} B from {addr}")
+                    continue
+                return data, None
+            raise ObjectLostError(
+                ref.id, f"no usable location for {ref.id}: {last}")
         try:
             # idempotent retry: a pin GRANTED on an attempt whose reply was
             # lost must come back as the same grant (one ledger entry), not
@@ -1626,6 +1642,19 @@ class CoreWorker:
             loc = (node_id, address)
             if loc not in rec.locations:
                 rec.locations.append(loc)
+        return True
+
+    async def handle_remove_object_location(self, object_id: ObjectID,
+                                            node_id: str, address: str):
+        """A node dropped its (possibly partial) copy — e.g. a striped pull
+        that registered after its first chunk then failed and freed the
+        segment.  Without this, the append-only location list would forever
+        route pullers at a holder with nothing to serve."""
+        rec = self.memory_store.get_if_exists(object_id)
+        if isinstance(rec, PlasmaRecord):
+            loc = (node_id, address)
+            if loc in rec.locations:
+                rec.locations.remove(loc)
         return True
 
     async def handle_escrow_hold(self, object_id: ObjectID, hold_id: str):

@@ -34,10 +34,14 @@ from . import chaos
 from .common import ResourceSet, TaskSpec, detect_node_resources
 from .config import get_config
 from .ids import NodeID, ObjectID, WorkerID
-from .object_store import NodeObjectStore, ObjectStoreFullError
-from .rpc import (ClientPool, ConnectionLost, RpcClient, RpcServer,
-                  TransientServerError)
+from .object_store import (ChunkNotAvailable, NodeObjectStore,
+                           ObjectStoreFullError)
+from .rpc import (ClientPool, ConnectionLost, RemoteError, RpcClient,
+                  RpcServer, TransientServerError)
 from .scheduling import NodeView, pick_node
+from .transfer import (KEY_CHUNK_OUT, KEY_PROXY_IN, ChunkCrcError,
+                       ChunkLedger, StripedPull, chunk_checksum,
+                       transfer_metrics)
 
 # Lazy singleton: node telemetry gauges (reference: metric_defs.cc core
 # metrics).  Module-level so in-process multi-agent clusters (tests, the
@@ -216,6 +220,9 @@ class NodeAgent:
         # strong refs to fire-and-forget loop tasks (event writes): the
         # event loop itself only holds weak references
         self._bg_tasks: set = set()
+        # per-(owner, object) tail of the location-update chain (see
+        # _location_update: add/remove must apply in issue order)
+        self._loc_updates: Dict[Tuple[str, ObjectID], "asyncio.Task"] = {}
 
     # ------------------------------------------------------------------ boot
 
@@ -1150,6 +1157,16 @@ class NodeAgent:
         if e is not None and e.sealed and not e.freed:
             return {"path": e.segment.path, "size": e.size,
                     "host_key": self.host_key, "proxy": False}
+        if (e is not None and not e.freed and e.avail
+                and get_config().object_transfer_partial_serving):
+            # in-progress pull publishing its chunk ledger: advertise the
+            # held [start, end) ranges so other pullers stripe onto us
+            # mid-broadcast.  Not zero-copy attachable (no pin on an
+            # unsealed entry) — byte pulls only.
+            return {"path": e.segment.path, "size": e.size,
+                    "host_key": self.host_key, "proxy": False,
+                    "partial": True,
+                    "ranges": [list(r) for r in e.avail]}
         p = self.store._proxies.get(object_id)
         if p is not None and not p.freed:
             return {"path": p.path, "size": p.size,
@@ -1284,18 +1301,34 @@ class NodeAgent:
 
     # -------------------------------------------------------- object transfer
 
-    async def handle_read_chunk(self, object_id: ObjectID, offset: int, length: int):
-        """Serve a chunk of a sealed local object to a remote agent
-        (reference: chunked object push/pull, object_manager.proto:61).
+    async def handle_read_chunk(self, object_id: ObjectID, offset: int,
+                                length: int, with_crc: bool = False):
+        """Serve a chunk of a local object to a remote agent (reference:
+        chunked object push/pull, object_manager.proto:61).  Serves sealed
+        entries, same-host proxies, and the SEALED RANGES of an in-progress
+        pull (partial-object serving — the chunk ledger publishes each
+        landed chunk, so this node relays a broadcast after one chunk-time;
+        an uncovered range raises a typed ChunkNotAvailable the puller
+        re-stripes).
 
         The copy out of the store is deliberate (the reply flushes a loop
         tick later, and eviction must not be able to mutate in-flight
         bytes); the PickleBuffer wrapper makes that copy the LAST one on
         this side — the RPC layer ships it as an out-of-band vectored
-        frame instead of re-copying it through the pickle stream."""
+        frame instead of re-copying it through the pickle stream.
+
+        ``with_crc`` adds a per-chunk checksum (native CRC-32C / zlib) the
+        puller verifies before marking the chunk landed."""
         import pickle as _pickle
-        return _pickle.PickleBuffer(
-            self.store.read_chunk(object_id, offset, length))
+        data = self.store.read_chunk(object_id, offset, length)
+        m = transfer_metrics()
+        if m is not None:
+            m["bytes"].inc_key(KEY_CHUNK_OUT, len(data))
+        if with_crc:
+            crc, algo = chunk_checksum(data)
+            return {"crc": crc, "algo": algo,
+                    "data": _pickle.PickleBuffer(data)}
+        return _pickle.PickleBuffer(data)
 
     async def handle_fetch_object(self, object_id: ObjectID, size: int,
                                   locations: List[Tuple[str, str]],
@@ -1394,7 +1427,6 @@ class NodeAgent:
                 path, sz = self.store.get_path(object_id)
                 return {"path": path, "size": sz}
             cfg = get_config()
-            last_err: Optional[Exception] = None
             candidates = [(nid, addr) for nid, addr in locations
                           if addr != self.server.address]
             random.shuffle(candidates)
@@ -1414,93 +1446,269 @@ class NodeAgent:
                                              object_id=object_id)
                 except Exception:
                     continue
-                if (not info or info.get("proxy")
+                if (not info or info.get("proxy") or info.get("partial")
                         or info.get("host_key") != self.host_key):
+                    # partial holders can't grant a pin (unsealed entry):
+                    # byte pulls may stripe onto them, attaches may not
                     continue
                 try:
                     t_pin = time.time()
                     if await client.call("pin_object", object_id=object_id):
                         self.store.add_proxy(object_id, info["path"],
                                              info["size"], addr)
+                        m = transfer_metrics()
+                        if m is not None:
+                            m["bytes"].inc_key(KEY_PROXY_IN, info["size"])
                         self._trace_transfer(
                             kind="proxy_attach", object=object_id.hex()[:12],
                             source=addr, bytes=info["size"],
                             t0=t_pin, t1=time.time())
                         if owner:
                             # A proxy holder IS a source for byte pullers
-                            # (read_chunk serves through get_path); same-host
-                            # pullers skip it via object_info.proxy and go
-                            # to the origin (no proxy-of-proxy pin chains).
-                            try:
-                                await self.worker_clients.get(owner).notify(
-                                    "add_object_location",
-                                    object_id=object_id,
-                                    node_id=self.node_id.hex(),
-                                    address=self.server.address)
-                            except Exception:
-                                pass
+                            # (read_chunk attaches the proxied slice);
+                            # same-host pullers skip it via
+                            # object_info.proxy and go to the origin (no
+                            # proxy-of-proxy pin chains).
+                            self._register_object_location(owner, object_id)
                         return {"path": info["path"], "size": info["size"]}
                 except Exception:
                     continue
-            for node_id, addr in candidates:
-                client = self.agent_clients.get(addr)
+            return await self._pull_object_chunks(
+                object_id, size, [addr for _nid, addr in candidates],
+                owner, cfg)
+
+    def _register_object_location(self, owner: str, object_id: ObjectID):
+        """Tell the owner this node now holds (part of) the object.
+
+        Retried with an idempotency token (``call_retry``): the old
+        fire-and-forget notify meant one dropped frame permanently hid this
+        source from the owner's location view.  Runs as a background task —
+        the pull's caller shouldn't wait out a retry backoff — with a
+        strong ref so the loop can't GC it mid-flight."""
+        self._location_update(owner, "add_object_location", object_id)
+
+    def _deregister_object_location(self, owner: str, object_id: ObjectID):
+        """Withdraw an early (partial) registration after a FAILED pull:
+        the owner's location list must not keep routing pullers at a node
+        that freed the segment."""
+        self._location_update(owner, "remove_object_location", object_id)
+
+    def _location_update(self, owner: str, method: str,
+                         object_id: ObjectID):
+        """Background location add/remove, SEQUENCED per (owner, object):
+        updates for one object chain behind each other, so a failed pull's
+        remove can never overtake its own still-retrying add (unordered
+        tasks could re-register a freed segment forever)."""
+        key = (owner, object_id)
+        prev = self._loc_updates.get(key)
+
+        async def _send():
+            if prev is not None:
                 try:
-                    path = self.store.create(object_id, size)
-                    seg = self.store._entries[object_id].segment
-                    # windowed parallel chunk pull (reference:
-                    # push_manager.h chunked parallel transfer) — overlaps
-                    # the RTTs instead of paying them serially
-                    chunk_n = cfg.object_transfer_chunk_bytes
-                    offsets = list(range(0, size, chunk_n))
-                    window = asyncio.Semaphore(
-                        max(1, cfg.object_transfer_parallelism))
+                    await asyncio.shield(prev)
+                except Exception:
+                    pass
+            try:
+                await self.worker_clients.get(owner).call_retry(
+                    method, object_id=object_id,
+                    node_id=self.node_id.hex(),
+                    address=self.server.address, _timeout=15.0)
+            except Exception:
+                pass
 
-                    async def pull(off: int):
-                        async with window:
-                            n = min(chunk_n, size - off)
-                            t_c = time.time()
-                            chunk = await client.call(
-                                "read_chunk", object_id=object_id,
-                                offset=off, length=n)
-                            seg.view()[off:off + len(chunk)] = chunk
-                            self._trace_transfer(
-                                kind="chunk",
-                                object=object_id.hex()[:12],
-                                source=addr, offset=off, bytes=n,
-                                t0=t_c, t1=time.time())
+        t = asyncio.ensure_future(_send())
+        self._loc_updates[key] = t
+        self._bg_tasks.add(t)
 
-                    pulls = [asyncio.ensure_future(pull(o)) for o in offsets]
-                    try:
-                        await asyncio.gather(*pulls)
-                    except BaseException:
-                        # stragglers must stop before store.free unmaps the
-                        # segment they write into
-                        for t in pulls:
-                            t.cancel()
-                        await asyncio.gather(*pulls, return_exceptions=True)
-                        raise
-                    self.store.seal(object_id)
-                    if owner:
-                        # register as a new source for later pullers
-                        try:
-                            await self.worker_clients.get(owner).notify(
-                                "add_object_location", object_id=object_id,
-                                node_id=self.node_id.hex(),
-                                address=self.server.address)
-                        except Exception:
-                            pass
-                    located = self.store.get_path(object_id)
-                    if located is None:
-                        # freed/evicted while the pull's awaits ran
-                        raise RuntimeError(
-                            f"object {object_id} vanished during pull")
-                    path, sz = located
-                    return {"path": path, "size": sz}
-                except Exception as e:  # noqa: BLE001 — try next location
-                    last_err = e
-                    self.store.free(object_id)
+        def _done(task, _key=key):
+            self._bg_tasks.discard(task)
+            if self._loc_updates.get(_key) is task:
+                del self._loc_updates[_key]
+
+        t.add_done_callback(_done)
+
+    async def _pull_object_chunks(self, object_id: ObjectID, size: int,
+                                  sources: List[str], owner: Optional[str],
+                                  cfg) -> dict:
+        """Chunk-ledger striped byte pull (the cross-host broadcast path).
+
+        Chunks are scheduled across ALL known sources concurrently
+        (per-source windows, work-stealing of slow chunks, chunk-granular
+        retry on another source), every landed chunk is published so this
+        node relays the broadcast while still pulling, and the owner's
+        location view is re-polled mid-pull to fold in new sources.  See
+        ``core/transfer.py`` for the engine."""
+        if not sources and not owner:
             raise RuntimeError(
-                f"failed to fetch {object_id} from {locations}: {last_err}")
+                f"failed to fetch {object_id}: no locations and no owner")
+        import random as _random
+        self.store.create(object_id, size)
+        # Transfer pin for the pull's whole duration: partial serving
+        # registers this node with the owner after the FIRST chunk, so an
+        # owner-side free can now arrive MID-PULL — unpinned, it would
+        # complete immediately and recycle the arena range under the
+        # in-flight chunk landings (create+pin run in one loop tick, so
+        # the free cannot slip between them).  Pinned, the free defers;
+        # the unpin below completes it and the pull reports "vanished".
+        self.store.pin(object_id)
+        seg = self.store._entries[object_id].segment
+        # per-puller permuted claim order (rarest-first in spirit): the
+        # pullers of one broadcast land COMPLEMENTARY ranges, so partial
+        # serving actually relays — in lockstep 0..N order every peer only
+        # ever holds the prefix the others already have
+        n_chunks = max(1, -(-size // cfg.object_transfer_chunk_bytes))
+        order = list(range(n_chunks))
+        _random.shuffle(order)
+        ledger = ChunkLedger(size, cfg.object_transfer_chunk_bytes,
+                             order=order)
+        partial = cfg.object_transfer_partial_serving
+        registered = False
+
+        def on_chunk(i, off, n, addr, t0, t1, stolen):
+            nonlocal registered
+            if partial:
+                # publish the landed range BEFORE registering as a source:
+                # a puller that finds us must find bytes
+                self.store.mark_available(object_id, off, n)
+            self._trace_transfer(
+                kind="chunk", object=object_id.hex()[:12], source=addr,
+                offset=off, bytes=n, t0=t0, t1=t1, stolen=stolen)
+            if partial and not registered and owner:
+                registered = True
+                self._register_object_location(owner, object_id)
+
+        async def fetch_chunk(addr, off, n):
+            return await self._fetch_chunk(object_id, seg, addr, off, n,
+                                           cfg)
+
+        async def probe_source(addr):
+            try:
+                info = await self.agent_clients.get(addr).call(
+                    "object_info", object_id=object_id, _timeout=5.0)
+            except Exception:
+                return None
+            if not info:
+                return None
+            if info.get("partial"):
+                return {"full": False, "ranges": info.get("ranges") or []}
+            return {"full": True}
+
+        async def refresh_sources():
+            rec = await self.worker_clients.get(owner).call(
+                "locate_object", object_id=object_id, timeout=0,
+                _timeout=5.0)
+            if rec and rec[0] == "plasma":
+                return [addr for _nid, addr in rec[2]
+                        if addr != self.server.address]
+            return []
+
+        puller = StripedPull(
+            ledger, fetch_chunk=fetch_chunk, probe_source=probe_source,
+            refresh_sources=refresh_sources if owner else None,
+            on_chunk=on_chunk,
+            per_source_window=cfg.object_transfer_per_source_window,
+            total_window=cfg.object_transfer_parallelism,
+            steal_after_s=cfg.object_transfer_steal_after_s,
+            max_source_failures=cfg.object_transfer_max_source_failures,
+            refresh_period_s=cfg.object_transfer_source_refresh_s,
+            stall_timeout_s=cfg.object_transfer_stall_timeout_s)
+        t_pull = time.time()
+        try:
+            try:
+                stats = await puller.run(sources)
+            except asyncio.CancelledError:
+                # engine teardown already awaited every in-flight landing,
+                # so freeing the segment cannot race a late chunk write
+                if registered and owner:
+                    self._deregister_object_location(owner, object_id)
+                self.store.free(object_id)  # defers under our pin
+                raise
+            except BaseException as e:  # noqa: BLE001
+                if registered and owner:
+                    # withdraw the early partial registration — the owner
+                    # must not keep routing pullers at a freed segment
+                    self._deregister_object_location(owner, object_id)
+                self.store.free(object_id)  # defers under our pin
+                raise RuntimeError(
+                    f"failed to fetch {object_id} from {sources}: {e}"
+                ) from e
+            self.store.seal(object_id)
+        finally:
+            # releases the transfer pin; completes any free deferred
+            # during the pull (our own failure free above, or an
+            # owner-side free that raced the broadcast)
+            self.store.unpin(object_id)
+        self._trace_transfer(
+            kind="pull_summary", object=object_id.hex()[:12], bytes=size,
+            t0=t_pull, t1=time.time(), **stats)
+        if owner:
+            self._register_object_location(owner, object_id)
+        located = self.store.get_path(object_id)
+        if located is None:
+            # owner freed it mid-pull (the deferred free completed on our
+            # unpin): the object is gone — report it, never serve it
+            raise RuntimeError(f"object {object_id} vanished during pull")
+        path, sz = located
+        return {"path": path, "size": sz}
+
+    async def _fetch_chunk(self, object_id: ObjectID, seg, addr: str,
+                           off: int, n: int, cfg) -> int:
+        """Land one chunk from ``addr`` into the destination segment.
+
+        The reply's out-of-band buffer lands DIRECTLY into the segment
+        view (``call_into`` readinto-style receive) — no intermediate
+        ``bytes``, no slice-assign: zero extra copies on this side beyond
+        the socket read itself.  Returns the byte count landed; the engine
+        rejects short chunks (a truncated reply must never seal a corrupt
+        object)."""
+        client = self.agent_clients.get(addr)
+        sink = seg.view()[off:off + n]
+        with_crc = cfg.object_transfer_checksum
+        if with_crc:
+            # Checksum mode trades the zero-copy landing for soundness: a
+            # work-steal hedge means a straggler duplicate reply can arrive
+            # AFTER another source already landed this chunk — landing
+            # unverified bytes in place would overwrite a DONE chunk the
+            # ledger will never re-pull (fail on DONE is a no-op).  Fetch
+            # to a scratch buffer, verify, THEN copy.
+            try:
+                res = await client.call(
+                    "read_chunk",
+                    _timeout=cfg.object_transfer_chunk_timeout_s,
+                    object_id=object_id, offset=off, length=n,
+                    with_crc=True)
+            except RemoteError as e:
+                if isinstance(e.cause, ChunkNotAvailable):
+                    raise e.cause from None
+                raise
+            crc, algo, data = res["crc"], res["algo"], res["data"]
+            landed = data.nbytes if isinstance(data, memoryview) \
+                else len(data)
+            if landed == n:
+                got, got_algo = chunk_checksum(data)
+                if got_algo == algo and got != crc:
+                    raise ChunkCrcError(
+                        f"chunk [{off}, {off + n}) from {addr}: checksum "
+                        f"mismatch ({got:#x} != {crc:#x})")
+                sink[:n] = data
+            return landed
+        try:
+            res = await client.call_into(
+                "read_chunk", sink,
+                _timeout=cfg.object_transfer_chunk_timeout_s,
+                object_id=object_id, offset=off, length=n)
+        except RemoteError as e:
+            if isinstance(e.cause, ChunkNotAvailable):
+                # typed partial miss: the engine re-stripes the chunk and
+                # re-probes this source's advertised ranges
+                raise e.cause from None
+            raise
+        if isinstance(res, memoryview):
+            return res.nbytes     # landed in place by the sink receive
+        landed = len(res)         # small in-band reply: place it ourselves
+        if landed <= n:
+            sink[:landed] = res
+        return landed
 
     # ------------------------------------------------------------ OOM defense
 

@@ -36,6 +36,41 @@ class ObjectStoreFullError(Exception):
     pass
 
 
+class ChunkNotAvailable(Exception):
+    """``read_chunk`` hit a range an in-progress (partial) holder has not
+    landed yet — the puller should re-stripe the chunk onto another source
+    and re-probe this one's advertised ranges, NOT treat the holder as
+    dead.  Travels across the RPC boundary as a RemoteError cause."""
+
+
+# -- sealed-range bookkeeping (partial-object serving) ----------------------
+
+def range_add(ranges: list, start: int, end: int) -> list:
+    """Fold [start, end) into a sorted, merged list of [start, end) pairs."""
+    out = []
+    placed = False
+    for s, e in ranges:
+        if e < start or s > end:
+            if not placed and s > end:
+                out.append([start, end])
+                placed = True
+            out.append([s, e])
+        else:
+            start, end = min(s, start), max(e, end)
+    if not placed:
+        out.append([start, end])
+    out.sort()
+    return out
+
+
+def range_covers(ranges: list, start: int, end: int) -> bool:
+    """True iff [start, end) lies inside one merged range."""
+    for s, e in ranges:
+        if s <= start and end <= e:
+            return True
+    return False
+
+
 # ---------------------------------------------------------------------------
 # Shared-memory segments
 # ---------------------------------------------------------------------------
@@ -168,6 +203,11 @@ class _Entry:
     pinned: int = 0          # pin count: live reader views + peer transfers
     freed: bool = False      # owner freed it while pins were live (deferred)
     last_access: float = field(default_factory=time.monotonic)
+    #: sealed [start, end) byte ranges of an UNSEALED entry being pulled —
+    #: the chunk ledger publishes each landed chunk here so ``read_chunk``
+    #: can serve it to later pullers before the whole object seals
+    #: (partial-object serving; None once sealed / for plain writers).
+    avail: Optional[list] = None
 
 
 @dataclass
@@ -207,6 +247,9 @@ class NodeObjectStore:
         # Same-host zero-copy references (see _ProxyEntry): not counted
         # against capacity — the bytes live in the source node's arena.
         self._proxies: Dict[ObjectID, _ProxyEntry] = {}
+        # attach-mode cache for serving chunks of paths this store does
+        # not own (proxy relaying; see _attach_view)
+        self._attach_maps: Dict[str, ShmSegment] = {}
         self._sealed_events: Dict[ObjectID, asyncio.Event] = {}
         self.num_creates = 0
         self.num_evictions = 0
@@ -329,9 +372,26 @@ class NodeObjectStore:
     def seal(self, object_id: ObjectID):
         e = self._entries[object_id]
         e.sealed = True
+        e.avail = None  # full: range map no longer meaningful
         ev = self._sealed_events.pop(object_id, None)
         if ev:
             ev.set()
+
+    def mark_available(self, object_id: ObjectID, offset: int, length: int):
+        """Publish one landed chunk of an in-progress pull: ``read_chunk``
+        serves it and ``object_info`` advertises it from now on."""
+        e = self._entries.get(object_id)
+        if e is None or e.sealed or e.freed:
+            return
+        e.avail = range_add(e.avail or [], offset, offset + length)
+
+    def available_ranges(self, object_id: ObjectID) -> Optional[list]:
+        """Sealed ranges of an UNSEALED entry (None when nothing landed or
+        the object is sealed/freed/absent)."""
+        e = self._entries.get(object_id)
+        if e is None or e.sealed or e.freed:
+            return None
+        return e.avail
 
     # -- reads ------------------------------------------------------------
 
@@ -388,14 +448,42 @@ class NodeObjectStore:
     def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
         e = self._entries.get(object_id)
         if e is None:
+            # Same-host proxy holders ARE byte sources: serve straight off
+            # the source pool slice / file the proxy references (remote
+            # pullers that can't zero-copy attach still get the bytes).
+            p = self._proxies.get(object_id)
+            if p is not None and not p.freed:
+                return bytes(self._attach_view(p.path, p.size)
+                             [offset:offset + length])
             self._maybe_restore(object_id)
             e = self._entries[object_id]
         if e.freed:
             # deleted, just not yet reclaimed (reader pins live): a remote
             # puller must try another source, not copy a freed object
             raise KeyError(f"object {object_id} is freed")
+        if not e.sealed:
+            # partial holder (an in-progress pull publishing its ledger):
+            # serve only ranges that actually landed — anything else is a
+            # typed miss the puller re-stripes, never silent garbage
+            if not (e.avail and range_covers(e.avail, offset,
+                                             offset + length)):
+                raise ChunkNotAvailable(
+                    f"object {object_id}: [{offset}, {offset + length}) "
+                    f"not yet held (have {e.avail or []})")
         e.last_access = time.monotonic()
         return bytes(e.segment.view()[offset:offset + length])
+
+    def _attach_view(self, path: str, size: int) -> memoryview:
+        """Attach-mode view over a path this store does not own (proxy
+        serving); file-backed attaches are cached like ShmReader's."""
+        if "#" in path:
+            pool_path, off = path.rsplit("#", 1)
+            return _pool_attach.view(pool_path, int(off), size)
+        seg = self._attach_maps.get(path)
+        if seg is None:
+            seg = ShmSegment(path, size, create=False)
+            self._attach_maps[path] = seg
+        return seg.view()[:size]
 
     def size_of(self, object_id: ObjectID) -> Optional[int]:
         e = self._entries.get(object_id)
@@ -493,6 +581,13 @@ class NodeObjectStore:
 
     def _complete_free(self, object_id: ObjectID) -> Optional[str]:
         proxy = self._proxies.pop(object_id, None)
+        if proxy is not None:
+            # drop the chunk-serving attach mapping (if any): holding it
+            # past the proxy's life would keep the origin's unlinked shm
+            # pages resident forever on a long-lived agent
+            seg = self._attach_maps.pop(proxy.path, None)
+            if seg is not None:
+                seg.close()
         # A freed object may live in shm, on the spill disk, or both.
         spilled = self._spilled.pop(object_id, None)
         if spilled:
@@ -501,6 +596,13 @@ class NodeObjectStore:
             except OSError:
                 pass
         e = self._entries.pop(object_id, None)
+        # Freeing an UNSEALED entry (a failed striped pull) must wake any
+        # wait_sealed() waiter NOW: they re-resolve (get_path -> None ->
+        # remote pull) instead of sleeping out their full timeout against
+        # an event nothing will ever set.
+        ev = self._sealed_events.pop(object_id, None)
+        if ev:
+            ev.set()
         if e is None:
             return proxy.source_addr if proxy else None
         self.used -= e.size
@@ -600,6 +702,9 @@ class NodeObjectStore:
         return rows
 
     def shutdown(self):
+        for seg in self._attach_maps.values():
+            seg.close()
+        self._attach_maps.clear()
         for oid in list(self._entries):
             self.free(oid, force=True)
         # spill files of still-referenced-but-evicted objects would otherwise

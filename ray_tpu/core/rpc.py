@@ -154,7 +154,8 @@ def _encode(msg) -> bytes:
 # Vectored large-frame protocol: a frame whose length word has the top bit
 # set carries out-of-band buffers after the pickle stream —
 #
-#   [4B  VEC_FLAG | len(payload)] [payload] [4B nbufs] [8B size]*nbufs [buf]*
+#   [4B VEC_FLAG | len(payload)] [payload] [4B nbufs] [8B hint]
+#                                          [8B size]*nbufs [buf]*
 #
 # Large buffer-protocol payloads (object chunks, big inlined task args) ride
 # as raw bytes instead of being re-copied through the pickle stream: the
@@ -162,6 +163,14 @@ def _encode(msg) -> bytes:
 # see _flush_writer's large-part handling), and the receiver reads each into
 # its own contiguous allocation and hands it to pickle out-of-band.  That
 # removes one full-payload copy per side versus in-band pickling.
+#
+# ``hint`` is the reply's req_id (0 for requests/notifies): it lets the
+# CLIENT route the first out-of-band buffer into a pre-registered
+# destination view (``RpcClient.call_into`` — chunk pulls land readinto-
+# style straight into the target shm segment, skipping the intermediate
+# ``bytes`` materialization AND the slice-assign copy).  The req_id cannot
+# serve this purpose from inside the payload: pickle.loads needs the
+# buffers BEFORE it can surface the req_id.
 _VEC_FLAG = 0x8000_0000
 #: buffers below this stay in-band (framing + syscall overhead dominates)
 _VEC_MIN_BUF = 256 * 1024
@@ -169,9 +178,10 @@ _VEC_MIN_BUF = 256 * 1024
 _LARGE_PART = 128 * 1024
 
 
-def _encode_parts(msg) -> list:
+def _encode_parts(msg, hint: int = 0) -> list:
     """Encode ``msg``, extracting large contiguous buffers out-of-band.
-    Returns a list of wire parts (length 1 == a regular frame)."""
+    Returns a list of wire parts (length 1 == a regular frame).  ``hint``
+    rides the vectored header (the reply's req_id; see protocol note)."""
     bufs: list = []
 
     def _cb(pb: pickle.PickleBuffer):
@@ -191,6 +201,7 @@ def _encode_parts(msg) -> list:
         return [len(payload).to_bytes(4, "big") + payload]
     head = ((_VEC_FLAG | len(payload)).to_bytes(4, "big") + payload
             + len(bufs).to_bytes(4, "big")
+            + max(0, hint).to_bytes(8, "big")
             + b"".join(b.nbytes.to_bytes(8, "big") for b in bufs))
     return [head] + bufs
 
@@ -218,14 +229,15 @@ def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
         asyncio.get_event_loop().call_soon(_flush_writer, writer)
 
 
-def coalesced_write_frame(writer: "asyncio.StreamWriter", msg) -> int:
+def coalesced_write_frame(writer: "asyncio.StreamWriter", msg,
+                          hint: int = 0) -> int:
     """Encode + queue one message, using the vectored wire format when the
     payload carries large buffers.  Vectored frames flush IMMEDIATELY (in
     FIFO order with everything already queued): their out-of-band parts are
     views over caller memory that must not dangle across a loop tick, and a
     multi-MB frame gains nothing from coalescing anyway.  Returns the wire
     bytes queued (the RPC byte counters' data source)."""
-    parts = _encode_parts(msg)
+    parts = _encode_parts(msg, hint)
     if len(parts) == 1:
         coalesced_write(writer, parts[0])
         return len(parts[0])
@@ -288,26 +300,94 @@ async def drain_if_needed(writer: "asyncio.StreamWriter",
         pass
 
 
-async def _read_msg(reader: asyncio.StreamReader):
-    """-> (message, wire_bytes) for one frame."""
+class _OobSink:
+    """A registered destination for one reply's out-of-band buffer (see
+    ``RpcClient.call_into``).  ``done`` is set once the read loop has
+    finished (or abandoned) landing into ``view`` — the caller's cleanup
+    awaits it so no late frame can write into memory the caller is about
+    to recycle."""
+
+    __slots__ = ("view", "started", "done")
+
+    def __init__(self, view: memoryview):
+        self.view = view
+        self.started = False
+        self.done = asyncio.Event()
+
+
+async def _read_buffer_into(reader: asyncio.StreamReader,
+                            view: memoryview) -> None:
+    """readinto-style exact read: drain the stream buffer DIRECTLY into
+    ``view`` (one copy) instead of materializing an intermediate ``bytes``
+    and slice-assigning it (two copies).  Uses StreamReader's internal
+    buffer the same way readexactly does; falls back to readexactly+copy
+    if the internals ever change shape."""
+    n = view.nbytes
+    buf = getattr(reader, "_buffer", None)
+    if buf is None or not hasattr(reader, "_wait_for_data") \
+            or not hasattr(reader, "_maybe_resume_transport"):
+        view[:] = await reader.readexactly(n)
+        return
+    pos = 0
+    while pos < n:
+        exc = reader.exception()
+        if exc is not None:
+            raise exc
+        if buf:
+            take = min(len(buf), n - pos)
+            with memoryview(buf) as mv:
+                view[pos:pos + take] = mv[:take]
+            del buf[:take]
+            reader._maybe_resume_transport()
+            pos += take
+            continue
+        if reader.at_eof():
+            raise asyncio.IncompleteReadError(b"", n)
+        await reader._wait_for_data("_read_buffer_into")
+
+
+async def _read_msg(reader: asyncio.StreamReader,
+                    sinks: Optional[Dict[int, _OobSink]] = None):
+    """-> (message, wire_bytes) for one frame.
+
+    ``sinks`` (client side only): req_id -> _OobSink.  When a vectored
+    reply's hint matches a registered sink, its first out-of-band buffer
+    is landed readinto-style straight into the sink view and that view is
+    handed to pickle — zero-extra-copy receive for chunk pulls."""
     hdr = await reader.readexactly(4)
     n = int.from_bytes(hdr, "big")
     if not n & _VEC_FLAG:
         return pickle.loads(await reader.readexactly(n)), 4 + n
     # Vectored frame: pickle stream + out-of-band buffers.  Each buffer is
-    # read into its own allocation and handed to pickle out-of-band — the
-    # receive path's only copy; in-band pickling would pay a second one
+    # read into its own allocation (or the registered sink) and handed to
+    # pickle out-of-band — in-band pickling would pay an extra copy
     # materializing the bytes out of the stream.
     plen = n & (_VEC_FLAG - 1)
     payload = await reader.readexactly(plen)
     nbufs = int.from_bytes(await reader.readexactly(4), "big")
+    hint = int.from_bytes(await reader.readexactly(8), "big")
     sizes_raw = await reader.readexactly(8 * nbufs)
     bufs = []
-    total = 8 + plen + 8 * nbufs
-    for i in range(nbufs):
-        size = int.from_bytes(sizes_raw[8 * i:8 * i + 8], "big")
-        bufs.append(await reader.readexactly(size))
-        total += size
+    total = 16 + plen + 8 * nbufs
+    entry = sinks.pop(hint, None) if (sinks is not None and hint) else None
+    try:
+        for i in range(nbufs):
+            size = int.from_bytes(sizes_raw[8 * i:8 * i + 8], "big")
+            if entry is not None and size <= entry.view.nbytes:
+                entry.started = True
+                try:
+                    target = entry.view[:size]
+                    await _read_buffer_into(reader, target)
+                    bufs.append(target)
+                finally:
+                    entry.done.set()
+                entry = None
+            else:
+                bufs.append(await reader.readexactly(size))
+            total += size
+    finally:
+        if entry is not None:  # popped but unused (size mismatch)
+            entry.done.set()
     return pickle.loads(payload, buffers=bufs), total
 
 
@@ -549,7 +629,10 @@ class RpcServer:
                 return
             try:
                 try:
-                    n = coalesced_write_frame(writer, (req_id, ok, result))
+                    # hint=req_id lets the client land this reply's
+                    # out-of-band buffer into a pre-registered sink
+                    n = coalesced_write_frame(writer, (req_id, ok, result),
+                                              hint=req_id)
                 except (ConnectionResetError, BrokenPipeError):
                     return
                 except Exception:
@@ -605,6 +688,9 @@ class RpcClient:
         # call_start parked at an await (chaos delay) could still insert —
         # that call then hung to its full timeout instead of failing fast.
         self._pending: Dict[int, asyncio.Future] = {}
+        #: req_id -> _OobSink, per connection like _pending: registered
+        #: destination views for replies' out-of-band buffers (call_into)
+        self._sinks: Dict[int, _OobSink] = {}
         self._req_ids = itertools.count(1)
         self._connect_lock: asyncio.Lock | None = None
         self._closed = False
@@ -627,18 +713,20 @@ class RpcClient:
                                         limit=16 << 20),
                 timeout=cfg.rpc_connect_timeout_s)
             self._pending = {}
+            self._sinks = {}
             if self._connected_once:
                 m = rpc_metrics()
                 if m is not None:
                     m.reconnects.inc()
             self._connected_once = True
             asyncio.ensure_future(
-                self._read_loop(self._reader, self._writer, self._pending))
+                self._read_loop(self._reader, self._writer, self._pending,
+                                self._sinks))
 
-    async def _read_loop(self, reader, writer, pending):
+    async def _read_loop(self, reader, writer, pending, sinks):
         try:
             while True:
-                msg, nbytes = await _read_msg(reader)
+                msg, nbytes = await _read_msg(reader, sinks)
                 req_id, ok, payload = msg
                 if req_id < 0:  # server push
                     if self._push_handler:
@@ -676,6 +764,11 @@ class RpcClient:
                 if not fut.done():
                     fut.set_exception(err)
             pending.clear()
+            # never-consumed sinks can't be written anymore: release any
+            # call_into cleanup parked on them
+            for entry in sinks.values():
+                entry.done.set()
+            sinks.clear()
 
     def _chaos_pre(self, method: str):
         """Client-side chaos consultation for one outbound frame:
@@ -701,16 +794,22 @@ class RpcClient:
             except Exception:
                 pass
 
-    async def call_start(self, method: str, **kwargs) -> "asyncio.Future":
+    async def call_start(self, method: str, _oob_sink=None,
+                         **kwargs) -> "asyncio.Future":
         """Issue the request and return its response future without awaiting it.
         Successive call_start invocations hit the server in program order —
         used for actor-call sequencing (reference: per-handle sequence numbers
-        in CoreWorkerDirectActorTaskSubmitter)."""
+        in CoreWorkerDirectActorTaskSubmitter).
+
+        ``_oob_sink`` (a writable memoryview) registers a destination for
+        the reply's first out-of-band buffer: the read loop lands it there
+        readinto-style (see call_into), and the reply object pickle returns
+        is a view over that memory."""
         if self._closed:
             raise RpcError("client closed")
         inj, delay = self._chaos_pre(method)
         await self._ensure_connected()
-        writer, pending = self._writer, self._pending
+        writer, pending, sinks = self._writer, self._pending, self._sinks
         if delay > 0.0:
             await asyncio.sleep(delay)
             # the connection may have died (or been replaced) during the
@@ -722,6 +821,10 @@ class RpcClient:
         req_id = next(self._req_ids)
         fut = asyncio.get_event_loop().create_future()
         pending[req_id] = fut
+        if _oob_sink is not None:
+            entry = _OobSink(_oob_sink)
+            sinks[req_id] = entry
+            fut._raytpu_sink = (sinks, req_id, entry, writer)
         if inj is not None and inj.should("drop_request", method,
                                           self.address):
             nbytes = 0
@@ -761,6 +864,49 @@ class RpcClient:
         fut = await self.call_start(method, **kwargs)
         timeout = _timeout if _timeout is not None else get_config().rpc_call_timeout_s
         return await asyncio.wait_for(fut, timeout)
+
+    async def call_into(self, method: str, sink: memoryview,
+                        _timeout: float | None = None, **kwargs) -> Any:
+        """``call`` whose reply's out-of-band buffer lands DIRECTLY into
+        ``sink`` (zero-extra-copy receive: stream buffer -> sink, no
+        intermediate bytes, no slice-assign).  The returned value for an
+        out-of-band reply is a (readonly) memoryview over ``sink``; small
+        in-band replies still return bytes the caller must place itself.
+
+        The finally block guarantees that once this coroutine returns — by
+        result, error, timeout or cancellation — NO late frame can write
+        into ``sink``: the registration is withdrawn, or a landing already
+        in progress is awaited to completion.  Callers may recycle the
+        memory behind ``sink`` immediately after."""
+        fut = await self.call_start(method, _oob_sink=sink, **kwargs)
+        timeout = (_timeout if _timeout is not None
+                   else get_config().rpc_call_timeout_s)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            info = getattr(fut, "_raytpu_sink", None)
+            if info is not None:
+                sinks, req_id, entry, writer = info
+                if sinks.get(req_id) is entry:
+                    del sinks[req_id]  # read loop never took it: safe now
+                elif entry.started and not entry.done.is_set():
+                    # landing in progress on the read loop: wait it out so
+                    # the caller can recycle the sink's memory
+                    try:
+                        await asyncio.wait_for(entry.done.wait(), 30.0)
+                    except asyncio.TimeoutError:
+                        # a landing wedged mid-stream for 30 s: kill the
+                        # connection so the read loop aborts NOW — the
+                        # no-late-write guarantee must hold even here
+                        # (the caller may recycle an arena range next)
+                        try:
+                            writer.transport.abort()
+                        except Exception:
+                            pass
+                        try:
+                            await asyncio.wait_for(entry.done.wait(), 10.0)
+                        except asyncio.TimeoutError:
+                            pass
 
     async def call_retry(self, method: str, _timeout: float | None = None,
                          _attempts: int | None = None,

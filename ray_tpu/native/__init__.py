@@ -121,8 +121,18 @@ def load_crc32c():
         return _crc_fn
 
 
-def _crc_fn(data: bytes, seed: int = 0) -> int:
-    return _CRC_LIB.rt_crc32c(data, len(data), seed)
+def _crc_fn(data, seed: int = 0) -> int:
+    if isinstance(data, bytes):
+        return _CRC_LIB.rt_crc32c(data, len(data), seed)
+    # Buffer-protocol payloads (the transfer plane checksums chunks that
+    # landed directly in a shm segment view): hand ctypes the buffer
+    # in place — round-tripping through bytes() would copy the chunk.
+    mv = memoryview(data)
+    if mv.readonly:
+        arr = (ctypes.c_char * mv.nbytes).from_buffer_copy(mv)
+    else:
+        arr = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+    return _CRC_LIB.rt_crc32c(arr, mv.nbytes, seed)
 
 
 class ShmPool:
