@@ -146,8 +146,16 @@ def main():
                         "CPU-sized via --preset; auto on CPU is unwise)")
     args = p.parse_args()
 
-    import jax
-    import jax.numpy as jnp
+    try:
+        import jax
+        import jax.numpy as jnp  # noqa: F401
+    except Exception as e:  # a TPU-terminal plugin can raise at import
+        print(json.dumps({
+            "metric": "train_tokens_per_sec_per_chip",
+            "skipped": "no TPU",
+            "error": f"jax import failed: {str(e).splitlines()[0][:300]}",
+        }), flush=True)
+        return
 
     devices = _devices_or_skip(jax, timeout_s=args.backend_timeout)
     if devices[0].platform == "cpu" and args.preset != "debug" \

@@ -94,6 +94,36 @@ def run_suite(S: float, with_serve: bool) -> dict:
         results["tasks_async"] = timeit(
             lambda: ray_tpu.get([noop.remote() for _ in range(n)]), n)
 
+        # submit_burst: 1k no-arg tasks submitted back-to-back, then one
+        # batched get — end-to-end ops/s PLUS percentiles of the bare
+        # .remote() submission call (the user-thread cost the fast path's
+        # spec-template cache and submit coalescing shave).
+        nb = int(1000 * S)
+        results["submit_burst_submit_us_p50"] = []
+        results["submit_burst_submit_us_p99"] = []
+        burst_calls = [0]
+
+        def burst():
+            burst_calls[0] += 1
+            t_sub = []
+            refs = []
+            for _ in range(nb):
+                s0 = time.perf_counter()
+                refs.append(noop.remote())
+                t_sub.append(time.perf_counter() - s0)
+            ray_tpu.get(refs)
+            if burst_calls[0] == 1:
+                return  # timeit()'s warmup pass: cold-path latencies
+                # (lease acquisition, spec-cache fill) must not skew the
+                # warm percentiles — ops/s already excludes warmup
+            t_sub.sort()
+            results["submit_burst_submit_us_p50"].append(
+                t_sub[len(t_sub) // 2] * 1e6)
+            results["submit_burst_submit_us_p99"].append(
+                t_sub[min(len(t_sub) - 1, int(len(t_sub) * 0.99))] * 1e6)
+
+        results["submit_burst"] = timeit(burst, nb)
+
         a = Counter.remote()
         ray_tpu.get(a.ping.remote())
         n = int(300 * S)
@@ -174,6 +204,65 @@ def run_suite(S: float, with_serve: bool) -> dict:
     return results
 
 
+#: the "off" arm of the fast-path A/B: result inlining, spec template
+#: caching, and lease pipelining all disabled — results route through the
+#: shm store (worker-side store_create + caller-side fetch per result) and
+#: every submission re-encodes its full spec, isolating exactly what the
+#: submission fast path buys on this box in this run.
+FASTPATH_OFF = {"inline_result_max_bytes": 0,
+                "spec_cache_enabled": False,
+                "lease_pipeline_window": 0}
+
+
+def _measure_submission(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster measurement of the submission-plane metrics only
+    (the A/B arms; full-suite metrics stay with run_suite)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=8, object_store_memory=2 << 30,
+                 _system_config=system_config or None)
+    out = {}
+
+    @ray_tpu.remote
+    def noop(_x=None):
+        return None
+
+    @ray_tpu.remote
+    class Counter:
+        def ping(self):
+            return None
+
+    try:
+        ray_tpu.get([noop.remote() for _ in range(8)])
+        n = int(1000 * S)
+        out["tasks_async"] = max(timeit(
+            lambda: ray_tpu.get([noop.remote() for _ in range(n)]), n))
+        a = Counter.remote()
+        ray_tpu.get(a.ping.remote())
+        n = int(300 * S)
+        out["actor_calls_sync_1_1"] = max(timeit(
+            lambda: [ray_tpu.get(a.ping.remote()) for _ in range(n)], n))
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_fastpath(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: fast path ON vs OFF, alternating fresh
+    clusters so box drift lands evenly on both arms."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_submission(S, None))
+        off_runs.append(_measure_submission(S, dict(FASTPATH_OFF)))
+        print(f"# ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": FASTPATH_OFF, "ratio_on_off": ratio}
+
+
 def main():
     global _REPS
     p = argparse.ArgumentParser()
@@ -188,6 +277,10 @@ def main():
                         "aggregate reports median + IQR + min per metric")
     p.add_argument("--reps", type=int, default=_REPS,
                    help="timed repetitions per metric within one suite pass")
+    p.add_argument("--ab-fastpath", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of the "
+                        "submission fast path (inlining + spec caching + "
+                        "lease pipelining) on vs off and embed the ratios")
     args = p.parse_args()
     _REPS = max(args.reps, 1)
 
@@ -222,6 +315,8 @@ def main():
            "min": {k: round(min(samples[k]), 1) for k in metrics},
            "vs_baseline": {k: round(med[k] / BASELINE[k], 3)
                            for k in metrics if k in BASELINE}}
+    if args.ab_fastpath > 0:
+        out["fastpath_ab"] = run_ab_fastpath(args.scale, args.ab_fastpath)
     line = json.dumps(out)
     print(line)
     if args.out:

@@ -73,6 +73,40 @@ class Config:
     max_tasks_in_flight_per_worker: int = 16
     #: Max actor calls coalesced into one batched submission RPC per handle.
     actor_call_pipeline: int = 32
+
+    # -- submission fast path ---------------------------------------------
+    #: Task/actor RETURN values at or under this many bytes travel back
+    #: inside the task-reply frame and land directly in the caller's
+    #: in-process store — no worker-side ``store_create`` and no
+    #: caller-side fetch RPC per result.  0 disables result inlining
+    #: entirely (every result goes through the shm store; the perf A/B's
+    #: "off" arm).  Streaming-generator yields are NOT governed by this
+    #: knob — they keep the plain ``max_direct_call_object_size``
+    #: threshold so the yield pipeline is unchanged.
+    inline_result_max_bytes: int = 100 * 1024
+    #: TaskSpec template cache: the invariant portion of a spec (function
+    #: descriptor, options, runtime-env hash) is wire-encoded once per
+    #: (function, options) pair and interned by hash on the receiving
+    #: worker, so each submission ships only args + ids (core/spec_cache.py).
+    spec_cache_enabled: bool = True
+    #: Bounded LRU size of the spec template cache, both sender side
+    #: (encoded template blobs) and receiver side (interned prototypes).
+    spec_cache_max_entries: int = 512
+    #: Lease pipelining: when a pool has unmet demand it requests this many
+    #: leases BEYOND the current deficit, so the next submission burst finds
+    #: a granted worker instead of paying a lease round trip.  0 disables.
+    lease_pipeline_window: int = 1
+    #: Return a leased worker after it has executed this many tasks even if
+    #: more are queued (bounds lease reuse so one pool cannot monopolise a
+    #: node's workers; 0 = unlimited reuse).
+    lease_reuse_max_tasks: int = 0
+    #: Owner-side idle-lease return delay in milliseconds: a leased worker
+    #: idle this long with nothing queued is returned to the agent.
+    lease_idle_return_ms: float = 2000.0
+    #: Max leases requested from one agent in a single batched
+    #: ``request_worker_leases`` RPC (same-tick submission bursts coalesce
+    #: their lease demand into one control-plane round trip).
+    submit_batch_max: int = 16
     #: Spill directory ("" = default under /tmp; "off" disables spilling).
     object_spilling_dir: str = ""
     #: Spill when store utilization exceeds this fraction.

@@ -38,7 +38,7 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import serialization
+from . import serialization, spec_cache
 from .common import (STREAMING_RETURNS, ActorDiedError, GetTimeoutError,
                      NodeAffinitySchedulingStrategy, ObjectLostError,
                      OutOfMemoryError, PlacementGroupSchedulingStrategy,
@@ -455,6 +455,8 @@ class LeasedWorker:
     busy: bool = False
     idle_since: float = field(default_factory=time.monotonic)
     return_scheduled: bool = False
+    #: tasks completed under this lease (``lease_reuse_max_tasks`` bound)
+    tasks_done: int = 0
 
 
 class LeasePool:
@@ -505,12 +507,21 @@ class LeasePool:
             lw.busy = True
             asyncio.ensure_future(self._run_on(lw, batch))
         # Request more leases only for demand not already covered by idle
-        # leased workers or in-flight lease requests.
+        # leased workers or in-flight lease requests.  When there IS unmet
+        # demand, pipeline: ask for ``lease_pipeline_window`` leases beyond
+        # the deficit so the next burst finds a granted worker instead of
+        # paying a lease round trip.  Same-tick demand coalesces into
+        # batched ``request_worker_leases`` RPCs of up to submit_batch_max.
+        cfg = get_config()
         deficit = len(self.queue) - len(idle) - self.requesting
+        if deficit > 0:
+            deficit += max(0, cfg.lease_pipeline_window)
         want = min(deficit, self.MAX_LEASES - len(self.leased) - self.requesting)
-        for _ in range(max(0, want)):
-            self.requesting += 1
-            asyncio.ensure_future(self._acquire_lease())
+        while want > 0:
+            batch = min(want, max(1, cfg.submit_batch_max))
+            want -= batch
+            self.requesting += batch
+            asyncio.ensure_future(self._acquire_leases(batch))
         # Return leases that ended up idle with nothing queued (covers leases
         # granted after the queue drained).
         if not self.queue:
@@ -519,11 +530,26 @@ class LeasePool:
                     lw.return_scheduled = True
                     asyncio.ensure_future(self._maybe_return(lw))
 
-    async def _acquire_lease(self):
+    async def _acquire_leases(self, count: int):
+        """Acquire up to ``count`` leases with ONE batched
+        ``request_worker_leases`` RPC per attempt — a same-tick submission
+        burst's whole lease demand rides a single control-plane round trip
+        instead of one RPC per lease.  Spillback/infeasible replies
+        retarget exactly like the old single-lease loop; a partial grant
+        returns what it got and lets the next ``_pump`` re-evaluate the
+        remaining deficit against the (possibly drained) queue."""
+        granted = 0
         try:
             target_addr = None
             hops = 0
-            while not self.w._shutdown:
+            while not self.w._shutdown and granted < count:
+                if not self.queue:
+                    # Demand drained (idle workers ate the queue, or a grant
+                    # that parked at the agent came back late): STOP
+                    # acquiring.  Without this exit a batch that can never
+                    # fill its count keeps cycling grant->idle-return->grant
+                    # forever, pinning the node's capacity.
+                    return
                 try:
                     view = await self.w.get_cluster_view()
                 except Exception:
@@ -558,8 +584,9 @@ class LeasePool:
                     # reply was lost comes back from the agent's dedup
                     # window on retry instead of leasing a SECOND worker
                     # that nothing would ever return.
-                    grant = await agent.call_retry(
-                        "request_worker_lease",
+                    res = await agent.call_retry(
+                        "request_worker_leases",
+                        count=count - granted,
                         resources=self.resources,
                         bundle=self.bundle,
                         runtime_env=self.runtime_env,
@@ -592,38 +619,75 @@ class LeasePool:
                     target_addr = None
                     await asyncio.sleep(0.2)
                     continue
-                if "worker_address" in grant:
-                    lw = LeasedWorker(grant["worker_address"], grant["worker_id"],
-                                      grant["lease_id"], grant["node_id"], target_addr)
-                    self.leased[lw.lease_id] = lw
+                grants = res.get("grants") if isinstance(res, dict) else None
+                if grants:
+                    for grant in grants:
+                        lw = LeasedWorker(grant["worker_address"],
+                                          grant["worker_id"],
+                                          grant["lease_id"],
+                                          grant["node_id"], target_addr)
+                        self.leased[lw.lease_id] = lw
+                        granted += 1
+                    if granted < count:
+                        # Partial grant: the node saturated mid-batch.  Pump
+                        # NOW so the granted workers start, then keep
+                        # acquiring the remainder — the saturated node's
+                        # slow path answers with a spillback target, which
+                        # is what spreads a burst across the cluster.
+                        self._pump()
+                        if not self.queue:
+                            return
+                        continue
                     return
-                if "spillback" in grant:
-                    target_addr = grant["spillback"]["address"]
+                if "spillback" in res:
+                    target_addr = res["spillback"]["address"]
                     hops += 1
                     continue
-                if grant.get("infeasible"):
+                if res.get("infeasible"):
                     target_addr = None
                     await asyncio.sleep(0.5)
                     continue
+                # unrecognized reply shape: back off rather than spin
+                target_addr = None
+                await asyncio.sleep(0.2)
         finally:
-            self.requesting -= 1
+            self.requesting -= count
             self._pump()
+
+    async def _push_specs(self, client, specs: List[TaskSpec]):
+        """Ship one batch to a leased worker, wire-encoding each spec
+        through the template cache (invariant portion by hash; args + ids
+        per call).  The connection is established FIRST so the encoder's
+        delivered-set tracks the connection these frames ride."""
+        await client._ensure_connected()
+        enc = self.w.spec_encoder
+        if (len(specs) == 1
+                and specs[0].num_returns != STREAMING_RETURNS):
+            return [await client.call("push_task",
+                                      spec=enc.encode(client, specs[0]),
+                                      _timeout=86400.0)]
+        # Batch RPC even for one task when it streams: only the batch
+        # handler has the live writer that yield frames ride on.
+        return await client.call("push_task_batch",
+                                 specs=[enc.encode(client, s)
+                                        for s in specs],
+                                 _timeout=86400.0)
 
     async def _run_on(self, lw: LeasedWorker, specs: List[TaskSpec]):
         client = self.w.worker_clients.get(lw.address)
         for spec in specs:
             self.w.task_event(spec, "RUNNING", node_id=lw.node_id)
         try:
-            if (len(specs) == 1
-                    and specs[0].num_returns != STREAMING_RETURNS):
-                results_list = [await client.call("push_task", spec=specs[0],
-                                                  _timeout=86400.0)]
-            else:
-                # Batch RPC even for one task when it streams: only the batch
-                # handler has the live writer that yield frames ride on.
-                results_list = await client.call("push_task_batch",
-                                                 specs=specs,
-                                                 _timeout=86400.0)
+            try:
+                results_list = await self._push_specs(client, specs)
+            except RemoteError as e:
+                if not isinstance(e.cause, spec_cache.SpecCacheMiss):
+                    raise
+                # The worker evicted a template we thought delivered (its
+                # decode raised before dispatching anything): resend once
+                # with full templates.
+                spec_cache.SpecEncoder.forget_client(client)
+                results_list = await self._push_specs(client, specs)
         except (RpcError, RemoteError, OSError) as e:
             # RpcError covers ConnectionLost AND "client closed" (the
             # pooled client force-closed by a worker-killed notification
@@ -633,8 +697,24 @@ class LeasePool:
         for spec, results in zip(specs, results_list):
             if results != "__streamed__":  # else completed via push already
                 self.w.task_manager.complete(spec.task_id, results)
-        lw.busy = False
-        lw.idle_since = time.monotonic()
+        lw.tasks_done += len(specs)
+        reuse_cap = get_config().lease_reuse_max_tasks
+        if (reuse_cap > 0 and lw.tasks_done >= reuse_cap
+                and lw.lease_id in self.leased):
+            # Reuse bound hit: hand the worker back so one pool cannot
+            # monopolise a node; the pump re-leases for remaining demand.
+            self.leased.pop(lw.lease_id, None)
+            try:
+                agent = self.w.agent_clients.get(lw.agent_address)
+                await agent.call_retry("return_worker_lease",
+                                       lease_id=lw.lease_id,
+                                       worker_id=lw.worker_id,
+                                       worker_alive=True)
+            except Exception:
+                pass
+        else:
+            lw.busy = False
+            lw.idle_since = time.monotonic()
         self._pump()
 
     async def _on_worker_failure(self, lw: LeasedWorker, specs: List[TaskSpec],
@@ -703,7 +783,7 @@ class LeasePool:
 
     async def _maybe_return(self, lw: LeasedWorker):
         try:
-            await asyncio.sleep(get_config().idle_worker_timeout_s)
+            await asyncio.sleep(get_config().lease_idle_return_ms / 1000.0)
         finally:
             lw.return_scheduled = False
         if lw.busy or self.queue or lw.lease_id not in self.leased:
@@ -788,6 +868,13 @@ class CoreWorker:
         self._submit_lock = threading.Lock()
         self._submit_flush_scheduled = False
         self.fn_cache: Dict[bytes, Any] = {}
+        # Submission fast path: per-(function, options) spec template
+        # encoder (core/spec_cache.py) — invariant spec portions wire-encode
+        # once per peer connection, each call ships only args + ids.
+        self.spec_encoder = spec_cache.SpecEncoder()
+        # In-flight inline->shm promotions (oid -> future): concurrent
+        # borrowers of one inlined result share a single store_create.
+        self._promotions: Dict[ObjectID, "asyncio.Future"] = {}
         # Streaming-generator state: owner side (task_id -> StreamState for
         # tasks WE submitted) and executor side (task_id -> _GenEmitter for
         # streaming tasks we are currently RUNNING).
@@ -1513,6 +1600,12 @@ class CoreWorker:
                 s.seq_no = tgt.seq = tgt.seq + 1
                 self.task_event(s, "RUNNING")
             try:
+                # Wire-encode through the spec template cache: the actor
+                # METHOD descriptor (actor id, method name, options) interns
+                # once per handle; each call ships args + ids.  Connect
+                # first so the delivered-set tracks this connection.
+                await client._ensure_connected()
+                enc = self.spec_encoder
                 if (len(specs) == 1
                         and specs[0].num_returns != STREAMING_RETURNS):
                     # Single non-streaming call: token'd retry.  A reply
@@ -1523,16 +1616,25 @@ class CoreWorker:
                     # results stream as side-channel pushes that a dedup
                     # replay would not re-emit.)
                     results_list = [await client.call_retry(
-                        "actor_task", spec=specs[0], _timeout=86400.0,
-                        _attempts=3)]
+                        "actor_task", spec=enc.encode(client, specs[0]),
+                        _timeout=86400.0, _attempts=3)]
                 else:
                     # Batch RPC even for one call when it streams: only the
                     # batch handler holds the writer yield frames ride on.
                     results_list = await client.call(
-                        "actor_task_batch", specs=specs, _timeout=86400.0)
+                        "actor_task_batch",
+                        specs=[enc.encode(client, s) for s in specs],
+                        _timeout=86400.0)
             except (RpcError, OSError) as e:
                 from .chaos import ChaosFault
                 from .rpc import TransientServerError
+                if (isinstance(e, RemoteError)
+                        and isinstance(e.cause, spec_cache.SpecCacheMiss)):
+                    # The actor worker evicted a template we thought
+                    # delivered; its decode raised before running anything.
+                    # Resend with full templates on the next loop pass.
+                    spec_cache.SpecEncoder.forget_client(client)
+                    continue
                 if (isinstance(e, RemoteError)
                         and not isinstance(e.cause, (ChaosFault,
                                                      TransientServerError))):
@@ -1848,7 +1950,67 @@ class CoreWorker:
             return ("plasma", rec.size, rec.locations)
         if isinstance(rec, ErrorRecord):
             return ("error", rec.error, rec.system)
+        if (isinstance(rec, (bytes, bytearray)) and self.agent is not None
+                and len(rec) > get_config().max_direct_call_object_size):
+            # A result inlined under inline_result_max_bytes is being
+            # borrowed cross-process and exceeds the direct-call size:
+            # promote it to the shm store so borrowers ride the transfer
+            # plane (chunked pulls, zero-copy same-host) instead of every
+            # locate_object reply copying the payload.
+            plas = await self._promote_inline(object_id, rec)
+            if plas is not None:
+                return ("plasma", plas.size, plas.locations)
+            rec = self.memory_store.get_if_exists(object_id)
+            if rec is None or isinstance(rec, PlasmaRecord):
+                return None if rec is None else ("plasma", rec.size,
+                                                 rec.locations)
         return ("inline", rec)
+
+    async def _promote_inline(self, oid: ObjectID, data) -> Optional[PlasmaRecord]:
+        """Spill one inlined result to the node's shm store (borrower
+        appeared).  Deduped per object so concurrent borrowers share a
+        single ``store_create``; ownership and refcounts do not move — the
+        record simply becomes a PlasmaRecord whose free path is the
+        standard ``store_free`` fan-out."""
+        fut = self._promotions.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_event_loop().create_future()
+        self._promotions[oid] = fut
+        rec: Optional[PlasmaRecord] = None
+        try:
+            try:
+                res = await self.agent.call_retry("store_create",
+                                                  object_id=oid,
+                                                  size=len(data))
+                seg = ShmSegment(res["path"], len(data), create=False)
+                try:
+                    seg.view()[:len(data)] = data
+                finally:
+                    seg.close()
+                await self.agent.notify("store_seal", object_id=oid)
+            except Exception:
+                fut.set_result(None)
+                return None
+            if not self.memory_store.contains(oid):
+                # the last reference died mid-promotion: the inline record
+                # is gone, so the shm copy must go too (nobody will free it)
+                try:
+                    await self.agent.call_retry("store_free",
+                                                object_ids=[oid])
+                except Exception:
+                    pass
+                fut.set_result(None)
+                return None
+            rec = PlasmaRecord(len(data),
+                               [(self.node_id, self.agent_address)])
+            self.memory_store.put(oid, rec)
+            fut.set_result(rec)
+            return rec
+        finally:
+            if not fut.done():
+                fut.set_result(rec)
+            self._promotions.pop(oid, None)
 
     async def handle_get_object(self, object_id: ObjectID):
         return await self.handle_locate_object(object_id, timeout=30.0)
@@ -1892,7 +2054,8 @@ class CoreWorker:
 
     # -- execution (worker mode) ------------------------------------------
 
-    async def handle_push_task(self, spec: TaskSpec):
+    async def handle_push_task(self, spec):
+        spec = spec_cache.decode(spec)
         fut = asyncio.get_event_loop().create_future()
         self.exec_queue.put(("task", spec, fut, asyncio.get_event_loop()))
         return await fut
@@ -1977,6 +2140,9 @@ class CoreWorker:
         the main thread, each result STREAMED back as it lands, one final
         reply as the completion barrier (reference counterpart:
         direct_task_transport.h:151 pipelining)."""
+        # Template decode is all-or-nothing: a SpecCacheMiss raises BEFORE
+        # any task is queued, so the sender's resend re-runs nothing.
+        specs = spec_cache.decode_many(specs)
         loop = asyncio.get_event_loop()
         futs = []
         for spec in specs:
@@ -2002,6 +2168,7 @@ class CoreWorker:
         streaming.  Async actors overlap the whole batch on their private
         loop; threaded actors keep per-call dispatch so the batch doesn't
         defeat max_concurrency."""
+        specs = spec_cache.decode_many(specs)  # raises before any dispatch
         loop = asyncio.get_event_loop()
         futs = []
         for spec in specs:
@@ -2027,7 +2194,8 @@ class CoreWorker:
         self.exec_queue.put(("create_actor", spec, fut, asyncio.get_event_loop()))
         return await fut
 
-    async def handle_actor_task(self, spec: TaskSpec):
+    async def handle_actor_task(self, spec):
+        spec = spec_cache.decode(spec)
         if self.actor_spec is not None and self.actor_spec.is_async_actor:
             return await self._run_async_actor_task(spec)
         fut = asyncio.get_event_loop().create_future()
@@ -2219,12 +2387,25 @@ class CoreWorker:
         if n > 1 and len(values) != n:
             raise ValueError(f"task {spec.name} declared num_returns={n} but "
                              f"returned {len(values)} values")
-        return [self._package_one(spec, v, i) for i, v in enumerate(values)]
+        limit = get_config().inline_result_max_bytes
+        return [self._package_one(spec, v, i, limit)
+                for i, v in enumerate(values)]
 
-    def _package_one(self, spec: TaskSpec, v, index: int) -> tuple:
-        """Package one return/yield value as a result descriptor tuple."""
+    def _package_one(self, spec: TaskSpec, v, index: int,
+                     inline_limit: Optional[int] = None) -> tuple:
+        """Package one return/yield value as a result descriptor tuple.
+
+        ``inline_limit`` is the result-inlining threshold: task/actor
+        returns use ``inline_result_max_bytes`` (values at or under it ride
+        back inside the reply frame — no ``store_create``, no caller-side
+        fetch), while streaming-generator yields pass the plain
+        ``max_direct_call_object_size`` so the yield pipeline bypasses the
+        result-inlining knob unchanged."""
         cfg = get_config()
-        if v is None:  # ubiquitous for side-effect calls: skip the pickler
+        if inline_limit is None:
+            inline_limit = cfg.max_direct_call_object_size
+        if v is None and inline_limit > 0:
+            # ubiquitous for side-effect calls: skip the pickler
             return ("inline", serialization.none_bytes(), [])
         so = serialization.serialize(v)
         # Ship descriptors of any ObjectRefs inside the value so the
@@ -2254,7 +2435,7 @@ class CoreWorker:
                     hold_id = None  # owner gone: nothing to protect
             contained.append((r.id.binary(), r_owner, hold_id))
         size = so.flat_size()
-        if size <= cfg.max_direct_call_object_size or self.agent is None:
+        if size <= inline_limit or self.agent is None:
             return ("inline", so.to_bytes(), contained)
         oid = ObjectID.for_task_return(spec.task_id, index)
         res = run_async(self.agent.call_retry("store_create", object_id=oid,

@@ -24,6 +24,7 @@ Plays the role of the reference's raylet (``src/ray/raylet/node_manager.h:125``)
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import sys
 import time
@@ -171,6 +172,11 @@ class NodeAgent:
         self.labels.setdefault("node_id", self.node_id.hex())
         self.store = NodeObjectStore(self.node_id.hex()[:12], object_store_memory)
         self.workers: Dict[str, WorkerHandle] = {}
+        # O(1) dispatch fast path: per-env-hash MRU stack of idle worker
+        # ids.  Entries are validated on pop (lazy deletion), so a state
+        # change that bypassed the queue can never hand out a stale worker;
+        # the full O(n) scan remains as the empty-queue fallback.
+        self._idle_ready: Dict[Optional[str], "collections.deque[str]"] = {}
         self.lease_queue: List[LeaseRequest] = []
         self.bundles: Dict[Tuple[str, int], ResourceSet] = {}       # committed
         self.prepared_bundles: Dict[Tuple[str, int], ResourceSet] = {}
@@ -549,6 +555,7 @@ class NodeAgent:
         if w.state == "STARTING":
             w.state = "IDLE"
             w.idle_since = time.monotonic()
+            self._mark_idle_ready(w)
         w.registered.set()
         if self._chaos_runtime_applied:
             # a runtime chaos_set happened before this worker existed: its
@@ -613,6 +620,74 @@ class NodeAgent:
 
     handle_request_worker_lease.rpc_pass_writer = True
 
+    async def handle_request_worker_leases(self, count: int,
+                                           resources: Dict[str, float],
+                                           bundle: Optional[Tuple[str, int]] = None,
+                                           runtime_env: Optional[dict] = None,
+                                           allow_spillback: bool = True,
+                                           owner: Optional[str] = None,
+                                           task_label: str = "",
+                                           _writer=None):
+        """Batched lease grant: up to ``count`` workers in ONE round trip.
+
+        -> {"grants": [grant, ...]} | {"spillback": ...} | {"infeasible": ...}
+
+        The fast path reserves each slot's resources SYNCHRONOUSLY (no
+        await between the can_fit check and the acquire), then finishes the
+        grants concurrently — a cold batch spawns its workers in parallel
+        exactly like ``count`` independent lease RPCs used to, minus the
+        per-lease round trips.  When nothing is grantable right now the
+        request degrades to the single-lease slow path (queue park /
+        spillback / infeasible), preserving those semantics unchanged."""
+        count = max(1, int(count))
+        pending = []
+        pool = self._resource_pool_for(bundle)  # ValueError surfaces as-is
+        feasible = (bundle is not None
+                    or ResourceSet(self.total.to_dict()).can_fit(resources))
+        if feasible:
+            while len(pending) < count and pool.can_fit(resources):
+                pool.acquire(resources)
+                pending.append(self._grant_lease(
+                    resources, bundle, runtime_env, owner=owner,
+                    task_label=task_label, pre_acquired=True))
+        if pending:
+            out = await asyncio.gather(*pending, return_exceptions=True)
+            grants = [g for g in out if isinstance(g, dict)]
+            errors = [g for g in out if not isinstance(g, dict)]
+            if not grants:
+                raise errors[0]
+            if errors:
+                # Partial failure with partial success: the reply can only
+                # carry the grants, but the cause must not vanish — the
+                # owner reads a short grant list as "saturated" and simply
+                # re-requests, so this log line is the ONLY place a
+                # recurring spawn/register failure surfaces.
+                try:
+                    print(f"[node-agent] {len(errors)}/{len(out)} lease "
+                          f"grants in a batch failed: {errors[0]!r}",
+                          flush=True)
+                except Exception:
+                    pass
+            if _writer is not None and _writer.is_closing():
+                # undeliverable (same contract as the single-lease handler):
+                # reclaim every granted worker and let a same-token retry
+                # on a live connection re-execute
+                for g in grants:
+                    await self.handle_return_worker_lease(
+                        g["lease_id"], g["worker_id"], worker_alive=True)
+                raise TransientServerError(
+                    "lease grant undeliverable: requester connection closed")
+            return {"grants": grants}
+        g = await self.handle_request_worker_lease(
+            resources, bundle=bundle, runtime_env=runtime_env,
+            allow_spillback=allow_spillback, owner=owner,
+            task_label=task_label, _writer=_writer)
+        if isinstance(g, dict) and "worker_address" in g:
+            return {"grants": [g]}
+        return g
+
+    handle_request_worker_leases.rpc_pass_writer = True
+
     async def _request_worker_lease(self, resources, bundle, runtime_env,
                                     allow_spillback, owner, task_label,
                                     writer=None):
@@ -660,10 +735,15 @@ class NodeAgent:
 
     async def _grant_lease(self, resources, bundle, runtime_env,
                            owner: Optional[str] = None,
-                           task_label: str = "") -> dict:
+                           task_label: str = "",
+                           pre_acquired: bool = False) -> dict:
         from .runtime_env import worker_env_hash
         pool = self._resource_pool_for(bundle)
-        pool.acquire(resources)
+        if not pre_acquired:
+            # batched grants reserve synchronously BEFORE their coroutines
+            # interleave (see handle_request_worker_leases) so concurrent
+            # slots cannot over-commit the pool
+            pool.acquire(resources)
         lease_id = self._next_lease_id()
         if bundle is None:
             self._lease_resources[lease_id] = dict(resources)
@@ -715,8 +795,24 @@ class NodeAgent:
             self.available.release(self._lease_resources.get(lease_id, {}))
         self._lease_resources.pop(lease_id, None)
 
+    def _mark_idle_ready(self, w: WorkerHandle):
+        """Push a worker that just became IDLE onto the O(1) ready stack
+        (MRU at the right — the most recently idled worker has the warmest
+        caches and is popped first)."""
+        self._idle_ready.setdefault(w.env_hash, collections.deque()) \
+            .append(w.worker_id)
+
     def _pop_idle_worker(self, env_hash: Optional[str] = None
                          ) -> Optional[WorkerHandle]:
+        # Fast path: pop from the per-env ready stack, skipping stale
+        # entries (workers that died or were leased through another path).
+        dq = self._idle_ready.get(env_hash)
+        while dq:
+            w = self.workers.get(dq.pop())
+            if w is not None and w.state == "IDLE" and w.env_hash == env_hash:
+                return w
+        # Fallback scan: catches IDLE workers that reached the state
+        # without passing _mark_idle_ready.
         best = None
         for w in self.workers.values():
             if w.state == "IDLE" and w.env_hash == env_hash:
@@ -801,6 +897,7 @@ class NodeAgent:
                 w.state = "IDLE"
                 w.lease_id = None
                 w.idle_since = time.monotonic()
+                self._mark_idle_ready(w)
             elif not worker_alive:
                 await self._kill_worker_proc(w)
         await self._process_lease_queue()

@@ -1,0 +1,225 @@
+"""TaskSpec template cache — the submission hot path's serialization plane.
+
+A process submitting the same function (or actor method) thousands of times
+re-pickles the same invariant spec fields — function descriptor, options,
+resources, runtime-env — on every call, and the executor re-unpickles them.
+This module splits a :class:`~ray_tpu.core.common.TaskSpec` into
+
+* a **template**: every field invariant across calls of one
+  ``(function, options)`` pair (or one actor method), pickled ONCE and
+  addressed by a 16-byte content hash; and
+* a **delta**: the per-call fields (``task_id``, ``args``, ``retry_count``,
+  ``seq_no``, ``trace_ctx``) that ride every submission.
+
+The sender keeps a bounded LRU of encoded templates keyed by the spec's
+template key and tracks, per RPC connection, which template hashes the peer
+has already received — so steady-state submissions wire-encode only the
+hash plus the delta.  The receiver interns decoded templates by hash in a
+bounded LRU of prototype specs; decoding a warm submission is a ``__dict__``
+copy plus five field stores, no pickling of the invariant portion at all.
+
+Redefinition is handled by content addressing: a changed function or option
+set produces a different template key AND hash, and stale entries age out
+of both LRUs (eviction-on-redefine).  A receiver that evicted a template a
+sender still believes is delivered raises :class:`SpecCacheMiss`; the
+sender forgets its delivered-set for that connection and resends the full
+template (the handler raised before executing anything, so the resend is
+safe).
+
+Reference analogue: the reference ships functions by content hash through
+the GCS function table (``python/ray/_private/function_manager.py``) for
+exactly this reason; here the same interning is applied to the whole
+invariant spec portion on the direct task-transport path.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import pickle
+import time
+from typing import Optional, Tuple
+
+from .common import TaskSpec
+from .config import get_config
+
+#: wire tag for a template-cached spec (anything else decodes as-is)
+_WIRE_TAG = "tspec"
+
+#: TaskSpec fields that vary per call — everything else is template.
+VOLATILE_FIELDS = ("task_id", "args", "retry_count", "seq_no", "trace_ctx",
+                   "submitted_at")
+
+#: args blobs at least this large ride as out-of-band pickle-5 buffers in
+#: the wire delta (same threshold as the RPC layer's vectored frames).
+from .rpc import _VEC_MIN_BUF as _OOB_ARGS_MIN
+
+
+class SpecCacheMiss(Exception):
+    """The receiver does not hold the template a hash-only submission
+    references (its LRU evicted it, or a reordered first frame).  Raised
+    BEFORE any task is dispatched, so the sender may safely resend the
+    batch with the full template included."""
+
+
+def _template_key(spec: TaskSpec) -> tuple:
+    """Cheap hashable identity of the spec's invariant portion.  Must cover
+    every non-volatile field that can differ between two specs a process
+    submits — a collision here would run a task under another template's
+    options."""
+    return (
+        spec.is_actor_task,
+        spec.fn_id,
+        spec.actor_id.binary() if spec.actor_id is not None else None,
+        spec.actor_method,
+        spec.name,
+        spec.num_returns,
+        tuple(sorted(spec.resources.items())) if spec.resources else (),
+        repr(spec.scheduling_strategy),
+        spec.max_retries,
+        spec.retry_exceptions,
+        repr(sorted(spec.runtime_env.items())) if spec.runtime_env else None,
+        spec.generator_backpressure,
+        spec.owner,
+        spec.job_id.binary(),
+        # constant defaults on task/method specs today, but covered so a
+        # future path that sets them cannot collide two templates
+        spec.max_restarts, spec.max_task_retries, spec.max_concurrency,
+        spec.is_async_actor, spec.actor_name, spec.namespace, spec.lifetime,
+    )
+
+
+def _template_fields(spec: TaskSpec) -> dict:
+    d = dict(spec.__dict__)
+    for f in VOLATILE_FIELDS:
+        d.pop(f, None)
+    return d
+
+
+class SpecEncoder:
+    """Sender side: one per CoreWorker.  ``encode`` returns either the raw
+    TaskSpec (cache disabled / actor-creation specs) or the compact wire
+    tuple, including the template blob only when this connection has not
+    seen the hash yet."""
+
+    def __init__(self):
+        # template key -> (hash, blob); LRU by move-to-end on hit
+        self._lru: "collections.OrderedDict[tuple, Tuple[bytes, bytes]]" = \
+            collections.OrderedDict()
+
+    def _template_for(self, spec: TaskSpec) -> Tuple[bytes, bytes]:
+        key = _template_key(spec)
+        hit = self._lru.get(key)
+        if hit is not None:
+            self._lru.move_to_end(key)
+            return hit
+        blob = pickle.dumps(_template_fields(spec), protocol=5)
+        thash = hashlib.blake2b(blob, digest_size=16).digest()
+        self._lru[key] = (thash, blob)
+        cap = max(get_config().spec_cache_max_entries, 8)
+        while len(self._lru) > cap:
+            self._lru.popitem(last=False)
+        return thash, blob
+
+    @staticmethod
+    def _delivered_set(client) -> set:
+        """Hashes the peer has received ON THE CURRENT CONNECTION.  Keyed
+        by writer identity: a reconnect installs a fresh writer, and the
+        receiver interns process-globally, so stale entries only ever cause
+        a redundant template resend, never a miss."""
+        w = client._writer
+        rec = getattr(client, "_raytpu_tmpl_sent", None)
+        if rec is None or rec[0] is not w:
+            rec = client._raytpu_tmpl_sent = (w, set())
+        return rec[1]
+
+    @staticmethod
+    def forget_client(client) -> None:
+        """Drop the delivered-set after a :class:`SpecCacheMiss` so the
+        next encode resends full templates."""
+        client._raytpu_tmpl_sent = None
+
+    def encode(self, client, spec: TaskSpec):
+        if not get_config().spec_cache_enabled or spec.is_actor_creation:
+            return spec
+        thash, blob = self._template_for(spec)
+        sent = self._delivered_set(client)
+        if thash in sent:
+            tblob = None
+        else:
+            tblob = blob
+            sent.add(thash)
+        args = spec.args
+        if isinstance(args, bytes) and len(args) >= _OOB_ARGS_MIN:
+            args = pickle.PickleBuffer(args)
+        return (_WIRE_TAG, thash, tblob, spec.task_id, args,
+                spec.retry_count, spec.seq_no, spec.trace_ctx)
+
+
+class SpecInterner:
+    """Receiver side: process-global intern table hash -> prototype spec.
+    Decoding clones the prototype (``__dict__`` copy) and stores the five
+    volatile fields — no pickling of the invariant portion on warm
+    submissions."""
+
+    def __init__(self):
+        self._lru: "collections.OrderedDict[bytes, TaskSpec]" = \
+            collections.OrderedDict()
+
+    def _intern(self, thash: bytes, tblob: bytes) -> TaskSpec:
+        proto = TaskSpec.__new__(TaskSpec)
+        fields = pickle.loads(tblob)
+        proto.__dict__.update(fields)
+        self._lru[thash] = proto
+        cap = max(get_config().spec_cache_max_entries, 8)
+        while len(self._lru) > cap:
+            self._lru.popitem(last=False)
+        return proto
+
+    def decode(self, wire) -> TaskSpec:
+        if isinstance(wire, TaskSpec):
+            return wire
+        if not (isinstance(wire, tuple) and len(wire) == 8
+                and wire[0] == _WIRE_TAG):
+            raise TypeError(f"not a task spec wire form: {type(wire)}")
+        _tag, thash, tblob, task_id, args, retry_count, seq_no, trace_ctx = \
+            wire
+        proto = self._lru.get(thash)
+        if proto is None:
+            if tblob is None:
+                raise SpecCacheMiss(
+                    f"unknown spec template {thash.hex()[:16]} "
+                    "(receiver cache evicted it?)")
+            proto = self._intern(thash, tblob)
+        else:
+            self._lru.move_to_end(thash)
+        spec = TaskSpec.__new__(TaskSpec)
+        spec.__dict__.update(proto.__dict__)
+        spec.task_id = task_id
+        spec.args = args if isinstance(args, bytes) else bytes(args)
+        spec.retry_count = retry_count
+        spec.seq_no = seq_no
+        spec.trace_ctx = trace_ctx
+        spec.submitted_at = time.time()
+        return spec
+
+
+_interner: Optional[SpecInterner] = None
+
+
+def interner() -> SpecInterner:
+    global _interner
+    if _interner is None:
+        _interner = SpecInterner()
+    return _interner
+
+
+def decode(wire) -> TaskSpec:
+    return interner().decode(wire)
+
+
+def decode_many(wires) -> list:
+    """Decode a batch, raising :class:`SpecCacheMiss` before any spec is
+    acted on (the all-or-nothing contract the resend path relies on)."""
+    it = interner()
+    return [it.decode(w) for w in wires]

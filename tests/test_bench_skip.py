@@ -1,0 +1,86 @@
+"""bench.py crash-path regression (the BENCH_r05 failure): ``jax.devices()``
+raising ``RuntimeError`` / ``JaxRuntimeError`` during backend init must NOT
+escape as an rc=1 traceback — the harness gets one parseable
+``{"skipped": "no TPU"}`` JSON line and rc=0.  Runs bench.py in a
+subprocess against a stub ``jax`` whose ``devices()`` raises exactly the
+way the wedged TPU plugin did."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write_stub_jax(tmp_path, raise_src: str):
+    pkg = tmp_path / "jax"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(textwrap.dedent(f"""
+        class errors:
+            class JaxRuntimeError(RuntimeError):
+                pass
+
+        def devices():
+            {raise_src}
+    """))
+    (pkg / "numpy.py").write_text("")  # bench.py imports jax.numpy
+
+
+@pytest.mark.parametrize("raise_src", [
+    # the BENCH_r05 tail verbatim: plain RuntimeError from xla_bridge
+    "raise RuntimeError(\"Unable to initialize backend 'tpu': "
+    "UNAVAILABLE: TPU backend setup/compile error (Unavailable).\")",
+    # the chained original: the plugin's JaxRuntimeError
+    "raise errors.JaxRuntimeError(\"UNAVAILABLE: TPU backend setup/compile "
+    "error (Unavailable).\")",
+])
+def test_bench_backend_init_failure_emits_structured_skip(tmp_path,
+                                                          raise_src):
+    _write_stub_jax(tmp_path, raise_src)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--backend-timeout", "20"],
+        capture_output=True, text=True, timeout=120,
+        cwd=str(REPO),
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(tmp_path),
+             "HOME": "/tmp"})
+    assert proc.returncode == 0, \
+        f"bench.py exited rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no output: stderr={proc.stderr[-500:]}"
+    out = json.loads(lines[-1])
+    assert out.get("skipped") == "no TPU", out
+    assert out["metric"] == "train_tokens_per_sec_per_chip"
+    assert "UNAVAILABLE" in out.get("error", "")
+
+
+def test_bench_wedged_backend_init_times_out_to_skip(tmp_path):
+    """A plugin that WEDGES (never returns, never raises) inside
+    ``jax.devices()`` must also resolve to the structured skip once the
+    probe timeout lapses."""
+    pkg = tmp_path / "jax"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(textwrap.dedent("""
+        import time
+
+        class errors:
+            class JaxRuntimeError(RuntimeError):
+                pass
+
+        def devices():
+            time.sleep(3600)
+    """))
+    (pkg / "numpy.py").write_text("")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--backend-timeout", "3"],
+        capture_output=True, text=True, timeout=120,
+        cwd=str(REPO),
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(tmp_path),
+             "HOME": "/tmp"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out.get("skipped") == "no TPU", out
+    assert "backend init exceeded" in out.get("error", "")

@@ -1,0 +1,105 @@
+"""Static hot-path lint (CI tooling satellite of the submission fast path,
+in the style of ``test_metric_naming.py``): the task-submission hot path —
+CoreWorker submit/push/actor-pump functions and the node agent's
+lease/dispatch functions — must not pickle full TaskSpecs inline.  Spec
+(de)serialization on these paths goes through the template cache
+(``core/spec_cache.py``), and a ``pickle.dumps``/``pickle.loads`` creeping
+back into any of these functions is exactly how the optimization would
+silently rot.
+
+The scan is AST-based and alias-following (``import pickle as _pickle``),
+and it asserts it actually FOUND every named hot-path function — a rename
+cannot silently drop a function out of the lint.
+"""
+
+import ast
+import pathlib
+
+CORE = pathlib.Path(__file__).resolve().parent.parent / "ray_tpu" / "core"
+
+#: functions on the submission hot path, per file
+HOT_FUNCTIONS = {
+    "core_worker.py": {
+        "submit_task", "submit_actor_task", "_enqueue_submit",
+        "_flush_submits", "_pool_for", "_push_specs", "_run_on",
+        "_actor_pump", "_run_actor_batch",
+        "handle_push_task", "handle_push_task_batch",
+        "handle_actor_task", "handle_actor_task_batch",
+    },
+    "node_agent.py": {
+        "handle_request_worker_lease", "handle_request_worker_leases",
+        "_request_worker_lease", "_grant_lease", "_process_lease_queue",
+        "_pop_idle_worker", "handle_return_worker_lease",
+    },
+}
+
+#: forbidden calls inside hot functions: full-spec pickling must go
+#: through the spec template cache instead
+FORBIDDEN_ATTRS = {"dumps", "loads", "dump", "load"}
+PICKLE_MODULES = {"pickle", "cloudpickle"}
+
+
+def _pickle_aliases(tree) -> set:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in PICKLE_MODULES:
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in PICKLE_MODULES:
+                for a in node.names:
+                    if a.name in FORBIDDEN_ATTRS:
+                        out.add(a.asname or a.name)
+    return out
+
+
+def _violations_in(fn_node, aliases, path, problems):
+    for node in ast.walk(fn_node):
+        # local `import pickle as _pickle` inside the function body
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in PICKLE_MODULES:
+                    aliases = aliases | {a.asname or a.name}
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in FORBIDDEN_ATTRS
+                and isinstance(f.value, ast.Name) and f.value.id in aliases):
+            problems.append(
+                f"{path.name}:{node.lineno}: {fn_node.name} calls "
+                f"{f.value.id}.{f.attr} on the submission hot path — "
+                "spec encode/decode must go through core/spec_cache.py")
+        elif isinstance(f, ast.Name) and f.id in aliases:
+            problems.append(
+                f"{path.name}:{node.lineno}: {fn_node.name} calls {f.id}() "
+                "on the submission hot path")
+
+
+def test_submit_hot_path_does_not_pickle_specs_inline():
+    problems = []
+    for fname, wanted in HOT_FUNCTIONS.items():
+        path = CORE / fname
+        tree = ast.parse(path.read_text(), filename=str(path))
+        aliases = _pickle_aliases(tree)
+        found = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in wanted:
+                found.add(node.name)
+                _violations_in(node, aliases, path, problems)
+        missing = wanted - found
+        assert not missing, (
+            f"{fname}: hot-path functions renamed/removed without updating "
+            f"the lint: {sorted(missing)}")
+    assert not problems, "hot-path pickling violations:\n" + \
+        "\n".join(problems)
+
+
+def test_spec_cache_is_wired_into_the_hot_path():
+    """Companion positive check: the hot path actually routes through the
+    template cache (encode on the sender, decode on the executor) — the
+    lint above would be vacuous if the cache were simply deleted."""
+    src = (CORE / "core_worker.py").read_text()
+    assert "spec_cache.decode" in src and ".encode(client" in src
+    assert "SpecEncoder" in (CORE / "spec_cache.py").read_text()

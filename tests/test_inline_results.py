@@ -1,0 +1,207 @@
+"""Edge cases of small-result inlining (the submission fast path's result
+plane): threshold-exact values inline, over-threshold values go to the shm
+store, an inlined result later borrowed cross-process is PROMOTED to the
+shm store (with the standard free fan-out), retries under chaos frame
+drops replay the same inlined bytes exactly once, and streaming-generator
+yields bypass the result-inlining knob unchanged.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.core.object_store import PlasmaRecord
+from ray_tpu.core.rpc import run_async
+from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+
+def _record_of(ref):
+    from ray_tpu.core.core_worker import global_worker
+    return global_worker().memory_store.get_if_exists(ref.id)
+
+
+def _flat_size(value) -> int:
+    return serialization.serialize(value).flat_size()
+
+
+# ------------------------------------------------------------- threshold
+
+def test_result_exactly_at_threshold_inlines():
+    """A result whose serialized size is EXACTLY inline_result_max_bytes
+    still inlines (<=, not <); one byte past it goes to the shm store."""
+    at = b"y" * 150_000
+    over = b"y" * 150_001
+    threshold = _flat_size(at)
+    assert _flat_size(over) == threshold + 1
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"inline_result_max_bytes": threshold})
+    try:
+        @ray_tpu.remote
+        def make(n):
+            return b"y" * n
+
+        ref_at = make.remote(len(at))
+        assert ray_tpu.get(ref_at, timeout=60) == at
+        rec = _record_of(ref_at)
+        assert isinstance(rec, (bytes, bytearray)), \
+            f"at-threshold result was not inlined: {type(rec)}"
+
+        ref_over = make.remote(len(over))
+        assert ray_tpu.get(ref_over, timeout=60) == over
+        assert isinstance(_record_of(ref_over), PlasmaRecord), \
+            "over-threshold result did not spill to the shm store"
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- promotion
+
+def test_inlined_result_promotes_on_cross_process_borrow():
+    """An inlined result above the direct-call size that a borrower pulls
+    cross-process must be promoted to the shm store — the owner's record
+    becomes a PlasmaRecord, the borrower reads the right bytes, and the
+    standard refcount free reclaims the shm copy."""
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"inline_result_max_bytes": 400_000})
+    try:
+        from ray_tpu.core.core_worker import global_worker
+        w = global_worker()
+
+        def stats():
+            return run_async(w.agent.call("store_stats"))
+
+        base_objects = stats()["num_objects"]
+
+        @ray_tpu.remote
+        def produce():
+            return np.arange(30_000, dtype=np.float64)  # ~240 KB, inlined
+
+        ref = produce.remote()
+        out = ray_tpu.get(ref, timeout=60)
+        assert isinstance(_record_of(ref), (bytes, bytearray)), \
+            "result above max_direct_call_object_size was not inlined " \
+            "under the raised inline_result_max_bytes"
+
+        @ray_tpu.remote
+        class Borrower:
+            def grab(self, boxed):
+                v = ray_tpu.get(boxed[0])
+                return float(v.sum())
+
+        b = Borrower.remote()
+        got = ray_tpu.get(b.grab.remote([ref]), timeout=60)
+        assert got == float(out.sum())
+        rec = _record_of(ref)
+        assert isinstance(rec, PlasmaRecord), \
+            f"borrowed inlined result was not promoted: {type(rec)}"
+        assert stats()["num_objects"] >= base_objects + 1
+
+        # the promoted copy frees through the normal refcount fan-out
+        ray_tpu.kill(b)
+        del ref, rec
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if stats()["num_objects"] <= base_objects:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f"promoted result never freed: {stats()}")
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ chaos retry
+
+@pytest.mark.chaos
+def test_retried_inlined_actor_result_exactly_once():
+    """A dropped actor_task reply replays the COMMITTED inlined result from
+    the worker's dedup window: the method runs exactly once and the caller
+    sees the same inlined bytes the first execution produced."""
+    spec = {"seed": 3, "rules": [
+        {"kind": "drop_reply", "prob": 1.0, "method": "actor_task",
+         "times": 1}]}
+    spec_json = json.dumps(spec)
+    os.environ["RAYTPU_CHAOS_SPEC"] = spec_json
+    try:
+        ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                     _system_config={"chaos_spec": spec_json})
+
+        @ray_tpu.remote
+        class Bump:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                # payload varies per EXECUTION: a re-run would change it,
+                # so equality below proves replay-not-reexecute
+                return (self.n, os.urandom(20_000))
+
+        a = Bump.remote()
+        n1, blob1 = ray_tpu.get(a.bump.remote(), timeout=120)
+        assert n1 == 1, "dropped reply re-executed the method"
+        assert len(blob1) == 20_000
+        n2, _ = ray_tpu.get(a.bump.remote(), timeout=120)
+        assert n2 == 2, f"method ran {n2 - 1} times for the second call"
+    finally:
+        os.environ.pop("RAYTPU_CHAOS_SPEC", None)
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_task_inline_result_survives_push_frame_drop():
+    """A dropped push_task frame fails the lease's worker; the retry
+    re-executes the (stateless) task and the caller still gets the exact
+    inlined bytes.  (Client-side drop_request: the driver's injector fires
+    exactly once — a server-side drop_reply would re-fire in every freshly
+    spawned worker's injector and exhaust any retry budget.)"""
+    spec = {"seed": 5, "rules": [
+        {"kind": "drop_request", "prob": 1.0, "method": "push_task",
+         "times": 1}]}
+    spec_json = json.dumps(spec)
+    os.environ["RAYTPU_CHAOS_SPEC"] = spec_json
+    try:
+        ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                     _system_config={"chaos_spec": spec_json})
+
+        @ray_tpu.remote(max_retries=3)
+        def blob():
+            return b"z" * 30_000
+
+        assert ray_tpu.get(blob.remote(), timeout=120) == b"z" * 30_000
+    finally:
+        os.environ.pop("RAYTPU_CHAOS_SPEC", None)
+        ray_tpu.shutdown()
+
+
+# -------------------------------------------------------------- generators
+
+def test_generator_yields_bypass_result_inlining():
+    """Streaming yields are governed by max_direct_call_object_size, NOT by
+    inline_result_max_bytes: a huge result-inline threshold must not pull
+    multi-hundred-KB yields out of the shm store (the streaming pipeline
+    is unchanged by the fast path)."""
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"inline_result_max_bytes": 10 << 20})
+    try:
+        @ray_tpu.remote(num_returns="streaming")
+        def gen():
+            for i in range(3):
+                yield np.full(50_000, i, dtype=np.float64)  # ~400 KB
+
+        out_refs = list(gen.remote())
+        assert len(out_refs) == 3
+        for i, r in enumerate(out_refs):
+            rec = _record_of(r)
+            assert isinstance(rec, PlasmaRecord), \
+                f"yield {i} was inlined ({type(rec)}) — generator returns " \
+                "must bypass inline_result_max_bytes"
+            v = ray_tpu.get(r, timeout=60)
+            assert float(v[0]) == float(i) and v.shape == (50_000,)
+    finally:
+        ray_tpu.shutdown()

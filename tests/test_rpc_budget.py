@@ -1,0 +1,126 @@
+"""RPC round-trip budget of the warm submission hot path (metric-asserted,
+not timed — the style of ``test_copy_discipline.py``): a warm no-arg task
+costs at most TWO control-plane round trips and a warm actor call at most
+ONE (plus the reply riding the same round trip), with ZERO per-call
+``store_create`` / ``fetch_object`` / lease RPCs.
+
+These pin the submission fast path deterministically: a reintroduced
+per-result ``store_create``, a per-call lease request/return, or a
+caller-side fetch of an inlined result shows up as a nonzero delta in the
+per-method RPC client metrics and fails tier-1 — no wall clock involved.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.rpc import rpc_metrics
+from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+#: the per-call submission round trips (the ONLY RPCs a warm call may pay)
+PUSH_METHODS = {"push_task", "push_task_batch"}
+ACTOR_METHODS = {"actor_task", "actor_task_batch"}
+
+#: RPCs that must NEVER appear per warm call — each of these firing per
+#: submission is exactly the regression this test exists to catch
+FORBIDDEN_PER_CALL = {
+    "store_create", "store_get", "store_seal", "fetch_object",
+    "store_verify", "locate_object", "reconstruct_object",
+    "request_worker_lease", "request_worker_leases", "return_worker_lease",
+    "kv_put", "kv_get", "register_actor", "wait_actor_alive",
+    "get_cluster_view",
+}
+
+
+def _client_counts() -> dict:
+    """{method: completed client calls} from the RPC metrics plane."""
+    m = rpc_metrics()
+    assert m is not None, "rpc metrics disabled — the budget cannot be pinned"
+    snap = m.client_seconds.snapshot()["count"]
+    out: dict = {}
+    for key, n in snap.items():
+        method = dict(key).get("method", "?")
+        out[method] = out.get(method, 0) + n
+    return out
+
+
+def _delta(before: dict, after: dict, methods) -> int:
+    return sum(after.get(mth, 0) - before.get(mth, 0) for mth in methods)
+
+
+@pytest.fixture
+def budget_cluster():
+    # Task events are flushed to the GCS on a 1 s cadence — disable them so
+    # the window contains ONLY the calls under test.  Everything else on
+    # the driver's client is per-call by construction.
+    ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"task_events_enabled": False})
+    yield
+    ray_tpu.shutdown()
+
+
+def _settle():
+    # let in-flight background frames (lease grants, borrower notes from
+    # the warm-up) finish so they cannot leak into the measured window
+    time.sleep(0.3)
+
+
+def test_warm_noarg_task_rpc_budget(budget_cluster):
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    for _ in range(5):  # warm: lease held, function registered, spec cached
+        ray_tpu.get(noop.remote())
+    _settle()
+
+    n = 20
+    before = _client_counts()
+    for _ in range(n):
+        ray_tpu.get(noop.remote())
+    after = _client_counts()
+
+    assert _delta(before, after, FORBIDDEN_PER_CALL) == 0, (
+        "warm no-arg tasks paid store/lease/fetch round trips:\n"
+        + "\n".join(f"  {mth}: +{after.get(mth, 0) - before.get(mth, 0)}"
+                    for mth in sorted(FORBIDDEN_PER_CALL)
+                    if after.get(mth, 0) != before.get(mth, 0)))
+    pushes = _delta(before, after, PUSH_METHODS)
+    assert 0 < pushes <= 2 * n, (
+        f"warm no-arg task budget blown: {pushes} push round trips "
+        f"for {n} tasks (budget 2 per task)")
+
+
+def test_warm_actor_call_rpc_budget(budget_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+    a = Counter.remote()
+    for _ in range(5):
+        ray_tpu.get(a.ping.remote())
+    _settle()
+
+    n = 20
+    before = _client_counts()
+    for _ in range(n):
+        ray_tpu.get(a.ping.remote())
+    after = _client_counts()
+
+    assert _delta(before, after, FORBIDDEN_PER_CALL) == 0, (
+        "warm actor calls paid store/lease/fetch round trips:\n"
+        + "\n".join(f"  {mth}: +{after.get(mth, 0) - before.get(mth, 0)}"
+                    for mth in sorted(FORBIDDEN_PER_CALL)
+                    if after.get(mth, 0) != before.get(mth, 0)))
+    calls = _delta(before, after, ACTOR_METHODS)
+    assert 0 < calls <= n, (
+        f"warm actor-call budget blown: {calls} round trips for {n} calls "
+        f"(budget 1 per call, the reply rides it)")
+    # sanity: the calls actually executed, in order, exactly once each
+    assert ray_tpu.get(a.ping.remote()) == 5 + n + 1
