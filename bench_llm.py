@@ -24,6 +24,49 @@ import random
 import threading
 import time
 
+#: Schema contract for one configuration's per-request breakdown — the
+#: full serving picture (open item #2) captured in one run.  Guarded by
+#: tests/test_serve_observability.py::test_bench_llm_breakdown_schema so a
+#: refactor cannot silently drop a field between chip windows.
+REQUEST_KEYS = frozenset({
+    "req_per_s", "n_requests", "decode_tok_per_s",
+    "p50_ttft_ms", "p95_ttft_ms", "p99_ttft_ms",
+    "p50_tpot_ms", "p95_tpot_ms",
+})
+#: engine-side breakdown keys (LLMEngine.breakdown(), via LLMServer.stats)
+ENGINE_KEYS = frozenset({
+    "admit_batches", "batch_occupancy", "padding_fraction",
+})
+
+
+def request_rollup(samples, wall_s: float) -> dict:
+    """Per-request metrics rollup: ``samples`` is a list of
+    ``(ttft_s, latency_s, n_tokens)`` tuples; returns the REQUEST_KEYS
+    dict.  Pure — the schema-guard test drives it with synthetic
+    samples.  TPOT = (latency - ttft) / (n_tokens - 1): steady-state
+    decode pace after the first token."""
+    n = len(samples)
+    if not n:
+        raise ValueError("no request samples")
+    ttfts = sorted(s[0] for s in samples)
+    tpots = sorted((lat - ttft) / (nt - 1)
+                   for ttft, lat, nt in samples if nt > 1)
+
+    def pct(xs, q):
+        return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
+
+    rnd = lambda v: None if v is None else round(v * 1000, 2)  # noqa: E731
+    return {
+        "req_per_s": round(n / wall_s, 2),
+        "n_requests": n,
+        "decode_tok_per_s": round(sum(s[2] for s in samples) / wall_s, 1),
+        "p50_ttft_ms": rnd(pct(ttfts, 0.50)),
+        "p95_ttft_ms": rnd(pct(ttfts, 0.95)),
+        "p99_ttft_ms": rnd(pct(ttfts, 0.99)),
+        "p50_tpot_ms": rnd(pct(tpots, 0.50)),
+        "p95_tpot_ms": rnd(pct(tpots, 0.95)),
+    }
+
 
 def main():
     p = argparse.ArgumentParser()
@@ -57,8 +100,8 @@ def main():
         return _prefix + [rng.randint(1, 1000) for _ in range(32)]
 
     def drive(handle, make_prompt):
-        """Run the client fleet; returns (req_s, p50_ttft, p99_ttft, tok_s)."""
-        ttfts, latencies, tokens = [], [], [0]
+        """Run the client fleet; returns the REQUEST_KEYS breakdown."""
+        samples = []  # (ttft_s, latency_s, n_tokens) per request
         lock = threading.Lock()
         reqs_per_client = args.requests // args.clients
 
@@ -71,11 +114,8 @@ def main():
                     if first is None:
                         first = time.monotonic() - t0
                     n += 1
-                dt = time.monotonic() - t0
                 with lock:
-                    ttfts.append(first)
-                    latencies.append(dt)
-                    tokens[0] += n
+                    samples.append((first, time.monotonic() - t0, n))
 
         t0 = time.time()
         threads = [threading.Thread(target=client)
@@ -84,16 +124,7 @@ def main():
             t.start()
         for t in threads:
             t.join()
-        wall = time.time() - t0
-        n_reqs = len(latencies)
-        ttfts.sort()
-        return {
-            "req_per_s": round(n_reqs / wall, 2),
-            "p50_ttft_ms": round(ttfts[n_reqs // 2] * 1000, 1),
-            "p99_ttft_ms": round(
-                ttfts[min(n_reqs - 1, int(n_reqs * 0.99))] * 1000, 1),
-            "decode_tok_per_s": round(tokens[0] / wall, 1),
-        }
+        return request_rollup(samples, time.time() - t0)
 
     def run_serve(paged: bool, make_prompt, label: str):
         """One full cluster lifecycle per configuration: the TPU is held
@@ -109,7 +140,15 @@ def main():
                                "paged": paged})
             h = serve.run(dep, timeout_s=900)
             list(h.stream({"tokens": make_prompt(), "max_tokens": 4}))
-            return drive(h, make_prompt)
+            res = drive(h, make_prompt)
+            # engine-side serving picture: batch occupancy/padding waste,
+            # KV page utilization, prefix-cache hit rate (LLMServer.stats
+            # -> LLMEngine.breakdown)
+            try:
+                res["engine"] = h.stats.remote().result(timeout_s=60)
+            except Exception as e:  # noqa: BLE001 — breakdown is additive
+                res["engine"] = {"error": repr(e)}
+            return res
         finally:
             try:
                 serve.shutdown()

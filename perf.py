@@ -246,6 +246,58 @@ def _measure_submission(S: float, system_config: dict | None) -> dict:
     return out
 
 
+def _measure_serve_reqs(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster serve request-throughput measurement (the
+    serve-observability A/B arms): a 2-replica noop deployment driven via
+    the handle path, sequential (latency-bound) and pipelined."""
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(num_cpus=8, _system_config=system_config or None)
+    out = {}
+    try:
+        @serve.deployment(num_replicas=2, max_concurrent_queries=64)
+        def snoop(_x=None):
+            return b"ok"
+
+        h = serve.run(snoop)
+        for _ in range(20):
+            h.remote().result()
+        n = int(300 * S)
+        out["serve_noop_req_s"] = max(timeit(
+            lambda: [h.remote().result() for _ in range(n)], n))
+        n = int(600 * S)
+
+        def pipelined():
+            rs = [h.remote() for _ in range(n)]
+            for r in rs:
+                r.result()
+
+        out["serve_pipelined_req_s"] = max(timeit(pipelined, n))
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_serve_metrics(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: serve_metrics_enabled on vs off — the
+    serve observability plane's request-throughput overhead (the ISSUE-6
+    acceptance gate: <= 5%)."""
+    on_runs, off_runs = [], []
+    off_cfg = {"serve_metrics_enabled": False}
+    for i in range(pairs):
+        on_runs.append(_measure_serve_reqs(S, None))
+        off_runs.append(_measure_serve_reqs(S, dict(off_cfg)))
+        print(f"# serve ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": off_cfg, "ratio_on_off": ratio}
+
+
 def run_ab_fastpath(S: float, pairs: int) -> dict:
     """Interleaved same-box A/B: fast path ON vs OFF, alternating fresh
     clusters so box drift lands evenly on both arms."""
@@ -281,6 +333,10 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of the "
                         "submission fast path (inlining + spec caching + "
                         "lease pipelining) on vs off and embed the ratios")
+    p.add_argument("--ab-serve", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of "
+                        "serve_metrics_enabled on vs off (serve request "
+                        "throughput; the serve-observability overhead gate)")
     args = p.parse_args()
     _REPS = max(args.reps, 1)
 
@@ -317,6 +373,9 @@ def main():
                            for k in metrics if k in BASELINE}}
     if args.ab_fastpath > 0:
         out["fastpath_ab"] = run_ab_fastpath(args.scale, args.ab_fastpath)
+    if args.ab_serve > 0:
+        out["serve_metrics_ab"] = run_ab_serve_metrics(args.scale,
+                                                       args.ab_serve)
     line = json.dumps(out)
     print(line)
     if args.out:

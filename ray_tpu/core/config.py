@@ -223,6 +223,17 @@ class Config:
 
     # -- metrics -----------------------------------------------------------
     metrics_export_enabled: bool = True
+    #: Serve-plane observability (serve/observability.py): per-request
+    #: latency/TTFT/TPOT histograms, queue-depth gauges, batch occupancy,
+    #: KV/prefix-cache gauges, request-scoped stage spans, and the rolling
+    #: SLO window the controller aggregates.  One kill switch sheds ALL of
+    #: it (the serve hot path keeps only a boolean check per request) for
+    #: A/B overhead measurement — same discipline as rpc_metrics_enabled.
+    serve_metrics_enabled: bool = True
+    #: Rolling window over which each replica computes its TTFT
+    #: percentiles + queue-depth signal for the controller (the SLO
+    #: autoscaler input).  Samples older than this age out.
+    serve_slo_window_s: float = 60.0
     #: Per-method RPC client/server latency histograms + byte counters
     #: (core/rpc.py).  Cheap (one histogram observe per call) but the hot
     #: path can shed it entirely for A/B overhead measurement.

@@ -939,6 +939,10 @@ class CoreWorker:
     def start(self):
         run_async(self._start())
         set_global_worker(self)
+        # spans recorded before this process had a worker (driver pre-init)
+        # were buffered locally — drain them into the event stream now
+        from ray_tpu.util.tracing import flush_pending_spans
+        flush_pending_spans()
         return self
 
     @property
@@ -2553,18 +2557,31 @@ class CoreWorker:
             method = getattr(self.actor_instance, spec.actor_method)
             stages: Dict[str, list] = {}
             args, kwargs = self._resolve_args(spec, stages)
-            t_exec = time.time()
-            res = method(*args, **kwargs)
-            if asyncio.iscoroutine(res):
-                res = await res
-            if spec.num_returns == STREAMING_RETURNS:
-                # Sync generators route through the async driver too — its
-                # backpressure wait is awaitable, so a slow consumer parks
-                # only this task, not the actor's whole event loop.
-                return await self._run_generator_async(spec, res)
-            t_put = time.time()
-            stages["execute"] = [t_exec, t_put]
-            results = self._package_returns(spec, res)
+            # Async actor methods join the submitter's trace exactly like
+            # sync task execution (_execute_task): spans opened inside the
+            # method — a serve replica's batch_wait/prefill/decode stamps —
+            # chain under this task's span id, keeping a proxied request
+            # ONE connected trace across processes.
+            from ray_tpu.util import tracing as _tracing
+            trace_id = (spec.trace_ctx[0] if spec.trace_ctx
+                        else spec.task_id.hex()[:12])
+            trace_token = _tracing.set_context((trace_id,
+                                                spec.task_id.hex()[:12]))
+            try:
+                t_exec = time.time()
+                res = method(*args, **kwargs)
+                if asyncio.iscoroutine(res):
+                    res = await res
+                if spec.num_returns == STREAMING_RETURNS:
+                    # Sync generators route through the async driver too —
+                    # its backpressure wait is awaitable, so a slow consumer
+                    # parks only this task, not the actor's whole event loop.
+                    return await self._run_generator_async(spec, res)
+                t_put = time.time()
+                stages["execute"] = [t_exec, t_put]
+                results = self._package_returns(spec, res)
+            finally:
+                _tracing.reset_context(trace_token)
             stages["result_put"] = [t_put, time.time()]
             self.flush_borrower_notes()  # see _execute_task
             self._record_stages(spec, stages)

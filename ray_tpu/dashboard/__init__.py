@@ -7,6 +7,6 @@ of scope (the reference's is ~25k LoC of TypeScript), but every endpoint
 returns plain JSON consumable by curl / the CLI / a future UI.
 """
 
-from .head import DashboardHead, start_dashboard
+from .head import DashboardHead, start_dashboard, stop_dashboard
 
-__all__ = ["DashboardHead", "start_dashboard"]
+__all__ = ["DashboardHead", "start_dashboard", "stop_dashboard"]

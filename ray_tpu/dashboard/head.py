@@ -14,7 +14,8 @@ Reference endpoints mirrored (dashboard/modules/*):
   GET  /api/jobs/{id}          job info
   GET  /api/jobs/{id}/logs     job logs (text)
   POST /api/jobs/{id}/stop     stop a job
-  GET  /api/serve              serve app status (serve module)
+  GET  /api/serve              serve app status + per-deployment SLO rollup
+  GET  /api/serve/signal       SLO autoscaler signal (queue depth, TTFT pXX)
   GET  /api/timeline           chrome://tracing export (timeline)
 
 Runs inside the driver (``start_dashboard()``) or as a standalone actor.
@@ -170,6 +171,20 @@ class DashboardHead:
             return ray_tpu.get(ctrl.get_status.remote(), timeout=30)
 
         return _json(await _off(_status))
+
+    async def serve_signal(self, _req):
+        """The per-deployment SLO signal (queue depth + rolling TTFT
+        percentiles) in the autoscaler-contract shape — see
+        ServeController.get_serve_signal."""
+        from ray_tpu import serve as serve_api
+
+        def _signal():
+            try:
+                return serve_api.slo_signal()
+            except Exception:
+                return {}
+
+        return _json(await _off(_signal))
 
     async def serve_deploy(self, req):
         """Declarative deploy over REST (reference:
@@ -439,6 +454,7 @@ class DashboardHead:
         r.add_get("/api/jobs/{job_id}/logs", self.job_logs)
         r.add_post("/api/jobs/{job_id}/stop", self.job_stop)
         r.add_get("/api/serve", self.serve_status)
+        r.add_get("/api/serve/signal", self.serve_signal)
         r.add_post("/api/serve/deploy", self.serve_deploy)
         r.add_get("/api/stacks", self.stacks)
         r.add_get("/api/timeline", self.timeline)

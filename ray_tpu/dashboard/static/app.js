@@ -189,14 +189,35 @@ const pages = {
   },
 
   async serve() {
+    // /api/serve = controller get_status(): {name -> {status, version,
+    // target_replicas, slo, replicas[]}} — slo is the rolling
+    // queue-depth/TTFT signal each replica heartbeats to the controller.
     const s = await api("serve");
-    const deployments = s.deployments || s.applications || {};
-    const rows = Object.entries(deployments).map(([name, d]) => [
-      name, badge(d.status || (d.replicas ? "HEALTHY" : "?")),
-      d.num_replicas ?? d.replicas ?? "", d.route_prefix || ""]);
-    return h("div", {}, h("h2", {}, "Serve"),
-      rows.length ? table(["deployment", "status", "replicas", "route"], rows)
+    const ms = (v) => (v === undefined || v === null) ? "-" : `${v.toFixed(1)}ms`;
+    const rows = Object.entries(s).map(([name, d]) => {
+      const slo = d.slo || {};
+      const running = (d.replicas || []).filter((r) => r.state === "RUNNING").length;
+      return [name, badge(d.status || "?"),
+        `${running}/${d.target_replicas ?? "?"}`,
+        slo.queue_depth ?? 0, ms(slo.ttft_p50_ms), ms(slo.ttft_p95_ms),
+        ms(slo.ttft_p99_ms), slo.window_n ?? 0];
+    });
+    const view = h("div", {}, h("h2", {}, "Serve"),
+      rows.length ? table(["deployment", "status", "replicas", "queue depth",
+        "ttft p50", "ttft p95", "ttft p99", "window n"], rows)
         : h("p", { class: "muted" }, "no serve apps running"));
+    const reps = Object.entries(s).flatMap(([name, d]) =>
+      (d.replicas || []).map((r) => {
+        const slo = r.slo || {};
+        return [name, (r.name || "").slice(0, 28), badge(r.state),
+          r.ongoing ?? 0, ms(slo.ttft_p95_ms), slo.window_n ?? 0];
+      }));
+    if (reps.length) {
+      view.append(h("h2", {}, "Replicas"),
+        table(["deployment", "replica", "state", "ongoing", "ttft p95",
+          "window n"], reps));
+    }
+    return view;
   },
 
   async timeline() {

@@ -311,6 +311,11 @@ class PageAllocator:
     def available(self) -> int:
         return len(self._free)
 
+    def used(self) -> int:
+        """Pages currently referenced (the KV-utilization numerator; page 0
+        is the reserved null page and counts as neither used nor free)."""
+        return self.num_pages - 1 - len(self._free)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         if n > len(self._free):
             return None
@@ -344,6 +349,12 @@ class PrefixCache:
         self.page = page_size
         self._map: Dict[bytes, int] = {}        # chunk hash -> page id
         self._lru: List[bytes] = []
+        # lookup accounting (serve observability + bench_llm read these):
+        # a lookup is a hit when >= 1 page was reused
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_reused = 0
+        self.evictions = 0
 
     @staticmethod
     def _hash(tokens: Sequence[int]) -> bytes:
@@ -351,11 +362,18 @@ class PrefixCache:
             b"".join(int(t).to_bytes(4, "little") for t in tokens),
             digest_size=16).digest()
 
-    def match_prefix(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+    def match_prefix(self, tokens: Sequence[int],
+                     max_pages: Optional[int] = None
+                     ) -> Tuple[int, List[int]]:
         """Longest reusable page-aligned prefix.  Returns (n_tokens_reused,
-        page_ids) with refcounts already taken."""
+        page_ids) with refcounts already taken.  ``max_pages`` caps the
+        reuse (the LLM engine must leave >= 1 prompt token to prefill for
+        logits) — capping HERE keeps the hit/tokens_reused counters in
+        agreement with what the caller actually reuses."""
         pages: List[int] = []
         n_full = len(tokens) // self.page
+        if max_pages is not None:
+            n_full = min(n_full, max_pages)
         reused = 0
         for i in range(n_full):
             key = self._hash(tokens[:(i + 1) * self.page])
@@ -367,6 +385,23 @@ class PrefixCache:
         if pages:
             self.alloc.incref(pages)
         return reused, pages
+
+    def count_lookup(self, tokens_reused: int):
+        """Account one admission's prefix reuse — called once per ADMITTED
+        request, not inside match_prefix: an arena-full backpressure retry
+        re-runs the lookup and must not double-count, or hit_rate inflates
+        exactly when the engine is under KV memory pressure."""
+        self.lookups += 1
+        if tokens_reused > 0:
+            self.hits += 1
+            self.tokens_reused += tokens_reused
+
+    def stats(self) -> Dict[str, float]:
+        return {"lookups": self.lookups, "hits": self.hits,
+                "hit_rate": self.hits / self.lookups if self.lookups else 0.0,
+                "tokens_reused": self.tokens_reused,
+                "cached_pages": len(self._map),
+                "evictions": self.evictions}
 
     def insert(self, tokens: Sequence[int], page_ids: Sequence[int]):
         """Register freshly-filled full pages for future reuse.  The cache
@@ -390,4 +425,5 @@ class PrefixCache:
             if pid is not None:
                 self.alloc.release([pid])
                 dropped += 1
+        self.evictions += dropped
         return dropped

@@ -371,12 +371,40 @@ def cmd_serve(args):
         except KeyboardInterrupt:
             pass
     elif args.action == "status":
-        print(json.dumps(serve_schema.status_summary(), indent=2,
-                         default=str))
+        status = serve_schema.status_summary()
+        if args.json or not status:
+            print(json.dumps(status, indent=2, default=str))
+        else:
+            _print_serve_status(status)
     elif args.action == "shutdown":
         from ray_tpu import serve as serve_api
         serve_api.shutdown()
         print("serve shut down")
+
+
+def _print_serve_status(status: dict):
+    """Per-deployment table with the SLO signal surface: replica counts,
+    live queue depth, and the rolling TTFT percentiles each replica
+    piggybacks on its health-check heartbeat (worst replica wins) — the
+    exact per-deployment signal the SLO autoscaler consumes."""
+    print(f"{'DEPLOYMENT':<20} {'STATUS':<10} {'REPLICAS':>8} "
+          f"{'QUEUE':>6} {'TTFT p50':>9} {'TTFT p95':>9} "
+          f"{'TTFT p99':>9} {'WINDOW':>7}")
+
+    def ms(v):
+        return f"{v:.1f}ms" if v is not None else "-"
+
+    for name, d in sorted(status.items()):
+        slo = d.get("slo") or {}
+        running = len([r for r in d.get("replicas", [])
+                       if r.get("state") == "RUNNING"])
+        print(f"{name:<20} {d.get('status', '?'):<10} "
+              f"{running}/{d.get('target_replicas', '?'):<6} "
+              f"{slo.get('queue_depth', 0):>6} "
+              f"{ms(slo.get('ttft_p50_ms')):>9} "
+              f"{ms(slo.get('ttft_p95_ms')):>9} "
+              f"{ms(slo.get('ttft_p99_ms')):>9} "
+              f"{slo.get('window_n', 0):>7}")
 
 
 # ------------------------------------------------------------------ main
@@ -448,6 +476,8 @@ def main(argv=None):
                    help="config file (deploy) or module:app (run)")
     s.add_argument("--route-prefix", default=None)
     s.add_argument("--no-wait", action="store_true")
+    s.add_argument("--json", action="store_true",
+                   help="status: raw JSON instead of the SLO table")
     s.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)

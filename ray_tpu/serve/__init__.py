@@ -10,7 +10,7 @@ a TPU-first continuous-batching LLM deployment (``ray_tpu.serve.llm``).
 """
 
 from .api import (delete, get_deployment_handle, grpc_config, http_config,
-                  run, shutdown, start, status)
+                  run, shutdown, slo_signal, start, status)
 from .asgi import ASGIApp, ASGIRequest, ingress
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -25,7 +25,7 @@ __all__ = [
     "DeploymentHandle", "Request", "batch", "run", "start", "status",
     "delete", "shutdown", "get_deployment_handle", "http_config",
     "multiplexed", "get_multiplexed_model_id", "DAGDriver",
-    "ingress", "ASGIApp", "ASGIRequest", "grpc_config",
+    "ingress", "ASGIApp", "ASGIRequest", "grpc_config", "slo_signal",
 ]
 
 # Usage telemetry: which libraries a cluster actually uses (reference:

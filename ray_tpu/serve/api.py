@@ -125,6 +125,15 @@ def status() -> dict:
     return ray_tpu.get(ctrl.get_status.remote(), timeout=30)
 
 
+def slo_signal() -> dict:
+    """Per-deployment SLO signal (queue depth + rolling p50/p95/p99 TTFT
+    from the replicas' heartbeat windows) — the documented input contract
+    for SLO-driven autoscaling.  Same data ``raytpu serve status`` tables
+    and ``/api/serve`` embed."""
+    ctrl = _get_controller()
+    return ray_tpu.get(ctrl.get_serve_signal.remote(), timeout=30)
+
+
 def http_config() -> Optional[dict]:
     ctrl = _get_controller()
     return ray_tpu.get(ctrl.get_http_config.remote(), timeout=30)

@@ -12,7 +12,10 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import time
 from typing import Any, Callable, List, Optional
+
+from . import observability as obs
 
 
 class _BatchQueue:
@@ -31,7 +34,13 @@ class _BatchQueue:
 
     async def submit(self, instance, args, kwargs) -> Any:
         fut = asyncio.get_event_loop().create_future()
-        await self.queue.put((instance, args, kwargs, fut))
+        # enqueue stamp: (deployment tag, trace ctx, wall clock) ride the
+        # item so _run_batch can account each member's batch_wait and chain
+        # its span under the request that queued it
+        from ray_tpu.util import tracing
+        item_obs = (obs.current_deployment(), tracing.current_context(),
+                    time.time()) if obs.enabled() else None
+        await self.queue.put((instance, args, kwargs, fut, item_obs))
         self._ensure_flusher()
         return await fut
 
@@ -60,6 +69,7 @@ class _BatchQueue:
         kw_lists = {k: [item[2][k] for item in batch]
                     for k in batch[0][2]}
         futs = [item[3] for item in batch]
+        self._record_flush(batch)
         try:
             if instance is not None:
                 results = self.fn(instance, *arg_lists, **kw_lists)
@@ -78,6 +88,29 @@ class _BatchQueue:
             for fut in futs:
                 if not fut.done():
                     fut.set_exception(e)
+
+    def _record_flush(self, batch: List[tuple]):
+        """Observability for one flushed batch: occupancy (how full vs
+        max_batch_size — padding waste is the complement), each member's
+        queue wait, and a ``batch_wait`` span per member chained under the
+        request that queued it."""
+        stamps = [item[4] for item in batch if item[4] is not None]
+        if not stamps or not obs.enabled():
+            return
+        now = time.time()
+        deployment = stamps[0][0]
+        obs.record_batch(deployment, len(batch), self.max_batch_size,
+                         waits_s=[now - t0 for _d, _c, t0 in stamps])
+        for _dep, ctx, t0 in stamps:
+            if ctx is None:
+                # no request trace: skip rather than let record_span fall
+                # back to the flusher TASK's inherited context (which is
+                # whatever request created the flusher — the span would
+                # chain into an unrelated request's trace)
+                continue
+            obs.stamp_span("batch_wait", t0, now - t0,
+                           trace_id=ctx[0], parent_id=ctx[1],
+                           deployment=deployment)
 
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,

@@ -45,7 +45,8 @@ HEALTH_FAILURE_THRESHOLD = 3
 
 class _Replica:
     __slots__ = ("name", "handle", "version", "state", "failures",
-                 "started_at", "last_ongoing", "code_hash", "last_probe")
+                 "started_at", "last_ongoing", "code_hash", "last_probe",
+                 "last_slo")
 
     def __init__(self, name: str, handle, version: str,
                  code_hash: Optional[str] = None):
@@ -58,6 +59,10 @@ class _Replica:
         self.last_ongoing = 0
         self.code_hash = code_hash
         self.last_probe = 0.0
+        #: rolling SLO snapshot the replica piggybacks on health checks
+        #: ({queue_depth, ttft_p50/p95/p99_ms, window_n} — serve/
+        #: observability.slo_snapshot)
+        self.last_slo: dict = {}
 
 
 class _DeploymentState:
@@ -89,6 +94,26 @@ class _DeploymentState:
         return [r for r in self.replicas
                 if r.state == RUNNING
                 and (version is None or r.version == version)]
+
+    def slo_rollup(self) -> dict:
+        """Deployment-level SLO signal from the replicas' heartbeat
+        snapshots: total queue depth, and the WORST replica's rolling TTFT
+        percentiles (the conservative scaling signal — one hot replica is
+        exactly what an SLO autoscaler must react to)."""
+        running = self.running()
+        out = {
+            "queue_depth": sum(
+                int(r.last_slo.get("queue_depth", r.last_ongoing))
+                for r in running),
+            "window_n": sum(int(r.last_slo.get("window_n", 0))
+                            for r in running),
+        }
+        for p in ("p50", "p95", "p99"):
+            key = f"ttft_{p}_ms"
+            vals = [r.last_slo[key] for r in running if key in r.last_slo]
+            if vals:
+                out[key] = max(vals)
+        return out
 
     def status(self) -> str:
         if self.deleting:
@@ -126,6 +151,10 @@ class ServeController:
 
     async def startup(self) -> bool:
         """Idempotent: spawn the reconcile loop on the actor's event loop."""
+        from . import observability as obs
+        # a wedged reconcile loop surfaces as
+        # raytpu_event_loop_lag_seconds{process="serve_controller"}
+        obs.ensure_loop_monitor(self, "serve_controller")
         if self._loop_task is None or self._loop_task.done():
             self._table_event = asyncio.Event()
             self._loop_task = asyncio.get_event_loop().create_task(
@@ -246,10 +275,33 @@ class ServeController:
                 "status": ds.status(),
                 "version": ds.version,
                 "target_replicas": ds.target_count(),
+                "slo": ds.slo_rollup(),
                 "replicas": [
                     {"name": r.name, "state": r.state, "version": r.version,
-                     "ongoing": r.last_ongoing}
+                     "ongoing": r.last_ongoing, "slo": r.last_slo}
                     for r in ds.replicas],
+            }
+        return out
+
+    async def get_serve_signal(self):
+        """The SLO autoscaler input contract, one row per deployment:
+        ``{deployment: {queue_depth, ttft_p50_ms?, ttft_p95_ms?,
+        ttft_p99_ms?, window_n, running_replicas, target_replicas, ts}}``.
+        Queue depth is the live total across RUNNING replicas; TTFT
+        percentiles are the worst replica's rolling window (absent until a
+        replica has served a request inside the window).  Consumed by
+        ``raytpu serve status``, ``/api/serve`` dashboards, and the future
+        SLO-driven autoscaling policy."""
+        now = time.time()
+        out = {}
+        for name, ds in self._deployments.items():
+            if ds.deleting:
+                continue
+            out[name] = {
+                **ds.slo_rollup(),
+                "running_replicas": len(ds.running()),
+                "target_replicas": ds.target_count(),
+                "ts": now,
             }
         return out
 
@@ -348,6 +400,7 @@ class ServeController:
                     ds.config.health_check_timeout_s)
                 r.failures = 0
                 r.last_ongoing = int(res.get("ongoing", 0))
+                r.last_slo = res.get("slo") or {}
                 if r.state == STARTING:
                     r.state = RUNNING
                     changed = True

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from . import observability as obs
 from .deployment import deployment as serve_deployment
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
@@ -44,7 +45,9 @@ _FLUSH = object()
 class GenRequest:
     __slots__ = ("tokens", "max_tokens", "temperature", "top_k", "eos_id",
                  "out", "slot", "generated", "submitted_at", "first_token_at",
-                 "pages")
+                 "pages", "prompt_len", "deployment", "trace_ctx",
+                 "submitted_wall", "admitted_wall", "first_token_wall",
+                 "span_parent")
 
     def __init__(self, tokens: List[int], max_tokens: int,
                  temperature: float, top_k: int, eos_id: Optional[int]):
@@ -57,8 +60,20 @@ class GenRequest:
         self.slot = -1
         self.pages: List[int] = []
         self.generated = 0
+        self.prompt_len = len(tokens)
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
+        # observability: who/what this request belongs to (the replica's
+        # deployment tag + the caller's trace context, captured at submit
+        # on the caller's thread) and the wall-clock stage stamps the
+        # engine thread turns into batch_wait/prefill/decode spans
+        self.deployment = "-"
+        self.trace_ctx: Optional[tuple] = None
+        self.submitted_wall = time.time()
+        self.admitted_wall: Optional[float] = None
+        self.first_token_wall: Optional[float] = None
+        #: previous stage's span id — batch_wait -> prefill -> decode chain
+        self.span_parent: Optional[str] = None
 
 
 class LLMEngine:
@@ -179,6 +194,12 @@ class LLMEngine:
         # steady-state metrics
         self.steps = 0
         self.tokens_out = 0
+        # admission accounting (padding waste = padded rows the fixed-size
+        # prefill batch shipped for nothing; bench_llm reads these)
+        self.admit_batches = 0
+        self.admit_rows_real = 0
+        self.admit_rows_padded = 0
+        self._obs_dep = "-"  # deployment tag, learned from first request
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-engine")
         self._thread.start()
@@ -196,6 +217,22 @@ class LLMEngine:
                              f"{self.max_len}")
         req = GenRequest(list(map(int, tokens)), max_tokens, temperature,
                          top_k, eos_id)
+        if obs.enabled():
+            # caller-thread capture: the replica set both before invoking
+            # user code, so engine-side spans/metrics carry the request's
+            # deployment tag and chain into its trace
+            req.deployment = obs.current_deployment()
+            from ray_tpu.util import tracing
+            req.trace_ctx = tracing.current_context()
+            if req.trace_ctx is None:
+                # standalone engine use (no serve request context): mint
+                # ONE trace per request so batch_wait -> prefill -> decode
+                # still chain together instead of three orphan traces with
+                # dangling cross-trace parent links
+                req.trace_ctx = (tracing.new_id(), None)
+            if req.deployment != "-":
+                self._obs_dep = req.deployment
+            obs.add_tokens(req.deployment, "in", req.prompt_len)
         self._pending.put(req)
         self._wake.set()
         return req
@@ -218,6 +255,32 @@ class LLMEngine:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
+
+    def breakdown(self) -> dict:
+        """Serving-picture rollup (bench_llm records this next to the
+        per-request percentiles): admission batch occupancy + padding
+        waste, KV page utilization, prefix-cache hit rate."""
+        rows = self.admit_rows_real + self.admit_rows_padded
+        out = {
+            "admit_batches": self.admit_batches,
+            "batch_occupancy": (self.admit_rows_real / rows) if rows else 0.0,
+            "padding_fraction": (self.admit_rows_padded / rows) if rows
+            else 0.0,
+            "active_slots": len(self._active),
+            "num_slots": self.num_slots,
+        }
+        if self.paged:
+            # total = ALLOCATABLE pages (page 0 is the reserved null page),
+            # so used/total equals the utilization field
+            allocatable = max(self.num_pages - 1, 1)
+            out["kv_pages"] = {
+                "total": allocatable,
+                "used": self.allocator.used(),
+                "utilization": self.allocator.used() / allocatable,
+            }
+            out["prefix_cache"] = (self.prefix.stats()
+                                   if self.prefix is not None else None)
+        return out
 
     def warmup(self, bucket: Optional[int] = None):
         """Compile prefill(bucket)+decode ahead of traffic."""
@@ -269,6 +332,74 @@ class LLMEngine:
             return jax.tree_util.tree_unflatten(treedef, placed)
 
         return place(params), place(cache)
+
+    # ----------------------------------------------------- observability
+
+    def _obs_admit(self, reqs: List[GenRequest]):
+        """One successful admit batch: padding accounting, occupancy +
+        queue-wait metrics, batch_wait span per request (chained under
+        the request's trace), KV/slot gauges.  Engine-thread side; every
+        metric call is a precomputed-key observe behind one enabled()
+        check."""
+        self.admit_batches += 1
+        self.admit_rows_real += len(reqs)
+        self.admit_rows_padded += self.prefill_batch - len(reqs)
+        if not obs.enabled():
+            return
+        now = time.time()
+        dep = self._obs_dep
+        obs.record_batch(dep, len(reqs), self.prefill_batch,
+                         waits_s=[now - r.submitted_wall for r in reqs])
+        self._obs_gauges()
+        for r in reqs:
+            r.admitted_wall = now
+            r.span_parent = obs.stamp_span(
+                "batch_wait", r.submitted_wall, now - r.submitted_wall,
+                trace_id=r.trace_ctx[0] if r.trace_ctx else None,
+                parent_id=r.trace_ctx[1] if r.trace_ctx else None,
+                deployment=r.deployment)
+
+    def _obs_first_token(self, r: GenRequest, now_mono: float):
+        """Prefill finished for one request: engine-level TTFT (the rolling
+        SLO window takes the replica-level sample instead — one per
+        request) + the ``prefill`` span, chained under batch_wait."""
+        if not obs.enabled():
+            return
+        r.first_token_wall = time.time()
+        obs.observe_ttft(r.deployment, now_mono - r.submitted_at,
+                         stage="engine", window=False)
+        t0 = r.admitted_wall or r.submitted_wall
+        r.span_parent = obs.stamp_span(
+            "prefill", t0, r.first_token_wall - t0,
+            trace_id=r.trace_ctx[0] if r.trace_ctx else None,
+            parent_id=r.span_parent,
+            deployment=r.deployment, prompt_len=r.prompt_len)
+
+    def _obs_retire(self, r: GenRequest):
+        """Generation done: decode span (first token -> last), TPOT, token
+        counters, refreshed slot/KV gauges."""
+        if not obs.enabled():
+            return
+        obs.add_tokens(r.deployment, "out", r.generated)
+        now = time.time()
+        if r.first_token_wall is not None:
+            obs.stamp_span(
+                "decode", r.first_token_wall, now - r.first_token_wall,
+                trace_id=r.trace_ctx[0] if r.trace_ctx else None,
+                parent_id=r.span_parent,
+                deployment=r.deployment, tokens=r.generated)
+        if r.generated > 1 and r.first_token_at is not None:
+            obs.observe_tpot(r.deployment,
+                             (time.monotonic() - r.first_token_at)
+                             / (r.generated - 1))
+        self._obs_gauges()
+
+    def _obs_gauges(self):
+        obs.set_engine_gauges(
+            self._obs_dep, len(self._active),
+            kv_pages_used=self.allocator.used() if self.paged else None,
+            kv_pages_total=(max(self.num_pages - 1, 1) if self.paged
+                            else None))
 
     # -------------------------------------------------------- scheduler
 
@@ -391,6 +522,7 @@ class LLMEngine:
             snapshot[s] = r
         self._unfetched.append((first, snapshot, slots))
         self.steps += 1
+        self._obs_admit(reqs)
 
     def _plan_pages(self, r: GenRequest):
         """Reserve pages for one request: reuse cached prefix pages, allocate
@@ -400,13 +532,11 @@ class LLMEngine:
         total = min(len(r.tokens) + r.max_tokens + 1, self.max_len)
         reused, rpages = 0, []
         if self.prefix is not None:
-            reused, rpages = self.prefix.match_prefix(r.tokens)
-            # always leave >= 1 prompt token for the prefill (logits needed)
-            max_reuse_pages = (len(r.tokens) - 1) // page
-            if len(rpages) > max_reuse_pages:
-                self.allocator.release(rpages[max_reuse_pages:])
-                rpages = rpages[:max_reuse_pages]
-                reused = max_reuse_pages * page
+            # always leave >= 1 prompt token for the prefill (logits
+            # needed) — capped inside the lookup so the counters below
+            # match the reuse actually granted
+            reused, rpages = self.prefix.match_prefix(
+                r.tokens, max_pages=(len(r.tokens) - 1) // page)
         need = -(-total // page) - len(rpages)
         private = self.allocator.alloc(need)
         if private is None and self.prefix is not None:
@@ -415,6 +545,11 @@ class LLMEngine:
         if private is None:
             self.allocator.release(rpages)
             return None
+        if self.prefix is not None:
+            # counted only on a SUCCESSFUL plan: an arena-full requeue
+            # retries this whole function and must not double-count
+            self.prefix.count_lookup(reused)
+            obs.record_prefix_lookup(r.deployment, reused > 0, reused)
         return reused, rpages + private
 
     def _admit_paged(self, reqs: List[GenRequest], bucket: int):
@@ -467,6 +602,7 @@ class LLMEngine:
                                    r.pages[:len(r.tokens) // self.page_size])
         self._unfetched.append((first, snapshot, slots))
         self.steps += 1
+        self._obs_admit(preqs)
 
     def _dispatch_step(self):
         self.cache, self._state, emitted = self._decode_fn(
@@ -484,6 +620,7 @@ class LLMEngine:
             for i, s in enumerate(prefill_slots):
                 r = snapshot[s]
                 r.first_token_at = now
+                self._obs_first_token(r, now)
                 self._emit(r, int(tokens[i]))
         else:
             # decode entry: [steps_per_dispatch, slots]
@@ -512,6 +649,7 @@ class LLMEngine:
         if r.slot in self._active and self._active[r.slot] is r:
             del self._active[r.slot]
             self._free_slots.append(r.slot)
+            self._obs_retire(r)
             if self.paged and r.pages:
                 # refcounted: shared prefix pages survive on the prefix
                 # cache's refs; private pages return to the free list.
@@ -568,7 +706,8 @@ class LLMServer:
         return {"steps": self.engine.steps,
                 "tokens_out": self.engine.tokens_out,
                 "active": len(self.engine._active),
-                "free_slots": len(self.engine._free_slots)}
+                "free_slots": len(self.engine._free_slots),
+                **self.engine.breakdown()}
 
 
 def llm_deployment(preset: str = "tiny", *, num_replicas: int = 1,

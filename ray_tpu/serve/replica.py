@@ -16,6 +16,8 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from . import observability as obs
+
 
 class Request:
     """Lightweight HTTP request container handed to deployments that take one
@@ -62,6 +64,43 @@ class ReplicaActor:
         if user_config is not None:
             self._apply_user_config(user_config)
 
+    # ------------------------------------------------------- observability
+
+    def _obs_begin(self):
+        """Per-request instrumentation entry: install the event-loop stall
+        monitor once (this runs ON the actor loop — __init__ does not),
+        publish queue depth, and tag downstream instrumentation
+        (@serve.batch, the LLM engine) with this deployment's config
+        name.  Returns (t0, ctx token) for _obs_end."""
+        obs.ensure_loop_monitor(
+            self, f"serve_replica:{self.deployment_name}")
+        obs.set_replica_queue_depth(self.deployment_name, self.num_ongoing)
+        return time.monotonic(), obs.set_current_deployment(
+            self.deployment_name)
+
+    def _obs_end(self, begin, first_token_at: Optional[float] = None,
+                 ok: bool = True, window: bool = True):
+        """Request done: one TTFT sample into the histogram + rolling SLO
+        window (streaming requests pass their first-chunk time; unary
+        requests' TTFT is their full latency — the first response byte).
+        Failed requests don't feed anything (an instant exception is not a
+        fast first token — it would drag the SLO percentiles DOWN exactly
+        when the deployment is misbehaving), and named-method calls
+        (``window=False``: h.stats.remote() and other introspection/
+        control routes) skip the WINDOW so fast non-inference polls can't
+        mask real serving degradation — they still land in the TTFT
+        histogram under the same deployment tag."""
+        t0, token = begin
+        obs.set_replica_queue_depth(self.deployment_name, self.num_ongoing)
+        if ok:
+            obs.observe_ttft(self.deployment_name,
+                             (first_token_at if first_token_at is not None
+                              else time.monotonic()) - t0,
+                             window=window)
+        # last: the ctx reset is the one step that can be running inside
+        # asyncgen finalization (foreign context) — nothing may depend on it
+        obs.reset_current_deployment(token)
+
     # ------------------------------------------------------------- serving
 
     def _resolve(self, method: Optional[str]):
@@ -79,6 +118,8 @@ class ReplicaActor:
         if self._draining:
             raise RuntimeError(f"replica {self.replica_id} is draining")
         self.num_ongoing += 1
+        begin = self._obs_begin()
+        ok = False
         try:
             if args and isinstance(args[0], Request):
                 from .multiplex import _set_current_model_id
@@ -90,10 +131,12 @@ class ReplicaActor:
             if inspect.isgenerator(out) or inspect.isasyncgen(out):
                 raise TypeError(
                     "streaming responses go through handle_request_streaming")
+            ok = True
             return out
         finally:
             self.num_ongoing -= 1
             self.num_processed += 1
+            self._obs_end(begin, ok=ok, window=method is None)
 
     async def handle_request_streaming(self, stream_id: str, args: tuple,
                                        kwargs: dict,
@@ -105,24 +148,34 @@ class ReplicaActor:
         self.num_ongoing += 1
         self._streams[stream_id] = []
         self._stream_done[stream_id] = False
+        begin = self._obs_begin()
+        first_at: Optional[float] = None
+        ok = False
         try:
             fn = self._resolve(method)
             out = fn(*args, **kwargs)
             if inspect.isasyncgen(out):
                 async for chunk in out:
+                    if first_at is None:
+                        first_at = time.monotonic()
                     self._streams[stream_id].append(chunk)
             elif inspect.isgenerator(out):
                 for chunk in out:
+                    if first_at is None:
+                        first_at = time.monotonic()
                     self._streams[stream_id].append(chunk)
                     await asyncio.sleep(0)  # let pollers interleave
             else:
                 if inspect.iscoroutine(out):
                     out = await out
                 self._streams[stream_id].append(out)
+            ok = True
         finally:
             self._stream_done[stream_id] = True
             self.num_ongoing -= 1
             self.num_processed += 1
+            self._obs_end(begin, first_token_at=first_at, ok=ok,
+                          window=method is None)
 
     async def handle_request_gen(self, args: tuple, kwargs: dict,
                                  method: Optional[str] = None):
@@ -134,6 +187,9 @@ class ReplicaActor:
         if self._draining:
             raise RuntimeError(f"replica {self.replica_id} is draining")
         self.num_ongoing += 1
+        begin = self._obs_begin()
+        first_at: Optional[float] = None
+        ok = False
         try:
             fn = self._resolve(method)
             out = fn(*args, **kwargs)
@@ -141,16 +197,24 @@ class ReplicaActor:
                 out = await out
             if inspect.isasyncgen(out):
                 async for chunk in out:
+                    if first_at is None:
+                        first_at = time.monotonic()
                     yield chunk
             elif inspect.isgenerator(out):
                 for chunk in out:
+                    if first_at is None:
+                        first_at = time.monotonic()
                     yield chunk
                     await asyncio.sleep(0)  # keep the actor loop responsive
             else:
+                first_at = time.monotonic()
                 yield out
+            ok = True
         finally:
             self.num_ongoing -= 1
             self.num_processed += 1
+            self._obs_end(begin, first_token_at=first_at, ok=ok,
+                          window=method is None)
 
     async def next_chunks(self, stream_id: str, cursor: int) -> tuple:
         """Poll a stream: returns (new_chunks, next_cursor, done)."""
@@ -192,8 +256,13 @@ class ReplicaActor:
             res = target.check_health()
             if inspect.iscoroutine(res):
                 await res
+        # SLO heartbeat piggyback: the rolling TTFT percentiles + queue
+        # depth ride the health check the controller already runs — no
+        # extra RPC, and the controller aggregates per deployment.
         return {"ongoing": self.num_ongoing, "processed": self.num_processed,
-                "draining": self._draining}
+                "draining": self._draining,
+                "slo": obs.slo_snapshot(self.deployment_name,
+                                        self.num_ongoing)}
 
     async def queue_len(self) -> int:
         return self.num_ongoing

@@ -65,9 +65,23 @@ class Router:
         self._table: Dict[str, List[str]] = {}       # deployment -> replica names
         self._handles: Dict[str, Any] = {}           # replica name -> handle
         self._inflight: Dict[str, int] = {}          # replica name -> local count
+        self._dep_inflight: Dict[str, int] = {}      # queue-depth gauge feed
         self._last_refresh = 0.0
         self._table_version = -1
         self._lock = threading.Lock()
+
+    def _track(self, deployment: str, delta: int):
+        from . import observability as obs
+        if not obs.enabled():  # kill switch sheds the lock + bookkeeping too
+            return
+        # under _lock, including the gauge publish: increments come from N
+        # client threads while decrements run in as_future done-callbacks —
+        # an unlocked RMW would lose updates, and publishing outside the
+        # lock could land a stale value last and pin the gauge there
+        with self._lock:
+            n = max(0, self._dep_inflight.get(deployment, 0) + delta)
+            self._dep_inflight[deployment] = n
+            obs.set_router_queue_depth(deployment, n)
 
     # ------------------------------------------------------------ table
 
@@ -153,6 +167,7 @@ class Router:
                 self._evict(deployment, name)
                 continue
             self._inflight[name] = self._inflight.get(name, 0) + 1
+            self._track(deployment, +1)
             self._attach_done(ref, deployment, name)
             return name, ref
         raise last_err or RuntimeError("routing failed")
@@ -162,6 +177,7 @@ class Router:
 
         def _done(f):
             self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+            self._track(deployment, -1)
             exc = f.exception()
             if isinstance(exc, (ActorDiedError, ActorUnavailableError)):
                 self._evict(deployment, name)
@@ -180,6 +196,13 @@ class Router:
                 h = self._replica_handle(name)
                 ref = h.handle_request_streaming.remote(stream_id, args,
                                                         kwargs, method)
+                # streams count toward p2c load + the queue-depth gauge
+                # like unary calls — long-lived LLM streams are exactly
+                # the traffic the SLO signal must see; the completion ref
+                # resolves when the generator finishes, releasing both
+                self._inflight[name] = self._inflight.get(name, 0) + 1
+                self._track(deployment, +1)
+                self._attach_done(ref, deployment, name)
                 return name, stream_id, ref
             except Exception as e:  # noqa: BLE001
                 last = e

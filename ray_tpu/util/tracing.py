@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -28,6 +29,104 @@ _ctx: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
 
 def new_id() -> str:
     return os.urandom(6).hex()
+
+
+#: Spans recorded while NO global worker exists (driver before ``init``,
+#: a serve proxy process mid-boot) buffer here instead of being dropped,
+#: and drain into the worker's task-event stream (-> GCS) the next time a
+#: span is recorded with a worker present, or via ``flush_pending_spans``.
+#: Bounded: a process that never gets a worker must not grow forever.
+#: The lock covers the check-then-append and copy-then-clear windows —
+#: spans are recorded from arbitrary threads (actor loops, the LLM engine
+#: thread) racing the worker appearing, and an unsynchronized drain could
+#: drop a concurrently-buffered span or deliver the backlog twice.
+_pending: List[dict] = []
+_pending_lock = threading.Lock()
+_PENDING_MAX = 10_000
+
+
+def _append_event(ev: dict) -> None:
+    """Land one span event: into the worker's task-event stream when one
+    exists (its flush loop ships batches to the GCS), else into the local
+    pending buffer.  Any buffered backlog drains first so ordering by
+    ``ts`` survives the buffer hop."""
+    from ray_tpu.core.core_worker import global_worker_or_none
+
+    try:
+        with _pending_lock:
+            w = global_worker_or_none()
+            if w is None:
+                if len(_pending) < _PENDING_MAX:
+                    _pending.append(ev)
+                return
+            if _pending:
+                w._task_events.extend(_pending)
+                _pending.clear()
+            w._task_events.append(ev)
+    except Exception:
+        pass
+
+
+def flush_pending_spans() -> int:
+    """Drain spans buffered while no worker existed into the (now
+    present) worker's event stream; returns how many moved.  No-op when
+    there is still no worker."""
+    from ray_tpu.core.core_worker import global_worker_or_none
+
+    try:
+        with _pending_lock:
+            w = global_worker_or_none()
+            if w is None or not _pending:
+                return 0
+            n = len(_pending)
+            w._task_events.extend(_pending)
+            _pending.clear()
+            return n
+    except Exception:
+        return 0
+
+
+def record_span(name: str, t0: float, dur: float, *,
+                trace_id: Optional[str] = None,
+                span_id: Optional[str] = None,
+                parent_id: Optional[str] = None,
+                **attributes) -> str:
+    """Explicit-timestamp span record — for instrumentation whose begin and
+    end straddle awaits or thread hops (serve request stages: the proxy's
+    ``router_queue``, the engine's ``prefill``/``decode``), where a
+    ``with span()`` block cannot bracket the measured interval.  Defaults
+    parent/trace to the ambient context; returns the span id so a caller
+    can chain a follow-up stage under this one."""
+    parent = _ctx.get()
+    if trace_id is None:
+        trace_id = parent[0] if parent else new_id()
+    if parent_id is None and parent is not None:
+        parent_id = parent[1]
+    if span_id is None:
+        span_id = new_id()
+    _append_event({
+        "task_id": f"span-{name}-{int(t0 * 1e6)}",
+        "name": name, "state": "SPAN",
+        "job_id": "", "ts": t0, "dur": max(dur, 0.0),
+        "actor_id": None,
+        "attributes": attributes or None,
+        "worker": _worker_hint(),
+        "trace_id": trace_id, "span_id": span_id,
+        "parent_id": parent_id,
+    })
+    return span_id
+
+
+def _worker_hint() -> str:
+    from ray_tpu.core.core_worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if w is not None:
+        try:
+            return w.worker_id.hex()[:12]
+        except Exception:
+            pass
+    return f"pid-{os.getpid()}"
 
 
 def current_context() -> Optional[Tuple[str, str]]:
@@ -48,7 +147,10 @@ def reset_context(token):
 def span(name: str, **attributes) -> Iterator[None]:
     """User-code span: records begin/end into the task-event stream, so user
     phases land in the same timeline as task state transitions.  Nested
-    spans and remote calls made inside chain to it via the context var."""
+    spans and remote calls made inside chain to it via the context var.
+    With no global worker yet (driver before ``init``, a serve proxy
+    process mid-boot) the record buffers locally and flushes through the
+    worker/GCS path once one exists — never silently dropped."""
     from ray_tpu.core.core_worker import global_worker_or_none
 
     w = global_worker_or_none()
@@ -61,21 +163,18 @@ def span(name: str, **attributes) -> Iterator[None]:
         yield
     finally:
         _ctx.reset(token)
-        if w is not None:
-            try:
-                w._task_events.append({
-                    "task_id": f"span-{name}-{int(t0 * 1e6)}",
-                    "name": name, "state": "SPAN",
-                    "job_id": w.job_id.hex() if w.job_id else "",
-                    "ts": t0, "dur": time.time() - t0,
-                    "actor_id": None,
-                    "attributes": attributes or None,
-                    "worker": w.worker_id.hex()[:12],
-                    "trace_id": trace_id, "span_id": span_id,
-                    "parent_id": parent[1] if parent else None,
-                })
-            except Exception:
-                pass
+        _append_event({
+            "task_id": f"span-{name}-{int(t0 * 1e6)}",
+            "name": name, "state": "SPAN",
+            "job_id": (w.job_id.hex() if w is not None and w.job_id
+                       else ""),
+            "ts": t0, "dur": time.time() - t0,
+            "actor_id": None,
+            "attributes": attributes or None,
+            "worker": _worker_hint(),
+            "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent[1] if parent else None,
+        })
 
 
 def _pid_for(ev: dict) -> str:

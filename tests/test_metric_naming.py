@@ -80,6 +80,110 @@ def _check_call(path, call, cls, problems):
         # positional tag_keys would be args[2] — nothing in-tree uses it
 
 
+# ------------------------------------------------------- serve cardinality
+
+SERVE_DIR = PKG_ROOT / "serve"
+#: observability entry points whose arguments become raytpu_serve_* tag
+#: values (deployment / route / status / ...)
+OBS_TAGGED_FNS = {
+    "record_request", "observe_ttft", "observe_tpot", "add_tokens",
+    "set_router_queue_depth", "set_replica_queue_depth", "record_batch",
+    "set_engine_gauges", "record_prefix_lookup", "stamp_span",
+    "slo_snapshot", "slo_window", "set_current_deployment",
+}
+#: attribute names that mark a value as derived from the RAW REQUEST —
+#: unbounded cardinality if it ever becomes a tag value.  Tag values must
+#: come from deployment config (deployment name, route_prefix), never
+#: from what the client sent.
+REQUEST_DERIVED_ATTRS = {"path", "headers", "query", "url", "body"}
+#: the label-set bound: every raytpu_serve_* metric may only declare
+#: these tag keys (each with a config/enumeration-derived value domain)
+ALLOWED_SERVE_TAG_KEYS = {"deployment", "route", "status", "stage",
+                          "direction", "result"}
+
+
+def _obs_aliases(tree):
+    """Local names bound to ray_tpu.serve.observability in this module."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "observability":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("serve.observability"):
+                    names.add(a.asname or a.name.split(".")[0])
+    return names
+
+
+def test_serve_metric_tag_values_are_config_derived():
+    """Unbounded-cardinality guard: no argument fed into a serve
+    observability call may be derived from the raw request (``.path``,
+    ``.headers``, ``.query`` …).  ``deployment``/``route`` tag values must
+    trace back to deployment config — the proxy tags with the MATCHED
+    route prefix, never ``request.path``."""
+    problems = []
+    call_count = 0
+    for path in sorted(SERVE_DIR.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        aliases = _obs_aliases(tree)
+        if not aliases:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in OBS_TAGGED_FNS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in aliases):
+                continue
+            call_count += 1
+            where = (f"{path.relative_to(PKG_ROOT.parent)}:{node.lineno}: "
+                     f"{node.func.attr}")
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr in REQUEST_DERIVED_ATTRS):
+                        problems.append(
+                            f"{where}: argument derives from raw-request "
+                            f"attribute .{sub.attr} — serve tag values "
+                            "must come from deployment config")
+    assert not problems, ("serve tag cardinality violations:\n"
+                          + "\n".join(problems))
+    # the scan must actually see the serve instrumentation call sites
+    assert call_count >= 10, (
+        f"serve-observability scan only matched {call_count} calls — "
+        "alias following broke or the instrumentation moved")
+
+
+def test_serve_metric_tag_keys_are_bounded():
+    """Every ``raytpu_serve_*`` metric declares only allowlisted tag keys
+    (the label SET bound that makes the value-domain rule above
+    sufficient)."""
+    tree = ast.parse((SERVE_DIR / "observability.py").read_text())
+    problems = []
+    seen = 0
+    for call, cls in _metric_calls(tree):
+        name_node = call.args[0] if call.args else None
+        if not (isinstance(name_node, ast.Constant)
+                and str(name_node.value).startswith("raytpu_serve_")):
+            continue
+        seen += 1
+        for kw in call.keywords:
+            if kw.arg != "tag_keys" or not isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                continue
+            for el in kw.value.elts:
+                if (isinstance(el, ast.Constant)
+                        and el.value not in ALLOWED_SERVE_TAG_KEYS):
+                    problems.append(
+                        f"observability.py:{call.lineno}: {cls} "
+                        f"{name_node.value!r} declares tag key "
+                        f"{el.value!r} outside {sorted(ALLOWED_SERVE_TAG_KEYS)}")
+    assert not problems, "\n".join(problems)
+    assert seen >= 8, f"only {seen} raytpu_serve_ metrics found"
+
+
 def test_all_runtime_metrics_use_raytpu_namespace():
     problems = []
     scanned = 0
