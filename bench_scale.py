@@ -1,0 +1,172 @@
+"""Scale-envelope benchmark — the million-task proof point.
+
+Produces the queue-depth curve the ROADMAP asks for: for each depth N,
+submit N no-arg tasks from one driver (through the admission gate) and
+drain them, recording
+
+* drain throughput (tasks/s over the whole submit+drain wall clock),
+* p50/p99 of the bare ``.remote()`` submission call (gate waits included
+  — at depths past ``submit_inflight_limit`` the p99 IS the pipelining
+  behavior, not a defect),
+* peak RSS and RSS delta of the driver process,
+* admission-gate park count and the owner's shed-event count.
+
+Also cycles placement groups (create→ready→remove) and churns actors
+(create→ping→kill in waves) to exercise the other two envelope axes.
+
+Run: ``python bench_scale.py [--depths 10000,100000,1000000]
+[--pg-cycles 1000] [--actors 1000] [--out BENCH_SCALE.json]``
+
+Each depth runs on a FRESH cluster so retained state from one depth
+cannot subsidize (or poison) the next.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import time
+
+from ray_tpu.util.procmem import PeakRssSampler, rss_mb
+
+
+def _pctl(sorted_xs, q):
+    return sorted_xs[min(len(sorted_xs) - 1, int(len(sorted_xs) * q))]
+
+
+def bench_depth(depth: int) -> dict:
+    import ray_tpu
+    from ray_tpu.core.core_worker import global_worker
+
+    ray_tpu.init(num_cpus=8, object_store_memory=1 << 30)
+    out: dict = {"depth": depth}
+    try:
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        ray_tpu.get([inc.remote(0) for _ in range(8)])  # warm the pool
+        gc.collect()
+        rss0 = rss_mb()
+        sampler = PeakRssSampler()
+        t_sub = []
+        t0 = time.perf_counter()
+        refs = []
+        for i in range(depth):
+            s0 = time.perf_counter()
+            refs.append(inc.remote(i))
+            t_sub.append(time.perf_counter() - s0)
+        t_submitted = time.perf_counter()
+        total, count = 0, 0
+        for i in range(0, depth, 10_000):
+            chunk = ray_tpu.get(refs[i:i + 10_000], timeout=1800)
+            count += len(chunk)
+            total += sum(chunk)
+        t1 = time.perf_counter()
+        peak = sampler.stop()
+        assert count == depth and total == depth * (depth + 1) // 2
+        w = global_worker()
+        t_sub.sort()
+        out.update({
+            "drained": count,
+            "submit_s": round(t_submitted - t0, 2),
+            "total_s": round(t1 - t0, 2),
+            "drain_tasks_per_s": round(depth / (t1 - t0), 1),
+            "submit_us_p50": round(_pctl(t_sub, 0.50) * 1e6, 1),
+            "submit_us_p99": round(_pctl(t_sub, 0.99) * 1e6, 1),
+            "peak_rss_mb": round(peak, 1),
+            "rss_delta_mb": round(peak - rss0, 1),
+            "gate_parks": w.admission_gate.blocked_total,
+            "events_shed": w.task_events_shed_total,
+        })
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_pg_cycles(cycles: int) -> dict:
+    import ray_tpu
+    ray_tpu.init(num_cpus=8)
+    try:
+        # warm
+        pg = ray_tpu.placement_group([{"CPU": 0.01}])
+        pg.ready(timeout=30)
+        ray_tpu.remove_placement_group(pg)
+        t0 = time.perf_counter()
+        for _ in range(cycles):
+            pg = ray_tpu.placement_group([{"CPU": 0.01}])
+            pg.ready(timeout=30)
+            ray_tpu.remove_placement_group(pg)
+        dt = time.perf_counter() - t0
+        return {"cycles": cycles, "total_s": round(dt, 2),
+                "cycles_per_s": round(cycles / dt, 1)}
+    finally:
+        ray_tpu.shutdown()
+
+
+def bench_actor_churn(total: int, wave: int = 50) -> dict:
+    import ray_tpu
+    ray_tpu.init(num_cpus=8)
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class A:
+            def ping(self):
+                return 1
+
+        done = 0
+        t0 = time.perf_counter()
+        while done < total:
+            n = min(wave, total - done)
+            actors = [A.remote() for _ in range(n)]
+            ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+            for a in actors:
+                ray_tpu.kill(a)
+            done += n
+        dt = time.perf_counter() - t0
+        return {"actors": total, "wave": wave, "total_s": round(dt, 2),
+                "actors_per_s": round(total / dt, 1)}
+    finally:
+        ray_tpu.shutdown()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--depths", default="10000,100000,1000000",
+                   help="comma-separated queue depths for the task curve")
+    p.add_argument("--pg-cycles", type=int, default=1000)
+    p.add_argument("--actors", type=int, default=1000)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    from ray_tpu.core.config import Config
+    cfg = Config()
+    out = {
+        "metric": "scale_envelope",
+        "config": {
+            "submit_inflight_limit": cfg.submit_inflight_limit,
+            "submit_batching_enabled": cfg.submit_batching_enabled,
+            "lease_queue_max_depth": cfg.lease_queue_max_depth,
+            "gcs_table_shards": cfg.gcs_table_shards,
+        },
+        "task_curve": [],
+    }
+    for d in [int(x) for x in args.depths.split(",") if x.strip()]:
+        res = bench_depth(d)
+        out["task_curve"].append(res)
+        print(f"# depth {d}: {json.dumps(res)}", flush=True)
+    if args.pg_cycles > 0:
+        out["pg_cycles"] = bench_pg_cycles(args.pg_cycles)
+        print(f"# pg: {json.dumps(out['pg_cycles'])}", flush=True)
+    if args.actors > 0:
+        out["actor_churn"] = bench_actor_churn(args.actors)
+        print(f"# actors: {json.dumps(out['actor_churn'])}", flush=True)
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,8 +13,12 @@ metric, and a ``vs_baseline`` per metric where BASELINE.md has a row.
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import time
+
+
+from ray_tpu.util.procmem import rss_mb as _rss_mb
 
 
 BASELINE = {
@@ -123,6 +127,42 @@ def run_suite(S: float, with_serve: bool) -> dict:
                 t_sub[min(len(t_sub) - 1, int(len(t_sub) * 0.99))] * 1e6)
 
         results["submit_burst"] = timeit(burst, nb)
+
+        # submit_churn: sustained WINDOW-deep submit/drain steady state —
+        # every completion admits the next submission, so this measures
+        # the pipeline the admission gate enforces at production depths
+        # (ops/s, bare-submit latency percentiles, and the RSS the steady
+        # state retains), not a one-shot burst.
+        nc = int(4000 * S)
+        window = 1000
+        results["submit_churn_submit_us_p50"] = []
+        results["submit_churn_submit_us_p99"] = []
+        results["submit_churn_rss_delta_mb"] = []
+        churn_calls = [0]
+
+        def churn():
+            churn_calls[0] += 1
+            rss0 = _rss_mb()
+            t_sub = []
+            dq = collections.deque()
+            for _ in range(nc):
+                s0 = time.perf_counter()
+                dq.append(noop.remote())
+                t_sub.append(time.perf_counter() - s0)
+                if len(dq) >= window:
+                    ray_tpu.get(dq.popleft())
+            ray_tpu.get(list(dq))
+            if churn_calls[0] == 1:
+                return  # warmup pass: exclude cold-path latencies
+            t_sub.sort()
+            results["submit_churn_submit_us_p50"].append(
+                t_sub[len(t_sub) // 2] * 1e6)
+            results["submit_churn_submit_us_p99"].append(
+                t_sub[min(len(t_sub) - 1, int(len(t_sub) * 0.99))] * 1e6)
+            results["submit_churn_rss_delta_mb"].append(
+                max(0.0, _rss_mb() - rss0))
+
+        results["submit_churn"] = timeit(churn, nc)
 
         a = Counter.remote()
         ray_tpu.get(a.ping.remote())
@@ -298,6 +338,29 @@ def run_ab_serve_metrics(S: float, pairs: int) -> dict:
             "off_config": off_cfg, "ratio_on_off": ratio}
 
 
+#: the "off" arm of the batched-submission A/B: one task per push RPC, one
+#: lease per request RPC, one actor call per batch — the unbatched
+#: submission plane the scale-envelope work replaced.
+SUBMIT_BATCH_OFF = {"submit_batching_enabled": False}
+
+
+def run_ab_submit_batching(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: batched submission on vs off (the ISSUE-7
+    acceptance gate: >= 1.5x tasks_async)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_submission(S, None))
+        off_runs.append(_measure_submission(S, dict(SUBMIT_BATCH_OFF)))
+        print(f"# submit ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": SUBMIT_BATCH_OFF, "ratio_on_off": ratio}
+
+
 def run_ab_fastpath(S: float, pairs: int) -> dict:
     """Interleaved same-box A/B: fast path ON vs OFF, alternating fresh
     clusters so box drift lands evenly on both arms."""
@@ -337,6 +400,10 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of "
                         "serve_metrics_enabled on vs off (serve request "
                         "throughput; the serve-observability overhead gate)")
+    p.add_argument("--ab-submit", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of batched "
+                        "submission on vs off (push/lease/actor-call "
+                        "batching; the scale-envelope gate)")
     args = p.parse_args()
     _REPS = max(args.reps, 1)
 
@@ -376,6 +443,9 @@ def main():
     if args.ab_serve > 0:
         out["serve_metrics_ab"] = run_ab_serve_metrics(args.scale,
                                                        args.ab_serve)
+    if args.ab_submit > 0:
+        out["submit_batching_ab"] = run_ab_submit_batching(args.scale,
+                                                           args.ab_submit)
     line = json.dumps(out)
     print(line)
     if args.out:

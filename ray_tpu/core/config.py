@@ -107,6 +107,45 @@ class Config:
     #: ``request_worker_leases`` RPC (same-tick submission bursts coalesce
     #: their lease demand into one control-plane round trip).
     submit_batch_max: int = 16
+    # -- scale envelope (million-task submission pipeline) -----------------
+    #: Owner-side admission control: max tasks in flight (submitted but not
+    #: yet finished/failed) per CoreWorker before ``.remote()`` blocks on
+    #: the waitable admission gate.  A driver firing 1M submissions
+    #: degrades to smooth pipelining at this window instead of building
+    #: 1M specs of owner state and flooding the agents' lease queues.
+    #: 0 disables admission control (unbounded in-flight).
+    submit_inflight_limit: int = 50_000
+    #: Bounded submission flush window in milliseconds: the first
+    #: submission of a burst arms the flush; further same-window calls ride
+    #: the same flush.  0 flushes on the next loop tick (lowest latency);
+    #: >0 trades up to that much latency for bigger push batches.  A buffer
+    #: reaching ``submit_flush_max`` flushes immediately regardless.
+    submit_flush_window_ms: float = 0.0
+    #: Flush the submit buffer immediately once it holds this many entries,
+    #: even inside an armed ``submit_flush_window_ms`` window.
+    submit_flush_max: int = 512
+    #: Master switch for submission batching (the scale-envelope A/B knob):
+    #: False degrades to one task per push RPC, one lease per request RPC,
+    #: one actor call per batch — the unbatched submission plane.
+    submit_batching_enabled: bool = True
+    #: Hash-shard count of the GCS hot tables (KV, actor table): rehash
+    #: pauses are bounded by the largest shard and maintenance scans can
+    #: yield between shards (core/sharded_table.py).
+    gcs_table_shards: int = 16
+    #: Per-topic pubsub log length at the GCS.  Each topic keeps its own
+    #: seq-ordered log (polls bisect past their cursor instead of scanning
+    #: global traffic); a subscriber lagging more than this many events on
+    #: one topic misses the trimmed window, same as the old global ring.
+    gcs_pubsub_topic_log_len: int = 4000
+    #: Agent-side lease-queue depth bound: a lease request arriving at an
+    #: agent whose queue is already this deep is answered with a
+    #: ``backpressure`` reply instead of parking — the owner backs off and
+    #: re-picks a node, so a 1M-task burst cannot grow an unbounded parked
+    #: queue on one agent.  0 disables the bound.
+    lease_queue_max_depth: int = 4096
+    #: How long an owner waits after a lease ``backpressure`` reply before
+    #: re-evaluating its cluster view and retrying.
+    lease_backpressure_retry_s: float = 0.2
     #: Spill directory ("" = default under /tmp; "off" disables spilling).
     object_spilling_dir: str = ""
     #: Spill when store utilization exceeds this fraction.

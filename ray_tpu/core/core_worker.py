@@ -152,6 +152,76 @@ def _task_retry_delay(retry_count: int) -> float:
     return delay * random.uniform(0.5, 1.0)
 
 
+class _AdmissionGate:
+    """Owner-side submission admission control (the scale-envelope gate).
+
+    Bounds tasks in flight (submitted, not yet finished/failed) per
+    CoreWorker at ``submit_inflight_limit``: a driver firing 1M
+    ``.remote()`` calls degrades to smooth pipelining at the window
+    instead of building a million specs of owner-side state and flooding
+    every agent's lease queue.  The gate is WAITABLE — a full window
+    parks the submitting thread until completions drain below the limit —
+    and thread-aware: a submitter already running on an asyncio loop
+    (the RPC IO loop processes the very completions that would free the
+    window; actor loops must stay live) is never parked, only counted.
+    """
+
+    __slots__ = ("_cond", "_inflight", "_waiting", "blocked_total")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._waiting = 0
+        #: times a submission had to park (observability / tests)
+        self.blocked_total = 0
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def acquire(self, worker: "CoreWorker") -> None:
+        limit = get_config().submit_inflight_limit
+        with self._cond:
+            if limit <= 0 or self._inflight < limit:
+                self._inflight += 1
+                return
+        # Window full.  Parking an event-loop thread would deadlock (the
+        # loop processes the completions that drain the window) — count
+        # and proceed; backpressure still lands on plain driver threads,
+        # which is where million-task bursts come from.
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pass
+        else:
+            with self._cond:
+                self._inflight += 1
+            return
+        # Worker-mode submitters release their lease's resources while
+        # parked (same contract as blocking in ray.get) so nested tasks
+        # can still run on the node.
+        worker._on_block()
+        try:
+            with self._cond:
+                self._waiting += 1
+                self.blocked_total += 1
+                try:
+                    while (self._inflight >= limit
+                           and not worker._shutdown):
+                        self._cond.wait(timeout=0.2)
+                finally:
+                    self._waiting -= 1
+                self._inflight += 1
+        finally:
+            worker._on_unblock()
+
+    def release(self, n: int = 1) -> None:
+        with self._cond:
+            self._inflight -= n
+            if self._waiting:
+                self._cond.notify_all()
+
+
 # ---------------------------------------------------------------------------
 # Reference counting (reference: src/ray/core_worker/reference_count.h:61)
 # ---------------------------------------------------------------------------
@@ -252,6 +322,9 @@ class PendingTask:
     spec: TaskSpec
     retries_left: int
     arg_refs: List[ObjectRef] = field(default_factory=list)
+    #: holds one admission-gate slot (public submit entry points); internal
+    #: resubmissions (reconstruction) bypass the gate and must not release
+    gated: bool = False
 
 
 def _result_contained_refs(res: tuple) -> list:
@@ -283,8 +356,10 @@ class TaskManager:
         self.oom_kill_counts[task_id] = n
         return n
 
-    def add_pending(self, spec: TaskSpec, arg_refs: List[ObjectRef]):
-        self.pending[spec.task_id] = PendingTask(spec, spec.max_retries, arg_refs)
+    def add_pending(self, spec: TaskSpec, arg_refs: List[ObjectRef],
+                    gated: bool = False):
+        self.pending[spec.task_id] = PendingTask(spec, spec.max_retries,
+                                                 arg_refs, gated=gated)
         for r in arg_refs:
             self._w.reference_counter.add_submitted(r.id)
 
@@ -322,6 +397,8 @@ class TaskManager:
         self.oom_kill_counts.pop(task_id, None)
         if pt is None:
             return
+        if pt.gated:
+            self._w.admission_gate.release()
         self._release_args(pt)
         spec = pt.spec
         if results and results[0][0] in ("gen_done", "gen_buffered"):
@@ -391,6 +468,8 @@ class TaskManager:
         self.oom_kill_counts.pop(task_id, None)
         if pt is None:
             return
+        if pt.gated:
+            self._w.admission_gate.release()
         self._release_args(pt)
         # fail() is only reached for runtime-detected faults (worker death,
         # OOM kill, retries exhausted) — never for a task body's own raise,
@@ -487,7 +566,11 @@ class LeasePool:
         # split evenly across idle workers so batching never costs
         # parallelism (reference: direct_task_transport.h:151 pipelining).
         idle = [lw for lw in self.leased.values() if not lw.busy]
-        max_batch = get_config().max_tasks_in_flight_per_worker
+        cfg = get_config()
+        # submit_batching_enabled=False is the scale-envelope A/B off arm:
+        # one task per push RPC, one lease per request RPC.
+        max_batch = (cfg.max_tasks_in_flight_per_worker
+                     if cfg.submit_batching_enabled else 1)
         while self.queue and idle:
             # Split the queue over EXPECTED capacity (idle workers + leases
             # still being granted), not just current idle workers: batching
@@ -512,13 +595,14 @@ class LeasePool:
         # the deficit so the next burst finds a granted worker instead of
         # paying a lease round trip.  Same-tick demand coalesces into
         # batched ``request_worker_leases`` RPCs of up to submit_batch_max.
-        cfg = get_config()
         deficit = len(self.queue) - len(idle) - self.requesting
         if deficit > 0:
             deficit += max(0, cfg.lease_pipeline_window)
         want = min(deficit, self.MAX_LEASES - len(self.leased) - self.requesting)
+        lease_batch_max = (max(1, cfg.submit_batch_max)
+                           if cfg.submit_batching_enabled else 1)
         while want > 0:
-            batch = min(want, max(1, cfg.submit_batch_max))
+            batch = min(want, lease_batch_max)
             want -= batch
             self.requesting += batch
             asyncio.ensure_future(self._acquire_leases(batch))
@@ -646,6 +730,16 @@ class LeasePool:
                 if res.get("infeasible"):
                     target_addr = None
                     await asyncio.sleep(0.5)
+                    continue
+                if res.get("backpressure"):
+                    # The agent's lease queue is at its depth bound: back
+                    # off for the advertised interval, then re-pick a node
+                    # (the fresh cluster view may route around the hot
+                    # agent; spillback spreads the rest).
+                    target_addr = None
+                    await asyncio.sleep(res.get(
+                        "retry_after_s",
+                        get_config().lease_backpressure_retry_s))
                     continue
                 # unrecognized reply shape: back off rather than spin
                 target_addr = None
@@ -867,6 +961,14 @@ class CoreWorker:
         self._submit_buffer: collections.deque = collections.deque()
         self._submit_lock = threading.Lock()
         self._submit_flush_scheduled = False
+        # Bounded flush window state: an armed call_later handle
+        # (submit_flush_window_ms) and whether a buffer-full promotion
+        # already scheduled an immediate flush for this window.
+        self._submit_timer = None
+        self._submit_flush_promoted = False
+        # Admission control: the waitable in-flight window every public
+        # submission passes through (see _AdmissionGate).
+        self.admission_gate = _AdmissionGate()
         self.fn_cache: Dict[bytes, Any] = {}
         # Submission fast path: per-(function, options) spec template
         # encoder (core/spec_cache.py) — invariant spec portions wire-encode
@@ -884,6 +986,12 @@ class CoreWorker:
         self._gen_emitters: Dict[TaskID, "_GenEmitter"] = {}
         self._view_cache: Tuple[float, Dict[str, NodeView]] = (0.0, {})
         self._task_events: List[dict] = []
+        #: events shed because the owner buffer hit task_events_max_buffer
+        #: between flushes (a 1M-task drain must not hold 3M event dicts);
+        #: _dropped is the since-last-flush delta (shipped to the GCS and
+        #: reset), _shed_total the process-lifetime cumulative count
+        self._task_events_dropped = 0
+        self.task_events_shed_total = 0
         #: owner-side submit timestamps: the "queue" (submit->dispatch) and
         #: "total" (submit->terminal) stage durations are computed from these
         self._submit_ts: Dict[TaskID, float] = {}
@@ -1008,6 +1116,18 @@ class CoreWorker:
             ev.setdefault("trace_id", spec.trace_ctx[0])
             ev.setdefault("parent_id", spec.trace_ctx[1])
             ev.setdefault("span_id", spec.task_id.hex()[:12])
+        self._append_task_event(ev)
+
+    def _append_task_event(self, ev: dict):
+        """Bounded owner-side event buffer: beyond task_events_max_buffer
+        unflushed events, new ones are SHED (drop-newest, O(1)) and counted
+        — a million-task drain keeps a flat event-memory ceiling instead of
+        holding millions of dicts between flush ticks.  The shed count
+        rides the next flush so the GCS can surface the gap."""
+        if len(self._task_events) >= get_config().task_events_max_buffer:
+            self._task_events_dropped += 1
+            self.task_events_shed_total += 1
+            return
         self._task_events.append(ev)
 
     def _record_stages(self, spec: TaskSpec, stages: Dict[str, list]):
@@ -1038,7 +1158,7 @@ class CoreWorker:
                 return
             self._stage_event_count += 1
         # deliberately slim (no job/actor ids): one of these ships per task
-        self._task_events.append({
+        self._append_task_event({
             "task_id": spec.task_id.hex(), "name": spec.name,
             "state": "STAGES",
             "ts": min(t0 for t0, _ in payload.values()),
@@ -1046,14 +1166,20 @@ class CoreWorker:
             "stages": payload})
 
     async def _flush_task_events_loop(self):
+        CHUNK = 10_000  # bound the per-RPC frame, not one giant pickle
         while not self._shutdown:
             await asyncio.sleep(1.0)
             if self._task_events and self.gcs:
                 batch, self._task_events = self._task_events, []
+                dropped, self._task_events_dropped = \
+                    self._task_events_dropped, 0
                 try:
                     # token'd retry: a lost reply must not double-record
                     # the batch (duplicate events skew summarize_tasks)
-                    await self.gcs.call_retry("add_task_events", events=batch)
+                    for i in range(0, len(batch), CHUNK):
+                        await self.gcs.call_retry(
+                            "add_task_events", events=batch[i:i + CHUNK],
+                            dropped=dropped if i == 0 else 0)
                 except Exception:
                     pass
 
@@ -1439,6 +1565,7 @@ class CoreWorker:
 
         Returns a list of ObjectRefs, or an ObjectRefGenerator for
         ``num_returns="streaming"`` tasks."""
+        self.admission_gate.acquire(self)
         if spec.num_returns == STREAMING_RETURNS:
             self.streams[spec.task_id] = StreamState(
                 spec.task_id, spec.generator_backpressure)
@@ -1446,24 +1573,53 @@ class CoreWorker:
         else:
             ret = [ObjectRef(oid, owner=self.address)
                    for oid in spec.return_ids()]
-        self.task_manager.add_pending(spec, arg_refs)
+        self.task_manager.add_pending(spec, arg_refs, gated=True)
         self.task_event(spec, "SUBMITTED")
         self._enqueue_submit(("task", spec))
         return ret
 
     def _enqueue_submit(self, item: tuple):
+        promote = False
         with self._submit_lock:
             self._submit_buffer.append(item)
             need_flush = not self._submit_flush_scheduled
             self._submit_flush_scheduled = True
+            if (not need_flush and not self._submit_flush_promoted
+                    and len(self._submit_buffer)
+                    >= get_config().submit_flush_max):
+                # An armed flush window already exists but the buffer hit
+                # the size bound: promote to an immediate flush.
+                promote = self._submit_flush_promoted = True
         if need_flush:
+            get_loop().call_soon_threadsafe(self._arm_submit_flush)
+        elif promote:
             get_loop().call_soon_threadsafe(self._flush_submits)
 
+    def _arm_submit_flush(self):
+        """On the IO loop: flush now, or arm the bounded flush window
+        (``submit_flush_window_ms``) so a burst's stragglers coalesce into
+        the same batch.  A window only ever delays by the configured bound;
+        ``submit_flush_max`` promotes a full buffer to an immediate flush."""
+        cfg = get_config()
+        window = (cfg.submit_flush_window_ms
+                  if cfg.submit_batching_enabled else 0.0)
+        if window > 0 and len(self._submit_buffer) < cfg.submit_flush_max:
+            self._submit_timer = asyncio.get_event_loop().call_later(
+                window / 1000.0, self._flush_submits)
+        else:
+            self._flush_submits()
+
     def _flush_submits(self):
+        timer, self._submit_timer = self._submit_timer, None
+        if timer is not None:
+            timer.cancel()  # no-op when we ARE the timer callback
         with self._submit_lock:
             items = list(self._submit_buffer)
             self._submit_buffer.clear()
             self._submit_flush_scheduled = False
+            self._submit_flush_promoted = False
+        if not items:
+            return  # a promoted flush raced the window timer's flush
         pools: Dict[int, LeasePool] = {}
         pumped_actors: Dict[str, ActorTarget] = {}
         for kind, *rest in items:
@@ -1522,6 +1678,7 @@ class CoreWorker:
         """Fire-and-forget like submit_task: enqueue into the target's
         ordered outbox on the IO loop; the per-target pump batches and
         sends.  Streaming methods return an ObjectRefGenerator."""
+        self.admission_gate.acquire(self)
         if spec.num_returns == STREAMING_RETURNS:
             self.streams[spec.task_id] = StreamState(
                 spec.task_id, spec.generator_backpressure)
@@ -1529,7 +1686,7 @@ class CoreWorker:
         else:
             ret = [ObjectRef(oid, owner=self.address)
                    for oid in spec.return_ids()]
-        self.task_manager.add_pending(spec, arg_refs)
+        self.task_manager.add_pending(spec, arg_refs, gated=True)
         self.task_event(spec, "SUBMITTED")
         self._enqueue_submit(("actor", actor_id, spec))
         return ret
@@ -1538,7 +1695,9 @@ class CoreWorker:
         try:
             while tgt.outbox:
                 batch: List[TaskSpec] = []
-                limit = get_config().actor_call_pipeline
+                cfg = get_config()
+                limit = (cfg.actor_call_pipeline
+                         if cfg.submit_batching_enabled else 1)
                 # Intra-batch dependencies are safe: per-call results are
                 # streamed back as they land (handle_actor_task_batch).
                 while tgt.outbox and len(batch) < limit:

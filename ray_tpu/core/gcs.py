@@ -27,6 +27,7 @@ State is optionally snapshotted to disk so a restarted GCS can recover cluster m
 from __future__ import annotations
 
 import asyncio
+import bisect
 import os
 import pickle
 import time
@@ -38,25 +39,40 @@ from .config import get_config
 from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .rpc import ClientPool, RpcServer
 from .scheduling import NodeView, pack_bundles, pick_node
+from .sharded_table import SecondaryIndex, ShardedTable
 
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persistence_path: Optional[str] = None):
         self.server = RpcServer(self, host, port)
+        cfg = get_config()
         self.nodes: Dict[str, NodeView] = {}
         self.node_last_seen: Dict[str, float] = {}
-        self._event_log: List[Tuple[int, str, dict]] = []
+        # Pubsub: PER-TOPIC seq-ordered logs (a poll for topic T touches
+        # only T's log, cursor-indexed by bisect — never a scan of every
+        # topic's traffic), fanned out once per loop tick (_fanout_tick).
+        self._topic_logs: Dict[str, List[Tuple[int, dict]]] = {}
         self._event_seq = 0
         self._event_waiters: List[asyncio.Event] = []
-        self.kv: Dict[Tuple[str, str], bytes] = {}
-        self.actors: Dict[str, dict] = {}          # actor_id hex -> info
+        self._fanout_scheduled = False
+        # Hot tables are hash-sharded (bounded rehash pauses, per-shard
+        # iteration) with O(1)-maintained reverse indexes replacing every
+        # failure-path full-table scan (see core/sharded_table.py).
+        shards = max(1, cfg.gcs_table_shards)
+        self.kv: ShardedTable = ShardedTable(shards)  # (ns, key) -> bytes
+        self._kv_ns_index = SecondaryIndex()          # ns -> {key}
+        self.actors: ShardedTable = ShardedTable(shards)  # actor hex -> info
+        self._actors_by_node = SecondaryIndex()       # node_id -> {actor hex}
+        self._live_actors_by_job = SecondaryIndex()   # job hex -> {actor hex}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor id hex
         self.pgs: Dict[str, dict] = {}
         self._pg_events: Dict[str, asyncio.Event] = {}
         self.jobs: Dict[str, dict] = {}
         self.agent_clients = ClientPool()
-        self.task_events: deque = deque(maxlen=get_config().task_events_max_buffer)
+        self.task_events: deque = deque(maxlen=cfg.task_events_max_buffer)
+        #: events owners shed at their bounded buffers (observability)
+        self.task_events_dropped = 0
         # Runtime chaos control (core/chaos.py): the cluster-wide spec and
         # its version; agents learn of changes via heartbeat piggyback
         # (and anyone else via the "chaos" pubsub topic).
@@ -102,10 +118,14 @@ class GcsServer:
         if p and os.path.exists(p):
             with open(p, "rb") as f:
                 snap = pickle.load(f)
-            self.kv = snap.get("kv", {})
+            for k, v in snap.get("kv", {}).items():
+                self.kv[k] = v
+                self._kv_ns_index.add(k[0], k[1])
             self.jobs = snap.get("jobs", {})
             self.named_actors = snap.get("named_actors", {})
-            self.actors = snap.get("actors", {})
+            for aid, info in snap.get("actors", {}).items():
+                self.actors[aid] = info
+                self._index_actor(aid, info)
             self._job_counter = snap.get("job_counter", 0)
 
     def _persist(self):
@@ -114,10 +134,31 @@ class GcsServer:
             return
         tmp = p + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump({"kv": self.kv, "jobs": self.jobs,
-                         "named_actors": self.named_actors, "actors": self.actors,
+            pickle.dump({"kv": self.kv.to_dict(), "jobs": self.jobs,
+                         "named_actors": self.named_actors,
+                         "actors": self.actors.to_dict(),
                          "job_counter": self._job_counter}, f)
         os.replace(tmp, p)
+
+    # ------------------------------------------------------- actor indexes
+
+    def _index_actor(self, aid: str, info: dict):
+        """(Re)derive one actor's index membership from its info dict —
+        used on restore; live transitions maintain the indexes in place."""
+        if info.get("state") == "DEAD":
+            return
+        self._actors_by_node.add(info.get("node_id"), aid)
+        self._live_actors_by_job.add(info.get("job_id"), aid)
+
+    def _actor_placed(self, aid: str, info: dict, node_id: str):
+        self._actors_by_node.move(info.get("node_id"), node_id, aid)
+
+    def _actor_unplaced(self, aid: str, info: dict):
+        self._actors_by_node.discard(info.get("node_id"), aid)
+
+    def _actor_dead(self, aid: str, info: dict):
+        self._actors_by_node.discard(info.get("node_id"), aid)
+        self._live_actors_by_job.discard(info.get("job_id"), aid)
 
     # ---------------------------------------------------------------- pubsub
     #
@@ -128,9 +169,31 @@ class GcsServer:
 
     def _publish(self, topic: str, payload: dict):
         self._event_seq += 1
-        self._event_log.append((self._event_seq, topic, payload))
-        if len(self._event_log) > 10000:
-            del self._event_log[:5000]
+        log = self._topic_logs.setdefault(topic, [])
+        log.append((self._event_seq, payload))
+        cap = max(100, get_config().gcs_pubsub_topic_log_len)
+        if len(log) > cap:
+            # trim front half: cursors are global seqs, so a subscriber
+            # that fell further behind simply misses the trimmed window
+            # (same contract the old global ring had)
+            del log[:len(log) // 2]
+        # Fanout is BATCHED per loop tick: a burst of N publishes in one
+        # tick (an actor wave, a node death cascade) wakes each parked
+        # subscriber once, not N times — wake cost is O(subscribers) per
+        # tick instead of O(subscribers x publishes).
+        if not self._fanout_scheduled:
+            self._fanout_scheduled = True
+            try:
+                # get_running_loop (not get_event_loop): with no RUNNING
+                # loop the latter hands back a fresh dead loop on 3.10,
+                # the callback never fires, and the latched flag would
+                # suppress every future wakeup
+                asyncio.get_running_loop().call_soon(self._fanout_tick)
+            except RuntimeError:
+                self._fanout_tick()  # no loop (unit tests): wake inline
+
+    def _fanout_tick(self):
+        self._fanout_scheduled = False
         for ev in self._event_waiters:
             ev.set()
 
@@ -143,8 +206,18 @@ class GcsServer:
     async def handle_pubsub_poll(self, topics: List[str], cursor: int,
                                  timeout: float = 30.0):
         def pending():
-            return [(seq, t, p) for seq, t, p in self._event_log
-                    if seq > cursor and t in topics]
+            # Cursor-indexed per-topic reads: bisect each requested topic's
+            # log past the cursor and merge by seq — cost is O(new events
+            # for THESE topics), flat in total cluster traffic.
+            out: List[Tuple[int, str, dict]] = []
+            for t in topics:
+                log = self._topic_logs.get(t)
+                if not log:
+                    continue
+                i = bisect.bisect_right(log, cursor, key=lambda e: e[0])
+                out.extend((seq, t, p) for seq, p in log[i:])
+            out.sort(key=lambda e: e[0])
+            return out
 
         got = pending()
         if got:
@@ -316,9 +389,11 @@ class GcsServer:
         n.alive = False
         self._publish("nodes", {"event": "dead", "node_id": node_id, "reason": reason})
         # Restart or fail actors that lived there (reference:
-        # GcsActorManager::OnNodeDead).
-        for aid, info in list(self.actors.items()):
-            if info.get("node_id") == node_id and info["state"] in ("ALIVE", "PENDING"):
+        # GcsActorManager::OnNodeDead) — via the by-node index, so a node
+        # death touches only ITS actors, not the whole table.
+        for aid in self._actors_by_node.get(node_id):
+            info = self.actors.get(aid)
+            if info is not None and info["state"] in ("ALIVE", "PENDING"):
                 await self._on_actor_failure(aid, f"node {node_id[:12]} died: {reason}")
 
     # ------------------------------------------------------------------- KV
@@ -329,6 +404,7 @@ class GcsServer:
         if not overwrite and k in self.kv:
             return False
         self.kv[k] = value
+        self._kv_ns_index.add(ns, key)
         self._persist()
         return True
 
@@ -339,10 +415,14 @@ class GcsServer:
         return {k: self.kv[(ns, k)] for k in keys if (ns, k) in self.kv}
 
     async def handle_kv_del(self, ns: str, key: str):
-        return self.kv.pop((ns, key), None) is not None
+        existed = self.kv.pop((ns, key), None) is not None
+        if existed:
+            self._kv_ns_index.discard(ns, key)
+        return existed
 
     async def handle_kv_keys(self, ns: str, prefix: str = ""):
-        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+        # per-namespace index: listing one ns never scans the others
+        return [k for k in self._kv_ns_index.get(ns) if k.startswith(prefix)]
 
     async def handle_kv_exists(self, ns: str, key: str):
         return (ns, key) in self.kv
@@ -374,6 +454,7 @@ class GcsServer:
             "death_cause": None, "num_restarts": 0, "class_name": spec.name,
             "lifetime": spec.lifetime, "job_id": spec.job_id.hex(),
         }
+        self._live_actors_by_job.add(spec.job_id.hex(), aid)
         asyncio.ensure_future(self._schedule_actor(aid))
         return aid
 
@@ -409,6 +490,7 @@ class GcsServer:
                         except Exception:
                             pass
                         return
+                    self._actor_placed(aid, info, nid)
                     info.update(state="ALIVE", address=res["worker_address"],
                                 node_id=nid, worker_id=res["worker_id"])
                     self._publish("actors", {"actor_id": aid, "state": "ALIVE",
@@ -429,6 +511,7 @@ class GcsServer:
             if info["restarts_left"] > 0:
                 info["restarts_left"] -= 1
             info["num_restarts"] += 1
+            self._actor_unplaced(aid, info)
             info.update(state="RESTARTING", address=None, node_id=None)
             self._publish("actors", {"actor_id": aid, "state": "RESTARTING"})
             asyncio.ensure_future(self._schedule_actor(aid, delay=0.1))
@@ -439,6 +522,7 @@ class GcsServer:
         info = self.actors.get(aid)
         if info is None:
             return
+        self._actor_dead(aid, info)
         info.update(state="DEAD", death_cause=reason)
         self._publish("actors", {"actor_id": aid, "state": "DEAD", "reason": reason})
 
@@ -688,8 +772,11 @@ class GcsServer:
             self._persist()
         # Job-scoped actor GC: non-detached actors die with their job
         # (reference: GcsActorManager::OnJobFinished); detached ones survive.
-        for aid, info in list(self.actors.items()):
-            if (info.get("job_id") == job_id and info.get("lifetime") != "detached"
+        # The by-job index holds only LIVE actors, so a job finish is
+        # O(its own survivors) regardless of table size.
+        for aid in self._live_actors_by_job.get(job_id):
+            info = self.actors.get(aid)
+            if (info is not None and info.get("lifetime") != "detached"
                     and info["state"] not in ("DEAD",)):
                 await self.handle_kill_actor(aid, no_restart=True)
         return True
@@ -699,8 +786,13 @@ class GcsServer:
 
     # ------------------------------------------------------------ task events
 
-    async def handle_add_task_events(self, events: List[dict]):
+    async def handle_add_task_events(self, events: List[dict],
+                                     dropped: int = 0):
         self.task_events.extend(events)
+        if dropped:
+            # owners shed events past their bounded buffer; keep the gap
+            # visible (state API completeness caveat) instead of silent
+            self.task_events_dropped += dropped
         return True
 
     async def handle_list_task_events(self, limit: int = 1000,

@@ -702,6 +702,15 @@ class NodeAgent:
                                                       bundle is None) else None
         if spill is not None:
             return spill
+        cfg = get_config()
+        if (cfg.lease_queue_max_depth > 0
+                and len(self.lease_queue) >= cfg.lease_queue_max_depth):
+            # Lease-queue admission control: parking past the depth bound
+            # would grow agent memory without bound under a million-task
+            # burst (every parked request pins a future + writer ref).
+            # Tell the owner to back off and re-route instead.
+            return {"backpressure": True,
+                    "retry_after_s": cfg.lease_backpressure_retry_s}
         fut = asyncio.get_event_loop().create_future()
         req = LeaseRequest(self._next_lease_id(), resources,
                            tuple(bundle) if bundle else None, fut, runtime_env,

@@ -1,0 +1,77 @@
+"""Version compatibility shims for the jax API surface we depend on.
+
+The runtime targets the modern ``jax.shard_map`` entry point
+(``check_vma=`` / ``axis_names=`` keywords).  Older jax releases (the
+0.4.x line baked into some images) only ship
+``jax.experimental.shard_map.shard_map`` with the pre-rename keywords
+(``check_rep=`` / ``auto=``).  Rather than pinning a jax version, every
+in-repo shard_map call routes through :func:`shard_map` here, which
+translates keywords to whatever the installed jax understands.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def has_native_shard_map() -> bool:
+    """True when jax ships the top-level ``jax.shard_map`` entry point.
+
+    On the 0.4.x experimental fallback, ``jit`` with explicit in/out
+    shardings composed over a shard_map (and any partial-``auto`` use)
+    lowers through a PartitionId instruction the CPU SPMD partitioner
+    rejects (UNIMPLEMENTED) — tests exercising that composition gate on this
+    capability instead of failing on an old-toolchain limitation."""
+    return hasattr(jax, "shard_map")
+
+
+def enable_cpu_multiprocess_collectives() -> bool:
+    """Make multiprocess collectives work on the CPU backend.
+
+    Old jax defaults the CPU client to NO collectives implementation, so a
+    2-process ``jax.distributed`` namespace compiles but every collective
+    dies with "Multiprocess computations aren't implemented on the CPU
+    backend".  Selecting the bundled gloo implementation fixes it; must run
+    BEFORE the backend is created (call ahead of
+    ``jax.distributed.initialize``).  Returns False when the installed jax
+    has no such flag (newer releases default sensibly) — harmless either
+    way, so callers can invoke it unconditionally on CPU."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:
+        return False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names: Optional[set] = None):
+    """``jax.shard_map`` with new-style keywords on any supported jax.
+
+    ``axis_names`` is the set of mesh axes the body handles MANUALLY (the
+    new-API meaning); on old jax it is translated to its complement,
+    ``auto=`` (the axes left automatic).  ``check_vma`` maps to the old
+    ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        kw: Dict[str, Any] = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma,
+                                 **kw)
+        except TypeError:
+            # a top-level shard_map predating the check_vma rename
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma,
+                                 **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {"check_rep": check_vma}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - set(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

@@ -1,0 +1,40 @@
+"""Process-memory introspection shared by benchmarks and scale tests."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def rss_mb() -> float:
+    """Current VmRSS of this process in MB (/proc; 0.0 if unreadable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+class PeakRssSampler:
+    """Samples VmRSS on a sibling thread; ``stop()`` returns the peak."""
+
+    def __init__(self, interval_s: float = 0.05):
+        self.peak = rss_mb()
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.peak = max(self.peak, rss_mb())
+            time.sleep(self._interval)
+
+    def stop(self) -> float:
+        self._stop.set()
+        self._t.join(timeout=2)
+        self.peak = max(self.peak, rss_mb())
+        return self.peak

@@ -12,6 +12,15 @@ from ray_tpu.parallel import (MeshSpec, init_pp_state, init_sharded_state,
                               make_mesh, make_optimizer, make_pp_train_step,
                               make_train_step, merge_layers, partition_layers)
 from ray_tpu.parallel.pipeline import pipeline_loss_fn
+from ray_tpu.util import jax_compat
+
+# jit(in_shardings=...) composed over the old experimental shard_map
+# fallback (and partial-auto axes) lowers a PartitionId op the CPU SPMD
+# partitioner rejects; these tests need the native jax.shard_map.
+needs_native_shard_map = pytest.mark.skipif(
+    not jax_compat.has_native_shard_map(),
+    reason="jit-with-shardings over the experimental shard_map fallback "
+           "miscompiles (PartitionId) on this jax")
 
 
 def _cfg():
@@ -73,6 +82,7 @@ def test_pipeline_gradients_match_plain():
         assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4, (ka, scale)
 
 
+@needs_native_shard_map
 def test_pipeline_train_step_decreases_loss():
     cfg = _cfg()
     mesh = make_mesh(pp=2, dp=2, fsdp=2)
@@ -150,6 +160,7 @@ def test_interleaved_pipeline_gradients_match_plain():
         assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4, (ka, scale)
 
 
+@needs_native_shard_map
 def test_interleaved_train_step_decreases_loss():
     cfg = _cfg()
     mesh = make_mesh(pp=2, dp=2)
@@ -167,6 +178,7 @@ def test_interleaved_train_step_decreases_loss():
     assert losses[-1] < losses[0], losses
 
 
+@needs_native_shard_map
 def test_pipeline_fsdp_loss_matches_plain():
     """pp x fsdp (ZeRO param/opt sharding inside the pipeline, fsdp left to
     the compiler) == single-device loss on identical f32 params."""
@@ -211,6 +223,7 @@ def test_pipeline_sp_loss_matches_plain():
         float(ref_loss), float(metrics["loss"]))
 
 
+@needs_native_shard_map
 def test_pipeline_fsdp_sp_train_steps():
     """pp x fsdp and pp x sp full train steps: state stays sharded, loss
     decreases (the historical sharding-rule bug sites — VERDICT r4 weak #6)."""

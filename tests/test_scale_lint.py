@@ -1,0 +1,130 @@
+"""Static scale lint (CI tooling satellite of the million-task envelope,
+in the style of ``test_hotpath_lint.py``): the owner's submit/dispatch/
+complete hot functions must stay O(1)-amortized in the number of
+in-flight tasks.  Iterating a per-task table (pending map, refcount
+maps, submit timestamps, event buffer, ...) inside any of these
+functions is exactly how a 1M-entry drain regresses to quadratic —
+every submission or completion re-walking owner state that grows with
+queue depth.
+
+The scan is AST-based: inside each named hot function it rejects
+
+* ``for``/``async for`` loops and comprehensions whose iterable mentions
+  a named table attribute (``for t in self.pending`` and
+  ``for x in self._w.streams.values()`` alike), and
+* ``.items()/.values()/.keys()`` calls on a named table, and
+* whole-table consumers (``list``/``sorted``/``max``/``min``/``sum``/
+  ``len`` is allowed — it's O(1)) applied to a named table.
+
+``next(iter(table))`` stays legal: that is the O(1)-amortized
+oldest-entry eviction idiom the bounded buffers use.  The lint asserts
+it actually FOUND every named function, so a rename cannot silently
+drop one out of coverage.
+"""
+
+import ast
+import pathlib
+
+CORE = pathlib.Path(__file__).resolve().parent.parent / "ray_tpu" / "core"
+
+#: submit/dispatch/complete hot functions per file.  (LeasePool._pump is
+#: deliberately absent: it iterates ``leased``, which is bounded by
+#: MAX_LEASES, not by queue depth.)
+HOT_FUNCTIONS = {
+    "core_worker.py": {
+        # submission entry points (user thread)
+        "submit_task", "submit_actor_task", "_enqueue_submit",
+        # dispatch flush (IO loop)
+        "_flush_submits", "_arm_submit_flush", "_pool_for",
+        # per-task bookkeeping
+        "add_pending", "complete", "fail", "use_retry",
+        "task_event", "_append_task_event", "store_task_result",
+    },
+}
+
+#: owner-side tables that grow with in-flight task count: full iteration
+#: inside a hot function is the forbidden O(n) regression
+TABLES = {
+    "pending", "lineage", "oom_kill_counts",        # TaskManager
+    "local", "submitted", "borrowers",              # ReferenceCounter
+    "_submit_ts", "_task_events", "_escrow_holds",  # CoreWorker
+    "_contained_borrows", "streams", "_kill_causes",
+    "lease_pools", "actor_targets",
+    # NOT _submit_buffer: the flush drains its whole batch exactly once
+    # per entry — O(1) amortized per task by construction.
+}
+
+#: whole-table consumer calls (len() is fine — O(1))
+CONSUMERS = {"list", "sorted", "max", "min", "sum", "set", "tuple", "dict"}
+
+
+def _mentions_table(node) -> str | None:
+    """Return the table name if this expression subtree touches one."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in TABLES:
+            return sub.attr
+    return None
+
+
+def _violations_in(fn_node, path, problems):
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            t = _mentions_table(node.iter)
+            if t:
+                problems.append(
+                    f"{path.name}:{node.lineno}: {fn_node.name} iterates "
+                    f"per-task table '{t}' — O(n) in in-flight tasks on "
+                    "the submit/complete hot path")
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                t = _mentions_table(gen.iter)
+                if t:
+                    problems.append(
+                        f"{path.name}:{node.lineno}: {fn_node.name} "
+                        f"comprehends over per-task table '{t}' on the "
+                        "submit/complete hot path")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("items", "values", "keys")
+                    and isinstance(f.value, ast.Attribute)
+                    and f.value.attr in TABLES):
+                problems.append(
+                    f"{path.name}:{node.lineno}: {fn_node.name} calls "
+                    f"{f.value.attr}.{f.attr}() on the hot path")
+            elif (isinstance(f, ast.Name) and f.id in CONSUMERS
+                    and any(isinstance(a, ast.Attribute)
+                            and a.attr in TABLES for a in node.args)):
+                problems.append(
+                    f"{path.name}:{node.lineno}: {fn_node.name} consumes a "
+                    f"whole per-task table via {f.id}() on the hot path")
+
+
+def test_submit_complete_hot_path_is_o1_in_queue_depth():
+    problems = []
+    for fname, wanted in HOT_FUNCTIONS.items():
+        path = CORE / fname
+        tree = ast.parse(path.read_text(), filename=str(path))
+        found = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in wanted:
+                found.add(node.name)
+                _violations_in(node, path, problems)
+        missing = wanted - found
+        assert not missing, (
+            f"{fname}: hot-path functions renamed/removed without updating "
+            f"the lint: {sorted(missing)}")
+    assert not problems, "hot-path O(n) table scans:\n" + "\n".join(problems)
+
+
+def test_admission_gate_is_wired_into_submission():
+    """Companion positive check: both public submit entry points actually
+    pass the admission gate and mark their pending entries gated — the
+    lint above pins bookkeeping costs, this pins the backpressure window
+    against simply being deleted."""
+    src = (CORE / "core_worker.py").read_text()
+    assert src.count("self.admission_gate.acquire(self)") >= 2
+    assert "gated=True" in src
+    assert "submit_inflight_limit" in src
