@@ -20,7 +20,9 @@ Spec format (JSON)::
         {"kind": "fail_after",   "prob": 0.5,  "method": "kv_put"},
         {"kind": "partition",    "peer": "127.0.0.1:6379", "times": 10}
       ],
-      "kills": [{"after_s": 3.0, "target": "worker", "node": "ab12"}]
+      "kills": [{"after_s": 3.0, "target": "worker", "node": "ab12"},
+                {"kind": "preempt_node", "after_s": 5.0, "notice_s": 2.0,
+                 "node": "cd34"}]
     }
 
 Rule fields: ``kind`` (required), ``prob`` (default 1.0), ``ms`` (delay
@@ -49,6 +51,13 @@ Fault semantics (where each hook lives):
   after install it kills one worker process (deterministic victim: first
   registered non-actor worker by worker id; ``node`` restricts the
   schedule entry to agents whose node id starts with that prefix).
+* ``kills`` entries with ``kind: "preempt_node"`` (or ``target: "node"``)
+  preempt the WHOLE matching node instead: the agent receives a shutdown
+  notice of ``notice_s`` seconds and drains — stops accepting leases,
+  re-homes sole-copy objects to the external spill tier / a peer, lets
+  outstanding leases return, deregisters — with a hard kill when the
+  notice expires.  ``notice_s: 0`` is the no-warning preemption (the node
+  just dies; recovery rides the external tier and lineage).
 
 Determinism: decisions are not drawn from a shared RNG stream (call
 interleaving would perturb them) — the n-th evaluation of rule *i* for
@@ -267,13 +276,23 @@ def injector() -> Optional[FaultInjector]:
 
 def install(spec: Any) -> Optional[FaultInjector]:
     """Install (or, with a falsy/empty spec, clear) the runtime chaos spec
-    for this process.  A runtime install overrides the config/env spec."""
+    for this process.  A runtime install overrides the config/env spec.
+
+    Idempotent per spec: re-installing the SAME spec keeps the existing
+    injector (and its counters/decision log).  The broadcast plane
+    converges through several channels — pubsub, heartbeat piggyback,
+    agent->worker forward — and in-process multi-agent clusters share one
+    injector, so the second delivery of one chaos_set must not wipe the
+    faults the first already recorded."""
     global _injector
     with _injector_lock:
         if isinstance(spec, str):
             spec = json.loads(spec) if spec.strip() else {}
         if not spec or (not spec.get("rules") and not spec.get("kills")):
             _injector = None
+        elif (isinstance(_injector, FaultInjector)
+                and _injector.spec == dict(spec)):
+            pass  # same spec re-delivered: keep counters + decision log
         else:
             _injector = FaultInjector(spec)
         return _injector

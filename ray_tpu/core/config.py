@@ -150,6 +150,15 @@ class Config:
     object_spilling_dir: str = ""
     #: Spill when store utilization exceeds this fraction.
     object_spilling_threshold: float = 0.8
+    #: External (fsspec-backed) spill tier base URI — e.g. ``gs://bucket/
+    #: prefix`` in production, ``file:///dir`` in tests; "" disables.  When
+    #: set, spill-on-evict writes the object once to
+    #: ``{uri}/{object_id}.obj`` and registers the URI with the OWNER as a
+    #: location that is not a node, so the object survives losing the node
+    #: that spilled it and any node's pull path can restore it (the
+    #: preemption-survivability tier; reference: ray's
+    #: ``object_spilling_config`` smart_open/fsspec spill targets).
+    object_spilling_external_uri: str = ""
 
     # -- scheduling --------------------------------------------------------
     #: Top-k fraction of feasible nodes considered by the hybrid policy

@@ -555,6 +555,14 @@ class LeasePool:
         self.queue: collections.deque[TaskSpec] = collections.deque()
         self.leased: Dict[str, LeasedWorker] = {}
         self.requesting = 0
+        # Hard node affinity (soft=False) pins execution to ONE node: the
+        # lease request must PARK at that agent when it is saturated, never
+        # accept a spillback target — following one would silently run the
+        # task on the wrong node (e.g. another pool's pipelined spare lease
+        # transiently holding the target's last CPU).
+        self.hard_affinity = (isinstance(strategy,
+                                         NodeAffinitySchedulingStrategy)
+                              and not strategy.soft)
 
     def submit(self, spec: TaskSpec):
         self.queue.append(spec)
@@ -674,7 +682,8 @@ class LeasePool:
                         resources=self.resources,
                         bundle=self.bundle,
                         runtime_env=self.runtime_env,
-                        allow_spillback=(hops < 4),
+                        allow_spillback=(hops < 4
+                                         and not self.hard_affinity),
                         owner=self.w.address,
                         task_label=str(self.key[0]),
                         _timeout=3600.0, _attempts=8)
@@ -1217,7 +1226,7 @@ class CoreWorker:
             self.memory_store.put(oid, so.to_bytes())
         else:
             res = await self.agent.call_retry("store_create", object_id=oid,
-                                              size=size)
+                                              size=size, owner=self.address)
             seg = ShmSegment(res["path"], size, create=False)
             try:
                 so.write_into(seg.view())
@@ -1353,7 +1362,22 @@ class CoreWorker:
             # pulls deregister): try every location, skip the unusable,
             # reject short replies (silent corruption otherwise).
             last: Optional[BaseException] = None
+            from . import external_spill
             for node_id, addr in list(record.locations):
+                if external_spill.is_external_address(addr):
+                    try:
+                        data = await asyncio.get_event_loop() \
+                            .run_in_executor(None, external_spill.timed_read,
+                                             addr)
+                    except Exception as e:  # noqa: BLE001 — try next
+                        last = e
+                        continue
+                    if len(data) != record.size:
+                        last = ObjectLostError(
+                            ref.id, f"external copy at {addr} has "
+                                    f"{len(data)} of {record.size} B")
+                        continue
+                    return data, None
                 client = self.agent_clients.get(addr)
                 try:
                     data = await client.call("read_chunk", object_id=ref.id,
@@ -2033,7 +2057,18 @@ class CoreWorker:
         rec = self.memory_store.get_if_exists(oid)
         self.memory_store.free(oid)
         if isinstance(rec, PlasmaRecord):
+            from . import external_spill
             for node_id, addr in rec.locations:
+                if external_spill.is_external_address(addr):
+                    # external-tier copy: not an agent to RPC — the owner
+                    # is its single deletion point (spilling nodes never
+                    # delete it; they may already be gone)
+                    try:
+                        await asyncio.get_event_loop().run_in_executor(
+                            None, external_spill.delete, addr)
+                    except Exception:
+                        pass
+                    continue
                 try:
                     await self.agent_clients.get(addr).call_retry(
                         "store_free", object_ids=[oid])
@@ -2145,7 +2180,8 @@ class CoreWorker:
             try:
                 res = await self.agent.call_retry("store_create",
                                                   object_id=oid,
-                                                  size=len(data))
+                                                  size=len(data),
+                                                  owner=self.address)
                 seg = ShmSegment(res["path"], len(data), create=False)
                 try:
                     seg.view()[:len(data)] = data
@@ -2602,7 +2638,8 @@ class CoreWorker:
             return ("inline", so.to_bytes(), contained)
         oid = ObjectID.for_task_return(spec.task_id, index)
         res = run_async(self.agent.call_retry("store_create", object_id=oid,
-                                              size=size))
+                                              size=size,
+                                              owner=spec.owner or None))
         seg = ShmSegment(res["path"], size, create=False)
         try:
             so.write_into(seg.view())

@@ -81,6 +81,7 @@ class GcsServer:
         self._job_counter = 0
         self._bg: List[asyncio.Task] = []
         self.persistence_path = persistence_path
+        self._persist_scheduled = False  # coalesces _persist_soon per tick
         self._started_at = time.time()
 
     # ------------------------------------------------------------------ boot
@@ -88,6 +89,8 @@ class GcsServer:
     async def start(self):
         self._maybe_restore()
         await self.server.start()
+        self._restart_pending_pgs()
+        self._restart_pending_actors()
         self._bg.append(asyncio.ensure_future(self._health_check_loop()))
 
         async def _self_call(method, **kw):
@@ -118,6 +121,11 @@ class GcsServer:
         if p and os.path.exists(p):
             with open(p, "rb") as f:
                 snap = pickle.load(f)
+            # Sharded tables restore entry-by-entry (the snapshot stores
+            # plain dicts, not shard layouts, so gcs_table_shards may
+            # change between incarnations) and their secondary indexes are
+            # REBUILT from the restored rows — the indexes are derived
+            # state, never independently authoritative.
             for k, v in snap.get("kv", {}).items():
                 self.kv[k] = v
                 self._kv_ns_index.add(k[0], k[1])
@@ -127,6 +135,58 @@ class GcsServer:
                 self.actors[aid] = info
                 self._index_actor(aid, info)
             self._job_counter = snap.get("job_counter", 0)
+            # pubsub topic logs + global seq: subscriber cursors from the
+            # previous incarnation stay valid (a poll after restart picks
+            # up exactly where it left off instead of replaying or
+            # skipping the world)
+            self._topic_logs = {t: [tuple(e) for e in log] for t, log in
+                                snap.get("topic_logs", {}).items()}
+            self._event_seq = snap.get("event_seq", 0)
+            # placement groups: CREATED placements restore as-is (their
+            # nodes re-register); PENDING ones get their scheduler kicked
+            # again once the loop runs
+            self.pgs = snap.get("pgs", {})
+            self._chaos_spec = snap.get("chaos_spec")
+            self._chaos_version = snap.get("chaos_version", 0)
+            for pg_id, info in self.pgs.items():
+                self._pg_events[pg_id] = asyncio.Event()
+                if info.get("state") in ("CREATED", "INFEASIBLE", "REMOVED"):
+                    self._pg_events[pg_id].set()
+
+    def _restart_pending_pgs(self):
+        for pg_id, info in self.pgs.items():
+            if info.get("state") == "PENDING":
+                asyncio.ensure_future(self._schedule_pg(pg_id))
+
+    def _restart_pending_actors(self):
+        """Re-kick scheduling for actors snapshotted mid-placement: the
+        in-flight _schedule_actor task died with the previous process, and
+        nothing else ever unsticks a PENDING/RESTARTING actor (the
+        report_actor_death path early-returns on RESTARTING)."""
+        for aid, info in list(self.actors.items()):
+            if (info.get("state") in ("PENDING", "RESTARTING")
+                    and info.get("spec") is not None):
+                asyncio.ensure_future(self._schedule_actor(aid))
+
+    def _persist_soon(self):
+        """Coalesced snapshot write: transitions that are NOT a durability
+        contract (actor/PG state — recoverable from re-registration and
+        owner retries) schedule ONE full-state write per loop tick instead
+        of pickling the whole GCS per event.  A 1000-actor wave costs one
+        snapshot, not 2-3 per actor.  KV/job writes stay synchronous: a
+        workflow step's commit must be on disk before its kv_put acks."""
+        if not self.persistence_path or self._persist_scheduled:
+            return
+        self._persist_scheduled = True
+
+        def _flush():
+            self._persist_scheduled = False
+            self._persist()
+
+        try:
+            asyncio.get_running_loop().call_soon(_flush)
+        except RuntimeError:
+            _flush()  # no loop (unit tests): write inline
 
     def _persist(self):
         p = self.persistence_path
@@ -137,7 +197,12 @@ class GcsServer:
             pickle.dump({"kv": self.kv.to_dict(), "jobs": self.jobs,
                          "named_actors": self.named_actors,
                          "actors": self.actors.to_dict(),
-                         "job_counter": self._job_counter}, f)
+                         "job_counter": self._job_counter,
+                         "pgs": self.pgs,
+                         "topic_logs": self._topic_logs,
+                         "event_seq": self._event_seq,
+                         "chaos_spec": self._chaos_spec,
+                         "chaos_version": self._chaos_version}, f)
         os.replace(tmp, p)
 
     # ------------------------------------------------------- actor indexes
@@ -293,7 +358,8 @@ class GcsServer:
                                queue_len: int = 0, store_stats: dict | None = None,
                                queued_demands: List[Dict[str, float]] | None = None,
                                total: Dict[str, float] | None = None,
-                               chaos_version: int | None = None):
+                               chaos_version: int | None = None,
+                               draining: bool = False):
         n = self.nodes.get(node_id)
         if n is None:
             return {"unknown": True}  # agent should re-register
@@ -301,6 +367,13 @@ class GcsServer:
         if total is not None:
             n.total = dict(total)
         n.queue_len = queue_len
+        if bool(draining) != n.draining:
+            n.draining = bool(draining)
+            if n.draining:
+                # broadcast the notice: schedulers route around the node
+                # while it finishes leases and re-homes its objects
+                self._publish("nodes", {"event": "draining",
+                                        "node_id": node_id})
         # resource shapes queued behind this node's leases — the autoscaler's
         # scale-up signal (reference: cluster load reported to the monitor,
         # autoscaler/_private/load_metrics.py)
@@ -366,7 +439,8 @@ class GcsServer:
         return {nid: {"address": n.address, "total": n.total,
                       "available": n.available, "labels": {k: v for k, v in n.labels.items()
                                                            if not k.startswith("_")},
-                      "alive": n.alive, "queue_len": n.queue_len}
+                      "alive": n.alive, "queue_len": n.queue_len,
+                      "draining": n.draining}
                 for nid, n in self.nodes.items()}
 
     async def handle_get_cluster_view(self):
@@ -418,6 +492,7 @@ class GcsServer:
         existed = self.kv.pop((ns, key), None) is not None
         if existed:
             self._kv_ns_index.discard(ns, key)
+            self._persist()
         return existed
 
     async def handle_kv_keys(self, ns: str, prefix: str = ""):
@@ -455,6 +530,7 @@ class GcsServer:
             "lifetime": spec.lifetime, "job_id": spec.job_id.hex(),
         }
         self._live_actors_by_job.add(spec.job_id.hex(), aid)
+        self._persist_soon()
         asyncio.ensure_future(self._schedule_actor(aid))
         return aid
 
@@ -493,6 +569,7 @@ class GcsServer:
                     self._actor_placed(aid, info, nid)
                     info.update(state="ALIVE", address=res["worker_address"],
                                 node_id=nid, worker_id=res["worker_id"])
+                    self._persist_soon()
                     self._publish("actors", {"actor_id": aid, "state": "ALIVE",
                                              "address": res["worker_address"]})
                     return
@@ -524,6 +601,7 @@ class GcsServer:
             return
         self._actor_dead(aid, info)
         info.update(state="DEAD", death_cause=reason)
+        self._persist_soon()
         self._publish("actors", {"actor_id": aid, "state": "DEAD", "reason": reason})
 
     async def handle_report_actor_death(self, actor_id: str, reason: str,
@@ -610,6 +688,7 @@ class GcsServer:
                            "state": "PENDING", "name": name, "placement": None,
                            "lifetime": lifetime, "created_at": time.time()}
         self._pg_events[pg_id] = asyncio.Event()
+        self._persist_soon()
         asyncio.ensure_future(self._schedule_pg(pg_id))
         # common case on an uncontended cluster: the placement settles
         # within one agent round trip — piggyback the result on the create
@@ -681,6 +760,7 @@ class GcsServer:
                     info.update(state="CREATED",
                                 placement=[(nid, self.nodes[nid].address)
                                            for nid in placement])
+                    self._persist_soon()
                     self._pg_settled(pg_id)
                     self._publish("pgs", {"pg_id": pg_id, "state": "CREATED"})
                     return
@@ -723,6 +803,7 @@ class GcsServer:
         self._pg_events.pop(pg_id, None)
         if info is None:
             return False
+        self._persist_soon()
         if info.get("placement"):
             # resource return is OFF the reply path (reference: removal is
             # async server-side); agents see the return frames before any

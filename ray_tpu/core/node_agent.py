@@ -31,12 +31,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from . import chaos
+from . import chaos, external_spill
 from .common import ResourceSet, TaskSpec, detect_node_resources
 from .config import get_config
+from .external_spill import EXTERNAL_NODE_ID, is_external_address
 from .ids import NodeID, ObjectID, WorkerID
 from .object_store import (ChunkNotAvailable, NodeObjectStore,
-                           ObjectStoreFullError)
+                           ObjectStoreFullError, sweep_orphan_spill_dirs)
 from .rpc import (ClientPool, ConnectionLost, RemoteError, RpcClient,
                   RpcServer, TransientServerError)
 from .scheduling import NodeView, pick_node
@@ -191,6 +192,17 @@ class NodeAgent:
         self._inflight_pulls: Dict[ObjectID, "asyncio.Future"] = {}
         self._lease_counter = 0
         self._shutting_down = False
+        # Preemption drain state: while draining the agent answers every
+        # lease request with backpressure (owners re-pick a node), spills
+        # sole-copy objects to the external tier / a peer, waits for
+        # outstanding leases to return, then deregisters — with a hard
+        # cutoff at the preemption notice deadline.
+        self._draining = False
+        self._preempt_task: Optional[asyncio.Task] = None
+        #: standalone-process hook (node_main sets os._exit): a preempted
+        #: node's process must actually disappear; in-process agents
+        #: (tests, the driver-embedded head) fall back to stop()
+        self._on_preempt_exit = None
         # Same-host identity for zero-copy object sharing: two agents with
         # equal host_key share one /dev/shm, so a "transfer" between them is
         # an mmap attach of the source's pool slice (plasma same-node
@@ -234,6 +246,27 @@ class NodeAgent:
 
     async def start(self):
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # Orphan sweep: a previous incarnation of a node on this host that
+        # died (preemption, SIGKILL) left spill files nothing will ever
+        # restore — delete dirs whose writing pid is gone before this
+        # incarnation starts accumulating its own.
+        if self.store.spill_root:
+            try:
+                sweep_orphan_spill_dirs(self.store.spill_root)
+            except Exception:
+                pass
+        # External-spill registration hook: once a spill write LANDS, tell
+        # the object's owner the external URI is a location (marshalled
+        # from the writer thread back onto this agent's loop).
+        loop = asyncio.get_event_loop()
+
+        def _on_ext_spill(oid, uri, owner, _loop=loop):
+            if owner:
+                _loop.call_soon_threadsafe(
+                    self._location_update, owner, "add_object_location",
+                    oid, EXTERNAL_NODE_ID, uri)
+
+        self.store.on_external_spill = _on_ext_spill
         await self.server.start()
         if get_config().metrics_export_enabled:
             # before registration: the endpoint port rides the node labels
@@ -299,7 +332,7 @@ class NodeAgent:
         self.cluster_view = {
             nid: NodeView(nid, d["address"], d["total"], d["available"],
                           d.get("labels", {}), d.get("alive", True),
-                          d.get("queue_len", 0))
+                          d.get("queue_len", 0), d.get("draining", False))
             for nid, d in payload.items()}
 
     async def _heartbeat_loop(self):
@@ -316,7 +349,8 @@ class NodeAgent:
                     queue_len=len(self.lease_queue),
                     queued_demands=self._aggregate_demands(),
                     store_stats=self.store.stats(),
-                    chaos_version=self._chaos_version)
+                    chaos_version=self._chaos_version,
+                    draining=self._draining)
                 if res.get("unknown"):
                     res2 = await self.gcs.call_retry(
                         "register_node", node_id=self.node_id.hex(),
@@ -640,6 +674,9 @@ class NodeAgent:
         request degrades to the single-lease slow path (queue park /
         spillback / infeasible), preserving those semantics unchanged."""
         count = max(1, int(count))
+        if self._draining:
+            return {"backpressure": True,
+                    "retry_after_s": get_config().lease_backpressure_retry_s}
         pending = []
         pool = self._resource_pool_for(bundle)  # ValueError surfaces as-is
         feasible = (bundle is not None
@@ -691,6 +728,13 @@ class NodeAgent:
     async def _request_worker_lease(self, resources, bundle, runtime_env,
                                     allow_spillback, owner, task_label,
                                     writer=None):
+        if self._draining:
+            # preemption notice received: stop accepting work — the owner
+            # folds this into node re-picking exactly like depth-bound
+            # backpressure, and the GCS view's draining flag keeps fresh
+            # picks away
+            return {"backpressure": True,
+                    "retry_after_s": get_config().lease_backpressure_retry_s}
         pool = self._resource_pool_for(bundle)
         if bundle is None and not ResourceSet(self.total.to_dict()).can_fit(resources):
             return {"infeasible": True}
@@ -1040,11 +1084,22 @@ class NodeAgent:
             node_sel = entry.get("node")
             if node_sel and not my_id.startswith(str(node_sel)):
                 continue
-            if entry.get("target", "worker") != "worker":
+            kind = entry.get("kind") or entry.get("target", "worker")
+            if kind not in ("worker", "preempt_node", "node"):
                 continue
             delay = t0 + float(entry.get("after_s", 0)) - time.monotonic()
             if delay > 0:
                 await asyncio.sleep(delay)
+            if kind in ("preempt_node", "node"):
+                # Seeded node preemption: deliver the shutdown notice to
+                # OURSELVES — notice_s>0 exercises the graceful drain,
+                # notice_s=0 the no-warning hard kill.  This agent is
+                # going away; stop walking the schedule.
+                if self._shutting_down:
+                    return
+                inj.record("preempt_node")
+                self._begin_preemption(float(entry.get("notice_s", 0.0)))
+                return
             # A scheduled kill with no victim yet (workers still booting)
             # waits briefly so "1 scheduled kill" reliably means 1 kill.
             victim = None
@@ -1077,6 +1132,214 @@ class NodeAgent:
         leased = [w for w in live if w.state == "LEASED"]
         pool = leased or live
         return pool[0] if pool else None
+
+    # ----------------------------------------------------- preemption drain
+
+    async def handle_drain_self(self, notice_s: float = 0.0):
+        """Deliver a preemption notice to this node (the cloud provider's
+        shutdown warning, an operator drain, or the chaos plane's seeded
+        ``preempt_node``).  ``notice_s > 0`` drains gracefully — stop
+        accepting leases, re-home sole-copy objects, let outstanding
+        leases return — with a HARD cutoff when the notice expires;
+        ``notice_s = 0`` is the no-warning preemption (the node just
+        disappears, recovery rides the external tier + lineage)."""
+        self._begin_preemption(notice_s)
+        return True
+
+    def _begin_preemption(self, notice_s: float):
+        if self._preempt_task is not None or self._shutting_down:
+            return
+        self._preempt_task = asyncio.ensure_future(self._preempt(notice_s))
+
+    async def _preempt(self, notice_s: float):
+        notice_s = max(0.0, float(notice_s))
+        try:
+            print(f"[preempt] node {self.node_id.hex()[:12]}: preemption "
+                  f"notice, {notice_s:.1f}s to drain", flush=True)
+        except Exception:
+            pass
+        if notice_s <= 0:
+            await self._preempt_finish(graceful=False)
+            return
+        self._draining = True
+        deadline = time.monotonic() + notice_s
+        # shed queued lease requests NOW: every parked owner re-picks a
+        # node instead of waiting on a grant that will never come
+        cfg = get_config()
+        for req in list(self.lease_queue):
+            self.lease_queue.remove(req)
+            if not req.future.done():
+                req.future.set_result(
+                    {"backpressure": True,
+                     "retry_after_s": cfg.lease_backpressure_retry_s})
+        try:
+            await asyncio.wait_for(
+                self._drain_objects(deadline),
+                max(0.05, deadline - time.monotonic()))
+        except asyncio.TimeoutError:
+            pass
+        except Exception:
+            pass
+        # flush: an evict-triggered external spill may still be in flight
+        # on the writer thread, and its owner registration only fires
+        # after the write lands — exiting now would kill the sole copy
+        # mid-upload (or leave it durable but unfindable)
+        try:
+            await asyncio.wait_for(
+                self._flush_external_writes(deadline),
+                max(0.05, deadline - time.monotonic()))
+        except (asyncio.TimeoutError, Exception):
+            pass
+        # let outstanding leases return on their own, up to the deadline
+        while (time.monotonic() < deadline
+               and any(w.state == "LEASED" for w in self.workers.values())):
+            await asyncio.sleep(0.05)
+        await self._preempt_finish(graceful=True)
+
+    async def _flush_external_writes(self, deadline: float):
+        """Wait out in-flight external spill writes AND the pending
+        owner-registration tasks they trigger (the write-done callback
+        marshals the registration onto this loop via
+        ``call_soon_threadsafe``, so one extra tick must pass before the
+        ``_loc_updates`` task even exists)."""
+        loop = asyncio.get_event_loop()
+        for fut in list(self.store._ext_writes.values()):
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            try:
+                await loop.run_in_executor(
+                    None, lambda f=fut, t=left: f.result(max(0.1, t)))
+            except Exception:
+                pass
+        await asyncio.sleep(0.05)  # let threadsafe-scheduled callbacks land
+        for t in list(self._loc_updates.values()):
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            try:
+                await asyncio.wait_for(asyncio.shield(t), left)
+            except Exception:
+                pass
+
+    async def _drain_objects(self, deadline: float):
+        """Re-home the owner-known sealed objects this node holds before
+        it disappears — BOTH in-store entries and locally-spilled files (a
+        local .spill file is just as much a sole copy as a shm entry):
+        write-once to the external tier when configured (and register the
+        URI with the owner as a non-node location), else replicate to a
+        live peer.  Objects already on the external tier are skipped —
+        they are durable already."""
+        my_id = self.node_id.hex()
+        peers = [v for nid, v in self.cluster_view.items()
+                 if nid != my_id and v.alive
+                 and not getattr(v, "draining", False)]
+        loop = asyncio.get_event_loop()
+
+        def _read_spill(path):
+            with open(path, "rb") as f:
+                return f.read()
+
+        # Only OWNER-KNOWN objects re-home: an ownerless upload could never
+        # be registered with anyone (undiscoverable) and nothing would
+        # ever delete it — a permanent tier leak.  Ownership tracks
+        # primariness by construction: task results / puts carry the owner
+        # through store_create, while copies this node PULLED do not — so
+        # the drain spends its notice window on the copies only this node
+        # has, not on re-uploading a broadcast's replicas.
+        victims = [(oid, e.owner, None)
+                   for oid, e in list(self.store._entries.items())
+                   if e.sealed and not e.freed and e.owner]
+        victims += [(oid, self.store._spilled_owners[oid], path)
+                    for oid, path in list(self.store._spilled.items())
+                    if oid in self.store._spilled_owners]
+        for oid, owner, spill_path in victims:
+            if time.monotonic() >= deadline:
+                return
+            if oid in self.store._spilled_external:
+                continue
+            try:
+                if spill_path is not None:
+                    data = await loop.run_in_executor(None, _read_spill,
+                                                      spill_path)
+                else:
+                    data = bytes(self.store._entries[oid].segment.view())
+            except Exception:
+                continue
+            if self.store.external_uri:
+                uri = external_spill.object_uri(self.store.external_uri, oid)
+                try:
+                    await loop.run_in_executor(
+                        None, external_spill.write, uri, data)
+                except Exception:
+                    continue
+                self.store._spilled_external[oid] = uri
+                m = external_spill.spill_metrics()
+                if m is not None:
+                    m["bytes"].inc_key(external_spill.KEY_TIER_EXTERNAL,
+                                       len(data))
+                if owner:
+                    # awaited (not the background _location_update): the
+                    # registration must land before this node dies or the
+                    # copy is durable but unfindable
+                    try:
+                        await self.worker_clients.get(owner).call_retry(
+                            "add_object_location", object_id=oid,
+                            node_id=EXTERNAL_NODE_ID, address=uri,
+                            _timeout=10.0)
+                    except Exception:
+                        pass
+            else:
+                # no external tier: replicate to the first peer that will
+                # take it (one full/slow peer must not drop the rest of
+                # the objects when others have room)
+                for peer in peers:
+                    if time.monotonic() >= deadline:
+                        return
+                    try:
+                        await self.agent_clients.get(
+                            peer.address).call_retry(
+                            "store_put", object_id=oid, data=data,
+                            owner=owner, _timeout=30.0)
+                    except Exception:
+                        continue
+                    if owner:
+                        try:
+                            await self.worker_clients.get(
+                                owner).call_retry(
+                                "add_object_location", object_id=oid,
+                                node_id=peer.node_id,
+                                address=peer.address, _timeout=10.0)
+                        except Exception:
+                            pass
+                    break
+
+    async def _preempt_finish(self, graceful: bool):
+        self._draining = True
+        if graceful and self.gcs is not None:
+            # deregister NOW: actors reschedule and the view stops routing
+            # here immediately, instead of waiting out the health-check
+            # threshold like an unannounced death
+            try:
+                await asyncio.wait_for(
+                    self.gcs.call("drain_node", node_id=self.node_id.hex()),
+                    5.0)
+            except Exception:
+                pass
+        hook = self._on_preempt_exit
+        if hook is not None:
+            # standalone agent process: the whole "VM" disappears — take
+            # the worker subprocesses down with it and exit hard, no
+            # orderly unwind (that is what a preemption is)
+            for w in list(self.workers.values()):
+                if w.proc is not None:
+                    try:
+                        w.proc.kill()
+                    except ProcessLookupError:
+                        pass
+            hook(graceful)
+            return
+        await self.stop()
 
     # --------------------------------------------------------------- actors
 
@@ -1196,9 +1459,10 @@ class NodeAgent:
 
     # ----------------------------------------------------------- object store
 
-    async def handle_store_create(self, object_id: ObjectID, size: int):
+    async def handle_store_create(self, object_id: ObjectID, size: int,
+                                  owner: Optional[str] = None):
         try:
-            path = self.store.create(object_id, size)
+            path = self.store.create(object_id, size, owner=owner)
         except ObjectStoreFullError as e:
             raise e
         return {"path": path}
@@ -1207,12 +1471,17 @@ class NodeAgent:
         self.store.seal(object_id)
         return True
 
-    async def handle_store_put(self, object_id: ObjectID, data: bytes):
-        self.store.create_and_write(object_id, data)
+    async def handle_store_put(self, object_id: ObjectID, data: bytes,
+                               owner: Optional[str] = None):
+        self.store.create_and_write(object_id, data, owner=owner)
         return {"path": self.store.get_path(object_id)[0]}
 
     async def handle_store_get(self, object_id: ObjectID,
                                timeout: Optional[float] = 0.0):
+        if self.store.external_only(object_id):
+            res = await self._restore_external(object_id)
+            if res is not None:
+                return res
         if not self.store.contains(object_id):
             if not timeout:
                 return None
@@ -1321,25 +1590,33 @@ class NodeAgent:
         return True
 
     async def _pin_sweep_loop(self):
-        """Liveness sweep for read-pin holders the worker monitor does not
-        cover — chiefly the DRIVER, which is a consumer but not a spawned
-        worker.  A consumer that vanishes without its exit drain (SIGKILL,
-        or leases GC'd after the worker's shutdown flag suppressed the
-        release notify) would otherwise leave its objects pinned —
-        unevictable, frees deferred — for the agent's whole lifetime.
-        Every consumer runs an RPC server with a ``ping`` handler, so a
-        repeatedly unreachable pinner address means the process is gone.
-        Draining on confirmed death only: a TIMEOUT means alive-but-busy,
-        and a single connect failure can be transient (fd exhaustion, one
-        dropped pooled connection) — releasing a LIVE consumer's pins
-        would let the arena recycle slices under its views, so death takes
-        three consecutive failed sweeps (~30 s) to declare."""
+        """Liveness sweep for read-pin holders AND lease owners the worker
+        monitor does not cover — chiefly the DRIVER, which is a consumer
+        but not a spawned worker.  A consumer that vanishes without its
+        exit drain (SIGKILL, preemption, or leases GC'd after the worker's
+        shutdown flag suppressed the release notify) would otherwise leave
+        its objects pinned — unevictable, frees deferred — for the agent's
+        whole lifetime; a dead DRIVER's granted leases would pin this
+        node's CPUs forever (the lease return is driver-side, and a
+        SIGKILLed driver never sends it — a 2-CPU node fully leased to a
+        dead driver can never schedule again).  Every consumer runs an RPC
+        server with a ``ping`` handler, so a repeatedly unreachable
+        address means the process is gone.  Acting on confirmed death
+        only: a TIMEOUT means alive-but-busy, and a single connect failure
+        can be transient (fd exhaustion, one dropped pooled connection) —
+        releasing a LIVE consumer's pins would let the arena recycle
+        slices under its views, so death takes three consecutive failed
+        sweeps (~30 s) to declare."""
         strikes: Dict[str, int] = {}
         while not self._shutting_down:
             await asyncio.sleep(10.0)
             managed = {w.address for w in self.workers.values()}
-            for addr in [a for a in list(self._read_pins)
-                         if a not in managed]:
+            lease_owners = {w.owner for w in self.workers.values()
+                            if w.state == "LEASED" and w.owner
+                            and not w.is_actor}
+            targets = {a for a in self._read_pins
+                       if a not in managed} | lease_owners
+            for addr in targets:
                 try:
                     await asyncio.wait_for(
                         self.worker_clients.get(addr).call("ping"), 5.0)
@@ -1355,9 +1632,25 @@ class NodeAgent:
                         strikes.pop(addr, None)
                         if addr in self._read_pins:
                             await self._drain_read_pins(addr)
+                        await self._reclaim_dead_owner_leases(addr)
             for a in list(strikes):
-                if a not in self._read_pins:
+                if a not in self._read_pins and a not in lease_owners:
                     strikes.pop(a)
+
+    async def _reclaim_dead_owner_leases(self, owner: str):
+        """A lease owner is confirmed dead: kill its leased task workers
+        (their results have nowhere to go — the work is orphaned) so the
+        lease resources return to the pool.  Actor workers are spared:
+        actor lifetime is GCS-managed (job GC / max_restarts), not tied to
+        the submitting owner's process."""
+        for w in list(self.workers.values()):
+            if w.state == "LEASED" and w.owner == owner and not w.is_actor:
+                try:
+                    print(f"[node-agent] reclaiming lease {w.lease_id} of "
+                          f"dead owner {owner}", flush=True)
+                except Exception:
+                    pass
+                await self._kill_worker_proc(w)
 
     async def _drain_read_pins(self, consumer_addr: Optional[str]):
         """Release every read pin a dead consumer still held (the plasma
@@ -1426,6 +1719,11 @@ class NodeAgent:
         ``with_crc`` adds a per-chunk checksum (native CRC-32C / zlib) the
         puller verifies before marking the chunk landed."""
         import pickle as _pickle
+        if self.store.external_only(object_id):
+            # a stale location routed a puller here after we evicted to the
+            # external tier: restore off-loop first, never inline on the
+            # serving loop
+            await self._restore_external(object_id)
         data = self.store.read_chunk(object_id, offset, length)
         m = transfer_metrics()
         if m is not None:
@@ -1472,9 +1770,18 @@ class NodeAgent:
     async def _locate_or_pull(self, object_id: ObjectID, size: int,
                               locations: List[Tuple[str, str]],
                               owner: Optional[str]):
+        if self.store.external_only(object_id):
+            res = await self._restore_external(object_id)
+            if res is not None:
+                return res
         if self.store.contains(object_id):
-            path, sz = self.store.get_path(object_id)
-            return {"path": path, "size": sz}
+            located = self.store.get_path(object_id)
+            # None: the only copy is an external record whose restore just
+            # failed (transient tier error) — fall through to the pull
+            # path, which can stripe over the URI and other holders
+            if located is not None:
+                path, sz = located
+                return {"path": path, "size": sz}
         e = self.store._entries.get(object_id)
         if e is not None and not e.freed:
             # Created locally but not sealed yet: the writer's one-way seal
@@ -1507,6 +1814,57 @@ class NodeAgent:
         finally:
             self._inflight_pulls.pop(object_id, None)
 
+    async def _restore_external(self, object_id: ObjectID) -> Optional[dict]:
+        """Restore an external-tier-only object into the local store with
+        the network read OFF-LOOP (a gs:// download must not freeze
+        heartbeats/lease grants for its duration — the store's synchronous
+        ``_maybe_restore`` stays only as the local-disk / direct-store
+        path).  Deduped through its own in-flight map so concurrent
+        readers share ONE external fetch; the shared future resolves to
+        the result dict OR None — never an exception — so followers fall
+        back to the normal locate/pull paths exactly like the leader
+        (``_inflight_pulls`` futures stay dict-only; mixing the two maps
+        would hand a follower None where it expects a dict)."""
+        inflight = self._inflight_restores.get(object_id)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._inflight_restores[object_id] = fut
+        res: Optional[dict] = None
+        try:
+            uri = self.store._spilled_external.get(object_id)
+            if uri is not None:
+                wfut = self.store._ext_writes.get(object_id)
+                if wfut is not None:
+                    # reader raced the spill write: wait it out off-loop
+                    await loop.run_in_executor(None,
+                                               lambda: wfut.result(60.0))
+                data = await loop.run_in_executor(
+                    None, external_spill.timed_read, uri)
+                self.store.restore_external_bytes(object_id, data)
+                located = self.store.get_path(object_id)
+                if located is not None:
+                    res = {"path": located[0], "size": located[1]}
+        except asyncio.CancelledError:
+            raise
+        except Exception:      # leader AND followers fall back to the
+            res = None         # normal locate/pull paths
+            # and the store's SYNC fallback must not re-attempt the read
+            # on the event loop right after this off-loop one failed
+            self.store._ext_backoff[object_id] = time.monotonic() + 5.0
+        finally:
+            if not fut.done():
+                fut.set_result(res)
+            self._inflight_restores.pop(object_id, None)
+        return res
+
+    @property
+    def _inflight_restores(self) -> Dict[ObjectID, "asyncio.Future"]:
+        if not hasattr(self, "_inflight_restores_map"):
+            self._inflight_restores_map: Dict[ObjectID, "asyncio.Future"] = {}
+        return self._inflight_restores_map
+
     def _trace_transfer(self, **ev):
         """Opt-in per-transfer timeline (RAYTPU_TRANSFER_TRACE_DIR): one
         JSONL per agent recording every chunk pull / zero-copy attach with
@@ -1530,11 +1888,21 @@ class NodeAgent:
         import random
         async with self._pull_sem:
             if self.store.contains(object_id):
-                path, sz = self.store.get_path(object_id)
-                return {"path": path, "size": sz}
+                located = self.store.get_path(object_id)
+                if located is not None:
+                    path, sz = located
+                    return {"path": path, "size": sz}
+                # external-only record whose restore failed: pull instead
             cfg = get_config()
+            # External-tier URIs ("external" locations, e.g. a gs://
+            # object the spilling node registered before dying) are valid
+            # CHUNK sources for the striped pull, but not RPC endpoints:
+            # keep them out of the zero-copy probe loop.
+            ext_sources = [addr for _nid, addr in locations
+                           if is_external_address(addr)]
             candidates = [(nid, addr) for nid, addr in locations
-                          if addr != self.server.address]
+                          if addr != self.server.address
+                          and not is_external_address(addr)]
             random.shuffle(candidates)
             # Same-host fast path: attach the source's pool slice instead of
             # copying bytes through a socket — the source pins the object for
@@ -1580,7 +1948,8 @@ class NodeAgent:
                 except Exception:
                     continue
             return await self._pull_object_chunks(
-                object_id, size, [addr for _nid, addr in candidates],
+                object_id, size,
+                [addr for _nid, addr in candidates] + ext_sources,
                 owner, cfg)
 
     def _register_object_location(self, owner: str, object_id: ObjectID):
@@ -1600,13 +1969,21 @@ class NodeAgent:
         self._location_update(owner, "remove_object_location", object_id)
 
     def _location_update(self, owner: str, method: str,
-                         object_id: ObjectID):
+                         object_id: ObjectID,
+                         node_id: Optional[str] = None,
+                         address: Optional[str] = None):
         """Background location add/remove, SEQUENCED per (owner, object):
         updates for one object chain behind each other, so a failed pull's
         remove can never overtake its own still-retrying add (unordered
-        tasks could re-register a freed segment forever)."""
+        tasks could re-register a freed segment forever).
+
+        ``node_id``/``address`` default to THIS node; the external-spill
+        hook passes ``(EXTERNAL_NODE_ID, uri)`` to register a copy that is
+        not on any node."""
         key = (owner, object_id)
         prev = self._loc_updates.get(key)
+        loc_node = node_id if node_id is not None else self.node_id.hex()
+        loc_addr = address if address is not None else self.server.address
 
         async def _send():
             if prev is not None:
@@ -1617,8 +1994,8 @@ class NodeAgent:
             try:
                 await self.worker_clients.get(owner).call_retry(
                     method, object_id=object_id,
-                    node_id=self.node_id.hex(),
-                    address=self.server.address, _timeout=15.0)
+                    node_id=loc_node,
+                    address=loc_addr, _timeout=15.0)
             except Exception:
                 pass
 
@@ -1688,6 +2065,12 @@ class NodeAgent:
                                            cfg)
 
         async def probe_source(addr):
+            if is_external_address(addr):
+                # external copies are complete by construction (the spill
+                # write is atomic: tmp-file rename / single upload)
+                ok = await asyncio.get_event_loop().run_in_executor(
+                    None, external_spill.exists, addr)
+                return {"full": True} if ok else None
             try:
                 info = await self.agent_clients.get(addr).call(
                     "object_info", object_id=object_id, _timeout=5.0)
@@ -1767,8 +2150,18 @@ class NodeAgent:
         the socket read itself.  Returns the byte count landed; the engine
         rejects short chunks (a truncated reply must never seal a corrupt
         object)."""
-        client = self.agent_clients.get(addr)
         sink = seg.view()[off:off + n]
+        if is_external_address(addr):
+            # external-tier chunk source: range-read the URI off-loop and
+            # land it like any other chunk — the ledger's short-chunk /
+            # retry / source-death handling applies unchanged
+            data = await asyncio.get_event_loop().run_in_executor(
+                None, external_spill.read_range, addr, off, n)
+            landed = len(data)
+            if landed <= n:
+                sink[:landed] = data
+            return landed
+        client = self.agent_clients.get(addr)
         with_crc = cfg.object_transfer_checksum
         if with_crc:
             # Checksum mode trades the zero-copy landing for soundness: a

@@ -39,6 +39,9 @@ def main():
                       session_dir=args.session_dir,
                       object_store_memory=args.object_store_memory)
     run_async(agent.start())
+    # A preempted standalone node's PROCESS must disappear (the "VM" is
+    # gone): exit hard from the drain path, no orderly unwind.
+    agent._on_preempt_exit = lambda graceful: os._exit(0)
     # Report our address on stdout so the parent can address this node.
     print(json.dumps({"node_id": agent.node_id.hex(),
                       "address": agent.address}), flush=True)

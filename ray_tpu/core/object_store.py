@@ -20,6 +20,7 @@ Two tiers, like the reference:
 from __future__ import annotations
 
 import asyncio
+import json
 import mmap
 import os
 import tempfile
@@ -27,7 +28,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from . import external_spill
 from .config import get_config
+from .external_spill import (KEY_TIER_EXTERNAL, KEY_TIER_LOCAL,
+                             spill_metrics)
 from .ids import ObjectID
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
@@ -203,6 +207,10 @@ class _Entry:
     pinned: int = 0          # pin count: live reader views + peer transfers
     freed: bool = False      # owner freed it while pins were live (deferred)
     last_access: float = field(default_factory=time.monotonic)
+    #: the owning CoreWorker's address (piggybacked on store_create): lets
+    #: the spill/drain paths register an external copy back with the owner
+    #: as a non-node location (None for legacy/ownerless writes)
+    owner: Optional[str] = None
     #: sealed [start, end) byte ranges of an UNSEALED entry being pulled —
     #: the chunk ledger publishes each landed chunk here so ``read_chunk``
     #: can serve it to later pullers before the whole object seals
@@ -256,11 +264,38 @@ class NodeObjectStore:
         # Spill-on-evict is ON by default (reference: raylet spills rather
         # than drop; local_object_manager.h:41) — an empty config value means
         # "pick a default dir", not "disable".  Set it to "off" to disable.
+        # Files live under a PER-STORE subdirectory with a pid marker, so a
+        # restarted node incarnation's orphan sweep (sweep_orphan_spill_dirs)
+        # can delete a dead store's leftovers without touching live peers'.
         if cfg.object_spilling_dir == "off":
+            self.spill_root = None
             self.spill_dir = None
         else:
-            self.spill_dir = cfg.object_spilling_dir or os.path.join(
+            self.spill_root = cfg.object_spilling_dir or os.path.join(
                 tempfile.gettempdir(), "raytpu", "spill")
+            self.spill_dir = os.path.join(self.spill_root, self.name)
+        # External durability tier (core/external_spill.py): spilled objects
+        # go to a cluster-readable fsspec URI instead of node-local disk and
+        # are registered with the owner as a non-node location — they
+        # survive this node's preemption and restore through ANY node's
+        # pull path.
+        self.external_uri = cfg.object_spilling_external_uri or None
+        #: oid -> external URI (recorded at spill-submit time; the write
+        #: itself may still be in flight — see _ext_writes)
+        self._spilled_external: Dict[ObjectID, str] = {}
+        #: oid -> in-flight external write future: readers racing the
+        #: write wait it out; frees racing it mark _ext_drop_after_write
+        self._ext_writes: Dict[ObjectID, "object"] = {}
+        self._ext_drop_after_write: set = set()
+        #: oid -> monotonic deadline of a restore-failure backoff window:
+        #: after the agent's off-loop restore fails, the SYNC fallback in
+        #: _maybe_restore must not re-attempt the same network read on the
+        #: event loop — the pull path covers instead
+        self._ext_backoff: Dict[ObjectID, float] = {}
+        self._ext_pool = None
+        #: agent hook, called (object_id, uri, owner) off-loop once an
+        #: external spill write LANDS — registers the URI with the owner
+        self.on_external_spill = None
         # Native arena (C++ first-fit allocator over ONE shm mapping — the
         # plasma design): per-object create cost drops from
         # open+ftruncate+mmap+page-zero to an allocator call.  Falls back to
@@ -322,11 +357,19 @@ class NodeObjectStore:
 
     # -- creation ---------------------------------------------------------
 
-    def create(self, object_id: ObjectID, size: int) -> str:
-        """Allocate a segment; returns the shm path the writer should mmap."""
+    def create(self, object_id: ObjectID, size: int,
+               owner: Optional[str] = None) -> str:
+        """Allocate a segment; returns the shm path the writer should mmap.
+
+        ``owner`` (the owning CoreWorker's address, when the caller knows
+        it) is remembered on the entry so a later spill/drain can register
+        an external copy back with the owner."""
         self._maybe_start_prefault()
         if object_id in self._entries:
-            return self._entries[object_id].segment.path
+            e = self._entries[object_id]
+            if owner and not e.owner:
+                e.owner = owner
+            return e.segment.path
         if size > self.capacity:
             raise ObjectStoreFullError(
                 f"object {object_id} ({size} B) exceeds store capacity {self.capacity} B")
@@ -341,7 +384,7 @@ class NodeObjectStore:
             except FileExistsError:
                 os.unlink(path)
                 seg = ShmSegment(path, size, create=True)
-        self._entries[object_id] = _Entry(segment=seg, size=size)
+        self._entries[object_id] = _Entry(segment=seg, size=size, owner=owner)
         self.used += size
         self.num_creates += 1
         return seg.path
@@ -362,8 +405,9 @@ class NodeObjectStore:
                 f"(used={self.pool.used}/{self.pool.capacity})")
         return PoolSlice(self.pool, off, size)
 
-    def create_and_write(self, object_id: ObjectID, data) -> str:
-        path = self.create(object_id, len(data))
+    def create_and_write(self, object_id: ObjectID, data,
+                         owner: Optional[str] = None) -> str:
+        path = self.create(object_id, len(data), owner=owner)
         e = self._entries[object_id]
         e.segment.view()[: len(data)] = data
         self.seal(object_id)
@@ -410,7 +454,8 @@ class NodeObjectStore:
         p = self._proxies.get(object_id)
         if p is not None and not p.freed:
             return True
-        return object_id in self._spilled
+        return (object_id in self._spilled
+                or object_id in self._spilled_external)
 
     async def wait_sealed(self, object_id: ObjectID, timeout: float | None = None) -> bool:
         e = self._entries.get(object_id)
@@ -576,10 +621,29 @@ class NodeObjectStore:
                     os.unlink(spilled)
                 except OSError:
                     pass
+            self._drop_external(object_id)
             return None
-        return self._complete_free(object_id)
+        return self._complete_free(object_id, drop_external=not force)
 
-    def _complete_free(self, object_id: ObjectID) -> Optional[str]:
+    def _drop_external(self, object_id: ObjectID):
+        """Delete this store's external-tier copy of a freed object.  If the
+        write is still in flight, deletion chains behind its completion
+        (free-during-spill race: the copy must not survive the free)."""
+        uri = self._spilled_external.pop(object_id, None)
+        if uri is None:
+            return
+        if object_id in self._ext_writes:
+            self._ext_drop_after_write.add(object_id)
+        else:
+            try:
+                # off the caller's (event-loop) thread: a gs:// delete is
+                # a network round trip, and free() runs in RPC handlers
+                self._ext_executor().submit(external_spill.delete, uri)
+            except Exception:
+                pass
+
+    def _complete_free(self, object_id: ObjectID,
+                       drop_external: bool = True) -> Optional[str]:
         proxy = self._proxies.pop(object_id, None)
         if proxy is not None:
             # drop the chunk-serving attach mapping (if any): holding it
@@ -588,13 +652,17 @@ class NodeObjectStore:
             seg = self._attach_maps.pop(proxy.path, None)
             if seg is not None:
                 seg.close()
-        # A freed object may live in shm, on the spill disk, or both.
+        # A freed object may live in shm, on the spill disk, the external
+        # tier, or several at once.
         spilled = self._spilled.pop(object_id, None)
+        self._spilled_owners.pop(object_id, None)
         if spilled:
             try:
                 os.unlink(spilled)
             except OSError:
                 pass
+        if drop_external:
+            self._drop_external(object_id)
         e = self._entries.pop(object_id, None)
         # Freeing an UNSEALED entry (a failed striped pull) must wake any
         # wait_sealed() waiter NOW: they re-resolve (get_path -> None ->
@@ -624,7 +692,7 @@ class NodeObjectStore:
             if freed >= need_bytes:
                 break
             oid = next(k for k, v in self._entries.items() if v is e)
-            if self.spill_dir:
+            if self.spill_dir or self.external_uri:
                 self._spill(oid, e)
             self._entries.pop(oid)
             self.used -= e.size
@@ -638,11 +706,123 @@ class NodeObjectStore:
                 f"(used={self.used}/{self.capacity})")
 
     def _spill(self, object_id: ObjectID, e: _Entry):
+        """Spill one evicted entry: to the external fsspec tier when
+        configured (durable — survives this node), else to node-local disk.
+
+        The external write runs on a background thread against a
+        synchronous COPY of the bytes (the segment is reclaimed the moment
+        eviction returns); the URI is recorded immediately so readers that
+        race the write wait on the in-flight future instead of missing the
+        copy.  Once the write lands, ``on_external_spill`` tells the agent
+        to register the URI with the owner as a non-node location."""
+        if self.external_uri:
+            self._spill_external(object_id, e)
+            return
         os.makedirs(self.spill_dir, exist_ok=True)
+        self._write_spill_marker()
         path = os.path.join(self.spill_dir, f"{self.name}-{object_id.hex()}.spill")
         with open(path, "wb") as f:
             f.write(e.segment.view())
         self._spilled.setdefault(object_id, path)
+        if e.owner:
+            # the entry record dies with the evict; the drain path still
+            # needs to know whom to tell when it re-homes this file
+            self._spilled_owners[object_id] = e.owner
+        m = spill_metrics()
+        if m is not None:
+            m["bytes"].inc_key(KEY_TIER_LOCAL, e.size)
+
+    def _spill_external(self, object_id: ObjectID, e: _Entry):
+        if (object_id in self._spilled_external
+                and object_id not in self._ext_writes):
+            # restore->evict cycle: the landed external copy (kept by
+            # _maybe_restore precisely for this) is still valid — byte
+            # content is immutable once sealed, so re-uploading the whole
+            # object (and re-firing the owner registration) is pure waste
+            return
+        data = bytes(e.segment.view())
+        uri = external_spill.object_uri(self.external_uri, object_id)
+        self._spilled_external[object_id] = uri
+        fut = self._ext_executor().submit(external_spill.write, uri, data)
+        self._ext_writes[object_id] = fut
+        fut.add_done_callback(
+            lambda f, oid=object_id, uri=uri, owner=e.owner, data=data:
+            self._ext_write_done(oid, uri, owner, f, data))
+
+    def _ext_executor(self):
+        if self._ext_pool is None:
+            import concurrent.futures
+            self._ext_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="ext-spill")
+        return self._ext_pool
+
+    def _ext_write_done(self, object_id: ObjectID, uri: str,
+                        owner: Optional[str], fut, data=None):
+        """Runs on the spill writer thread (dict single-op mutations only —
+        GIL-atomic; everything loop-bound goes through on_external_spill,
+        which the agent marshals back onto its loop)."""
+        self._ext_writes.pop(object_id, None)
+        try:
+            n = fut.result()
+        except Exception:
+            # failed write: the recorded URI is a dangling promise — drop
+            # it so contains()/restore stop advertising a copy that isn't,
+            # and FALL BACK to the local spill disk (the entry is already
+            # evicted; without this the sole copy is simply gone while the
+            # owner still routes pullers here)
+            self._spilled_external.pop(object_id, None)
+            if object_id in self._ext_drop_after_write:
+                self._ext_drop_after_write.discard(object_id)
+                return  # freed mid-write: nothing to preserve
+            if data is not None and self.spill_dir:
+                try:
+                    os.makedirs(self.spill_dir, exist_ok=True)
+                    self._write_spill_marker()
+                    path = os.path.join(
+                        self.spill_dir,
+                        f"{self.name}-{object_id.hex()}.spill")
+                    with open(path, "wb") as f:
+                        f.write(data)
+                    self._spilled[object_id] = path
+                    if owner:
+                        self._spilled_owners[object_id] = owner
+                    m = spill_metrics()
+                    if m is not None:
+                        m["bytes"].inc_key(KEY_TIER_LOCAL, len(data))
+                except Exception:
+                    pass
+            return
+        m = spill_metrics()
+        if m is not None:
+            m["bytes"].inc_key(KEY_TIER_EXTERNAL, n)
+        if object_id in self._ext_drop_after_write:
+            # freed while the write was in flight: the copy must not
+            # outlive the free
+            self._ext_drop_after_write.discard(object_id)
+            try:
+                external_spill.delete(uri)
+            except Exception:
+                pass
+            return
+        cb = self.on_external_spill
+        if cb is not None and self._spilled_external.get(object_id) == uri:
+            try:
+                cb(object_id, uri, owner)
+            except Exception:
+                pass
+
+    def _write_spill_marker(self):
+        """Pid marker for the orphan sweep: a later incarnation on this
+        host deletes spill dirs whose writing process is gone."""
+        marker = os.path.join(self.spill_dir, "owner.json")
+        if not os.path.exists(marker):
+            try:
+                with open(marker, "w") as f:
+                    json.dump({"pid": os.getpid(),
+                               "store": self.name,
+                               "started_at": time.time()}, f)
+            except OSError:
+                pass
 
     @property
     def _spilled(self) -> Dict[ObjectID, str]:
@@ -650,14 +830,80 @@ class NodeObjectStore:
             self._spilled_map: Dict[ObjectID, str] = {}
         return self._spilled_map
 
+    @property
+    def _spilled_owners(self) -> Dict[ObjectID, str]:
+        """Owner address per LOCALLY spilled object (the entry that held it
+        is gone; the drain path re-homes these files and must register the
+        new location with the owner)."""
+        if not hasattr(self, "_spilled_owners_map"):
+            self._spilled_owners_map: Dict[ObjectID, str] = {}
+        return self._spilled_owners_map
+
+    def external_only(self, object_id: ObjectID) -> bool:
+        """True when the ONLY local knowledge of this object is an
+        external-tier URI — the restore is a (possibly remote) network
+        read the agent must run off-loop, unlike the local-disk path."""
+        e = self._entries.get(object_id)
+        if e is not None and e.sealed and not e.freed:
+            return False
+        p = self._proxies.get(object_id)
+        if p is not None and not p.freed:
+            return False
+        return (object_id not in self._spilled
+                and object_id in self._spilled_external)
+
+    def restore_external_bytes(self, object_id: ObjectID,
+                               data: bytes) -> None:
+        """Land externally-restored bytes back into the store (the agent
+        read them off-loop; this runs ON the loop).  The external record is
+        kept — other nodes may be routed at it and re-evicting reuses it."""
+        if object_id in self._entries:
+            return
+        self.create_and_write(object_id, data)
+
     def _maybe_restore(self, object_id: ObjectID):
         path = self._spilled.pop(object_id, None)
-        if path is None:
+        if path is not None:
+            t0 = time.monotonic()
+            with open(path, "rb") as f:
+                data = f.read()
+            self.create_and_write(object_id, data,
+                                  owner=self._spilled_owners.pop(
+                                      object_id, None))
+            os.unlink(path)
+            m = spill_metrics()
+            if m is not None:
+                m["restore_seconds"].observe(time.monotonic() - t0)
             return
-        with open(path, "rb") as f:
-            data = f.read()
+        # External tier: wait out an in-flight spill write (the reader
+        # raced the evict), then read the URI back into the store.  The
+        # external copy is NOT deleted — it may be registered with the
+        # owner as a location other nodes are pulling from; the owner's
+        # free is its single deletion point.
+        #
+        # This SYNCHRONOUS branch is the local-disk-style fallback for
+        # direct store users; the agent's read paths go through the
+        # off-loop ``_restore_external`` FIRST and only land here after it
+        # failed, so the in-flight wait is capped short rather than
+        # letting one slow tier freeze the caller for a minute.
+        uri = self._spilled_external.get(object_id)
+        if uri is None:
+            return
+        if time.monotonic() < self._ext_backoff.get(object_id, 0.0):
+            return  # off-loop restore just failed: don't retry ON-loop
+        fut = self._ext_writes.get(object_id)
+        if fut is not None:
+            try:
+                fut.result(timeout=5.0)
+            except Exception:
+                return  # write failed/slow; the caller's pull path covers
+        try:
+            data = external_spill.timed_read(uri)
+        except Exception:
+            self._ext_backoff[object_id] = time.monotonic() + 5.0
+            return
+        self._ext_backoff.pop(object_id, None)
         self.create_and_write(object_id, data)
-        os.unlink(path)
 
     def stats(self) -> dict:
         largest_free = 0
@@ -680,6 +926,8 @@ class NodeObjectStore:
             "num_deferred_frees": sum(1 for e in self._entries.values()
                                       if e.freed)
             + sum(1 for p in self._proxies.values() if p.freed),
+            "num_spilled_local": len(self._spilled),
+            "num_spilled_external": len(self._spilled_external),
         }
 
     def objects(self) -> list:
@@ -699,6 +947,12 @@ class NodeObjectStore:
             rows.append({"object_id": oid.hex(), "size": None,
                          "sealed": True, "pinned": 0, "freed": False,
                          "kind": "spilled", "path": path})
+        for oid, uri in self._spilled_external.items():
+            if oid in self._entries:
+                continue  # restored: already reported as "local"
+            rows.append({"object_id": oid.hex(), "size": None,
+                         "sealed": True, "pinned": 0, "freed": False,
+                         "kind": "external", "path": uri})
         return rows
 
     def shutdown(self):
@@ -708,16 +962,77 @@ class NodeObjectStore:
         for oid in list(self._entries):
             self.free(oid, force=True)
         # spill files of still-referenced-but-evicted objects would otherwise
-        # outlive the session and accumulate under the shared default dir
+        # outlive the session and accumulate under the shared default dir.
+        # External-tier copies are deliberately NOT deleted here: they may be
+        # registered with owners as live locations (the whole point of the
+        # durability tier); the owner's free — or a later orphan GC — is
+        # their deletion point.
         for oid in list(self._spilled):
             path = self._spilled.pop(oid)
             try:
                 os.unlink(path)
             except OSError:
                 pass
+        if self.spill_dir and os.path.isdir(self.spill_dir):
+            # this incarnation's (now empty) spill subdir + marker
+            import shutil
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
+        if self._ext_pool is not None:
+            self._ext_pool.shutdown(wait=False)
+            self._ext_pool = None
         if self.pool is not None:
             self.pool.close(unlink=True)
             self.pool = None
+
+
+def sweep_orphan_spill_dirs(spill_root: str, grace_s: float = 60.0) -> int:
+    """Delete per-store local spill directories whose writing process is
+    gone (a restarted node incarnation cleaning up its previous life).
+    Each store writes an ``owner.json`` pid marker on first spill; a dir
+    whose pid is dead — or that has spill files but no marker — is an
+    orphan.  Marker-less dirs younger than ``grace_s`` are SKIPPED: a
+    sibling agent's first spill creates the dir a moment before its
+    marker write lands, and sweeping that window would delete a live
+    store's file out from under its evict.  Returns the number of
+    directories removed."""
+    import shutil
+    removed = 0
+    try:
+        names = os.listdir(spill_root)
+    except OSError:
+        return 0
+    for name in names:
+        d = os.path.join(spill_root, name)
+        if not os.path.isdir(d):
+            continue
+        marker = os.path.join(d, "owner.json")
+        pid = None
+        try:
+            with open(marker) as f:
+                pid = int(json.load(f).get("pid", 0))
+        except (OSError, ValueError, TypeError):
+            pid = None
+        if pid is None:
+            try:
+                if time.time() - os.path.getmtime(d) < grace_s:
+                    continue  # mid-creation by a live sibling
+            except OSError:
+                continue
+        alive = False
+        if pid:
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                alive = True  # exists, owned by someone else
+            except OSError:
+                alive = False
+        if not alive:
+            shutil.rmtree(d, ignore_errors=True)
+            removed += 1
+    return removed
 
 
 # ---------------------------------------------------------------------------

@@ -35,6 +35,9 @@ class NodeView:
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
     queue_len: int = 0
+    #: preemption notice received — still alive (finishing leases, spilling
+    #: objects) but not schedulable: pick_node/pack_bundles skip it
+    draining: bool = False
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)
@@ -60,7 +63,8 @@ def pick_node(view: Dict[str, NodeView],
               rng: random.Random | None = None) -> Optional[str]:
     """Return the chosen node_id hex, or None if no feasible node exists."""
     rng = rng or random
-    alive = {nid: n for nid, n in view.items() if n.alive}
+    alive = {nid: n for nid, n in view.items()
+             if n.alive and not n.draining}
 
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         n = alive.get(strategy.node_id)
@@ -152,7 +156,7 @@ def pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
     """
     alive = {nid: NodeView(n.node_id, n.address, dict(n.total), dict(n.available),
                            n.labels, n.alive, n.queue_len)
-             for nid, n in view.items() if n.alive}
+             for nid, n in view.items() if n.alive and not n.draining}
 
     def try_place(order_nodes_for_bundle) -> Optional[List[str]]:
         placement: List[str] = []

@@ -25,6 +25,14 @@ pull-side **chunk ledger**:
 The engine is transport-agnostic (callbacks for fetch/probe/refresh) so the
 striping, stealing and resume logic unit-test without a cluster; the node
 agent supplies RPC-backed callbacks (see ``NodeAgent._pull_object``).
+
+Sources are opaque ADDRESS strings to the engine — the agent's callbacks
+route ``host:port`` addresses over RPC and **external-tier URIs**
+(``gs://...``, ``file://...`` — see ``core/external_spill.py``) through
+fsspec range reads, so an object spilled to the external tier by a node
+that later died participates in the stripe exactly like a live peer:
+claimable chunk-by-chunk, hedgeable, retried, folded in by the mid-pull
+owner refresh when its registration lands mid-broadcast.
 """
 
 from __future__ import annotations

@@ -94,9 +94,58 @@ def cmd_stop(_args):
         pass
 
 
+# --------------------------------------------------------------- launcher
+
+def _launcher(args):
+    from ray_tpu.autoscaler.launcher import ClusterLauncher, load_config
+    if not args.config:
+        raise SystemExit("--config CONFIG.yaml required")
+    return ClusterLauncher(load_config(args.config),
+                           state_path=getattr(args, "state", None))
+
+
+def cmd_up(args):
+    """Summon the fleet described by a launcher YAML (queued-resource
+    creates via the GCE TPU provider; idempotent against live nodes)."""
+    launcher = _launcher(args)
+    created = launcher.up(wait=args.wait)
+    if not created:
+        print(f"cluster {launcher.cluster_name!r}: already at configured "
+              f"node counts")
+    for pid in created:
+        nt = launcher.provider._nodes.get(pid, {}).get("node_type")
+        print(f"created {pid} ({nt})")
+    print(f"state -> {launcher.state_path}")
+
+
+def cmd_down(args):
+    launcher = _launcher(args)
+    pids = launcher.down()
+    for pid in pids:
+        print(f"terminated {pid}")
+    print(f"cluster {launcher.cluster_name!r}: {len(pids)} node(s) torn down")
+
+
+def _print_launcher_status(args):
+    launcher = _launcher(args)
+    rows = launcher.status()
+    if not rows:
+        print(f"cluster {launcher.cluster_name!r}: no tracked nodes")
+        return
+    print(f"{'PROVIDER_ID':<16} {'NODE_TYPE':<16} {'STATE':<24} NODE")
+    for r in rows:
+        print(f"{r['provider_id']:<16} {str(r['node_type']):<16} "
+              f"{str(r['state']):<24} {r.get('raytpu_node_id') or '-'}")
+
+
 # ----------------------------------------------------------------- status
 
-def cmd_status(_args):
+def cmd_status(args):
+    if getattr(args, "config", None):
+        # launcher mode: fleet/QR states from the provider, no cluster
+        # connection needed (the fleet may still be provisioning)
+        _print_launcher_status(args)
+        return
     rt = _connect()
     nodes = rt.nodes()
     total = rt.cluster_resources()
@@ -426,8 +475,27 @@ def main(argv=None):
     s.set_defaults(fn=cmd_stop)
 
     s = sub.add_parser("status", help="cluster nodes + resources + per-node "
-                                      "telemetry and task-stage latency")
+                                      "telemetry and task-stage latency "
+                                      "(--config: launcher fleet status)")
+    s.add_argument("--config", default=None,
+                   help="launcher YAML: show the fleet's QR states instead")
+    s.add_argument("--state", default=None, help="launcher state file")
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("up", help="summon the fleet from a launcher YAML "
+                                  "(GCE TPU queued resources)")
+    s.add_argument("--config", required=True)
+    s.add_argument("--state", default=None,
+                   help="state file (default /tmp/raytpu/launcher-NAME.json)")
+    s.add_argument("--wait", action="store_true",
+                   help="block until created nodes reach ACTIVE")
+    s.set_defaults(fn=cmd_up)
+
+    s = sub.add_parser("down", help="tear down the fleet a previous "
+                                    "`raytpu up` launched")
+    s.add_argument("--config", required=True)
+    s.add_argument("--state", default=None)
+    s.set_defaults(fn=cmd_down)
 
     s = sub.add_parser("list", help="state API listings")
     s.add_argument("kind")

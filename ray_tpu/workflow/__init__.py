@@ -9,14 +9,15 @@ Durability rides the GCS KV (namespace ``workflow``) — the same store that
 survives GCS restarts via the snapshot file (test_fault_tolerance.py).
 """
 
-from .api import (get_output, get_status, list_all, resume, run, run_async,
-                  step)
+from .api import (get_output, get_status, list_all, list_committed_steps,
+                  resume, run, run_async, step)
 from .events import (EventListener, KVEventListener, event_received,
                      send_event, wait_for_event)
 
 __all__ = ["step", "run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "wait_for_event", "send_event", "event_received",
-           "EventListener", "KVEventListener"]
+           "list_all", "list_committed_steps", "wait_for_event",
+           "send_event", "event_received", "EventListener",
+           "KVEventListener"]
 
 # Usage telemetry: which libraries a cluster actually uses (reference:
 # usage_lib.record_library_usage at import time).  Never raises.

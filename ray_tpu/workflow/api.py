@@ -262,3 +262,16 @@ def list_all() -> List[str]:
     for key in _kv().keys():
         ids.add(key.split("/", 1)[0])
     return sorted(ids)
+
+
+def list_committed_steps(workflow_id: str) -> List[str]:
+    """Step keys whose results are committed to storage — the progress a
+    ``resume()`` will skip.  Readable from ANY driver connected to the
+    cluster (the KV outlives the driver that ran the workflow), which is
+    how a supervisor decides a crashed run is worth resuming."""
+    out = []
+    for key in _kv().keys(prefix=f"{workflow_id}/"):
+        step_key = key.split("/", 1)[1]
+        if not step_key.startswith("__"):
+            out.append(step_key)
+    return sorted(out)

@@ -1,0 +1,278 @@
+"""External spill tier (core/external_spill.py + ObjectStore hooks):
+spill-on-evict to an fsspec URI, restore through any node's pull path,
+free/evict/restore races, orphan sweep, and the spill metrics.
+
+Reference: ray's ``object_spilling_config`` external storage (smart_open /
+fsspec URIs) + ``test_object_spilling.py``; here the external copy is
+additionally a first-class OWNER LOCATION so it survives node loss."""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core import external_spill
+from ray_tpu.core.config import Config, set_config, reset_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import (NodeObjectStore,
+                                       sweep_orphan_spill_dirs)
+from ray_tpu.core.rpc import run_async
+
+
+@pytest.fixture
+def ext_store(tmp_path):
+    """Tiny store with a file:// external tier; yields (store, base_uri)."""
+    base_uri = f"file://{tmp_path}/ext"
+    set_config(Config(object_spilling_external_uri=base_uri,
+                      object_spilling_dir=str(tmp_path / "local"),
+                      object_store_use_native_pool=False))
+    store = NodeObjectStore("extspill-test", capacity=1 << 20)
+    yield store, base_uri
+    store.shutdown()
+    reset_config()
+
+
+def _wait_ext_writes(store, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while store._ext_writes and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not store._ext_writes, "external spill writes did not settle"
+
+
+def _wait_for(cond, timeout=10.0):
+    """Poll a condition (the spill done-callback's observable effects —
+    metric bump, owner hook — land a beat after _ext_writes drains)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_spill_on_evict_goes_external_and_restores(ext_store):
+    store, base_uri = ext_store
+    a, b = ObjectID.from_random(), ObjectID.from_random()
+    data = os.urandom(700 * 1024)
+    store.create_and_write(a, data, owner="owner-addr:1")
+    # second object overflows the 1 MiB capacity -> A evicts -> external
+    store.create_and_write(b, os.urandom(700 * 1024))
+    assert a not in store._entries
+    _wait_ext_writes(store)
+    uri = external_spill.object_uri(base_uri, a)
+    assert store._spilled_external[a] == uri
+    assert external_spill.read(uri) == data
+    # still "contained" (restorable) and restores byte-exact on read
+    assert store.contains(a)
+    assert store.read_chunk(a, 0, len(data)) == data
+    # the external copy is NOT consumed by a local restore (other nodes
+    # may be routed at it)
+    assert external_spill.exists(uri)
+    # spill metrics registered and counted
+    from ray_tpu.util.metrics import get_metric
+    m = get_metric("raytpu_spill_bytes_total")
+    assert m is not None
+
+    def _external_bytes():
+        return sum(v for k, v in m.snapshot()["values"].items()
+                   if ("tier", "external") in k)
+
+    assert _wait_for(lambda: _external_bytes() > 0), m.snapshot()
+    assert get_metric("raytpu_spill_restore_seconds") is not None
+
+
+def test_external_spill_reports_owner_location(ext_store):
+    """Once the spill write lands, the on_external_spill hook fires with
+    (oid, uri, owner) — the agent registers that with the owner as a
+    non-node location."""
+    store, base_uri = ext_store
+    calls = []
+    store.on_external_spill = lambda oid, uri, owner: calls.append(
+        (oid, uri, owner))
+    a = ObjectID.from_random()
+    store.create_and_write(a, os.urandom(700 * 1024), owner="owner-addr:2")
+    store.create_and_write(ObjectID.from_random(), os.urandom(700 * 1024))
+    _wait_ext_writes(store)
+    assert _wait_for(lambda: calls), "owner hook never fired"
+    assert calls == [(a, external_spill.object_uri(base_uri, a),
+                      "owner-addr:2")]
+
+
+def test_free_during_external_write_in_flight(ext_store, monkeypatch):
+    """A free that races the in-flight spill write must win: the external
+    copy is deleted after the write lands, never left dangling."""
+    store, base_uri = ext_store
+    gate = threading.Event()
+    real_write = external_spill.write
+
+    def slow_write(uri, data):
+        gate.wait(10.0)
+        return real_write(uri, data)
+
+    monkeypatch.setattr(external_spill, "write", slow_write)
+    a = ObjectID.from_random()
+    store.create_and_write(a, os.urandom(700 * 1024))
+    store.create_and_write(ObjectID.from_random(), os.urandom(700 * 1024))
+    assert a in store._ext_writes  # write parked on the gate
+    store.free(a)
+    assert a not in store._spilled_external
+    gate.set()
+    _wait_ext_writes(store)
+    assert _wait_for(lambda: not external_spill.exists(
+        external_spill.object_uri(base_uri, a))), \
+        "freed object's external copy survived the in-flight write"
+
+
+def test_read_waits_out_inflight_external_write(ext_store, monkeypatch):
+    """Evict-while-write-in-flight: a reader that races the spill write
+    parks on the write future and then restores, instead of missing the
+    copy or reading a partial object."""
+    store, base_uri = ext_store
+    gate = threading.Event()
+    real_write = external_spill.write
+
+    def slow_write(uri, data):
+        gate.wait(10.0)
+        return real_write(uri, data)
+
+    monkeypatch.setattr(external_spill, "write", slow_write)
+    a = ObjectID.from_random()
+    data = os.urandom(700 * 1024)
+    store.create_and_write(a, data, owner=None)
+    store.create_and_write(ObjectID.from_random(), os.urandom(700 * 1024))
+    assert a in store._ext_writes
+    threading.Timer(0.2, gate.set).start()
+    located = store.get_path(a)  # blocks on the in-flight write, then restores
+    assert located is not None
+    assert store.read_chunk(a, 0, len(data)) == data
+
+
+def test_failed_external_write_falls_back_to_local_spill(ext_store,
+                                                         monkeypatch):
+    """A write that raises drops the dangling URI record AND lands the
+    bytes on the local spill disk instead — the sole copy must not simply
+    vanish while the owner still routes pullers here."""
+    store, _ = ext_store
+
+    def broken_write(uri, data):
+        raise IOError("injected: bucket unavailable")
+
+    monkeypatch.setattr(external_spill, "write", broken_write)
+    a = ObjectID.from_random()
+    data = os.urandom(700 * 1024)
+    store.create_and_write(a, data, owner="owner-addr:9")
+    store.create_and_write(ObjectID.from_random(), os.urandom(700 * 1024))
+    _wait_ext_writes(store)
+    assert a not in store._spilled_external
+    assert _wait_for(lambda: a in store._spilled), \
+        "no local-disk fallback after the failed external write"
+    assert store._spilled_owners.get(a) == "owner-addr:9"
+    assert store.contains(a)
+    assert store.read_chunk(a, 0, len(data)) == data  # restores from disk
+
+
+def test_orphan_sweep_removes_dead_incarnations(tmp_path):
+    import json
+    import subprocess
+    import sys
+    root = tmp_path / "spillroot"
+    # a dead incarnation: marker pid from a process that has exited
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    dead = root / "deadstore"
+    dead.mkdir(parents=True)
+    (dead / "owner.json").write_text(json.dumps({"pid": proc.pid}))
+    (dead / "deadstore-aa.spill").write_bytes(b"x" * 128)
+    # a live incarnation (our own pid) must be left alone
+    live = root / "livestore"
+    live.mkdir()
+    (live / "owner.json").write_text(json.dumps({"pid": os.getpid()}))
+    (live / "livestore-bb.spill").write_bytes(b"y" * 128)
+    # markerless dirs with spill leftovers are orphans too — but only
+    # past the creation grace window (a sibling's first spill creates the
+    # dir a beat before its marker lands)
+    nomark = root / "nomarker"
+    nomark.mkdir()
+    (nomark / "nomarker-cc.spill").write_bytes(b"z")
+    fresh = root / "fresh-no-marker"
+    fresh.mkdir()
+    old = time.time() - 3600
+    os.utime(nomark, (old, old))
+    removed = sweep_orphan_spill_dirs(str(root), grace_s=60.0)
+    assert removed == 2
+    assert not dead.exists() and not nomark.exists()
+    assert live.exists() and (live / "livestore-bb.spill").exists()
+    assert fresh.exists()  # young marker-less dir: inside the grace window
+
+
+# ------------------------------------------------------- agent-level pulls
+
+@pytest.fixture
+def gcs_and_agent(tmp_path):
+    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.node_agent import NodeAgent
+    set_config(Config(object_store_use_native_pool=False,
+                      metrics_export_enabled=False))
+    gcs = GcsServer()
+    run_async(gcs.start())
+    agent = NodeAgent(gcs.address, num_cpus=1,
+                      session_dir=str(tmp_path / "sess"))
+    run_async(agent.start())
+    yield gcs, agent
+    run_async(agent.stop(), timeout=10)
+    run_async(gcs.stop(), timeout=5)
+    reset_config()
+
+
+def test_any_node_restores_from_external_location(gcs_and_agent, tmp_path):
+    """The point of the tier: a node that never held the object pulls it
+    from an ("external", uri) owner location — including when every node
+    location in the list is dead."""
+    _gcs, agent = gcs_and_agent
+    oid = ObjectID.from_random()
+    data = os.urandom(300 * 1024)
+    base_uri = f"file://{tmp_path}/ext2"
+    uri = external_spill.object_uri(base_uri, oid)
+    external_spill.write(uri, data)
+    dead_node = ("deadbeef" * 4, "127.0.0.1:1")  # nothing listens there
+    res = run_async(agent.handle_fetch_object(
+        oid, len(data),
+        locations=[dead_node, (external_spill.EXTERNAL_NODE_ID, uri)]),
+        timeout=60)
+    assert res["size"] == len(data)
+    assert agent.store.read_chunk(oid, 0, len(data)) == data
+
+
+def test_double_restore_dedup_single_external_fetch(gcs_and_agent, tmp_path,
+                                                    monkeypatch):
+    """Concurrent fetches of the same externally-spilled object share ONE
+    in-flight pull (the agent's _inflight_pulls map): the external tier is
+    read one object's worth of bytes, not once per caller."""
+    _gcs, agent = gcs_and_agent
+    oid = ObjectID.from_random()
+    data = os.urandom(300 * 1024)
+    base_uri = f"file://{tmp_path}/ext3"
+    uri = external_spill.object_uri(base_uri, oid)
+    external_spill.write(uri, data)
+    reads = []
+    real_read_range = external_spill.read_range
+
+    def counting_read_range(u, off, n):
+        reads.append((u, off, n))
+        return real_read_range(u, off, n)
+
+    monkeypatch.setattr(external_spill, "read_range", counting_read_range)
+    loc = [(external_spill.EXTERNAL_NODE_ID, uri)]
+
+    async def both():
+        return await asyncio.gather(
+            agent.handle_fetch_object(oid, len(data), locations=list(loc)),
+            agent.handle_fetch_object(oid, len(data), locations=list(loc)))
+
+    r1, r2 = run_async(both(), timeout=60)
+    assert r1["size"] == r2["size"] == len(data)
+    assert sum(n for _u, _off, n in reads) == len(data), \
+        f"expected one object's worth of external reads, got {reads}"
+    assert agent.store.read_chunk(oid, 0, len(data)) == data
