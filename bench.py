@@ -20,25 +20,13 @@ import os
 import time
 
 
-PEAK_BF16_FLOPS = {
-    # per-chip peak bf16 matmul FLOP/s
-    "v5 lite": 197e12,   # v5e
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v5": 459e12,
-    "v4": 275e12,
-    "v6 lite": 918e12,   # trillium
-    "v6e": 918e12,
-    "cpu": 1e12,         # nominal, for CI runs only
-}
-
-
+# The MFU arithmetic lives in the package now (the runtime train
+# observability plane shares it: ray_tpu/models/config.py).  Lazy wrapper,
+# not a top-level import — the {"skipped": "no TPU"} paths must work in a
+# bare environment where only a (possibly wedged) jax is importable.
 def detect_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in PEAK_BF16_FLOPS.items():
-        if key in kind:
-            return val
-    return PEAK_BF16_FLOPS["cpu"]
+    from ray_tpu.models.config import detect_peak_flops as _detect
+    return _detect(device)
 
 
 def estimate_hbm_bytes(cfg, batch: int, seq: int, n_devices: int) -> float:
@@ -208,6 +196,31 @@ def main():
     final_loss = float(metrics["loss"])
     dt = time.time() - t0
 
+    # Instrumented tail pass: per-step walls with a forcing read each —
+    # the runtime-comparable goodput fields (train/observability.py
+    # reports the same shapes at runtime).  Kept OUT of the headline
+    # timed region: the per-step host read stalls the dispatch pipeline.
+    step_walls = []
+    for _ in range(min(args.steps, 5)):
+        s0 = time.time()
+        state, metrics = step(state, batch_dict)
+        float(metrics["loss"])
+        step_walls.append(time.time() - s0)
+    step_walls.sort()
+    step_p50 = step_walls[len(step_walls) // 2]
+    memory = None
+    try:
+        ms = devices[0].memory_stats() or {}
+        memory = {k: int(ms[k]) for k in
+                  ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                  if k in ms} or None
+    except Exception:
+        pass
+    # goodput over this invocation: productive (timed-loop) step time over
+    # step time + the compile it paid — compile_s stays split out of every
+    # step median above, exactly like the runtime tracker
+    goodput = dt / max(compile_s + dt, 1e-9)
+
     tokens_per_step = batch * seq
     tok_s = tokens_per_step * args.steps / dt
     tok_s_chip = tok_s / n
@@ -228,6 +241,9 @@ def main():
         "peak_bf16_tflops": peak / 1e12,
         "compile_s": round(compile_s, 1),
         "step_ms": round(dt / args.steps * 1000, 1),
+        "step_ms_p50": round(step_p50 * 1000, 1),
+        "goodput": round(goodput, 4),
+        "memory": memory,
         "loss": round(final_loss, 4),
     }))
 

@@ -338,6 +338,78 @@ def run_ab_serve_metrics(S: float, pairs: int) -> dict:
             "off_config": off_cfg, "ratio_on_off": ratio}
 
 
+#: the "off" arm of the train-observability A/B: the kill switch sheds the
+#: step/stage histograms, MFU/goodput gauges, memory sampling AND the
+#: per-step trace spans — isolating exactly what train_metrics_enabled
+#: costs a tight report-every-step CPU loop.
+TRAIN_OBS_OFF = {"train_metrics_enabled": False}
+
+
+def _measure_train_obs(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster measurement of a small CPU train loop's
+    steps/s (the train-observability A/B arms): a 1-worker
+    DataParallelTrainer whose loop stamps the data_wait/step_compute
+    phases and reports EVERY step — the densest instrumentation pattern
+    a real loop would use."""
+    import tempfile
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, _system_config=system_config or None)
+    out = {}
+    try:
+        from ray_tpu.train import (DataParallelTrainer, RunConfig,
+                                   ScalingConfig)
+        steps = max(int(200 * S), 20)
+
+        def loop(config):
+            import time as _t
+
+            from ray_tpu import train
+            obs = train.get_context().observability()
+            obs.set_model(flops_per_token=1e3, tokens_per_step=1024,
+                          peak_flops=1e12)
+            n = config["steps"]
+            t0 = _t.perf_counter()
+            for i in range(n):
+                with obs.phase("data_wait"):
+                    pass
+                with obs.phase("step_compute"):
+                    pass
+                train.report(
+                    {"step": i,
+                     "steps_per_s": n / max(_t.perf_counter() - t0, 1e-9)})
+
+        trainer = DataParallelTrainer(
+            train_loop_per_worker=loop,
+            train_loop_config={"steps": steps},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="ab-train-obs",
+                                 storage_path=tempfile.mkdtemp()))
+        result = trainer.fit()
+        out["train_steps_per_s"] = result.metrics["steps_per_s"]
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_train_obs(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: train_metrics_enabled on vs off — the
+    train observability plane's per-step overhead (the ISSUE-10
+    acceptance gate: <= 5%)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_train_obs(S, None))
+        off_runs.append(_measure_train_obs(S, dict(TRAIN_OBS_OFF)))
+        print(f"# train-obs ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": TRAIN_OBS_OFF, "ratio_on_off": ratio}
+
+
 #: the "off" arm of the batched-submission A/B: one task per push RPC, one
 #: lease per request RPC, one actor call per batch — the unbatched
 #: submission plane the scale-envelope work replaced.
@@ -404,10 +476,15 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of batched "
                         "submission on vs off (push/lease/actor-call "
                         "batching; the scale-envelope gate)")
+    p.add_argument("--ab-train-obs", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of "
+                        "train_metrics_enabled on vs off (CPU train-loop "
+                        "steps/s; the train-observability overhead gate)")
     args = p.parse_args()
     _REPS = max(args.reps, 1)
 
     all_runs = []
+    # --runs 0: skip the full suite (targeted A/B-only invocations)
     for r in range(args.runs):
         res = run_suite(args.scale, args.serve)
         all_runs.append(res)
@@ -422,7 +499,7 @@ def main():
         lo, hi = int(i), min(int(i) + 1, len(xs) - 1)
         return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
 
-    metrics = list(all_runs[0])
+    metrics = list(all_runs[0]) if all_runs else []
     samples = {k: [x for r in all_runs for x in r[k]] for k in metrics}
     med = {k: quantile(samples[k], 0.5) for k in metrics}
     iqr = {k: quantile(samples[k], 0.75) - quantile(samples[k], 0.25)
@@ -446,6 +523,9 @@ def main():
     if args.ab_submit > 0:
         out["submit_batching_ab"] = run_ab_submit_batching(args.scale,
                                                            args.ab_submit)
+    if args.ab_train_obs > 0:
+        out["train_obs_ab"] = run_ab_train_obs(args.scale,
+                                               args.ab_train_obs)
     line = json.dumps(out)
     print(line)
     if args.out:

@@ -282,6 +282,27 @@ class Config:
     #: percentiles + queue-depth signal for the controller (the SLO
     #: autoscaler input).  Samples older than this age out.
     serve_slo_window_s: float = 60.0
+    #: Train-plane observability (train/observability.py): per-step
+    #: wall-clock decomposition (data_wait/host_to_device/step_compute/
+    #: checkpoint), first-call compile split out, running MFU + goodput,
+    #: device memory gauges, per-step trace spans, and the per-rank
+    #: snapshot rollup into train.Result / train.status().  One kill
+    #: switch sheds ALL of it (the train loop keeps one boolean check per
+    #: phase/report) for A/B overhead measurement — same discipline as
+    #: serve_metrics_enabled.
+    train_metrics_enabled: bool = True
+    #: Cap on per-step trace spans emitted per second per rank (the
+    #: task_stage_events_per_s discipline): step/stage HISTOGRAMS observe
+    #: every step regardless; only the timeline payload samples beyond
+    #: this rate — real accelerator steps run well under it, CPU toy
+    #: loops get a sampled timeline.  <= 0 means unlimited.
+    train_step_spans_per_s: int = 25
+    #: Dashboard cluster-metrics history (dashboard/history.py): the head
+    #: scrapes every node agent's /metrics on this period into a bounded
+    #: ring buffer covering this window, derives counter rates, and serves
+    #: GET /api/metrics/history (and the freshest sample on /api/metrics).
+    metrics_history_window_s: float = 600.0
+    metrics_scrape_period_s: float = 5.0
     #: Per-method RPC client/server latency histograms + byte counters
     #: (core/rpc.py).  Cheap (one histogram observe per call) but the hot
     #: path can shed it entirely for A/B overhead measurement.

@@ -2121,6 +2121,19 @@ class CoreWorker:
         from ray_tpu.util.debug import dump_all_stacks
         return dump_all_stacks()
 
+    async def handle_profile(self, duration_s: float = 2.0,
+                             out_dir: str = "/tmp/raytpu/profiles"):
+        """On-demand profiler capture (``raytpu profile``): jax.profiler
+        when this process runs a non-CPU backend, thread-stack sampling
+        to chrome-trace JSON otherwise.  The capture sleeps for the whole
+        window, so it runs OFF the RPC loop."""
+        from ray_tpu.util import profiler
+        loop = asyncio.get_event_loop()
+        path, mode = await loop.run_in_executor(
+            None, lambda: profiler.capture(duration_s, out_dir))
+        return {"path": path, "mode": mode,
+                "process": f"worker-{self.worker_id.hex()[:12]}"}
+
     async def handle_chaos_update(self, spec: Optional[dict] = None):
         """Runtime chaos-spec propagation: the node agent forwards GCS
         chaos_set/chaos_clear broadcasts to every worker it manages."""

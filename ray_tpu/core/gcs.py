@@ -546,7 +546,27 @@ class GcsServer:
             # overwritten back to ALIVE by a late placement success.
             if self.actors.get(aid) is not info or info["state"] == "DEAD":
                 return
-            nid = pick_node(self.nodes, spec.resources, spec.scheduling_strategy)
+            strategy = spec.scheduling_strategy
+            if (isinstance(strategy, tuple) and strategy
+                    and strategy[0] == "_pg"):
+                # PG-placed actor: the creation MUST go to the node holding
+                # its bundle — pick_node knows nothing about the resolved
+                # ("_pg", pg_id, idx, node_id) tuple and used to fall
+                # through to the DEFAULT policy, sending create_actor to an
+                # arbitrary node whose agent then raised "unknown placement
+                # bundle" (placement succeeded only by retry luck).  The
+                # PG table's CURRENT placement wins over the node recorded
+                # at submission (a rescheduled PG may have moved).
+                from .scheduling import NodeAffinitySchedulingStrategy
+                _tag, pg_id, idx, nid_hint = strategy
+                pg = self.pgs.get(pg_id)
+                target = None
+                placement = (pg or {}).get("placement")
+                if placement and 0 <= idx < len(placement):
+                    target = placement[idx][0]
+                strategy = NodeAffinitySchedulingStrategy(
+                    target or nid_hint, soft=False)
+            nid = pick_node(self.nodes, spec.resources, strategy)
             if nid is not None:
                 agent = self.agent_clients.get(self.nodes[nid].address)
                 try:

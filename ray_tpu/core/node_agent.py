@@ -1029,6 +1029,35 @@ class NodeAgent:
                 out[f"worker-{w.worker_id[:12]}"] = f"<unavailable: {e}>"
         return out
 
+    async def handle_profile(self, duration_s: float = 2.0,
+                             worker_id: Optional[str] = None):
+        """On-demand profiler capture on this node (``raytpu profile
+        --node <id> --duration <s>``): forwards to a registered worker —
+        that's the process holding the jax/TPU backend, so a TPU worker
+        answers with a ``jax.profiler.trace`` directory and a CPU worker
+        with sampled thread stacks as chrome-trace JSON.  LEASED workers
+        are preferred (the train/serve step is what the operator wants to
+        see); a node with no reachable worker profiles the agent itself.
+        Returns {"path", "mode", "process"} — the artifact lands under
+        the node's session dir."""
+        out_dir = os.path.join(self.session_dir, "profiles")
+        candidates = [w for w in self.workers.values()
+                      if w.address and (worker_id is None
+                                        or w.worker_id.startswith(worker_id))]
+        candidates.sort(key=lambda w: w.state != "LEASED")
+        for w in candidates[:3]:
+            try:
+                return await self.worker_clients.get(w.address).call(
+                    "profile", duration_s=duration_s, out_dir=out_dir,
+                    _timeout=duration_s + 30.0)
+            except Exception:
+                continue
+        from ray_tpu.util import profiler
+        loop = asyncio.get_event_loop()
+        path, mode = await loop.run_in_executor(
+            None, lambda: profiler.capture(duration_s, out_dir))
+        return {"path": path, "mode": mode, "process": "agent"}
+
     async def handle_kill_worker(self, worker_id: str, reason: str = ""):
         w = self.workers.get(worker_id)
         if w is None:

@@ -53,6 +53,10 @@ class DashboardHead:
         self.host = host
         self.port = port
         self._runner = None
+        #: cluster metrics history (dashboard/history.py): fed by the
+        #: background scrape loop, serves /api/metrics (+/history)
+        self.history = None
+        self._scrape_task = None
 
     # ---------------------------------------------------------- handlers
 
@@ -247,16 +251,29 @@ class DashboardHead:
                       "state": evs[-1].get("state"),
                       "events": evs})
 
-    async def metrics(self, _req):
-        """Scrape every node agent's Prometheus endpoint (advertised via
-        the node label metrics_port) and return parsed samples per node —
-        the data feed for the UI's sparkline view (reference:
-        dashboard metrics pages over grafana/prometheus)."""
+    # ------------------------------------------------- metrics history
+
+    def _ensure_history(self):
+        if self.history is None:
+            from ray_tpu.core.config import get_config
+            from .history import MetricsHistory
+            cfg = get_config()
+            self.history = MetricsHistory(
+                window_s=getattr(cfg, "metrics_history_window_s", 600.0),
+                period_s=getattr(cfg, "metrics_scrape_period_s", 5.0))
+        return self.history
+
+    async def _scrape_once(self):
+        """One scrape pass over every alive node's /metrics into the
+        history store.  Unreachable nodes are RECORDED as errors (they
+        must show up as explicit {"error": ...} entries, not silently
+        vanish from the response)."""
         import aiohttp
 
         from ray_tpu.util import state
+        from .history import parse_prometheus
+        store = self._ensure_history()
         nodes = await _off(state.list_nodes)
-        out: dict = {}
 
         async def scrape(sess, nid: str, host: str, port: str):
             try:
@@ -264,34 +281,79 @@ class DashboardHead:
                         f"http://{host}:{port}/metrics",
                         timeout=aiohttp.ClientTimeout(total=5)) as resp:
                     text = await resp.text()
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — surfaced to the API
+                store.record_error(nid, f"{type(e).__name__}: {e}")
                 return
-            samples = {}
-            for line in text.splitlines():
-                line = line.strip()
-                if not line or line.startswith("#"):
-                    continue
-                try:
-                    key, val = line.rsplit(None, 1)
-                    samples[key] = float(val)
-                except ValueError:
-                    continue
-            out[nid] = samples
+            samples, counters = parse_prometheus(text)
+            store.add_sample(nid, samples, counters)
 
         jobs = []
+        alive_ids = set()
         for n in nodes:
+            nid = (n.get("node_id") or "")[:12]
+            if not n.get("alive"):
+                continue
+            alive_ids.add(nid)
             port = (n.get("labels") or {}).get("metrics_port")
-            if not n.get("alive") or not port:
+            if not port:
+                store.record_error(nid, "no metrics_port advertised")
                 continue
             # scrape at the node's agent host — loopback is only right for
             # the head's own machine
             host = (n.get("address") or "127.0.0.1:0").rsplit(":", 1)[0]
-            jobs.append(((n.get("node_id") or "")[:12], host, port))
+            jobs.append((nid, host, port))
+        # nodes that died or left the cluster must DROP from the store —
+        # serving a dead node's last sample as live data reads as a
+        # healthy, saturated node (unreachable-but-alive nodes stay, as
+        # explicit error entries)
+        for known in store.nodes():
+            if known not in alive_ids:
+                store.forget(known)
         async with aiohttp.ClientSession() as sess:
             # concurrent: one timeout of wall clock, not one per dead node
             await asyncio.gather(
                 *[scrape(sess, nid, host, port) for nid, host, port in jobs])
-        return _json({"ts": time.time(), "nodes": out})
+
+    async def _scrape_loop(self):
+        store = self._ensure_history()
+        while True:
+            try:
+                await self._scrape_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            await asyncio.sleep(store.period_s)
+
+    async def metrics(self, _req):
+        """Freshest parsed /metrics sample per node, served from the
+        history store (the background loop scrapes; this handler never
+        re-scrapes the cluster per request).  Nodes whose last scrape
+        failed report {"error": ...} explicitly."""
+        store = self._ensure_history()
+        ts, nodes = store.latest()
+        if not nodes:
+            # first request racing the first scrape tick: do one pass
+            await self._scrape_once()
+            ts, nodes = store.latest()
+        return _json({"ts": ts or time.time(), "nodes": nodes})
+
+    async def metrics_history(self, req):
+        """Windowed time series + derived counter rates per node.
+        Query params: ``node`` (12-hex prefix; default all), ``prefix``
+        (metric-name filter, default ``raytpu_`` to bound the payload)."""
+        store = self._ensure_history()
+        want = req.query.get("node")
+        prefix = req.query.get("prefix", "raytpu_")
+        out: dict = {}
+        for nid in store.nodes():
+            if want and not nid.startswith(want):
+                continue
+            out[nid] = {**store.summary(nid),
+                        "series": store.series(nid, prefix=prefix),
+                        "rates": store.rates(nid, prefix=prefix)}
+        return _json({"ts": time.time(), "window_s": store.window_s,
+                      "period_s": store.period_s, "nodes": out})
 
     async def telemetry(self, _req):
         """Per-node runtime telemetry + task-stage latency percentiles —
@@ -444,6 +506,7 @@ class DashboardHead:
         r.add_get("/api/tasks", self.tasks)
         r.add_get("/api/tasks/{task_id:[0-9a-f]{8,}}", self.task_detail)
         r.add_get("/api/metrics", self.metrics)
+        r.add_get("/api/metrics/history", self.metrics_history)
         r.add_get("/api/telemetry", self.telemetry)
         r.add_get("/api/tasks/summarize", self.tasks_summarize)
         r.add_get("/api/objects", self.objects)
@@ -474,9 +537,18 @@ class DashboardHead:
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]
+        # cluster metrics history: one background scrape loop per head
+        self._scrape_task = asyncio.ensure_future(self._scrape_loop())
         return self.port
 
     async def stop(self):
+        if self._scrape_task is not None:
+            self._scrape_task.cancel()
+            try:
+                await self._scrape_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._scrape_task = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

@@ -1,0 +1,220 @@
+"""Cluster metrics history: a bounded ring-buffer time-series store over
+every node agent's Prometheus ``/metrics`` endpoint, with counter->rate
+derivation.
+
+The dashboard's ``/api/metrics`` used to re-scrape every node per
+request and could only answer "what is the value NOW" — no history, no
+rates, no way to see whether chips stayed saturated through a run.  The
+head now runs ONE background scrape loop (knobs
+``metrics_scrape_period_s`` / ``metrics_history_window_s``) feeding this
+store; ``/api/metrics`` serves the freshest sample (unreachable nodes
+become explicit ``{"error": ...}`` entries instead of silently
+vanishing) and ``/api/metrics/history`` serves the windowed series plus
+derived per-second rates for every counter/histogram sample.
+
+``raytpu top`` drives the same store from the CLI process (synchronous
+scrapes via urllib), so the terminal view and the REST surface can never
+disagree about what a sample means.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+def parse_prometheus(text: str) -> Tuple[Dict[str, float], set]:
+    """Prometheus exposition text -> ({'name{tags}': value}, counter-like
+    base names).  ``# TYPE`` lines classify counters AND histograms (whose
+    ``_bucket``/``_sum``/``_count`` samples are cumulative too) so the
+    store knows which keys are rate-derivable."""
+    samples: Dict[str, float] = {}
+    counters: set = set()
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4 and parts[3] in ("counter", "histogram"):
+                counters.add(parts[2])
+            continue
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+            samples[key] = float(val)
+        except ValueError:
+            continue
+    return samples, counters
+
+
+def scrape_node_sync(host: str, port: str, timeout: float = 5.0):
+    """One synchronous scrape (the CLI path; the head scrapes async)."""
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics",
+                                timeout=timeout) as resp:
+        return parse_prometheus(resp.read().decode("utf-8", "replace"))
+
+
+def find_samples(samples: Dict[str, float], name: str,
+                 **labels: str) -> List[float]:
+    """Values of every series of ``name`` whose rendered key carries all
+    the given label pairs (substring match on the exposition key — label
+    order varies, the quoting does not)."""
+    out = []
+    prefix = name + "{"
+    for key, val in samples.items():
+        if key == name or key.startswith(prefix):
+            if all(f'{k}="{v}"' in key for k, v in labels.items()):
+                out.append(val)
+    return out
+
+
+def find_one(samples: Dict[str, float], name: str, default=None,
+             agg=max, **labels: str):
+    vals = find_samples(samples, name, **labels)
+    return agg(vals) if vals else default
+
+
+class MetricsHistory:
+    """Per-node ring buffers of (ts, samples) capped by count AND age.
+
+    ``add_sample``/``record_error`` append; ``latest()`` answers the
+    instantaneous ``/api/metrics`` shape; ``series``/``rates`` answer the
+    history endpoint.  Rates handle counter RESETS (an agent or worker
+    restart zeroes its registry): a decrease is treated as a restart from
+    zero, so the derived rate is ``new_value / dt`` rather than a bogus
+    negative."""
+
+    def __init__(self, window_s: float = 600.0, period_s: float = 5.0):
+        self.window_s = float(window_s)
+        self.period_s = float(period_s)
+        self._maxlen = max(4, int(self.window_s
+                                  / max(self.period_s, 0.1)) + 2)
+        #: node -> deque[(ts, samples-or-None, error-or-None)]
+        self._samples: Dict[str, Deque[tuple]] = {}
+        self._counters: set = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ writes
+
+    def add_sample(self, node: str, samples: Dict[str, float],
+                   counters=(), ts: Optional[float] = None) -> None:
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            dq = self._samples.get(node)
+            if dq is None:
+                dq = self._samples[node] = deque(maxlen=self._maxlen)
+            dq.append((ts, samples, None))
+            if counters:
+                self._counters.update(counters)
+            self._prune(dq, ts)
+
+    def record_error(self, node: str, error: str,
+                     ts: Optional[float] = None) -> None:
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            dq = self._samples.get(node)
+            if dq is None:
+                dq = self._samples[node] = deque(maxlen=self._maxlen)
+            dq.append((ts, None, str(error)))
+            self._prune(dq, ts)
+
+    def _prune(self, dq: Deque[tuple], now: float) -> None:
+        horizon = now - self.window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def forget(self, node: str) -> None:
+        with self._lock:
+            self._samples.pop(node, None)
+
+    # ------------------------------------------------------------- reads
+
+    def nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._samples)
+
+    def latest(self) -> Tuple[float, Dict[str, dict]]:
+        """Freshest sample per node — the ``/api/metrics`` feed.  A node
+        whose last scrape failed reports ``{"error": ...}`` explicitly."""
+        out: Dict[str, dict] = {}
+        newest = 0.0
+        with self._lock:
+            for node, dq in self._samples.items():
+                if not dq:
+                    continue
+                ts, samples, err = dq[-1]
+                newest = max(newest, ts)
+                out[node] = {"error": err} if err is not None else samples
+        return newest, out
+
+    def _is_cumulative(self, key: str) -> bool:
+        name = key.split("{", 1)[0]
+        if name in self._counters:
+            return True
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[:-len(suf)] in self._counters:
+                return True
+        return False
+
+    def series(self, node: str, prefix: str = "") -> Dict[str, list]:
+        """{key: [[ts, value], ...]} over the retained window."""
+        with self._lock:
+            items = list(self._samples.get(node) or ())
+        out: Dict[str, list] = {}
+        for ts, samples, err in items:
+            if err is not None or samples is None:
+                continue
+            t = round(ts, 3)
+            for key, val in samples.items():
+                if prefix and not key.startswith(prefix):
+                    continue
+                out.setdefault(key, []).append([t, val])
+        return out
+
+    def rates(self, node: str, prefix: str = "") -> Dict[str, list]:
+        """Per-second rates of every cumulative (counter/histogram)
+        series: {key: [[ts, rate], ...]} between consecutive good
+        samples.  An error sample breaks the chain (no rate across the
+        gap); a value DECREASE is a counter reset and rates as
+        ``new / dt``."""
+        with self._lock:
+            items = list(self._samples.get(node) or ())
+            # snapshot the counter-name set once; _is_cumulative below
+            # runs lock-free against it
+        out: Dict[str, list] = {}
+        prev: Optional[Tuple[float, Dict[str, float]]] = None
+        for ts, samples, err in items:
+            if err is not None or samples is None:
+                prev = None
+                continue
+            if prev is not None:
+                pts, psamples = prev
+                dt = ts - pts
+                if dt > 0:
+                    for key, val in samples.items():
+                        if prefix and not key.startswith(prefix):
+                            continue
+                        if not self._is_cumulative(key):
+                            continue
+                        pval = psamples.get(key)
+                        if pval is None:
+                            continue
+                        delta = val - pval
+                        if delta < 0:  # counter reset (process restart)
+                            delta = val
+                        out.setdefault(key, []).append(
+                            [round(ts, 3), delta / dt])
+            prev = (ts, samples)
+        return out
+
+    def summary(self, node: str) -> dict:
+        with self._lock:
+            dq = self._samples.get(node)
+            if not dq:
+                return {"n_samples": 0}
+            ts, _samples, err = dq[-1]
+            return {"n_samples": len(dq), "latest_ts": round(ts, 3),
+                    "error": err}

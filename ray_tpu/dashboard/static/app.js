@@ -109,6 +109,7 @@ const pages = {
     const data = await api("metrics");
     const hist = (window._metricsHist = window._metricsHist || {});
     for (const [nid, samples] of Object.entries(data.nodes || {})) {
+      if (samples && samples.error !== undefined) continue; // unreachable node
       for (const [key, val] of Object.entries(samples)) {
         const k = `${nid} ${key}`;
         (hist[k] = hist[k] || []).push(val);

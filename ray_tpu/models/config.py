@@ -11,6 +11,30 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+#: Per-chip peak bf16 matmul FLOP/s by device kind — the denominator of
+#: every MFU number this repo reports (bench.py headline, the runtime
+#: train-observability plane's running MFU, MULTICHIP captures).
+PEAK_BF16_FLOPS = {
+    "v5 lite": 197e12,   # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12,   # trillium
+    "v6e": 918e12,
+    "cpu": 1e12,         # nominal, for CI runs only
+}
+
+
+def detect_peak_flops(device) -> float:
+    """Peak bf16 FLOP/s of one device, keyed on ``device_kind`` (falls
+    back to the nominal CPU figure for CI runs)."""
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_BF16_FLOPS["cpu"]
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:

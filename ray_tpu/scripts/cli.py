@@ -286,6 +286,226 @@ def cmd_memory(args):
               f"{refstr:>12}  {loc}")
 
 
+# -------------------------------------------------------------------- top
+
+def _scrape_cluster_frame(rt, store):
+    """One scrape of every alive node's /metrics into the history store
+    (the same store/parse the dashboard head feeds, so the terminal view
+    and the REST surface agree on what a sample means).  Nodes scrape
+    CONCURRENTLY — K unreachable nodes must cost one timeout of wall
+    clock per frame, not K (the head's async loop has the same shape)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ray_tpu.dashboard import history as hist
+
+    rows = rt.nodes()
+    alive, targets = [], []
+    for n in rows:
+        if not n.get("Alive"):
+            continue
+        nid = (n.get("NodeID") or "")[:12]
+        alive.append((nid, n))
+        port = (n.get("Labels") or {}).get("metrics_port")
+        if not port:
+            store.record_error(nid, "no metrics_port advertised")
+            continue
+        host = (n.get("AgentAddress") or "127.0.0.1:0").rsplit(":", 1)[0]
+        targets.append((nid, host, port))
+    alive_ids = {nid for nid, _n in alive}
+    for known in store.nodes():
+        if known not in alive_ids:  # dead nodes drop, not freeze
+            store.forget(known)
+
+    def scrape(target):
+        nid, host, port = target
+        try:
+            samples, counters = hist.scrape_node_sync(host, port, timeout=5.0)
+            store.add_sample(nid, samples, counters)
+        except Exception as e:  # noqa: BLE001 — rendered in the table
+            store.record_error(nid, f"{type(e).__name__}: {e}")
+
+    if targets:
+        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+            list(pool.map(scrape, targets))
+    return alive
+
+
+def _sum_rate(store, nid, name):
+    """Latest per-second rate summed across all series of one counter."""
+    rates = store.rates(nid, prefix=name)
+    total, found = 0.0, False
+    for key, pts in rates.items():
+        if key.split("{", 1)[0] == name and pts:
+            total += pts[-1][1]
+            found = True
+    return total if found else None
+
+
+def _hist_mean_rate(store, nid, name):
+    """Mean value per observation over the last tick, from a histogram's
+    _sum/_count rates (e.g. average TTFT or step time right now)."""
+    dsum = _sum_rate(store, nid, name + "_sum")
+    dcount = _sum_rate(store, nid, name + "_count")
+    if dsum is None or not dcount:
+        return None
+    return dsum / dcount
+
+
+def _render_top(store, alive_nodes) -> str:
+    """The `raytpu top` frame: per-node cpu/shm/lease-queue/loop-lag next
+    to the train (step-time/MFU/goodput) and serve (req/s, TTFT) rollups
+    derived from the same scrape."""
+    from ray_tpu.dashboard.history import find_one, find_samples
+
+    _ts, latest = store.latest()
+    lines = [f"raytpu top — {len(alive_nodes)} node(s) @ "
+             f"{time.strftime('%H:%M:%S')}",
+             f"{'NODE':<14} {'CPU':>9} {'SHM':>19} {'LEASEQ':>6} "
+             f"{'LOOPLAG':>8} {'WORKERS':>7}"]
+    for nid, _row in alive_nodes:
+        s = latest.get(nid)
+        if not s or "error" in s:
+            err = (s or {}).get("error", "no sample yet")
+            lines.append(f"{nid:<14} <unreachable: {err}>")
+            continue
+        cpu_t = find_one(s, "raytpu_resource_total", node=nid,
+                         resource="CPU") or 0.0
+        cpu_a = find_one(s, "raytpu_resource_available", node=nid,
+                         resource="CPU")
+        cpu = (f"{cpu_t - cpu_a:.1f}/{cpu_t:.0f}"
+               if cpu_a is not None else "?")
+        used = find_one(s, "raytpu_object_store_bytes", node=nid)
+        cap = find_one(s, "raytpu_object_store_capacity_bytes", node=nid)
+        shm = (f"{_fmt_bytes(used)}/{_fmt_bytes(cap)}"
+               if used is not None else "?")
+        leaseq = find_one(s, "raytpu_node_lease_queue_len", node=nid)
+        lag = find_samples(s, "raytpu_event_loop_lag_seconds")
+        lags = f"{max(lag) * 1e3:.0f}ms" if lag else "-"
+        nworkers = find_one(s, "raytpu_node_workers", node=nid)
+        lines.append(f"{nid:<14} {cpu:>9} {shm:>19} "
+                     f"{int(leaseq) if leaseq is not None else '-':>6} "
+                     f"{lags:>8} "
+                     f"{int(nworkers) if nworkers is not None else '-':>7}")
+
+    # train rollup: raytpu_train_* series land on the agent of whichever
+    # node the train workers run on — aggregate across all nodes
+    mfus, goodputs, steps_s, step_mean, compile_s = [], [], 0.0, [], []
+    any_train = False
+    for nid, _row in alive_nodes:
+        s = latest.get(nid) or {}
+        if "error" in s:
+            continue
+        mfus += find_samples(s, "raytpu_train_mfu")
+        goodputs += find_samples(s, "raytpu_train_goodput_fraction")
+        if find_samples(s, "raytpu_train_steps_total"):
+            any_train = True
+        r = _sum_rate(store, nid, "raytpu_train_steps_total")
+        if r:
+            steps_s += r
+        m = _hist_mean_rate(store, nid, "raytpu_train_step_seconds")
+        if m is not None:
+            step_mean.append(m)
+        compile_s += find_samples(s, "raytpu_train_compile_seconds_sum")
+    if any_train or mfus:
+        def avg(xs):
+            return sum(xs) / len(xs) if xs else None
+        mfu, gp = avg(mfus), avg(goodputs)
+        st = avg(step_mean)
+        lines.append(
+            "TRAIN  "
+            + f"steps/s={steps_s:.2f}  "
+            + (f"step={st * 1e3:.1f}ms  " if st is not None else "")
+            + (f"mfu={mfu:.3f}  " if mfu is not None else "mfu=-  ")
+            + (f"goodput={gp:.3f}  " if gp is not None else "goodput=-  ")
+            + (f"compile={max(compile_s):.1f}s" if compile_s else ""))
+    else:
+        lines.append("TRAIN  (no raytpu_train_* series; is a run live and "
+                     "train_metrics_enabled on?)")
+
+    # serve rollup
+    req_s, ttft = 0.0, []
+    any_serve = False
+    for nid, _row in alive_nodes:
+        s = latest.get(nid) or {}
+        if "error" in s:
+            continue
+        if find_samples(s, "raytpu_serve_requests_total"):
+            any_serve = True
+        r = _sum_rate(store, nid, "raytpu_serve_requests_total")
+        if r:
+            req_s += r
+        t = _hist_mean_rate(store, nid, "raytpu_serve_ttft_seconds")
+        if t is not None:
+            ttft.append(t)
+    if any_serve:
+        t = (sum(ttft) / len(ttft)) if ttft else None
+        lines.append("SERVE  "
+                     + f"req/s={req_s:.1f}  "
+                     + (f"ttft_avg={t * 1e3:.1f}ms" if t is not None
+                        else "ttft_avg=-"))
+    return "\n".join(lines)
+
+
+def cmd_top(args):
+    """Live cluster view (reference: `ray status` + the dashboard metrics
+    pages, as a terminal refresh loop): per-node cpu/shm/lease-queue/
+    loop-lag columns plus the train (step/MFU/goodput) and serve
+    (req/s, TTFT) rollups, all derived from the agents' /metrics.
+    ``--once`` prints one frame (two scrapes, so rates exist) and
+    exits."""
+    rt = _connect()
+    from ray_tpu.dashboard.history import MetricsHistory
+
+    interval = max(args.interval, 0.2)
+    store = MetricsHistory(window_s=max(60.0, interval * 30),
+                           period_s=interval)
+    alive = _scrape_cluster_frame(rt, store)
+    if args.once:
+        time.sleep(interval)
+        alive = _scrape_cluster_frame(rt, store)
+        print(_render_top(store, alive))
+        return
+    try:
+        while True:
+            time.sleep(interval)
+            alive = _scrape_cluster_frame(rt, store)
+            # clear screen + home, then the frame
+            print("\x1b[2J\x1b[H" + _render_top(store, alive), flush=True)
+    except KeyboardInterrupt:
+        pass
+
+
+# ---------------------------------------------------------------- profile
+
+def cmd_profile(args):
+    """On-demand profiler capture on one node (``jax.profiler.trace`` on
+    a TPU-backed worker; thread-stack sampling to chrome-trace JSON on
+    CPU).  Prints the artifact path on the TARGET node."""
+    rt = _connect()
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+
+    target = None
+    for n in rt.nodes():
+        if not (n.get("Alive") and n.get("AgentAddress")):
+            continue
+        if args.node is None or n["NodeID"].startswith(args.node):
+            target = n
+            break
+    if target is None:
+        raise SystemExit(f"no alive node matching {args.node!r}")
+    w = global_worker()
+    res = run_async(
+        w.agent_clients.get(target["AgentAddress"]).call(
+            "profile", duration_s=args.duration,
+            _timeout=args.duration + 60.0),
+        timeout=args.duration + 90.0)
+    print(f"profile captured on {target['NodeID'][:12]} "
+          f"({res['process']}, mode={res['mode']})")
+    print(res["path"])
+    return res
+
+
 def cmd_timeline(args):
     _connect()
     from ray_tpu.util.tracing import export_chrome_trace
@@ -505,6 +725,24 @@ def main(argv=None):
     s.add_argument("--json", action="store_true",
                    help="machine-readable full report")
     s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser("top", help="live cluster view: per-node cpu/shm/"
+                                   "lease-queue/loop-lag + train step/MFU/"
+                                   "goodput + serve req/s/TTFT")
+    s.add_argument("--once", action="store_true",
+                   help="print one frame (two scrapes for rates) and exit")
+    s.add_argument("--interval", type=float, default=2.0,
+                   help="refresh/scrape period in seconds")
+    s.set_defaults(fn=cmd_top)
+
+    s = sub.add_parser("profile", help="capture an on-demand profile on one "
+                                       "node (jax.profiler on TPU, thread-"
+                                       "stack sampling chrome-trace on CPU)")
+    s.add_argument("--node", default=None,
+                   help="node id prefix (default: first alive node)")
+    s.add_argument("--duration", type=float, default=2.0,
+                   help="capture window in seconds")
+    s.set_defaults(fn=cmd_profile)
 
     s = sub.add_parser("timeline", help="export chrome-trace timeline json")
     s.add_argument("--output", default=None)

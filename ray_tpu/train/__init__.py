@@ -20,6 +20,7 @@ from .worker_group import WorkerGroup
 from .backend_executor import BackendExecutor, TrainingFailedError
 from .trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from .jax_utils import load_pytree, save_pytree
+from .observability import StepTracker, status
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
@@ -28,7 +29,7 @@ __all__ = [
     "JaxBackendConfig", "TorchBackendConfig", "prepare_torch_model",
     "WorkerGroup", "BackendExecutor",
     "TrainingFailedError", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
-    "save_pytree", "load_pytree",
+    "save_pytree", "load_pytree", "StepTracker", "status",
 ]
 
 # Usage telemetry: which libraries a cluster actually uses (reference:

@@ -54,6 +54,11 @@ class BackendExecutor:
         # must survive worker-group re-creation).
         self.ckpt_manager = ckpt_manager or CheckpointManager(
             run_config.checkpoint_config, trial_dir)
+        #: latest per-run observability rollup (train/observability.py),
+        #: refreshed every barrier round from the per-rank snapshots that
+        #: piggyback on next_result — lands in Result.train_obs and the
+        #: live train.status() registry.
+        self.train_obs: Optional[Dict[str, Any]] = None
 
     def start(self) -> None:
         # PG bundles from the ScalingConfig: optional trainer bundle first
@@ -92,22 +97,28 @@ class BackendExecutor:
                 for i in range(n):
                     shard_sets[i][name] = ds
         trial_id = uuid.uuid4().hex[:8]
-        refs = []
-        for i, w in enumerate(wg.workers):
-            refs.append(w.init_session.remote(
-                world_rank=i, world_size=n,
-                local_rank=wg.local_rank_of[i],
-                local_world_size=wg.local_world_size_of[i],
-                node_rank=wg.node_rank_of[i],
-                experiment_name=self.run_config.name or "train",
-                trial_name=self.trial_name, trial_id=trial_id,
-                trial_dir=self.trial_dir,
-                checkpoint_path=checkpoint.path if checkpoint else None,
-                dataset_shards=shard_sets[i],
-                mesh_spec=self.scaling.mesh))
-        ray_tpu.get(refs, timeout=60)
-        ray_tpu.get([w.start_training.remote(train_fn, config)
-                     for w in wg.workers], timeout=60)
+        # The chief span: start_training actor tasks submitted inside it
+        # carry its trace context, so every rank's per-step spans chain
+        # into ONE chief -> worker-task -> step trace per run.
+        from ray_tpu.util import tracing
+        with tracing.span("train_chief", trial=self.trial_name,
+                          world_size=str(n)):
+            refs = []
+            for i, w in enumerate(wg.workers):
+                refs.append(w.init_session.remote(
+                    world_rank=i, world_size=n,
+                    local_rank=wg.local_rank_of[i],
+                    local_world_size=wg.local_world_size_of[i],
+                    node_rank=wg.node_rank_of[i],
+                    experiment_name=self.run_config.name or "train",
+                    trial_name=self.trial_name, trial_id=trial_id,
+                    trial_dir=self.trial_dir,
+                    checkpoint_path=checkpoint.path if checkpoint else None,
+                    dataset_shards=shard_sets[i],
+                    mesh_spec=self.scaling.mesh))
+            ray_tpu.get(refs, timeout=60)
+            ray_tpu.get([w.start_training.remote(train_fn, config)
+                         for w in wg.workers], timeout=60)
 
     def fetch_next(self, timeout: float = 3600.0):
         """One barrier round.  Returns ("report", rank0_metrics, ckpt) or
@@ -125,7 +136,8 @@ class BackendExecutor:
             # Typed system faults (OutOfMemoryError, WorkerCrashedError, …)
             # become a restartable training failure, not a raw crash.
             raise TrainingFailedError(f"worker group fault: {e}", cause=e)
-        kinds = {kind for kind, _, _ in results}
+        self._collect_obs(results)
+        kinds = {r[0] for r in results}
         if kinds == {"done"}:
             return ("done", results[0][1])
         if "done" in kinds:
@@ -138,22 +150,42 @@ class BackendExecutor:
         # only correct when every rank reported the same shared-filesystem
         # directory — divergent paths mean non-rank0 shards would be dropped.
         ckpt = None
-        reported = {p for _, _, p in results if p}
+        reported = {r[2] for r in results if r[2]}
         if len(reported) > 1:
             import logging
             logging.getLogger(__name__).warning(
                 "workers reported %d different checkpoint paths %s; using "
                 "rank0's. report(checkpoint=...) requires a shared storage "
                 "root across ranks", len(reported), sorted(reported)[:4])
-        for kind, metrics, ckpt_path in results:
-            if ckpt_path:
-                ckpt = Checkpoint(ckpt_path)
+        for r in results:
+            if r[2]:
+                ckpt = Checkpoint(r[2])
                 break
         tracked = None
         if ckpt is not None:
             tracked = self.ckpt_manager.register(ckpt, results[0][1])
         ray_tpu.get([w.resume.remote() for w in wg.workers], timeout=60)
         return ("report", results[0][1], tracked)
+
+    def _collect_obs(self, results) -> None:
+        """Fold the per-rank observability snapshots riding this round's
+        results into the run rollup + the live train.status() registry.
+        A rank piggybacks a snapshot only when its tracker recomputed one
+        (~2/s, not per step) — None keeps that rank's previous snapshot."""
+        from . import observability as train_obs
+        if not hasattr(self, "_obs_by_rank"):
+            self._obs_by_rank: Dict[int, dict] = {}
+        updated = False
+        for i, r in enumerate(results):
+            if len(r) > 3 and r[3]:
+                self._obs_by_rank[i] = r[3]
+                updated = True
+        if not updated:
+            return
+        rollup = train_obs.aggregate(self._obs_by_rank)
+        if rollup is not None:
+            self.train_obs = rollup
+            train_obs.publish_status(self.trial_name, rollup)
 
     def shutdown(self) -> None:
         if self.worker_group is not None:

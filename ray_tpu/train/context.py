@@ -52,6 +52,9 @@ class TrainContext:
         self._continue_evt = threading.Event()
         self._aborted = False
         self._reported_steps = 0
+        #: StepTracker (train/observability.py) — created by
+        #: TrainWorker.init_session; lazily here for session-less tests
+        self._obs = None
 
     # rank info — reference session.py get_world_rank/get_world_size/...
     def get_world_rank(self) -> int: return self._world_rank
@@ -66,6 +69,15 @@ class TrainContext:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._checkpoint
+
+    def observability(self):
+        """This rank's ``StepTracker`` (train/observability.py): phase
+        timers, MFU/goodput arithmetic (``set_model``), and the per-step
+        snapshot that rides ``report()`` to the driver."""
+        if self._obs is None:
+            from .observability import StepTracker
+            self._obs = StepTracker(self._world_rank, trial=self._trial_name)
+        return self._obs
 
     def get_dataset_shard(self, name: str = "train"):
         shard = self._dataset_shards.get(name)
@@ -95,19 +107,26 @@ class TrainContext:
         if self._aborted:
             raise SessionFinished()
         self._reported_steps += 1
+        # close the observability step AT the report call — the barrier
+        # wait below counts against goodput wall, not against any step
+        obs_snap = self._obs.on_report() if self._obs is not None else None
         self._continue_evt.clear()
         self._result_queue.put(
-            ("report", dict(metrics), checkpoint.path if checkpoint else None))
+            ("report", dict(metrics),
+             checkpoint.path if checkpoint else None, obs_snap))
         self._continue_evt.wait()
         if self._aborted:
             raise SessionFinished()
+        if self._obs is not None:
+            self._obs.on_resume()
 
     # --- driver-facing plumbing (used by TrainWorker) ---
     def _finish(self, value: Any) -> None:
-        self._result_queue.put(("done", value, None))
+        snap = self._obs.snapshot() if self._obs is not None else None
+        self._result_queue.put(("done", value, None, snap))
 
     def _fail(self, err: BaseException) -> None:
-        self._result_queue.put(("error", err, None))
+        self._result_queue.put(("error", err, None, None))
 
     def _next_result(self, timeout: Optional[float] = None):
         return self._result_queue.get(timeout=timeout)

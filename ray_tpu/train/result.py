@@ -18,6 +18,9 @@ class Result:
     metrics_history: Optional[List[Dict[str, Any]]] = None
     best_checkpoints: Optional[List[Tuple[Checkpoint, Dict[str, Any]]]] = None
     config: Optional[Dict[str, Any]] = None  # the trial's hyperparameters
+    #: training-observability rollup (train/observability.py aggregate):
+    #: steps, compile_s, step-time p50, MFU, goodput, per-rank snapshots
+    train_obs: Optional[Dict[str, Any]] = None
 
     @property
     def metrics_dataframe(self):

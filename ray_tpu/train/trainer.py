@@ -118,7 +118,8 @@ class BaseTrainer:
         result = Result(metrics=last_metrics,
                         checkpoint=best or latest or checkpoint,
                         path=trial_dir, error=error,
-                        metrics_history=history)
+                        metrics_history=history,
+                        train_obs=executor.train_obs)
         if error is not None and not getattr(self, "_suppress_errors", False):
             raise TrainingFailedError(
                 f"training failed after {failures} failure(s)") from error

@@ -56,6 +56,12 @@ class TrainWorker:
             trial_name=trial_name, trial_id=trial_id, trial_dir=trial_dir,
             checkpoint=ckpt, dataset_shards=dataset_shards,
             mesh_spec=mesh_spec)
+        # train-plane observability: the per-rank step tracker (created
+        # eagerly so even un-instrumented loops get step wall/goodput) and
+        # the event-loop stall monitor on this worker's RPC loop
+        from . import observability as train_obs
+        self._ctx._obs = train_obs.StepTracker(world_rank, trial=trial_name)
+        train_obs.ensure_loop_monitor(self, f"train_worker:{world_rank}")
 
     def start_training(self, train_fn: Callable, config: Dict[str, Any]) -> None:
         """Launch the user loop in a side thread; returns immediately."""
@@ -64,7 +70,19 @@ class TrainWorker:
         _set_context(ctx)
 
         import inspect
+
+        # This sync actor task executes with the submitter's trace context
+        # installed (core_worker); capture it so the side thread's per-step
+        # spans chain under the start_training task slice — the
+        # chief -> worker -> step chain `raytpu timeline` renders.
+        from ray_tpu.util import tracing
+        trace_ctx = tracing.current_context()
+
         def run():
+            if trace_ctx is not None:
+                tracing.set_context(trace_ctx)
+            if ctx._obs is not None:
+                ctx._obs.start()  # goodput clock starts at loop entry
             try:
                 sig = inspect.signature(train_fn)
                 out = train_fn(config) if len(sig.parameters) >= 1 \
@@ -82,13 +100,27 @@ class TrainWorker:
     def next_result(self, timeout: float = 3600.0):
         """Block until the user loop reports / finishes / errors.
 
-        Returns (kind, payload, checkpoint_path); kind in
-        {"report", "done", "error"}.  Errors re-raise in the driver.
+        Returns (kind, payload, checkpoint_path, obs_snapshot); kind in
+        {"report", "done", "error"}.  Errors re-raise in the driver.  The
+        observability snapshot (StepTracker rollup, None with the kill
+        switch off) piggybacks on the existing channel — no extra RPC.
         """
-        kind, payload, ckpt = self._ctx._next_result(timeout=timeout)
+        kind, payload, ckpt, obs = self._ctx._next_result(timeout=timeout)
+        if kind != "report":
+            # the executor kills this worker moments after done/error —
+            # push the tail of buffered step spans and the final metric
+            # snapshot out before that
+            from ray_tpu.util.metrics import flush_metrics
+
+            from .observability import flush_task_events
+            flush_task_events()
+            try:
+                flush_metrics()
+            except Exception:
+                pass
         if kind == "error":
             raise payload
-        return kind, payload, ckpt
+        return kind, payload, ckpt, obs
 
     def resume(self) -> None:
         self._ctx._resume()

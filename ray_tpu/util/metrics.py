@@ -290,6 +290,15 @@ def _flush_once() -> bool:
         return False
 
 
+def flush_metrics() -> bool:
+    """Push this process's registry to its node agent NOW (one flusher
+    tick, synchronously).  Short-lived processes — a train worker killed
+    moments after its loop finishes — call this at their last report so
+    the final gauge/counter values survive the process; everyone else
+    rides the periodic flusher."""
+    return _flush_once()
+
+
 def _ensure_flusher(period_s: float = 2.0):
     global _flusher_started
     with _registry_lock:

@@ -184,6 +184,45 @@ def test_serve_metric_tag_keys_are_bounded():
     assert seen >= 8, f"only {seen} raytpu_serve_ metrics found"
 
 
+# -------------------------------------------------------- train cardinality
+
+TRAIN_OBS_FILE = PKG_ROOT / "train" / "observability.py"
+#: the label-set bound for the train plane: rank (bounded by world size)
+#: and stage (the fixed decomposition names) ONLY — never worker
+#: hostnames, trial names, or anything else unbounded.
+ALLOWED_TRAIN_TAG_KEYS = {"rank", "stage"}
+
+
+def test_train_metric_tag_keys_are_bounded():
+    """Every ``raytpu_train_*`` metric declares only rank/stage tag keys
+    (matching the serve plane's cardinality discipline — a tag that can
+    carry a hostname or trial id would explode the series space on a
+    large fleet)."""
+    tree = ast.parse(TRAIN_OBS_FILE.read_text())
+    problems = []
+    seen = 0
+    for call, cls in _metric_calls(tree):
+        name_node = call.args[0] if call.args else None
+        if not (isinstance(name_node, ast.Constant)
+                and str(name_node.value).startswith("raytpu_train_")):
+            continue
+        seen += 1
+        for kw in call.keywords:
+            if kw.arg != "tag_keys" or not isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                continue
+            for el in kw.value.elts:
+                if (isinstance(el, ast.Constant)
+                        and el.value not in ALLOWED_TRAIN_TAG_KEYS):
+                    problems.append(
+                        f"observability.py:{call.lineno}: {cls} "
+                        f"{name_node.value!r} declares tag key "
+                        f"{el.value!r} outside "
+                        f"{sorted(ALLOWED_TRAIN_TAG_KEYS)}")
+    assert not problems, "\n".join(problems)
+    assert seen >= 8, f"only {seen} raytpu_train_ metrics found"
+
+
 def test_all_runtime_metrics_use_raytpu_namespace():
     problems = []
     scanned = 0

@@ -1,0 +1,140 @@
+"""Cluster metrics-history store tests (ISSUE 10 satellites): ring-buffer
+age-out, counter->rate derivation across resets, Prometheus parsing, and
+the dashboard /api/metrics rework (freshest-sample serving + explicit
+{"error": ...} entries for unreachable nodes)."""
+
+import pytest
+
+from ray_tpu.dashboard.history import (MetricsHistory, find_one,
+                                       find_samples, parse_prometheus)
+
+
+def test_parse_prometheus_types_and_samples():
+    text = "\n".join([
+        "# HELP raytpu_x_total things",
+        "# TYPE raytpu_x_total counter",
+        'raytpu_x_total{node="n1"} 5',
+        "# TYPE raytpu_h_seconds histogram",
+        'raytpu_h_seconds_bucket{le="+Inf"} 3',
+        'raytpu_h_seconds_sum 0.5',
+        'raytpu_h_seconds_count 3',
+        "# TYPE raytpu_g gauge",
+        "raytpu_g 7.5",
+        "garbage line without number x",
+        "",
+    ])
+    samples, counters = parse_prometheus(text)
+    assert samples['raytpu_x_total{node="n1"}'] == 5.0
+    assert samples["raytpu_g"] == 7.5
+    assert samples["raytpu_h_seconds_count"] == 3.0
+    # counters/histograms classified; gauges not
+    assert "raytpu_x_total" in counters
+    assert "raytpu_h_seconds" in counters
+    assert "raytpu_g" not in counters
+    # the malformed line is skipped, not fatal
+    assert "garbage" not in " ".join(samples)
+
+
+def test_ring_buffer_age_out_and_count_bound():
+    st = MetricsHistory(window_s=10.0, period_s=1.0)
+    for i in range(30):
+        st.add_sample("n1", {"raytpu_g": float(i)}, ts=100.0 + i)
+    ts, latest = st.latest()
+    assert ts == 129.0 and latest["n1"]["raytpu_g"] == 29.0
+    series = st.series("n1")["raytpu_g"]
+    # age-out: only the 10 s window survives (and the deque maxlen holds)
+    assert all(t >= 129.0 - 10.0 for t, _v in series)
+    assert 2 <= len(series) <= 12
+    # an idle node's buffer ages out relative to ITS OWN appends only;
+    # a fresh node doesn't disturb it
+    st.add_sample("n2", {"raytpu_g": 1.0}, ts=500.0)
+    assert st.series("n1")["raytpu_g"]
+
+
+def test_rates_and_counter_reset():
+    st = MetricsHistory(window_s=100.0, period_s=1.0)
+    st.add_sample("n1", {"raytpu_req_total": 10.0, "raytpu_g": 5.0},
+                  counters={"raytpu_req_total"}, ts=100.0)
+    st.add_sample("n1", {"raytpu_req_total": 30.0, "raytpu_g": 6.0},
+                  ts=102.0)
+    # counter: (30-10)/2 = 10/s; the gauge derives NO rate
+    rates = st.rates("n1")
+    assert rates["raytpu_req_total"] == [[102.0, 10.0]]
+    assert "raytpu_g" not in rates
+    # counter RESET (process restart): value drops -> rate = new/dt, not
+    # a bogus negative
+    st.add_sample("n1", {"raytpu_req_total": 4.0}, ts=104.0)
+    assert st.rates("n1")["raytpu_req_total"][-1] == [104.0, 2.0]
+    # histogram suffixes rate too (classified via the base name)
+    st.add_sample("n2", {"raytpu_h_seconds_count": 2.0},
+                  counters={"raytpu_h_seconds"}, ts=10.0)
+    st.add_sample("n2", {"raytpu_h_seconds_count": 6.0}, ts=12.0)
+    assert st.rates("n2")["raytpu_h_seconds_count"] == [[12.0, 2.0]]
+
+
+def test_error_samples_break_rate_chain_and_surface_in_latest():
+    st = MetricsHistory(window_s=100.0, period_s=1.0)
+    st.add_sample("n1", {"raytpu_req_total": 10.0},
+                  counters={"raytpu_req_total"}, ts=100.0)
+    st.record_error("n1", "ConnectionRefusedError: boom", ts=102.0)
+    st.add_sample("n1", {"raytpu_req_total": 50.0}, ts=104.0)
+    # latest() after a recovery serves the good sample again
+    _ts, latest = st.latest()
+    assert latest["n1"]["raytpu_req_total"] == 50.0
+    # but NO rate spans the scrape gap (the 10 -> 50 delta includes an
+    # unknown amount of downtime)
+    assert "raytpu_req_total" not in st.rates("n1")
+    # a node whose LAST sample errored reports the error explicitly
+    st.record_error("n1", "timeout", ts=106.0)
+    _ts, latest = st.latest()
+    assert latest["n1"] == {"error": "timeout"}
+    assert st.summary("n1")["error"] == "timeout"
+
+
+def test_find_helpers():
+    samples = {
+        'raytpu_resource_total{node="ab",reporter="r",resource="CPU"}': 8.0,
+        'raytpu_resource_total{node="ab",reporter="r",resource="TPU"}': 4.0,
+        "raytpu_plain": 1.0,
+    }
+    assert find_samples(samples, "raytpu_resource_total",
+                        resource="CPU") == [8.0]
+    assert find_one(samples, "raytpu_resource_total", node="ab") == 8.0
+    assert find_one(samples, "raytpu_plain") == 1.0
+    assert find_one(samples, "raytpu_missing", default=-1) == -1
+
+
+def test_dashboard_scrape_records_unreachable_nodes(monkeypatch):
+    """The /api/metrics rework satellite: a node that is alive but whose
+    /metrics cannot be scraped (or that advertises no metrics_port) must
+    land in the store as an explicit {"error": ...} entry, not silently
+    vanish from the response."""
+    pytest.importorskip("aiohttp")
+    import asyncio
+
+    from ray_tpu.dashboard.head import DashboardHead
+    from ray_tpu.util import state
+
+    rows = [
+        {"node_id": "a" * 24, "alive": True, "address": "127.0.0.1:1",
+         "labels": {"metrics_port": "1"}},      # nothing listens on :1
+        {"node_id": "b" * 24, "alive": True, "address": "127.0.0.1:2",
+         "labels": {}},                          # no metrics_port at all
+        {"node_id": "c" * 24, "alive": False, "address": "127.0.0.1:3",
+         "labels": {"metrics_port": "9"}},       # dead: skipped entirely
+    ]
+    monkeypatch.setattr(state, "list_nodes", lambda *a, **k: rows)
+    head = DashboardHead()
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(head._scrape_once())
+    _ts, latest = head.history.latest()
+    assert "error" in latest["a" * 12]
+    assert latest["b" * 12] == {"error": "no metrics_port advertised"}
+    assert "c" * 12 not in latest
+    # a node that DIES must drop from the store on the next pass — its
+    # last sample must not keep serving as live data
+    rows[0]["alive"] = False
+    loop.run_until_complete(head._scrape_once())
+    _ts, latest = head.history.latest()
+    assert "a" * 12 not in latest
+    assert "b" * 12 in latest
