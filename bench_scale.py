@@ -35,6 +35,41 @@ def _pctl(sorted_xs, q):
     return sorted_xs[min(len(sorted_xs) - 1, int(len(sorted_xs) * q))]
 
 
+def _saturation_snapshot() -> dict:
+    """Control-plane saturation rollup recorded per depth point: which
+    loop is how busy, the top GCS handlers by cumulative busy seconds,
+    and the backpressure-reject counts — the before-curve the
+    control-plane sharding work (ROADMAP item 5) will be judged
+    against.  Best effort: a missing piece records as None, never
+    fails the bench."""
+    out: dict = {}
+    try:
+        from ray_tpu.core.api import _state
+        from ray_tpu.core.core_worker import global_worker
+        from ray_tpu.core.rpc import run_async
+        w = global_worker()
+        stats = run_async(w.gcs.call("sched_stats"), timeout=30)
+        out["gcs_loop_busy_fraction"] = stats.get("loop_busy_fraction")
+        out["gcs_top_handlers"] = [
+            [m, round(s, 3)] for m, s in (stats.get("top_handlers")
+                                          or [])[:3]]
+        out["gcs_handler_calls_top"] = {
+            m: stats.get("handler_calls", {}).get(m)
+            for m, _s in (stats.get("top_handlers") or [])[:3]}
+        mon = getattr(w, "_loop_monitor", None)
+        out["owner_loop_busy_fraction"] = getattr(mon, "busy_fraction",
+                                                  None)
+        agent = getattr(_state, "node_agent", None)
+        if agent is not None:
+            amon = getattr(agent, "_loop_monitor", None)
+            out["agent_loop_busy_fraction"] = getattr(
+                amon, "busy_fraction", None)
+            out["backpressure_rejects"] = dict(agent._bp_rejects)
+    except Exception as e:  # noqa: BLE001 — observability must not wedge
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def bench_depth(depth: int) -> dict:
     import ray_tpu
     from ray_tpu.core.core_worker import global_worker
@@ -79,6 +114,9 @@ def bench_depth(depth: int) -> dict:
             "rss_delta_mb": round(peak - rss0, 1),
             "gate_parks": w.admission_gate.blocked_total,
             "events_shed": w.task_events_shed_total,
+            # saturation series: sampled at the END of the drain, while
+            # the busy-fraction windows still reflect steady state
+            "saturation": _saturation_snapshot(),
         })
     finally:
         ray_tpu.shutdown()
@@ -148,6 +186,7 @@ def main():
             "submit_batching_enabled": cfg.submit_batching_enabled,
             "lease_queue_max_depth": cfg.lease_queue_max_depth,
             "gcs_table_shards": cfg.gcs_table_shards,
+            "sched_metrics_enabled": cfg.sched_metrics_enabled,
         },
         "task_curve": [],
     }

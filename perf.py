@@ -410,6 +410,76 @@ def run_ab_train_obs(S: float, pairs: int) -> dict:
             "off_config": TRAIN_OBS_OFF, "ratio_on_off": ratio}
 
 
+#: the "off" arm of the scheduler-observability A/B: the kill switch sheds
+#: loop busy-fraction sampling, per-GCS-handler busy attribution, the
+#: owner serialize/flush histograms and the backpressure counters —
+#: isolating what sched_metrics_enabled costs the submission hot path.
+SCHED_OBS_OFF = {"sched_metrics_enabled": False}
+
+
+def _measure_sched_obs(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster measurement of the sched-observability A/B arms:
+    tasks_async (the owner-loop-bound path the saturation metrics watch)
+    plus submit_burst ops/s and bare-submit p99."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=8, object_store_memory=2 << 30,
+                 _system_config=system_config or None)
+    out = {}
+
+    @ray_tpu.remote
+    def noop(_x=None):
+        return None
+
+    try:
+        ray_tpu.get([noop.remote() for _ in range(8)])
+        n = int(1000 * S)
+        out["tasks_async"] = max(timeit(
+            lambda: ray_tpu.get([noop.remote() for _ in range(n)]), n))
+        nb = int(1000 * S)
+        sub_p99 = []
+        calls = [0]
+
+        def burst():
+            calls[0] += 1
+            t_sub = []
+            refs = []
+            for _ in range(nb):
+                s0 = time.perf_counter()
+                refs.append(noop.remote())
+                t_sub.append(time.perf_counter() - s0)
+            ray_tpu.get(refs)
+            if calls[0] == 1:
+                return  # warmup pass
+            t_sub.sort()
+            sub_p99.append(
+                t_sub[min(len(t_sub) - 1, int(len(t_sub) * 0.99))] * 1e6)
+
+        out["submit_burst"] = max(timeit(burst, nb))
+        out["submit_burst_submit_us_p99"] = (
+            sorted(sub_p99)[len(sub_p99) // 2] if sub_p99 else None)
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_sched_obs(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: sched_metrics_enabled on vs off over
+    tasks_async + submit_burst (the ISSUE-11 acceptance gate: <= 5%
+    overhead)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_sched_obs(S, None))
+        off_runs.append(_measure_sched_obs(S, dict(SCHED_OBS_OFF)))
+        print(f"# sched ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in ("tasks_async", "submit_burst")}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": SCHED_OBS_OFF, "ratio_on_off": ratio}
+
+
 #: the "off" arm of the batched-submission A/B: one task per push RPC, one
 #: lease per request RPC, one actor call per batch — the unbatched
 #: submission plane the scale-envelope work replaced.
@@ -480,6 +550,11 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of "
                         "train_metrics_enabled on vs off (CPU train-loop "
                         "steps/s; the train-observability overhead gate)")
+    p.add_argument("--ab-sched", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of "
+                        "sched_metrics_enabled on vs off (tasks_async + "
+                        "submit_burst; the scheduler-observability "
+                        "overhead gate)")
     args = p.parse_args()
     _REPS = max(args.reps, 1)
 
@@ -526,6 +601,8 @@ def main():
     if args.ab_train_obs > 0:
         out["train_obs_ab"] = run_ab_train_obs(args.scale,
                                                args.ab_train_obs)
+    if args.ab_sched > 0:
+        out["sched_obs_ab"] = run_ab_sched_obs(args.scale, args.ab_sched)
     line = json.dumps(out)
     print(line)
     if args.out:

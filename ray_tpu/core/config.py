@@ -297,6 +297,34 @@ class Config:
     #: this rate — real accelerator steps run well under it, CPU toy
     #: loops get a sampled timeline.  <= 0 means unlimited.
     train_step_spans_per_s: int = 25
+    #: Scheduler/control-plane saturation observability
+    #: (core/sched_explain.py): per-event-loop busy-fraction sampling
+    #: (``raytpu_loop_busy_fraction{process}``), per-GCS-handler busy
+    #: seconds (``raytpu_gcs_handler_seconds{method}``), owner-side
+    #: serialization/flush time histograms (``raytpu_sched_owner_*``) and
+    #: per-node backpressure-reject counters
+    #: (``raytpu_sched_backpressure_total``).  ONE kill switch sheds every
+    #: raytpu_sched_*/raytpu_loop_*/raytpu_gcs_* series (hot paths keep a
+    #: single boolean check) for A/B overhead measurement — same
+    #: discipline as rpc_metrics_enabled.
+    sched_metrics_enabled: bool = True
+    #: Bounded ring of scheduler decision records kept by the GCS
+    #: (candidates/rejection-causes/outcome per pick_node / pack_bundles /
+    #: lease-acquisition decision) — the ``raytpu explain`` /
+    #: ``state.explain`` backing store.
+    sched_decision_ring_len: int = 2048
+    #: Decision records older than this age out of the ring (and are
+    #: dropped from query replies) — a debug trail, not a history DB.
+    sched_decision_max_age_s: float = 600.0
+    #: Stamp queued tasks LEASE_QUEUED only after a lease request has been
+    #: outstanding this long — a fast grant must not pay a per-task
+    #: pending event on the happy path.
+    sched_pending_stamp_after_s: float = 0.5
+    #: Cap on per-transition pending-reason stamps: when a lease pool's
+    #: reason changes, at most this many queued specs get the event (the
+    #: decision record carries the full queue count) — a 50k-deep pool
+    #: flip must not pin the IO loop stamping every spec.
+    sched_explain_stamp_max: int = 1000
     #: Dashboard cluster-metrics history (dashboard/history.py): the head
     #: scrapes every node agent's /metrics on this period into a bounded
     #: ring buffer covering this window, derives counter rates, and serves

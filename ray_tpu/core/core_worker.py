@@ -38,7 +38,8 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import serialization, spec_cache
+from . import sched_explain, serialization, spec_cache
+from .sched_explain import PendingReason
 from .common import (STREAMING_RETURNS, ActorDiedError, GetTimeoutError,
                      NodeAffinitySchedulingStrategy, ObjectLostError,
                      OutOfMemoryError, PlacementGroupSchedulingStrategy,
@@ -179,7 +180,8 @@ class _AdmissionGate:
     def inflight(self) -> int:
         return self._inflight
 
-    def acquire(self, worker: "CoreWorker") -> None:
+    def acquire(self, worker: "CoreWorker",
+                spec: Optional[TaskSpec] = None) -> None:
         limit = get_config().submit_inflight_limit
         with self._cond:
             if limit <= 0 or self._inflight < limit:
@@ -197,6 +199,11 @@ class _AdmissionGate:
             with self._cond:
                 self._inflight += 1
             return
+        # About to park: stamp the typed reason onto the event plane so
+        # "why is my .remote() slow" is answerable from raytpu explain /
+        # summarize_tasks (the happy path above stamps nothing).
+        if spec is not None:
+            worker.pending_reason(spec, PendingReason.ADMISSION_GATE)
         # Worker-mode submitters release their lease's resources while
         # parked (same contract as blocking in ray.get) so nested tasks
         # can still run on the node.
@@ -563,10 +570,71 @@ class LeasePool:
         self.hard_affinity = (isinstance(strategy,
                                          NodeAffinitySchedulingStrategy)
                               and not strategy.soft)
+        #: human label for decision records (first submitted task's name —
+        #: the scheduling key itself is an opaque fn-id hash)
+        self.label: Optional[str] = None
+        # decision-record rate limiting: identical consecutive outcomes
+        # (a stuck pool re-picking every 0.5 s) record the transition plus
+        # a periodic heartbeat, not one record per attempt
+        self._last_outcome: Optional[str] = None
+        self._outcome_repeats = 0
 
     def submit(self, spec: TaskSpec):
         self.queue.append(spec)
         self._pump()
+
+    # ---------------------------------------------------- explain plane
+
+    def _note_reason(self, reason: str, **detail):
+        """Stamp the typed pending reason onto (a bounded prefix of) the
+        queued specs — called on TRANSITIONS only (per-task dedup lives in
+        pending_reason), so the happy path never sees this."""
+        cap = get_config().sched_explain_stamp_max
+        for i, spec in enumerate(self.queue):
+            if cap > 0 and i >= cap:
+                break
+            self.w.pending_reason(spec, reason, **detail)
+
+    def _decision(self, outcome: str, explain: Optional[dict] = None,
+                  node: Optional[str] = None, **extra):
+        """Append one structured decision record to the owner's bounded
+        buffer (flushed to the GCS ring with the task-event cadence).
+        Consecutive identical outcomes are coalesced: the transition
+        records, repeats keep a periodic heartbeat (every 10th)."""
+        if not get_config().task_events_enabled:
+            return
+        if outcome == self._last_outcome:
+            self._outcome_repeats += 1
+            if self._outcome_repeats % 10:
+                return
+        else:
+            self._last_outcome = outcome
+            self._outcome_repeats = 0
+        rec = {
+            "ts": time.time(), "kind": "task",
+            "label": self.label or "?",
+            "demand": dict(self.resources),
+            "strategy": str(self.strategy),
+            "outcome": outcome, "node": node,
+            "task_ids": [s.task_id.hex() for s in
+                         itertools.islice(self.queue, 5)],
+            "task_count": len(self.queue),
+            **extra}
+        if explain:
+            rec["candidates"] = explain.get("candidates")
+            rec.update(sched_explain.bound_rejected(
+                explain.get("rejected")))
+        self.w._sched_decisions.append(rec)
+
+    def _stamp_lease_queued(self, node: Optional[str], addr: str):
+        """call_later callback: the lease request has been outstanding past
+        ``sched_pending_stamp_after_s`` — it is parked in the agent's lease
+        queue (or the agent is saturated), so the queued tasks are now
+        observably LEASE_QUEUED rather than in a fast grant."""
+        if not self.queue:
+            return
+        self._note_reason(PendingReason.LEASE_QUEUED, node=node or addr)
+        self._decision("queued", node=node or addr)
 
     def _pump(self):
         # Dispatch queued tasks to idle leased workers.  Multiple queued
@@ -633,6 +701,7 @@ class LeasePool:
         granted = 0
         try:
             target_addr = None
+            target_nid = None
             hops = 0
             while not self.w._shutdown and granted < count:
                 if not self.queue:
@@ -650,13 +719,26 @@ class LeasePool:
                     await asyncio.sleep(0.2)
                     continue
                 if target_addr is None:
+                    # explain only when the event plane will carry it —
+                    # the None path keeps pick_node's promise that
+                    # un-observed picks pay nothing extra
+                    explain = ({} if get_config().task_events_enabled
+                               else None)
                     nid = pick_node(view, self.resources, self.strategy,
-                                    local_node_id=self.w.node_id)
+                                    local_node_id=self.w.node_id,
+                                    explain=explain)
                     if nid is None:
-                        # Infeasible right now: surface the demand shape to
-                        # the GCS so the autoscaler can see it (reference:
-                        # infeasible tasks show up in cluster load) and wait
-                        # for nodes.
+                        # Infeasible right now: stamp the typed reason
+                        # (NO_RESOURCES, or NODE_DRAINING when the only
+                        # would-be hosts are draining), record the
+                        # decision with its per-node rejection causes, and
+                        # surface the demand shape to the GCS so the
+                        # autoscaler can see it (reference: infeasible
+                        # tasks show up in cluster load) — then wait.
+                        reason = sched_explain.reason_for_no_node(explain)
+                        self._note_reason(reason)
+                        self._decision("no_node", explain=explain,
+                                       reason=reason)
                         try:
                             await self.w.gcs.call(
                                 "report_pending_demand",
@@ -670,7 +752,18 @@ class LeasePool:
                             return
                         continue
                     target_addr = view[nid].address
+                    target_nid = nid
                 agent = self.w.agent_clients.get(target_addr)
+                # LEASE_QUEUED is stamped LAZILY: only a request still
+                # unanswered after sched_pending_stamp_after_s marks the
+                # queue as parked at the agent — a fast grant pays one
+                # timer arm/cancel, never a per-task event.
+                stamp_h = None
+                stamp_after = get_config().sched_pending_stamp_after_s
+                if stamp_after > 0 and get_config().task_events_enabled:
+                    stamp_h = asyncio.get_event_loop().call_later(
+                        stamp_after, self._stamp_lease_queued,
+                        target_nid, target_addr)
                 try:
                     # Idempotent retrying lease request: a grant whose
                     # reply was lost comes back from the agent's dedup
@@ -702,18 +795,23 @@ class LeasePool:
                         return
                     # transient agent-side failure (register timeout etc.):
                     # back off and retry the lease
-                    target_addr = None
+                    target_addr = target_nid = None
                     await asyncio.sleep(0.5)
                     continue
                 except (RpcError, OSError):
                     # RemoteError (a subclass) is handled above; this
                     # covers ConnectionLost AND "client closed" from a
                     # pool entry force-closed under us
-                    target_addr = None
+                    target_addr = target_nid = None
                     await asyncio.sleep(0.2)
                     continue
+                finally:
+                    if stamp_h is not None:
+                        stamp_h.cancel()
                 grants = res.get("grants") if isinstance(res, dict) else None
                 if grants:
+                    self._decision("granted", node=target_nid,
+                                   granted=len(grants))
                     for grant in grants:
                         lw = LeasedWorker(grant["worker_address"],
                                           grant["worker_id"],
@@ -733,25 +831,36 @@ class LeasePool:
                         continue
                     return
                 if "spillback" in res:
+                    self._decision("spillback", node=target_nid,
+                                   spill_to=res["spillback"].get("node_id"))
                     target_addr = res["spillback"]["address"]
+                    target_nid = res["spillback"].get("node_id")
                     hops += 1
                     continue
                 if res.get("infeasible"):
-                    target_addr = None
+                    self._note_reason(PendingReason.NO_RESOURCES,
+                                      node=target_nid)
+                    self._decision("infeasible", node=target_nid)
+                    target_addr = target_nid = None
                     await asyncio.sleep(0.5)
                     continue
                 if res.get("backpressure"):
-                    # The agent's lease queue is at its depth bound: back
-                    # off for the advertised interval, then re-pick a node
-                    # (the fresh cluster view may route around the hot
-                    # agent; spillback spreads the rest).
-                    target_addr = None
+                    # The agent's lease queue is at its depth bound (or the
+                    # node is draining): stamp the transition, record the
+                    # decision, back off for the advertised interval, then
+                    # re-pick a node (the fresh cluster view may route
+                    # around the hot agent; spillback spreads the rest).
+                    self._note_reason(PendingReason.BACKPRESSURED,
+                                      node=target_nid)
+                    self._decision("backpressure", node=target_nid,
+                                   retry_after_s=res.get("retry_after_s"))
+                    target_addr = target_nid = None
                     await asyncio.sleep(res.get(
                         "retry_after_s",
                         get_config().lease_backpressure_retry_s))
                     continue
                 # unrecognized reply shape: back off rather than spin
-                target_addr = None
+                target_addr = target_nid = None
                 await asyncio.sleep(0.2)
         finally:
             self.requesting -= count
@@ -763,17 +872,18 @@ class LeasePool:
         per call).  The connection is established FIRST so the encoder's
         delivered-set tracks the connection these frames ride."""
         await client._ensure_connected()
-        enc = self.w.spec_encoder
+        # serialization-time attribution (sched_metrics_enabled) rides
+        # _timed_encode: the owner-side pickling cost per push batch is
+        # one of the candidate ceilings on the single-loop submit path
+        # (ROADMAP 5)
+        payloads = self.w._timed_encode(client, specs)
         if (len(specs) == 1
                 and specs[0].num_returns != STREAMING_RETURNS):
-            return [await client.call("push_task",
-                                      spec=enc.encode(client, specs[0]),
+            return [await client.call("push_task", spec=payloads[0],
                                       _timeout=86400.0)]
         # Batch RPC even for one task when it streams: only the batch
         # handler has the live writer that yield frames ride on.
-        return await client.call("push_task_batch",
-                                 specs=[enc.encode(client, s)
-                                        for s in specs],
+        return await client.call("push_task_batch", specs=payloads,
                                  _timeout=86400.0)
 
     async def _run_on(self, lw: LeasedWorker, specs: List[TaskSpec]):
@@ -789,6 +899,10 @@ class LeasePool:
                 # The worker evicted a template we thought delivered (its
                 # decode raised before dispatching anything): resend once
                 # with full templates.
+                for spec in specs:
+                    self.w.pending_reason(spec,
+                                          PendingReason.SPEC_CACHE_RESEND,
+                                          node=lw.node_id)
                 spec_cache.SpecEncoder.forget_client(client)
                 results_list = await self._push_specs(client, specs)
         except (RpcError, RemoteError, OSError) as e:
@@ -1004,6 +1118,15 @@ class CoreWorker:
         #: owner-side submit timestamps: the "queue" (submit->dispatch) and
         #: "total" (submit->terminal) stage durations are computed from these
         self._submit_ts: Dict[TaskID, float] = {}
+        # Scheduler explain plane (core/sched_explain.py): the last typed
+        # pending reason stamped per task (dedup — a backpressure retry
+        # loop stamps one transition, not one event per attempt; entries
+        # clear on RUNNING/terminal) and the bounded buffer of structured
+        # lease-acquisition decision records flushed to the GCS ring
+        # alongside task events.
+        self._last_reason: Dict[TaskID, str] = {}
+        self._sched_decisions: collections.deque = collections.deque(
+            maxlen=512)
         # STAGES-event rate cap bookkeeping (see _record_stages)
         self._stage_event_window = 0
         self._stage_event_count = 0
@@ -1114,6 +1237,10 @@ class CoreWorker:
                 if t0 is not None:
                     extra.setdefault("total_s", now - t0)
                     _observe_stage("total", now - t0)
+        if state in ("RUNNING", "FINISHED", "FAILED"):
+            # next pending episode (a retry re-queued by a worker death)
+            # gets a fresh reason transition
+            self._last_reason.pop(spec.task_id, None)
         ev = {
             "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
             "job_id": spec.job_id.hex(), "ts": now,
@@ -1126,6 +1253,46 @@ class CoreWorker:
             ev.setdefault("parent_id", spec.trace_ctx[1])
             ev.setdefault("span_id", spec.task_id.hex()[:12])
         self._append_task_event(ev)
+
+    def _timed_encode(self, client, specs: List[TaskSpec]) -> list:
+        """Wire-encode specs through the template cache, attributing the
+        pickling time to ``raytpu_sched_owner_serialize_seconds`` (one
+        observation per batch) — the owner-loop cost the saturation plane
+        must separate from dispatch/flush time."""
+        om = sched_explain.owner_metrics()
+        t0 = time.perf_counter() if om is not None else 0.0
+        payloads = [self.spec_encoder.encode(client, s) for s in specs]
+        if om is not None:
+            om["serialize"].observe(time.perf_counter() - t0)
+        return payloads
+
+    def pending_reason(self, spec: TaskSpec, reason: str, **detail):
+        """Stamp a typed pending-reason transition onto the task-event
+        plane: one ``state="PENDING"`` event carrying ``reason=<constant
+        from PendingReason>`` plus optional bounded detail (node id,
+        cause).  Deduped per task — re-entering the same reason (a
+        backpressure retry loop, repeated infeasible picks) records
+        nothing, so the trail is the TRANSITION history, with timestamps.
+
+        Reasons MUST be ``PendingReason.*`` constants (AST lint in
+        tests/test_metric_naming.py): they become event fields and rollup
+        keys, and a free-form string here would be an unbounded label."""
+        if not get_config().task_events_enabled:
+            return
+        if self._last_reason.get(spec.task_id) == reason:
+            return
+        self._last_reason[spec.task_id] = reason
+        # same ceiling discipline as _submit_ts: a flood of stuck tasks
+        # must not grow this map without bound.  Unlike _submit_ts this
+        # map has TWO writer threads (a gate-parked driver thread and the
+        # IO loop), so eviction must tolerate losing the race for the
+        # front key — never raise into a lease-acquisition task.
+        while len(self._last_reason) > get_config().task_events_max_buffer:
+            try:
+                self._last_reason.pop(next(iter(self._last_reason)), None)
+            except (StopIteration, RuntimeError, KeyError):
+                break
+        self.task_event(spec, "PENDING", reason=reason, **detail)
 
     def _append_task_event(self, ev: dict):
         """Bounded owner-side event buffer: beyond task_events_max_buffer
@@ -1191,6 +1358,17 @@ class CoreWorker:
                             dropped=dropped if i == 0 else 0)
                 except Exception:
                     pass
+            if self._sched_decisions and self.gcs:
+                # owner-side scheduling decision records ride the same
+                # cadence into the GCS ring (best effort: a lost batch
+                # costs explain detail, never correctness)
+                records = list(self._sched_decisions)
+                self._sched_decisions.clear()
+                try:
+                    await self.gcs.call(
+                        "add_sched_decisions", records=records, _timeout=10)
+                except Exception:
+                    pass
 
     # ---------------------------------------------------------- cluster view
 
@@ -1201,9 +1379,13 @@ class CoreWorker:
             return view
         payload = await self.gcs.call_retry("get_cluster_view",
                                             _idempotent=False)
+        # draining rides the view so OWNER-side pick_node routes around a
+        # preempted node up front (it used to be dropped here, and clients
+        # only learned via a backpressure round trip to the draining agent)
         view = {nid: NodeView(nid, d["address"], d["total"], d["available"],
                               d.get("labels", {}), d.get("alive", True),
-                              d.get("queue_len", 0))
+                              d.get("queue_len", 0),
+                              draining=d.get("draining", False))
                 for nid, d in payload.items()}
         self._view_cache = (now, view)
         return view
@@ -1589,7 +1771,7 @@ class CoreWorker:
 
         Returns a list of ObjectRefs, or an ObjectRefGenerator for
         ``num_returns="streaming"`` tasks."""
-        self.admission_gate.acquire(self)
+        self.admission_gate.acquire(self, spec)
         if spec.num_returns == STREAMING_RETURNS:
             self.streams[spec.task_id] = StreamState(
                 spec.task_id, spec.generator_backpressure)
@@ -1637,6 +1819,8 @@ class CoreWorker:
         timer, self._submit_timer = self._submit_timer, None
         if timer is not None:
             timer.cancel()  # no-op when we ARE the timer callback
+        om = sched_explain.owner_metrics()
+        t0 = time.perf_counter() if om is not None else 0.0
         with self._submit_lock:
             items = list(self._submit_buffer)
             self._submit_buffer.clear()
@@ -1664,6 +1848,11 @@ class CoreWorker:
             if not tgt.pump_running:
                 tgt.pump_running = True
                 asyncio.ensure_future(self._actor_pump(actor_id, tgt))
+        if om is not None:
+            # flush-time attribution: routing + pump work this IO-loop
+            # callback spent on the burst (serialization is separate —
+            # raytpu_sched_owner_serialize_seconds)
+            om["flush"].observe(time.perf_counter() - t0)
 
     def _pool_for(self, spec: TaskSpec) -> LeasePool:
         bundle = None
@@ -1677,6 +1866,8 @@ class CoreWorker:
             pool = LeasePool(self, key, spec.resources, strategy, bundle,
                              spec.runtime_env)
             self.lease_pools[key] = pool
+        if pool.label is None:
+            pool.label = spec.name
         return pool
 
     def _submit_spec(self, spec: TaskSpec):
@@ -1702,7 +1893,7 @@ class CoreWorker:
         """Fire-and-forget like submit_task: enqueue into the target's
         ordered outbox on the IO loop; the per-target pump batches and
         sends.  Streaming methods return an ObjectRefGenerator."""
-        self.admission_gate.acquire(self)
+        self.admission_gate.acquire(self, spec)
         if spec.num_returns == STREAMING_RETURNS:
             self.streams[spec.task_id] = StreamState(
                 spec.task_id, spec.generator_backpressure)
@@ -1776,6 +1967,14 @@ class CoreWorker:
         delivery order are preserved without a lock (reference:
         actor_scheduling_queue.h:40 sequencing)."""
         while specs:
+            if tgt.state != "ALIVE" or not tgt.address:
+                # the calls' dependency is the ACTOR itself — still being
+                # placed or restarted; the typed reason makes a hung
+                # handle call diagnosable (raytpu explain <actor id> then
+                # shows the GCS-side placement trail)
+                for s in specs:
+                    self.pending_reason(s, PendingReason.WAITING_DEPS,
+                                        actor=actor_id[:16])
             try:
                 tgt = await self._resolve_actor(actor_id)
             except ActorDiedError as e:
@@ -1792,7 +1991,7 @@ class CoreWorker:
                 # once per handle; each call ships args + ids.  Connect
                 # first so the delivered-set tracks this connection.
                 await client._ensure_connected()
-                enc = self.spec_encoder
+                payloads = self._timed_encode(client, specs)
                 if (len(specs) == 1
                         and specs[0].num_returns != STREAMING_RETURNS):
                     # Single non-streaming call: token'd retry.  A reply
@@ -1803,14 +2002,13 @@ class CoreWorker:
                     # results stream as side-channel pushes that a dedup
                     # replay would not re-emit.)
                     results_list = [await client.call_retry(
-                        "actor_task", spec=enc.encode(client, specs[0]),
+                        "actor_task", spec=payloads[0],
                         _timeout=86400.0, _attempts=3)]
                 else:
                     # Batch RPC even for one call when it streams: only the
                     # batch handler holds the writer yield frames ride on.
                     results_list = await client.call(
-                        "actor_task_batch",
-                        specs=[enc.encode(client, s) for s in specs],
+                        "actor_task_batch", specs=payloads,
                         _timeout=86400.0)
             except (RpcError, OSError) as e:
                 from .chaos import ChaosFault
@@ -1820,6 +2018,10 @@ class CoreWorker:
                     # The actor worker evicted a template we thought
                     # delivered; its decode raised before running anything.
                     # Resend with full templates on the next loop pass.
+                    for s in specs:
+                        self.pending_reason(
+                            s, PendingReason.SPEC_CACHE_RESEND,
+                            actor=actor_id[:16])
                     spec_cache.SpecEncoder.forget_client(client)
                     continue
                 if (isinstance(e, RemoteError)

@@ -34,10 +34,12 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import sched_explain
 from .common import TaskSpec
 from .config import get_config
 from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .rpc import ClientPool, RpcServer
+from .sched_explain import PendingReason
 from .scheduling import NodeView, pack_bundles, pick_node
 from .sharded_table import SecondaryIndex, ShardedTable
 
@@ -73,6 +75,16 @@ class GcsServer:
         self.task_events: deque = deque(maxlen=cfg.task_events_max_buffer)
         #: events owners shed at their bounded buffers (observability)
         self.task_events_dropped = 0
+        # Scheduler explain plane: bounded ring of structured decision
+        # records (pick_node/pack_bundles outcomes with per-node rejection
+        # causes) from this GCS's own scheduling loops AND from owners
+        # (add_sched_decisions piggybacks their task-event flush); plus
+        # per-handler cumulative busy seconds when sched metrics are on.
+        self.sched_decisions: deque = deque(
+            maxlen=max(64, cfg.sched_decision_ring_len))
+        self._handler_busy: Dict[str, float] = {}
+        self._handler_calls: Dict[str, int] = {}
+        self._gcs_hist_keys: Dict[str, tuple] = {}  # precomputed tag keys
         # Runtime chaos control (core/chaos.py): the cluster-wide spec and
         # its version; agents learn of changes via heartbeat piggyback
         # (and anyone else via the "chaos" pubsub topic).
@@ -88,6 +100,11 @@ class GcsServer:
 
     async def start(self):
         self._maybe_restore()
+        if sched_explain.enabled():
+            # per-handler busy attribution (synchronous-segment thread-CPU
+            # time; see rpc._BusyTimed) — the "what is the control plane
+            # spending its time on" half of the explain plane
+            self.server.busy_cb = self._on_handler_busy
         await self.server.start()
         self._restart_pending_pgs()
         self._restart_pending_actors()
@@ -541,12 +558,15 @@ class GcsServer:
         if info is None or info["state"] == "DEAD":
             return
         spec: TaskSpec = info["spec"]
+        pg_pending = False
+        last_reason = None
         for attempt in range(120):
             # Re-check each attempt: a kill while PENDING/RESTARTING must not be
             # overwritten back to ALIVE by a late placement success.
             if self.actors.get(aid) is not info or info["state"] == "DEAD":
                 return
             strategy = spec.scheduling_strategy
+            pg_pending = False
             if (isinstance(strategy, tuple) and strategy
                     and strategy[0] == "_pg"):
                 # PG-placed actor: the creation MUST go to the node holding
@@ -564,9 +584,35 @@ class GcsServer:
                 placement = (pg or {}).get("placement")
                 if placement and 0 <= idx < len(placement):
                     target = placement[idx][0]
+                # a missing/uncreated placement means the actor is blocked
+                # behind its placement group, not behind resources
+                pg_pending = target is None and (
+                    pg is None or pg.get("state") != "CREATED")
                 strategy = NodeAffinitySchedulingStrategy(
                     target or nid_hint, soft=False)
-            nid = pick_node(self.nodes, spec.resources, strategy)
+            explain: Dict[str, object] = {}
+            nid = pick_node(self.nodes, spec.resources, strategy,
+                            explain=explain)
+            if nid is None:
+                reason = (PendingReason.PG_PENDING if pg_pending
+                          else sched_explain.reason_for_no_node(explain))
+                if info.get("pending_reason") != reason:
+                    info["pending_reason"] = reason
+                    info["reason_since"] = time.time()
+                # decision records are rate-limited to transitions + a
+                # periodic heartbeat: a stuck actor's 120-attempt loop
+                # must not flood the ring with identical records
+                if reason != last_reason or attempt % 20 == 0:
+                    last_reason = reason
+                    self._record_decision({
+                        "kind": "actor", "id": aid,
+                        "label": info.get("class_name"),
+                        "demand": dict(spec.resources or {}),
+                        "outcome": "no_node", "reason": reason,
+                        "candidates": explain.get("candidates"),
+                        **sched_explain.bound_rejected(
+                            explain.get("rejected")),
+                        "attempt": attempt})
             if nid is not None:
                 agent = self.agent_clients.get(self.nodes[nid].address)
                 try:
@@ -587,8 +633,19 @@ class GcsServer:
                             pass
                         return
                     self._actor_placed(aid, info, nid)
+                    info.pop("pending_reason", None)
+                    info.pop("reason_since", None)
                     info.update(state="ALIVE", address=res["worker_address"],
                                 node_id=nid, worker_id=res["worker_id"])
+                    if last_reason is not None or attempt > 0:
+                        # close a previously-stuck trail; happy-path
+                        # placements stay out of the ring (actor churn
+                        # would evict the records worth keeping)
+                        self._record_decision({
+                            "kind": "actor", "id": aid,
+                            "label": info.get("class_name"),
+                            "outcome": "placed", "node": nid,
+                            "attempt": attempt})
                     self._persist_soon()
                     self._publish("actors", {"actor_id": aid, "state": "ALIVE",
                                              "address": res["worker_address"]})
@@ -735,8 +792,28 @@ class GcsServer:
         info = self.pgs.get(pg_id)
         if info is None:
             return
+        last_reason = None
         for attempt in range(200):
-            placement = pack_bundles(self.nodes, info["bundles"], info["strategy"])
+            explain: Dict[str, object] = {}
+            placement = pack_bundles(self.nodes, info["bundles"],
+                                     info["strategy"], explain=explain)
+            if placement is None:
+                reason = sched_explain.reason_for_no_node(explain)
+                if info.get("pending_reason") != reason:
+                    info["pending_reason"] = reason
+                    info["reason_since"] = time.time()
+                if reason != last_reason or attempt % 25 == 0:
+                    last_reason = reason
+                    self._record_decision({
+                        "kind": "pg", "id": pg_id,
+                        "label": info.get("name") or pg_id[:12],
+                        "demand": list(info["bundles"]),
+                        "strategy": info["strategy"],
+                        "outcome": "no_placement", "reason": reason,
+                        "candidates": explain.get("candidates"),
+                        **sched_explain.bound_rejected(
+                            explain.get("rejected")),
+                        "attempt": attempt})
             if placement is not None:
                 # 2-phase prepare/commit (reference PrepareBundleResources/
                 # CommitBundleResources), batched to ONE RPC per node per
@@ -777,6 +854,15 @@ class GcsServer:
                         for nid, ok in zip(by_node, commits):
                             results[nid] = results[nid] and ok
                 if all(results.values()):
+                    info.pop("pending_reason", None)
+                    info.pop("reason_since", None)
+                    if last_reason is not None:
+                        self._record_decision({
+                            "kind": "pg", "id": pg_id,
+                            "label": info.get("name") or pg_id[:12],
+                            "outcome": "placed",
+                            "nodes": list(dict.fromkeys(placement)),
+                            "attempt": attempt})
                     info.update(state="CREATED",
                                 placement=[(nid, self.nodes[nid].address)
                                            for nid in placement])
@@ -906,6 +992,126 @@ class GcsServer:
             if len(out) >= limit:
                 break
         return out
+
+    # ------------------------------------------------------- scheduler explain
+
+    def _on_handler_busy(self, method: str, busy_s: float):
+        self._handler_busy[method] = \
+            self._handler_busy.get(method, 0.0) + busy_s
+        self._handler_calls[method] = self._handler_calls.get(method, 0) + 1
+        hist = sched_explain.gcs_handler_hist()
+        if hist is not None:
+            key = self._gcs_hist_keys.get(method)
+            if key is None:
+                key = self._gcs_hist_keys[method] = (("method", method),)
+            hist.observe_key(key, busy_s)
+
+    def _prune_decisions(self):
+        max_age = get_config().sched_decision_max_age_s
+        if max_age <= 0:
+            return
+        cutoff = time.time() - max_age
+        d = self.sched_decisions
+        while d and d[0].get("ts", 0.0) < cutoff:
+            d.popleft()
+
+    def _record_decision(self, record: dict):
+        record.setdefault("ts", time.time())
+        self._prune_decisions()
+        self.sched_decisions.append(record)
+
+    async def handle_add_sched_decisions(self, records: List[dict]):
+        """Owner-side decision records (lease-acquisition outcomes) land in
+        the same ring as the GCS's own actor/PG placement decisions, so
+        ``explain`` sees one trail regardless of who decided."""
+        self._prune_decisions()
+        self.sched_decisions.extend(records)
+        return True
+
+    async def handle_get_sched_decisions(self, limit: int = 200,
+                                         id: Optional[str] = None,
+                                         kind: Optional[str] = None):
+        self._prune_decisions()
+        out: List[dict] = []
+        for rec in reversed(self.sched_decisions):
+            if kind is not None and rec.get("kind") != kind:
+                continue
+            if id is not None and not self._decision_mentions(rec, id):
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+    @staticmethod
+    def _decision_mentions(rec: dict, id: str) -> bool:
+        if rec.get("id") == id:
+            return True
+        ids = rec.get("task_ids")
+        return bool(ids) and id in ids
+
+    async def handle_explain(self, id: str):
+        """The full decision trail for one task / actor / placement group:
+        its typed pending-reason transitions (task events), the scheduling
+        decision records that mention it, and its current table state —
+        the payload behind ``state.explain`` / ``raytpu explain``."""
+        self._prune_decisions()
+        out: Dict[str, object] = {"id": id, "kind": None}
+        # task events: reason transitions + lifecycle, oldest first
+        events = [ev for ev in self.task_events
+                  if ev.get("task_id") == id or ev.get("actor_id") == id]
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        if events:
+            out["kind"] = "task"
+            out["events"] = events
+            latest = max((e for e in events
+                          if e.get("state") not in ("STAGES", "SPAN")),
+                         key=lambda e: e.get("ts", 0.0), default=None)
+            if latest is not None:
+                out["state"] = latest.get("state")
+                out["name"] = latest.get("name")
+                if latest.get("state") == "PENDING":
+                    out["pending_reason"] = latest.get("reason")
+        info = self.actors.get(id)
+        if info is not None:
+            out["kind"] = "actor"
+            out["actor"] = {k: v for k, v in info.items() if k != "spec"}
+            out["state"] = info.get("state")
+            if info.get("state") not in ("ALIVE",):
+                out["pending_reason"] = info.get("pending_reason")
+        pg = self.pgs.get(id)
+        if pg is not None:
+            out["kind"] = "pg"
+            out["pg"] = pg
+            out["state"] = pg.get("state")
+            if pg.get("state") == "PENDING":
+                out["pending_reason"] = pg.get("pending_reason")
+        label = out.get("name")
+        decisions = [rec for rec in self.sched_decisions
+                     if self._decision_mentions(rec, id)
+                     or (label is not None and rec.get("label") == label)]
+        decisions.sort(key=lambda r: r.get("ts", 0.0))
+        out["decisions"] = decisions[-100:]
+        return out
+
+    async def handle_sched_stats(self):
+        """Control-plane saturation rollup: per-handler cumulative busy
+        seconds + call counts, the GCS loop's busy fraction, and ring
+        occupancy — what ``raytpu status`` / ``/api/sched`` /
+        bench_scale.py read to name the bottleneck."""
+        mon = getattr(self, "_loop_monitor", None)
+        busy = {m: round(s, 6) for m, s in self._handler_busy.items()}
+        top = sorted(busy.items(), key=lambda kv: kv[1], reverse=True)
+        return {
+            "handler_busy_s": busy,
+            "handler_calls": dict(self._handler_calls),
+            "top_handlers": top[:10],
+            "loop_busy_fraction": getattr(mon, "busy_fraction", None),
+            "loop_stalls": getattr(mon, "stall_count", None),
+            "decision_ring_len": len(self.sched_decisions),
+            "task_events_dropped": self.task_events_dropped,
+            "sched_metrics_enabled": sched_explain.enabled(),
+        }
 
     # ------------------------------------------------------------- debug/info
 

@@ -31,7 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from . import chaos, external_spill
+from . import chaos, external_spill, sched_explain
 from .common import ResourceSet, TaskSpec, detect_node_resources
 from .config import get_config
 from .external_spill import EXTERNAL_NODE_ID, is_external_address
@@ -231,6 +231,13 @@ class NodeAgent:
         self._chaos_kill_task: Optional[asyncio.Task] = None
         self._chaos_runtime_spec: Optional[dict] = None
         self._chaos_runtime_applied = False
+        # Backpressure-reject accounting (the lease-queue admission
+        # control's visible half): plain counters always (node_info,
+        # bench_scale read them), mirrored into
+        # raytpu_sched_backpressure_total{node,reason} when
+        # sched_metrics_enabled.  reason in {"depth", "draining"}.
+        self._bp_rejects: Dict[str, int] = {}
+        self._bp_keys: Dict[str, tuple] = {}
         # worker_id -> memory-monitor kill cause, consumed by the lease
         # return so the owner raises a typed OutOfMemoryError.
         self._oom_kills: Dict[str, str] = {}
@@ -508,6 +515,12 @@ class NodeAgent:
         prev_state = w.state
         w.state = "DEAD"
         self.workers.pop(w.worker_id, None)
+        # drop the dead worker's pushed metric snapshot: under worker
+        # churn the per-reporter map would otherwise keep one stale
+        # registry copy per dead worker forever (every scrape re-renders
+        # them as live series)
+        if hasattr(self, "_metrics"):
+            self._metrics.pop(f"worker-{w.worker_id[:12]}", None)
         await self._drain_read_pins(w.address)
         # Wake any _grant_lease waiter parked on registration (a worker that
         # crashes during boot must fail the grant now, not after the full
@@ -546,6 +559,8 @@ class NodeAgent:
         was_dead = w.state == "DEAD"
         w.state = "DEAD"
         self.workers.pop(w.worker_id, None)
+        if hasattr(self, "_metrics"):  # see _on_worker_exit
+            self._metrics.pop(f"worker-{w.worker_id[:12]}", None)
         if not was_dead:
             await self._drain_read_pins(w.address)
         # Release any lease the victim held (kill paths bypass _on_worker_exit,
@@ -614,6 +629,18 @@ class NodeAgent:
         self._lease_counter += 1
         return f"{self.node_id.hex()[:8]}-{self._lease_counter}"
 
+    def _note_backpressure(self, reason: str):
+        """Count a backpressure-rejected lease request (reason: "depth" =
+        lease queue at its bound, "draining" = preemption notice)."""
+        self._bp_rejects[reason] = self._bp_rejects.get(reason, 0) + 1
+        c = sched_explain.backpressure_counter()
+        if c is not None:
+            key = self._bp_keys.get(reason)
+            if key is None:
+                key = self._bp_keys[reason] = (
+                    ("node", self.node_id.hex()[:12]), ("reason", reason))
+            c.inc_key(key)
+
     def _resource_pool_for(self, bundle: Optional[Tuple[str, int]]) -> ResourceSet:
         if bundle is not None:
             rs = self.bundles.get(tuple(bundle))
@@ -675,6 +702,7 @@ class NodeAgent:
         spillback / infeasible), preserving those semantics unchanged."""
         count = max(1, int(count))
         if self._draining:
+            self._note_backpressure("draining")
             return {"backpressure": True,
                     "retry_after_s": get_config().lease_backpressure_retry_s}
         pending = []
@@ -733,6 +761,7 @@ class NodeAgent:
             # folds this into node re-picking exactly like depth-bound
             # backpressure, and the GCS view's draining flag keeps fresh
             # picks away
+            self._note_backpressure("draining")
             return {"backpressure": True,
                     "retry_after_s": get_config().lease_backpressure_retry_s}
         pool = self._resource_pool_for(bundle)
@@ -753,6 +782,7 @@ class NodeAgent:
             # would grow agent memory without bound under a million-task
             # burst (every parked request pins a future + writer ref).
             # Tell the owner to back off and re-route instead.
+            self._note_backpressure("depth")
             return {"backpressure": True,
                     "retry_after_s": cfg.lease_backpressure_retry_s}
         fut = asyncio.get_event_loop().create_future()
@@ -1198,6 +1228,7 @@ class NodeAgent:
         for req in list(self.lease_queue):
             self.lease_queue.remove(req)
             if not req.future.done():
+                self._note_backpressure("draining")
                 req.future.set_result(
                     {"backpressure": True,
                      "retry_after_s": cfg.lease_backpressure_retry_s})
@@ -2536,6 +2567,11 @@ class NodeAgent:
                 "store": self.store.stats(),
                 "oom_kills": self._oom_kill_count,
                 "queue_len": len(self.lease_queue),
+                "draining": self._draining,
+                "backpressure_rejects": dict(self._bp_rejects),
+                "loop_busy_fraction": getattr(
+                    getattr(self, "_loop_monitor", None),
+                    "busy_fraction", None),
                 "queued_demands": [r.resources for r in self.lease_queue],
                 "cluster_view": {nid: {"available": v.available, "alive": v.alive}
                                  for nid, v in self.cluster_view.items()}}

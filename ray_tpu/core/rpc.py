@@ -424,6 +424,55 @@ class RemoteError(RpcError):
         return (RemoteError, (self.cause, self.remote_traceback))
 
 
+class _BusyTimed:
+    """Await a coroutine while accumulating the duration of each of its
+    SYNCHRONOUS segments (the stretches between suspension points) into
+    ``acc[0]``.
+
+    Driving the inner coroutine's ``__await__`` iterator by hand lets the
+    wrapper clock every ``send``/``throw`` — so a handler that parks 30 s
+    in a long-poll attributes only the slivers it actually ran, while a
+    handler that pickles a 10 MB table attributes all of it.  That
+    distinction is the whole point: wall-time histograms
+    (raytpu_rpc_server_seconds) can't tell "slow because busy" from
+    "slow because waiting".  Segments are timed with ``perf_counter``,
+    not the thread-CPU clock: a synchronous segment monopolizes the event
+    loop for its full wall duration (GIL waits included), and that —
+    "how long did this handler block the loop" — is the saturation
+    signal; the thread-CPU clock also ticks too coarsely (10 ms on some
+    kernels) to see microsecond handlers at all."""
+
+    __slots__ = ("coro", "acc")
+
+    def __init__(self, coro, acc):
+        self.coro = coro
+        self.acc = acc
+
+    def __await__(self):
+        it = self.coro.__await__()
+        acc = self.acc
+        val, exc = None, None
+        while True:
+            t0 = time.perf_counter()
+            try:
+                if exc is not None:
+                    e, exc = exc, None
+                    y = it.throw(e)
+                else:
+                    y = it.send(val)
+            except StopIteration as e:
+                acc[0] += time.perf_counter() - t0
+                return e.value
+            except BaseException:
+                acc[0] += time.perf_counter() - t0
+                raise
+            acc[0] += time.perf_counter() - t0
+            try:
+                val = yield y
+            except BaseException as e:  # noqa: BLE001 — forwarded inward
+                val, exc = None, e
+
+
 class RpcServer:
     """Dispatches ``(req_id, method, kwargs)`` to ``handler.handle_<method>`` coroutines."""
 
@@ -439,6 +488,13 @@ class RpcServer:
         self.port = port
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
+        #: optional per-handler BUSY-seconds attribution callback
+        #: ``(method, busy_s) -> None`` — when set (the GCS does, behind
+        #: sched_metrics_enabled), each dispatch drives the handler
+        #: coroutine through ``_BusyTimed`` and reports the time its
+        #: synchronous segments blocked the loop (awaits excluded), the
+        #: signal that names which handler is eating the event loop.
+        self.busy_cb = None
         # Idempotency dedup window (reference: exactly-once semantics for
         # retried mutating RPCs): token -> (expiry, in-flight future |
         # (ok, result), approx_bytes).  A retry carrying a token already
@@ -585,7 +641,17 @@ class RpcServer:
                         # routes them to its on_push handler) before the
                         # final reply.
                         kwargs["_writer"] = writer
-                    result = await fn(**kwargs)
+                    if self.busy_cb is not None:
+                        acc = [0.0]
+                        try:
+                            result = await _BusyTimed(fn(**kwargs), acc)
+                        finally:
+                            try:
+                                self.busy_cb(method, acc[0])
+                            except Exception:
+                                pass
+                    else:
+                        result = await fn(**kwargs)
                     ok = True
                 except BaseException as e:  # noqa: BLE001 — errors travel back
                     result = (e, traceback.format_exc())

@@ -56,22 +56,65 @@ class NodeView:
 _spread_rr = {"i": 0}
 
 
+def _note_rejections(explain: Optional[dict], view: Dict[str, NodeView],
+                     demand: Dict[str, float]):
+    """Fill an explain record's per-node rejection causes for the nodes the
+    policy will never consider: dead, draining, or infeasible for the
+    demand shape.  Causes use the bounded REJECT_CAUSES vocabulary
+    (core/sched_explain.py) — they become event fields, never free-form."""
+    if explain is None:
+        return
+    rejected = explain.setdefault("rejected", {})
+    for nid, n in view.items():
+        if not n.alive:
+            rejected[nid] = "dead"
+        elif not n.feasible(demand):
+            # infeasible beats draining: a node that could NEVER host the
+            # shape is a resource rejection whatever its drain state —
+            # "draining" is reserved for nodes the drain is actually
+            # costing us (feasible but routed around), which is what maps
+            # a failed pick to NODE_DRAINING vs NO_RESOURCES
+            rejected[nid] = "resources"
+        elif n.draining:
+            rejected[nid] = "draining"
+    explain["candidates"] = len(view)
+
+
 def pick_node(view: Dict[str, NodeView],
               demand: Dict[str, float],
               strategy="DEFAULT",
               local_node_id: Optional[str] = None,
-              rng: random.Random | None = None) -> Optional[str]:
-    """Return the chosen node_id hex, or None if no feasible node exists."""
+              rng: random.Random | None = None,
+              explain: Optional[dict] = None) -> Optional[str]:
+    """Return the chosen node_id hex, or None if no feasible node exists.
+
+    ``explain``, when a dict, is filled with the structured decision
+    record: ``candidates`` (nodes in view), ``rejected`` ({node: cause}
+    for every node the policy ruled out), ``chosen``.  The None-explain
+    path pays nothing — the explain plane's callers (GCS scheduling
+    loops, owner lease acquisition) opt in per decision."""
     rng = rng or random
     alive = {nid: n for nid, n in view.items()
              if n.alive and not n.draining}
+    _note_rejections(explain, view, demand)
+
+    def chose(nid: Optional[str]) -> Optional[str]:
+        if explain is not None:
+            explain["chosen"] = nid
+        return nid
 
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         n = alive.get(strategy.node_id)
         if n is not None and n.feasible(demand):
-            return strategy.node_id
+            return chose(strategy.node_id)
         if not strategy.soft:
-            return None
+            if explain is not None and strategy.node_id not in (
+                    explain.get("rejected") or {}):
+                # the pinned node exists but cannot take it — an affinity
+                # miss, not a resource shortage
+                explain.setdefault("rejected", {})[strategy.node_id] = \
+                    "affinity"
+            return chose(None)
         strategy = "DEFAULT"
 
     if isinstance(strategy, NodeLabelSchedulingStrategy):
@@ -80,39 +123,44 @@ def pick_node(view: Dict[str, NodeView],
         hard = [nid for nid, n in alive.items()
                 if n.feasible(demand) and match(n, strategy.hard)]
         if not hard:
-            return None
+            if explain is not None:
+                rej = explain.setdefault("rejected", {})
+                for nid, n in alive.items():
+                    if n.feasible(demand):
+                        rej.setdefault(nid, "affinity")
+            return chose(None)
         soft = [nid for nid in hard if match(alive[nid], strategy.soft)]
         pool = soft or hard
-        return rng.choice(pool)
+        return chose(rng.choice(pool))
 
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
         # Resolved earlier into a NodeAffinity by the PG manager; reaching here
         # means the bundle lookup failed.
-        return None
+        return chose(None)
 
     feasible = [nid for nid, n in alive.items() if n.feasible(demand)]
     if not feasible:
-        return None
+        return chose(None)
     fit_now = [nid for nid in feasible if alive[nid].can_fit_now(demand)]
 
     if strategy == "SPREAD":
         pool = fit_now or feasible
         pool = sorted(pool)
         _spread_rr["i"] = (_spread_rr["i"] + 1) % len(pool)
-        return pool[_spread_rr["i"]]
+        return chose(pool[_spread_rr["i"]])
 
     # DEFAULT: hybrid policy.
     cfg = get_config()
     if (local_node_id is not None and local_node_id in alive
             and alive[local_node_id].can_fit_now(demand)
             and alive[local_node_id].utilization() < cfg.scheduler_spread_threshold):
-        return local_node_id
+        return chose(local_node_id)
 
     pool = fit_now or feasible
     ranked = sorted(pool, key=lambda nid: (alive[nid].utilization(), alive[nid].queue_len))
     k = max(cfg.scheduler_top_k_absolute,
             int(len(ranked) * cfg.scheduler_top_k_fraction))
-    return rng.choice(ranked[:k])
+    return chose(rng.choice(ranked[:k]))
 
 
 def _ici_coord(n: NodeView) -> Optional[tuple]:
@@ -141,7 +189,20 @@ def _ici_span(coords: List[tuple]) -> int:
 
 
 def pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
-                 strategy: str) -> Optional[List[str]]:
+                 strategy: str,
+                 explain: Optional[dict] = None) -> Optional[List[str]]:
+    """Explain-aware wrapper over the packing policies: fills the decision
+    record (``rejected`` causes, ``bundles``, ``chosen`` placement) when a
+    dict is passed, at zero cost otherwise."""
+    placement = _pack_bundles(view, bundles, strategy, explain)
+    if explain is not None:
+        explain["chosen"] = placement
+    return placement
+
+
+def _pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
+                  strategy: str,
+                  explain: Optional[dict] = None) -> Optional[List[str]]:
     """Placement-group bundle packing (reference: bundle_scheduling_policy.h)
     with the TPU extension SURVEY §2.3 calls for: nodes carrying
     ``tpu_slice``/``ici_coord`` labels are packed ICI-contiguously.
@@ -157,6 +218,13 @@ def pack_bundles(view: Dict[str, NodeView], bundles: List[Dict[str, float]],
     alive = {nid: NodeView(n.node_id, n.address, dict(n.total), dict(n.available),
                            n.labels, n.alive, n.queue_len)
              for nid, n in view.items() if n.alive and not n.draining}
+    if explain is not None:
+        # per-node rejection causes against the largest single bundle: the
+        # shape a node must at least be able to hold to host any of them
+        biggest = max(bundles, key=lambda b: sum(b.values())) if bundles \
+            else {}
+        _note_rejections(explain, view, biggest)
+        explain["bundles"] = len(bundles)
 
     def try_place(order_nodes_for_bundle) -> Optional[List[str]]:
         placement: List[str] = []

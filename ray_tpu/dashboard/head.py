@@ -16,6 +16,9 @@ Reference endpoints mirrored (dashboard/modules/*):
   POST /api/jobs/{id}/stop     stop a job
   GET  /api/serve              serve app status + per-deployment SLO rollup
   GET  /api/serve/signal       SLO autoscaler signal (queue depth, TTFT pXX)
+  GET  /api/sched              scheduler explain plane: pending reasons,
+                               decision-ring tail, GCS handler busy seconds
+                               (?limit=N&id=<task|actor|pg>)
   GET  /api/timeline           chrome://tracing export (timeline)
 
 Runs inside the driver (``start_dashboard()``) or as a standalone actor.
@@ -386,6 +389,29 @@ class DashboardHead:
                       "total_tasks": summary.get("total_tasks", 0),
                       "stage_latency": summary.get("stage_latency", {})})
 
+    async def sched(self, req):
+        """Scheduler explain plane rollup: pending-reason counts, the
+        decision-ring tail, per-GCS-handler busy seconds and per-loop
+        busy fractions (query params: ``limit`` for the ring tail,
+        ``id`` to filter records to one task/actor/pg)."""
+        from ray_tpu.util import state
+        try:
+            limit = int(req.query.get("limit", 100))
+        except ValueError:
+            limit = 100
+        want_id = req.query.get("id")
+
+        def collect():
+            summary = state.summarize_tasks()
+            return {
+                "pending_reasons": summary.get("pending_reasons", {}),
+                "total_tasks": summary.get("total_tasks", 0),
+                "stats": state.sched_stats(),
+                "decisions": state.sched_decisions(limit=limit, id=want_id),
+            }
+
+        return _json({"ts": time.time(), **await _off(collect)})
+
     async def workflow_send_event(self, req):
         """HTTP event provider (reference: workflow/http_event_provider.py):
         external systems POST a JSON payload here to unblock every workflow
@@ -508,6 +534,7 @@ class DashboardHead:
         r.add_get("/api/metrics", self.metrics)
         r.add_get("/api/metrics/history", self.metrics_history)
         r.add_get("/api/telemetry", self.telemetry)
+        r.add_get("/api/sched", self.sched)
         r.add_get("/api/tasks/summarize", self.tasks_summarize)
         r.add_get("/api/objects", self.objects)
         r.add_get("/api/placement_groups", self.placement_groups)

@@ -149,14 +149,54 @@ const pages = {
     const stages = Object.entries(data.stage_latency || {}).filter(([, s]) => s);
     return h("div", {},
       h("h2", {}, "Node telemetry"),
-      table(["node", "workers", "queue", "store used", "capacity", "pinned", "oom kills"],
+      table(["node", "workers", "queue", "busy", "bp rejects", "store used",
+        "capacity", "pinned", "oom kills"],
         nodes.map(([nid, i]) => [nid,
           i.num_workers ?? "?", i.queue_len ?? "?",
+          i.loop_busy_fraction == null ? "-"
+            : `${Math.round(i.loop_busy_fraction * 100)}%`,
+          Object.entries(i.backpressure_rejects || {})
+            .map(([k, v]) => `${k}:${v}`).join(" ") || "0",
           fmtB((i.store || {}).used), fmtB((i.store || {}).capacity),
           (i.store || {}).num_pinned ?? "?", i.oom_kills ?? 0])),
       h("h2", {}, `Task stages (${data.total_tasks || 0} tasks)`),
       table(["stage", "count", "p50", "p90", "p99", "max"],
         stages.map(([k, s]) => [k, s.count, ms(s.p50), ms(s.p90), ms(s.p99), ms(s.max)])));
+  },
+
+  async sched() {
+    /* Scheduler explain plane (/api/sched): pending-reason rollup,
+       control-plane saturation (GCS loop busy fraction + per-handler
+       busy seconds) and the decision-ring tail. */
+    const d = await api("sched");
+    const stats = d.stats || {};
+    const busy = stats.loop_busy_fraction;
+    const reasons = Object.entries(d.pending_reasons || {});
+    const handlers = (stats.top_handlers || []).slice(0, 12);
+    const calls = stats.handler_calls || {};
+    const decisions = (d.decisions || []).slice(0, 60);
+    return h("div", {},
+      h("h2", {}, "Scheduler"),
+      h("div", { class: "cards" },
+        card("GCS loop busy", busy == null ? "-" : `${Math.round(busy * 100)}%`),
+        card("decision ring", stats.decision_ring_len ?? "-"),
+        card("events dropped", stats.task_events_dropped ?? 0),
+        card("sched metrics", stats.sched_metrics_enabled ? "on" : "OFF")),
+      h("h2", {}, "Pending tasks by reason"),
+      table(["reason", "count"],
+        reasons.map(([r, n]) => [badge(r), n])),
+      h("h2", {}, "GCS handlers by busy seconds"),
+      table(["handler", "busy s", "calls"],
+        handlers.map(([m, s]) => [m, s.toFixed(3), calls[m] ?? ""])),
+      h("h2", {}, `Decisions (${decisions.length} newest)`),
+      table(["time", "kind", "label", "outcome", "node", "rejected", "queue"],
+        decisions.map((r) => [
+          new Date((r.ts || 0) * 1000).toLocaleTimeString(),
+          r.kind || "", r.label || "", badge(r.outcome),
+          (r.node || "").slice(0, 12),
+          Object.entries(r.rejected || {}).slice(0, 4)
+            .map(([n, c]) => `${n.slice(0, 8)}=${c}`).join(" "),
+          r.task_count ?? ""])));
   },
 
   async pgs() {

@@ -158,6 +158,38 @@ def cmd_status(args):
         print(f"  {k}: {avail.get(k, 0):.1f}/{total[k]:.1f} available")
     _print_node_telemetry(rt, nodes)
     _print_stage_summary()
+    _print_sched_summary()
+
+
+def _print_sched_summary():
+    """Pending-reason rollup + control-plane saturation line: which typed
+    reason the non-running tasks are waiting on, how busy the GCS loop is
+    and which handlers are eating it (the explain plane's status view)."""
+    from ray_tpu.util import state as state_api
+
+    try:
+        summary = state_api.summarize_tasks()
+        stats = state_api.sched_stats()
+    except Exception:
+        return
+    reasons = {k: v for k, v in
+               (summary.get("pending_reasons") or {}).items() if v}
+    if reasons:
+        print("pending tasks by reason:")
+        for reason, n in sorted(reasons.items(), key=lambda kv: -kv[1]):
+            print(f"  {reason:<18} {n}")
+    busy = stats.get("loop_busy_fraction")
+    parts = []
+    if busy is not None:
+        parts.append(f"gcs loop busy={busy * 100:.0f}%")
+    top = [(m, s) for m, s in (stats.get("top_handlers") or [])[:3] if s]
+    if top:
+        parts.append("top handlers: " + ", ".join(
+            f"{m}={s:.2f}s" for m, s in top))
+    if stats.get("task_events_dropped"):
+        parts.append(f"events_dropped={stats['task_events_dropped']}")
+    if parts:
+        print("control plane: " + "  ".join(parts))
 
 
 def _print_node_telemetry(rt, nodes):
@@ -195,12 +227,22 @@ def _print_node_telemetry(rt, nodes):
             print("telemetry:")
             printed_header = True
         st = info.get("store", {})
-        print(f"  {info['node_id'][:12]}  workers={info['num_workers']} "
-              f"queue={info.get('queue_len', 0)} "
-              f"store={_fmt_bytes(st.get('used', 0))}"
-              f"/{_fmt_bytes(st.get('capacity', 0))} "
-              f"pinned={st.get('num_pinned', 0)} "
-              f"oom_kills={info.get('oom_kills', 0)}")
+        busy = info.get("loop_busy_fraction")
+        bp = info.get("backpressure_rejects") or {}
+        line = (f"  {info['node_id'][:12]}  workers={info['num_workers']} "
+                f"queue={info.get('queue_len', 0)} "
+                f"store={_fmt_bytes(st.get('used', 0))}"
+                f"/{_fmt_bytes(st.get('capacity', 0))} "
+                f"pinned={st.get('num_pinned', 0)} "
+                f"oom_kills={info.get('oom_kills', 0)}")
+        if busy is not None:
+            line += f" busy={busy * 100:.0f}%"
+        if bp:
+            line += " bp_rejects=" + ",".join(
+                f"{k}:{v}" for k, v in sorted(bp.items()))
+        if info.get("draining"):
+            line += " DRAINING"
+        print(line)
 
 
 def _print_stage_summary():
@@ -222,6 +264,77 @@ def _print_stage_summary():
         print(f"  {stage:<12} {s['count']:>6} {s['p50'] * 1e3:>8.1f}ms "
               f"{s['p90'] * 1e3:>8.1f}ms {s['p99'] * 1e3:>8.1f}ms "
               f"{s['max'] * 1e3:>8.1f}ms")
+
+
+def cmd_explain(args):
+    """``raytpu explain <task|actor|pg id>`` — the full decision trail:
+    current state, typed pending-reason transitions with timestamps, and
+    every scheduler decision record that mentions the id (candidates,
+    per-node rejection causes, outcome).  The stuck-task debugging
+    entry point (see README "Debugging a stuck task")."""
+    _connect()
+    from ray_tpu.util import state as state_api
+
+    report = state_api.explain(args.id)
+    if getattr(args, "json", False):
+        print(json.dumps(report, indent=2, default=str))
+        return
+    if report.get("kind") is None:
+        print(f"no task/actor/pg with id {args.id!r} in the event window")
+        return
+    kind = report["kind"]
+    name = report.get("name") or (report.get("actor") or {}).get(
+        "class_name") or (report.get("pg") or {}).get("name") or ""
+    head = f"{kind} {name} ({args.id[:16]}) — {report.get('state', '?')}"
+    if report.get("pending_reason"):
+        head += f" [{report['pending_reason']}]"
+    print(head)
+    if kind == "actor" and report.get("actor"):
+        a = report["actor"]
+        if a.get("node_id"):
+            print(f"  node={a['node_id'][:12]} restarts_left="
+                  f"{a.get('restarts_left')}")
+        if a.get("death_cause"):
+            print(f"  death_cause: {a['death_cause']}")
+    if kind == "pg" and report.get("pg"):
+        p = report["pg"]
+        print(f"  strategy={p.get('strategy')} bundles="
+              f"{len(p.get('bundles') or [])}")
+    events = [e for e in (report.get("events") or [])
+              if e.get("state") not in ("STAGES", "SPAN")]
+    if events:
+        t0 = events[0].get("ts", 0.0)
+        print("event trail:")
+        for ev in events:
+            line = (f"  +{ev.get('ts', 0.0) - t0:8.3f}s  "
+                    f"{ev.get('state', '?'):<10}")
+            if ev.get("reason"):
+                line += f" {ev['reason']}"
+            for k in ("node", "node_id", "actor", "error"):
+                if ev.get(k):
+                    line += f" {k}={str(ev[k])[:40]}"
+            print(line)
+    decisions = report.get("decisions") or []
+    print(f"decisions ({len(decisions)}):")
+    for rec in decisions[-20:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(rec.get("ts", 0)))
+        line = f"  {ts}  {rec.get('outcome', '?'):<12}"
+        if rec.get("node"):
+            line += f" node={str(rec['node'])[:12]}"
+        if rec.get("candidates") is not None:
+            line += f" candidates={rec['candidates']}"
+        rejected = rec.get("rejected") or {}
+        if rejected:
+            line += " rejected: " + ", ".join(
+                f"{nid[:12]}={cause}" for nid, cause in
+                list(rejected.items())[:6])
+        if rec.get("reason"):
+            line += f" -> {rec['reason']}"
+        if rec.get("task_count") is not None:
+            line += f" (queue={rec['task_count']})"
+        print(line)
+    if not decisions and not events:
+        print("  (no records — was the id right, and did it age out?)")
 
 
 def cmd_list(args):
@@ -361,7 +474,7 @@ def _render_top(store, alive_nodes) -> str:
     lines = [f"raytpu top — {len(alive_nodes)} node(s) @ "
              f"{time.strftime('%H:%M:%S')}",
              f"{'NODE':<14} {'CPU':>9} {'SHM':>19} {'LEASEQ':>6} "
-             f"{'LOOPLAG':>8} {'WORKERS':>7}"]
+             f"{'LOOPLAG':>8} {'BUSY':>5} {'WORKERS':>7}"]
     for nid, _row in alive_nodes:
         s = latest.get(nid)
         if not s or "error" in s:
@@ -381,10 +494,14 @@ def _render_top(store, alive_nodes) -> str:
         leaseq = find_one(s, "raytpu_node_lease_queue_len", node=nid)
         lag = find_samples(s, "raytpu_event_loop_lag_seconds")
         lags = f"{max(lag) * 1e3:.0f}ms" if lag else "-"
+        # saturation plane: worst per-process event-loop busy fraction
+        # reported by this node's registry (gcs/agent/driver/workers)
+        busy = find_samples(s, "raytpu_loop_busy_fraction")
+        busys = f"{max(busy) * 100:.0f}%" if busy else "-"
         nworkers = find_one(s, "raytpu_node_workers", node=nid)
         lines.append(f"{nid:<14} {cpu:>9} {shm:>19} "
                      f"{int(leaseq) if leaseq is not None else '-':>6} "
-                     f"{lags:>8} "
+                     f"{lags:>8} {busys:>5} "
                      f"{int(nworkers) if nworkers is not None else '-':>7}")
 
     # train rollup: raytpu_train_* series land on the agent of whichever
@@ -716,6 +833,13 @@ def main(argv=None):
     s.add_argument("--config", required=True)
     s.add_argument("--state", default=None)
     s.set_defaults(fn=cmd_down)
+
+    s = sub.add_parser("explain", help="decision trail for one task/actor/"
+                       "pg id: pending reason transitions + scheduler "
+                       "decision records (why is it not running?)")
+    s.add_argument("id", help="task / actor / placement-group id (hex)")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_explain)
 
     s = sub.add_parser("list", help="state API listings")
     s.add_argument("kind")

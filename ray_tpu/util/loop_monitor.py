@@ -67,6 +67,31 @@ def _lag_gauges():
     return _lag_gauges_get()
 
 
+def _build_busy_gauge():
+    from ray_tpu.util.metrics import Gauge
+    return Gauge("raytpu_loop_busy_fraction",
+                 "fraction of wall time the event-loop thread spent on-CPU "
+                 "over the last sampling window (thread-CPU clock deltas "
+                 "measured from inside the loop)",
+                 tag_keys=("process",))
+
+
+_busy_gauge_get = None
+
+
+def _busy_gauge():
+    """Gauge behind the sched_metrics_enabled kill switch: never
+    constructed (zero series) while the switch is off."""
+    from ray_tpu.core import sched_explain
+    if not sched_explain.enabled():
+        return None
+    global _busy_gauge_get
+    if _busy_gauge_get is None:
+        from ray_tpu.util.metrics import lazy
+        _busy_gauge_get = lazy(_build_busy_gauge)
+    return _busy_gauge_get()
+
+
 def format_loop_stack(thread_id: Optional[int]) -> str:
     """Render the current stack of one thread (the loop's) — the
     blocking frame is the deepest application frame."""
@@ -85,17 +110,35 @@ class LoopMonitor:
     coverage with separate sanitizer CI builds; this rides along.
     """
 
+    #: minimum window over which one busy-fraction sample is computed
+    BUSY_WINDOW_S = 0.5
+
     def __init__(self, loop, threshold_s: float = 0.5,
                  interval_s: float = 0.1,
                  on_stall: Optional[Callable[[float, str], None]] = None,
-                 source: str = ""):
+                 source: str = "", busy_enabled: bool = False,
+                 stall_gauges: bool = True):
         self.loop = loop
         self.threshold_s = float(threshold_s)
         self.interval_s = float(interval_s)
         self.on_stall = on_stall
         self.source = source
+        #: export the lag/stall gauges (loop_monitor_enabled scope); a
+        #: busy-only monitor (sched_metrics_enabled alone) must not grow
+        #: series outside its documented kill switch
+        self.stall_gauges = bool(stall_gauges)
         self.stall_count = 0
         self.worst_stall_s = 0.0
+        # Busy-fraction sampling (the control-plane saturation signal):
+        # the echo callback runs ON the loop thread, where
+        # time.thread_time() reads that thread's CPU clock — so
+        # delta(cpu)/delta(wall) between echoes is exactly the fraction of
+        # wall time the loop spent executing callbacks vs parked in epoll.
+        # This is what turns "tasks_async is slow" into "the owner loop is
+        # 97% busy" (vs "the loop is idle; the bottleneck is elsewhere").
+        self.busy_enabled = bool(busy_enabled)
+        self.busy_fraction = 0.0
+        self._busy_prev: Optional[tuple] = None  # (wall, thread_cpu)
         self._last_echo = time.monotonic()
         self._loop_thread_id: Optional[int] = None
         self._reported_current = False
@@ -107,6 +150,16 @@ class LoopMonitor:
         self._last_echo = time.monotonic()
         self._loop_thread_id = threading.get_ident()
         self._reported_current = False
+        if self.busy_enabled:
+            now, cpu = time.monotonic(), time.thread_time()
+            prev = self._busy_prev
+            if prev is None:
+                self._busy_prev = (now, cpu)
+            elif now - prev[0] >= self.BUSY_WINDOW_S:
+                dt = now - prev[0]
+                self.busy_fraction = min(1.0, max(0.0,
+                                                  (cpu - prev[1]) / dt))
+                self._busy_prev = (now, cpu)
 
     # -- monitor thread ----------------------------------------------------
     def _run(self):
@@ -117,7 +170,7 @@ class LoopMonitor:
                 return
             self._stop.wait(self.interval_s)
             overdue = time.monotonic() - self._last_echo
-            if self.source:
+            if self.source and self.stall_gauges:
                 # a healthy loop echoes within ~interval_s of the probe, so
                 # lag is whatever the echo is overdue beyond that
                 g = _lag_gauges()
@@ -127,6 +180,18 @@ class LoopMonitor:
                         g[0].set(max(0.0, overdue - self.interval_s), tags)
                         g[1].set(self.stall_count, tags)
                         g[2].set(self.worst_stall_s, tags)
+                    except Exception:
+                        pass
+            if self.source and self.busy_enabled:
+                bg = _busy_gauge()
+                if bg is not None:
+                    try:
+                        # process KIND only ("worker", "driver", "gcs",
+                        # "node_agent"...): the per-process id suffix would
+                        # be an unbounded tag value under worker churn —
+                        # the reporter label already separates processes
+                        bg.set(self.busy_fraction,
+                               {"process": self.source.split(":", 1)[0]})
                     except Exception:
                         pass
             if overdue > self.threshold_s:
@@ -173,11 +238,20 @@ def install(loop, source: str, gcs_call=None) -> Optional[LoopMonitor]:
     wedged, so it must never await; the distress event is enqueued via
     ``call_soon_threadsafe`` and flushes once the loop recovers — late,
     but carrying the stack captured DURING the stall, which is the part
-    that matters."""
+    that matters.
+
+    The saturation plane rides the same probe: with
+    ``sched_metrics_enabled`` on, the monitor installs even when stall
+    reporting is off and samples the loop's busy fraction
+    (``raytpu_loop_busy_fraction{process}``) — stall events remain gated
+    on ``loop_monitor_enabled``."""
+    from ray_tpu.core import sched_explain
     from ray_tpu.core.config import get_config
 
     cfg = get_config()
-    if not getattr(cfg, "loop_monitor_enabled", False):
+    stalls = getattr(cfg, "loop_monitor_enabled", False)
+    busy = sched_explain.enabled()
+    if not stalls and not busy:
         return None
 
     def on_stall(stall_s: float, stack: str):
@@ -199,5 +273,6 @@ def install(loop, source: str, gcs_call=None) -> Optional[LoopMonitor]:
             pass
 
     mon = LoopMonitor(loop, threshold_s=cfg.loop_monitor_threshold_s,
-                      on_stall=on_stall, source=source)
+                      on_stall=on_stall if stalls else None, source=source,
+                      busy_enabled=busy, stall_gauges=stalls)
     return mon.start()

@@ -143,14 +143,41 @@ def memory_summary() -> Dict[str, Any]:
     return {"nodes": nodes, "objects": _annotate_memory_rows(w, rows)}
 
 
+def explain(id: str) -> Dict[str, Any]:
+    """The scheduler's decision trail for one task / actor / placement
+    group id (hex): typed pending-reason transitions, the structured
+    decision records that mention it (candidates considered, per-node
+    rejection causes, outcome), and its current state — the programmatic
+    face of ``raytpu explain <id>``."""
+    return _gcs_call("explain", id=id)
+
+
+def sched_stats() -> Dict[str, Any]:
+    """Control-plane saturation rollup from the GCS: per-handler
+    cumulative busy seconds (time each handler blocked the GCS loop),
+    the GCS loop's busy fraction, and decision-ring occupancy."""
+    return _gcs_call("sched_stats")
+
+
+def sched_decisions(limit: int = 200, id: str | None = None,
+                    kind: str | None = None) -> List[Dict[str, Any]]:
+    """Tail of the GCS scheduler decision ring, newest first."""
+    return _gcs_call("get_sched_decisions", limit=limit, id=id, kind=kind)
+
+
 def summarize_tasks() -> Dict[str, Any]:
-    """Task-state rollup + per-stage latency percentiles.
+    """Task-state rollup + per-stage latency percentiles + pending-reason
+    rollup.
 
     ``stage_latency`` aggregates the lifecycle breakdown: owner-side
     ``queue`` (submit -> dispatch) and ``total`` (submit -> terminal)
     durations ride RUNNING/FINISHED events; executor-side ``dep_fetch`` /
     ``arg_deser`` / ``execute`` / ``result_put`` ride STAGES events
-    (``CoreWorker._record_stages``)."""
+    (``CoreWorker._record_stages``).
+
+    ``pending_reasons`` counts every task whose NEWEST event is
+    non-terminal by its typed reason (core/sched_explain.PendingReason);
+    queued tasks never stamped with a reason count under ``SUBMITTED``."""
     from ray_tpu.util.metrics import latency_summary
 
     events = _gcs_call("list_task_events", limit=100_000)
@@ -178,11 +205,18 @@ def summarize_tasks() -> Dict[str, Any]:
             prev = latest.get(tid)
             if prev is None or ev.get("ts", 0.0) > prev.get("ts", 0.0):
                 latest[tid] = ev
+    pending_reasons: collections.Counter = collections.Counter()
     for ev in latest.values():
-        by_name[ev.get("name", "?")][ev.get("state", "?")] += 1
+        state = ev.get("state", "?")
+        by_name[ev.get("name", "?")][state] += 1
+        if state == "PENDING":
+            pending_reasons[ev.get("reason") or "UNKNOWN"] += 1
+        elif state == "SUBMITTED":
+            pending_reasons["SUBMITTED"] += 1
     return {"cluster": {name: dict(states)
                         for name, states in sorted(by_name.items())},
             "total_tasks": len(latest),
+            "pending_reasons": dict(pending_reasons),
             "stage_latency": {stage: latency_summary(samples)
                               for stage, samples
                               in sorted(stage_samples.items())}}

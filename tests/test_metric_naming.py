@@ -223,6 +223,144 @@ def test_train_metric_tag_keys_are_bounded():
     assert seen >= 8, f"only {seen} raytpu_train_ metrics found"
 
 
+# --------------------------------------------------- scheduler cardinality
+
+#: the label-set bound for the control-plane saturation metrics: process
+#: (one per runtime process kind), method (GCS handler names), reason
+#: (the typed backpressure/pending vocabulary) and node — nothing that can
+#: carry a task id, address or other unbounded value.
+ALLOWED_SCHED_TAG_KEYS = {"process", "method", "reason", "node"}
+SCHED_PREFIXES = ("raytpu_sched_", "raytpu_loop_", "raytpu_gcs_")
+
+
+def test_sched_metric_tag_keys_are_bounded():
+    """Every ``raytpu_sched_*`` / ``raytpu_loop_*`` / ``raytpu_gcs_*``
+    metric anywhere in the runtime declares only allowlisted tag keys."""
+    problems = []
+    seen = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "metrics.py" and path.parent.name == "util":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for call, cls in _metric_calls(tree):
+            name_node = call.args[0] if call.args else None
+            if not (isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str)
+                    and name_node.value.startswith(SCHED_PREFIXES)):
+                continue
+            seen += 1
+            where = f"{path.relative_to(PKG_ROOT.parent)}:{call.lineno}"
+            for kw in call.keywords:
+                if kw.arg != "tag_keys" or not isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    continue
+                for el in kw.value.elts:
+                    if (isinstance(el, ast.Constant)
+                            and el.value not in ALLOWED_SCHED_TAG_KEYS):
+                        problems.append(
+                            f"{where}: {cls} {name_node.value!r} declares "
+                            f"tag key {el.value!r} outside "
+                            f"{sorted(ALLOWED_SCHED_TAG_KEYS)}")
+    assert not problems, "\n".join(problems)
+    # busy-fraction gauge + worst-stall gauge + backpressure counter +
+    # gcs handler histogram at minimum
+    assert seen >= 4, f"only {seen} sched/loop/gcs metrics found"
+
+
+# ---------------------------------------------- pending-reason stamp lint
+
+#: call names whose "reason" argument becomes an event field / rollup key
+REASON_STAMP_FNS = {"pending_reason": 1, "_note_reason": 0}
+#: helpers allowed to PRODUCE a reason value bound to a local name
+REASON_PRODUCERS = {"reason_for_no_node"}
+
+
+def _is_enum_attr(node, enum_names):
+    return (isinstance(node, ast.Attribute) and node.attr in enum_names
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "PendingReason")
+
+
+def _reason_assignments(fn_node, enum_names):
+    """Local names inside one function bound ONLY to PendingReason
+    constants or reason_for_no_node(...) results."""
+    ok, tainted = set(), set()
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Assign):
+            continue
+        targets = [t.id for t in sub.targets if isinstance(t, ast.Name)]
+        if not targets:
+            continue
+        v = sub.value
+        good = (_is_enum_attr(v, enum_names)
+                or (isinstance(v, ast.Call)
+                    and ((isinstance(v.func, ast.Name)
+                          and v.func.id in REASON_PRODUCERS)
+                         or (isinstance(v.func, ast.Attribute)
+                             and v.func.attr in REASON_PRODUCERS)))
+                or (isinstance(v, ast.IfExp)
+                    and _is_enum_attr(v.body, enum_names)))
+        # an IfExp like (PG_PENDING if x else reason_for_no_node(e))
+        if isinstance(v, ast.IfExp) and not good:
+            good = (_is_enum_attr(v.body, enum_names) or _is_enum_attr(
+                v.orelse, enum_names))
+        for t in targets:
+            (ok if good else tainted).add(t)
+    return ok - tainted
+
+
+def test_pending_reason_stamps_use_typed_enum():
+    """Every pending-reason stamp call site passes a
+    ``PendingReason.<CONSTANT>`` (or a local provably bound to one) — a
+    free-form string would become an unbounded rollup key / label value
+    and an untyped state nothing else understands."""
+    import ray_tpu.core.sched_explain as se
+    enum_names = set(se.PendingReason.ALL)
+    problems = []
+    stamps = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "sched_explain.py":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in REASON_STAMP_FNS:
+                continue  # the helpers' own forwarding plumbing
+            ok_names = None  # computed lazily per function
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in REASON_STAMP_FNS):
+                    continue
+                idx = REASON_STAMP_FNS[node.func.attr]
+                reason_arg = None
+                if len(node.args) > idx:
+                    reason_arg = node.args[idx]
+                else:
+                    reason_arg = next((kw.value for kw in node.keywords
+                                       if kw.arg == "reason"), None)
+                if reason_arg is None:
+                    continue  # a *args forward — not a literal stamp site
+                stamps += 1
+                if _is_enum_attr(reason_arg, enum_names):
+                    continue
+                if isinstance(reason_arg, ast.Name):
+                    if ok_names is None:
+                        ok_names = _reason_assignments(fn, enum_names)
+                    if reason_arg.id in ok_names:
+                        continue
+                problems.append(
+                    f"{path.relative_to(PKG_ROOT.parent)}:{node.lineno}: "
+                    f"{node.func.attr}() reason argument is not a "
+                    "PendingReason constant (free-form strings are "
+                    "unbounded label values)")
+    assert not problems, "\n".join(problems)
+    # the scan must actually see the stamp sites (gate, lease pool,
+    # actor path, spec-cache resend at minimum)
+    assert stamps >= 6, f"only {stamps} pending-reason stamps found"
+
+
 def test_all_runtime_metrics_use_raytpu_namespace():
     problems = []
     scanned = 0

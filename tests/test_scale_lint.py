@@ -125,6 +125,6 @@ def test_admission_gate_is_wired_into_submission():
     lint above pins bookkeeping costs, this pins the backpressure window
     against simply being deleted."""
     src = (CORE / "core_worker.py").read_text()
-    assert src.count("self.admission_gate.acquire(self)") >= 2
+    assert src.count("self.admission_gate.acquire(self") >= 2
     assert "gated=True" in src
     assert "submit_inflight_limit" in src
