@@ -480,6 +480,73 @@ def run_ab_sched_obs(S: float, pairs: int) -> dict:
             "off_config": SCHED_OBS_OFF, "ratio_on_off": ratio}
 
 
+#: the "off" arm of the object-observability A/B: the object plane's one
+#: kill switch — no raytpu_object_*/raytpu_mem_* series, no flight-recorder
+#: events, no copy-ledger accounting, no transfer-ring writes.
+OBJECT_OBS_OFF = {"object_metrics_enabled": False}
+
+
+def _measure_object_obs(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster measurement of the object-plane A/B arms: put
+    GB/s (the instrumented 1-copy path), same-host large get ops/s (the
+    instrumented 0-copy path), and an 8-way large-arg fan-out (every
+    worker fetches the same plasma object — the broadcast-shaped path)."""
+    import numpy as np
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=8, object_store_memory=2 << 30,
+                 _system_config=system_config or None)
+    out = {}
+    try:
+        big = np.zeros(64 * 1024 * 1024, np.uint8)  # 64 MB
+        n = max(int(8 * S), 2)
+
+        def put_big():
+            for _ in range(n):
+                ray_tpu.put(big)
+
+        out["put_gbps"] = max(ops * big.nbytes / 1e9
+                              for ops in timeit(put_big, n))
+
+        ref = ray_tpu.put(big)
+        ng = max(int(40 * S), 5)
+        out["get_big"] = max(timeit(
+            lambda: [ray_tpu.get(ref) for _ in range(ng)], ng))
+
+        @ray_tpu.remote
+        def touch(obj):
+            return int(obj[0])
+
+        ray_tpu.get([touch.remote(ref) for _ in range(8)])  # warmup
+        nb = max(int(6 * S), 2)
+
+        def fanout():
+            for _ in range(nb):
+                ray_tpu.get([touch.remote(ref) for _ in range(8)])
+
+        out["arg_fanout_8"] = max(ops * 8 for ops in timeit(fanout, nb))
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_object_obs(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: object_metrics_enabled on vs off over
+    put/get/fan-out (the ISSUE-12 acceptance gate: <= 5% overhead)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_object_obs(S, None))
+        off_runs.append(_measure_object_obs(S, dict(OBJECT_OBS_OFF)))
+        print(f"# object ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": OBJECT_OBS_OFF, "ratio_on_off": ratio}
+
+
 #: the "off" arm of the batched-submission A/B: one task per push RPC, one
 #: lease per request RPC, one actor call per batch — the unbatched
 #: submission plane the scale-envelope work replaced.
@@ -555,6 +622,11 @@ def main():
                         "sched_metrics_enabled on vs off (tasks_async + "
                         "submit_burst; the scheduler-observability "
                         "overhead gate)")
+    p.add_argument("--ab-object", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of "
+                        "object_metrics_enabled on vs off (put GB/s, "
+                        "large get, 8-way arg fan-out; the object-plane "
+                        "observability overhead gate)")
     args = p.parse_args()
     _REPS = max(args.reps, 1)
 
@@ -603,6 +675,9 @@ def main():
                                                args.ab_train_obs)
     if args.ab_sched > 0:
         out["sched_obs_ab"] = run_ab_sched_obs(args.scale, args.ab_sched)
+    if args.ab_object > 0:
+        out["object_obs_ab"] = run_ab_object_obs(args.scale,
+                                                 args.ab_object)
     line = json.dumps(out)
     print(line)
     if args.out:

@@ -325,6 +325,33 @@ class Config:
     #: decision record carries the full queue count) — a 50k-deep pool
     #: flip must not pin the IO loop stamping every spec.
     sched_explain_stamp_max: int = 1000
+    #: Object-plane observability (core/object_explain.py): the per-object
+    #: lifecycle flight recorder (CREATED/SEALED/SPILLED/RESTORED/
+    #: TRANSFERRED/RE_HOMED/FREED transition events into a bounded GCS
+    #: ring), the copy-amplification ledger
+    #: (``raytpu_object_bytes_total{path,copies}``), arena fragmentation +
+    #: spill-tier gauges (``raytpu_mem_*``), and the per-pull transfer
+    #: flight-recorder ring behind ``state.transfers()``.  ONE kill switch
+    #: sheds every ``raytpu_object_*``/``raytpu_mem_*`` series AND all
+    #: ring writes (hot paths keep a single cached boolean check) for A/B
+    #: overhead measurement — same discipline as sched_metrics_enabled.
+    object_metrics_enabled: bool = True
+    #: Bounded ring of object lifecycle events kept by the GCS (the
+    #: ``state.explain_object`` / ``raytpu explain <oid>`` backing store —
+    #: the sched_decision ring pattern applied to the data plane).
+    object_event_ring_len: int = 4096
+    #: Object events older than this age out of the ring (and are dropped
+    #: from query replies) — a debug trail, not a history DB.
+    object_event_max_age_s: float = 600.0
+    #: Bounded per-agent ring of completed-pull ChunkLedger end-states
+    #: (per-source bytes/steals/failures/relay fraction) behind
+    #: ``state.transfers()`` / ``raytpu transfers``.
+    object_transfer_ring_len: int = 256
+    #: Ref-debt detector: a read pin held longer than this by a live
+    #: consumer is reported as a leak suspect by ``raytpu memory --leaks``
+    #: (dead consumers' pins are drained by the liveness sweep already;
+    #: this catches the live-but-forgot case).
+    object_pin_leak_ttl_s: float = 300.0
     #: Dashboard cluster-metrics history (dashboard/history.py): the head
     #: scrapes every node agent's /metrics on this period into a bounded
     #: ring buffer covering this window, derives counter rates, and serves

@@ -38,7 +38,8 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import sched_explain, serialization, spec_cache
+from . import object_explain, sched_explain, serialization, spec_cache
+from .object_explain import ObjectEvent
 from .sched_explain import PendingReason
 from .common import (STREAMING_RETURNS, ActorDiedError, GetTimeoutError,
                      NodeAffinitySchedulingStrategy, ObjectLostError,
@@ -1127,6 +1128,12 @@ class CoreWorker:
         self._last_reason: Dict[TaskID, str] = {}
         self._sched_decisions: collections.deque = collections.deque(
             maxlen=512)
+        # Object-plane flight recorder (core/object_explain.py): bounded
+        # buffer of owner-side lifecycle transitions (CREATED/INLINED/
+        # FREED) flushed to the GCS object-event ring alongside task
+        # events.  Never written when object_metrics_enabled is off.
+        self._object_events: collections.deque = collections.deque(
+            maxlen=4096)
         # STAGES-event rate cap bookkeeping (see _record_stages)
         self._stage_event_window = 0
         self._stage_event_count = 0
@@ -1147,7 +1154,9 @@ class CoreWorker:
         self.gcs = RpcClient(self.gcs_address)
         if self.agent_address:
             self.agent = self.agent_clients.get(self.agent_address)
-        if get_config().task_events_enabled:
+        if get_config().task_events_enabled or object_explain.enabled():
+            # the flush loop also carries owner-side object events and
+            # sched decisions, so the object plane alone keeps it alive
             self._bg.append(asyncio.ensure_future(self._flush_task_events_loop()))
         from ray_tpu.util.usage_stats import usage_stats_enabled
         if usage_stats_enabled():
@@ -1294,6 +1303,16 @@ class CoreWorker:
                 break
         self.task_event(spec, "PENDING", reason=reason, **detail)
 
+    def object_event(self, oid: ObjectID, event: str, **extra):
+        """Stamp one owner-side object lifecycle transition (a constant
+        from ``ObjectEvent``) onto the flight-recorder plane.  One cached
+        boolean when the object plane is off; the deque bounds memory."""
+        if not object_explain.enabled():
+            return
+        self._object_events.append({
+            "object_id": oid.hex(), "event": event, "ts": time.time(),
+            "owner": self.address, **extra})
+
     def _append_task_event(self, ev: dict):
         """Bounded owner-side event buffer: beyond task_events_max_buffer
         unflushed events, new ones are SHED (drop-newest, O(1)) and counted
@@ -1358,6 +1377,17 @@ class CoreWorker:
                             dropped=dropped if i == 0 else 0)
                 except Exception:
                     pass
+            if self._object_events and self.gcs:
+                # owner-side object lifecycle events (CREATED/INLINED/
+                # FREED) piggyback the task-event cadence into the GCS
+                # object ring (best effort, same as decisions below)
+                events = list(self._object_events)
+                self._object_events.clear()
+                try:
+                    await self.gcs.call("add_object_events", events=events,
+                                        _timeout=10)
+                except Exception:
+                    pass
             if self._sched_decisions and self.gcs:
                 # owner-side scheduling decision records ride the same
                 # cadence into the GCS ring (best effort: a lost batch
@@ -1406,9 +1436,22 @@ class CoreWorker:
         size = so.flat_size()
         if size <= cfg.max_direct_call_object_size or self.agent is None:
             self.memory_store.put(oid, so.to_bytes())
+            object_explain.ledger_record(object_explain.KEY_PUT_INLINE,
+                                         size)
+            self.object_event(oid, ObjectEvent.INLINED, size=size)
         else:
             res = await self.agent.call_retry("store_create", object_id=oid,
                                               size=size, owner=self.address)
+            # CREATED is stamped BEFORE the seal notify: the agent's SEALED
+            # event must never carry an earlier timestamp than the owner's
+            # CREATED (explain_object sorts by ts — an inverted trail would
+            # render an impossible lifecycle).  The ledger's headline row
+            # rides along: the put path declares ONE payload copy
+            # (serialize straight into the arena mapping); the
+            # zero-copy-put rewrite must move this to copies=0.
+            object_explain.ledger_record(object_explain.KEY_PUT, size)
+            self.object_event(oid, ObjectEvent.CREATED, size=size,
+                              node=(self.node_id or "")[:12] or None)
             seg = ShmSegment(res["path"], size, create=False)
             try:
                 so.write_into(seg.view())
@@ -1614,6 +1657,10 @@ class CoreWorker:
                 except OSError:
                     pin.release()
                 else:
+                    # copy ledger: the pinned same-host get is the plane's
+                    # declared ZERO-copy path (plasma-client contract)
+                    object_explain.ledger_record(object_explain.KEY_GET,
+                                                 res["size"])
                     return view, pin
             try:
                 data = self.shm_reader.read(res["path"], res["size"])
@@ -1625,12 +1672,16 @@ class CoreWorker:
                 ok = False
             else:
                 if "#" not in res["path"]:
+                    object_explain.ledger_record(
+                        object_explain.KEY_GET, res["size"])
                     return data, None  # file-backed: unlink keeps views safe
                 ok = await self.agent.call_retry("store_verify",
                                                  object_id=object_id,
                                                  path=res["path"],
                                                  _idempotent=False)
             if ok:
+                object_explain.ledger_record(object_explain.KEY_GET_COPY,
+                                             res["size"])
                 return data, None
             res = await self.agent.call_retry("fetch_object",
                                               object_id=object_id,
@@ -2258,6 +2309,10 @@ class CoreWorker:
             self.reference_counter.remove_local_ref(cid, owner)
         rec = self.memory_store.get_if_exists(oid)
         self.memory_store.free(oid)
+        if rec is not None and not isinstance(rec, PlasmaRecord):
+            # inline record: no store sees this free, stamp it here (the
+            # plasma fan-out below is stamped by each store's own FREED)
+            self.object_event(oid, ObjectEvent.FREED)
         if isinstance(rec, PlasmaRecord):
             from . import external_spill
             for node_id, addr in rec.locations:
@@ -2397,6 +2452,13 @@ class CoreWorker:
                                                   object_id=oid,
                                                   size=len(data),
                                                   owner=self.address)
+                # stamped before the seal notify so CREATED can never sort
+                # after the agent's SEALED (see _store_serialized)
+                object_explain.ledger_record(object_explain.KEY_PROMOTE,
+                                             len(data))
+                self.object_event(oid, ObjectEvent.CREATED, size=len(data),
+                                  node=(self.node_id or "")[:12] or None,
+                                  promoted=True)
                 seg = ShmSegment(res["path"], len(data), create=False)
                 try:
                     seg.view()[:len(data)] = data
@@ -2855,6 +2917,14 @@ class CoreWorker:
         res = run_async(self.agent.call_retry("store_create", object_id=oid,
                                               size=size,
                                               owner=spec.owner or None))
+        # A task result landing in plasma is the same serialize-into-arena
+        # 1-copy write as a put — it must account the same ledger path and
+        # stamp CREATED, or result-heavy workloads (the common case)
+        # vanish from the copy-amplification gauge.
+        object_explain.ledger_record(object_explain.KEY_PUT, size)
+        self.object_event(oid, ObjectEvent.CREATED, size=size,
+                          node=(self.node_id or "")[:12] or None,
+                          task=spec.task_id.hex()[:16])
         seg = ShmSegment(res["path"], size, create=False)
         try:
             so.write_into(seg.view())

@@ -82,6 +82,14 @@ class GcsServer:
         # per-handler cumulative busy seconds when sched metrics are on.
         self.sched_decisions: deque = deque(
             maxlen=max(64, cfg.sched_decision_ring_len))
+        # Object-plane flight recorder: bounded age-out ring of object
+        # lifecycle transition events (CREATED/SEALED/SPILLED/RESTORED/
+        # TRANSFERRED/RE_HOMED/FREED) flushed by node agents and owners —
+        # the ``state.explain_object`` / ``raytpu explain <oid>`` backing
+        # store (the sched_decision ring pattern on the data plane).
+        self.object_events: deque = deque(
+            maxlen=max(64, cfg.object_event_ring_len))
+        self.object_events_dropped = 0
         self._handler_busy: Dict[str, float] = {}
         self._handler_calls: Dict[str, int] = {}
         self._gcs_hist_keys: Dict[str, tuple] = {}  # precomputed tag keys
@@ -1094,6 +1102,72 @@ class GcsServer:
         out["decisions"] = decisions[-100:]
         return out
 
+    # --------------------------------------------- object flight recorder
+
+    def _prune_object_events(self):
+        max_age = get_config().object_event_max_age_s
+        if max_age <= 0:
+            return
+        cutoff = time.time() - max_age
+        d = self.object_events
+        while d and d[0].get("ts", 0.0) < cutoff:
+            d.popleft()
+
+    async def handle_add_object_events(self, events: List[dict],
+                                       dropped: int = 0):
+        """Batched object lifecycle transitions from node agents and
+        owners land in one ring, so ``explain_object`` sees a single
+        trail regardless of which process observed the transition."""
+        self._prune_object_events()
+        self.object_events.extend(events)
+        self.object_events_dropped += dropped
+        return True
+
+    async def handle_get_object_events(self, limit: int = 200,
+                                       id: Optional[str] = None,
+                                       event: Optional[str] = None):
+        self._prune_object_events()
+        out: List[dict] = []
+        for rec in reversed(self.object_events):
+            if id is not None and rec.get("object_id") != id:
+                continue
+            if event is not None and rec.get("event") != event:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+    async def handle_explain_object(self, id: str):
+        """The lifecycle trail of ONE object: its transition events
+        (oldest first) with owner/location/tier history, its latest
+        state, and rollups (copies seen per node, spill tiers touched) —
+        the payload behind ``state.explain_object`` / ``raytpu explain
+        <object_id>``."""
+        self._prune_object_events()
+        events = [ev for ev in self.object_events
+                  if ev.get("object_id") == id]
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        out: Dict[str, object] = {"id": id, "kind": None, "events": events}
+        if self.object_events_dropped:
+            # an incomplete trail should say so: agents shed events past
+            # their 10k buffer and ship the count with every flush
+            out["events_dropped"] = self.object_events_dropped
+        if not events:
+            return out
+        out["kind"] = "object"
+        latest = events[-1]
+        out["state"] = latest.get("event")
+        out["size"] = next((e.get("size") for e in reversed(events)
+                            if e.get("size") is not None), None)
+        out["owner"] = next((e.get("owner") for e in reversed(events)
+                             if e.get("owner")), None)
+        out["nodes"] = sorted({e.get("node") for e in events
+                               if e.get("node")})
+        out["tiers"] = sorted({e.get("tier") for e in events
+                               if e.get("tier")})
+        return out
+
     async def handle_sched_stats(self):
         """Control-plane saturation rollup: per-handler cumulative busy
         seconds + call counts, the GCS loop's busy fraction, and ring
@@ -1110,6 +1184,8 @@ class GcsServer:
             "loop_stalls": getattr(mon, "stall_count", None),
             "decision_ring_len": len(self.sched_decisions),
             "task_events_dropped": self.task_events_dropped,
+            "object_events_dropped": self.object_events_dropped,
+            "object_event_ring_len": len(self.object_events),
             "sched_metrics_enabled": sched_explain.enabled(),
         }
 

@@ -31,7 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from . import chaos, external_spill, sched_explain
+from . import chaos, external_spill, object_explain, sched_explain
 from .common import ResourceSet, TaskSpec, detect_node_resources
 from .config import get_config
 from .external_spill import EXTERNAL_NODE_ID, is_external_address
@@ -94,6 +94,27 @@ def _build_telemetry_gauges():
         "resource_total": Gauge(
             "raytpu_resource_total",
             "schedulable capacity", tag_keys=("node", "resource")),
+        # -- object-plane memory gauges (object_metrics_enabled) --------
+        "mem_frag": Gauge(
+            "raytpu_mem_arena_frag_fraction",
+            "shm arena fragmentation (1 - largest_free/free; 0 = one "
+            "contiguous free region)", tag_keys=("node",)),
+        "mem_free_blocks": Gauge(
+            "raytpu_mem_arena_free_blocks",
+            "free blocks in the shm arena (sliver accumulation signal)",
+            tag_keys=("node",)),
+        "mem_spill_bytes": Gauge(
+            "raytpu_mem_spill_bytes",
+            "bytes currently resident on a spill tier, by tier",
+            tag_keys=("node", "tier")),
+        "mem_spill_objects": Gauge(
+            "raytpu_mem_spill_objects",
+            "objects currently resident on a spill tier, by tier",
+            tag_keys=("node", "tier")),
+        "mem_leaks": Gauge(
+            "raytpu_mem_leak_suspects",
+            "ref-debt suspects on this node (pins past TTL + deferred "
+            "frees stuck behind vanished pins)", tag_keys=("node",)),
     }
 
 
@@ -248,6 +269,18 @@ class NodeAgent:
         # per-(owner, object) tail of the location-update chain (see
         # _location_update: add/remove must apply in issue order)
         self._loc_updates: Dict[Tuple[str, ObjectID], "asyncio.Task"] = {}
+        # Object-plane flight recorder (core/object_explain.py): bounded
+        # buffer of lifecycle transition events flushed to the GCS ring,
+        # a bounded ring of completed-pull ChunkLedger end-states
+        # (state.transfers()), and first-grant timestamps per (pinner,
+        # object) for the pin-TTL leak detector.  All empty/unwritten
+        # when object_metrics_enabled is off.
+        self._object_events: List[dict] = []
+        self._object_events_dropped = 0
+        self._transfer_ring: collections.deque = collections.deque(
+            maxlen=max(16, get_config().object_transfer_ring_len))
+        self._pin_first_ts: Dict[Tuple[str, ObjectID], float] = {}
+        self.store.on_object_event = self._buffer_object_event
 
     # ------------------------------------------------------------------ boot
 
@@ -293,6 +326,7 @@ class NodeAgent:
             self._bg.append(asyncio.ensure_future(self._telemetry_loop()))
         self._bg.append(asyncio.ensure_future(self._idle_reaper_loop()))
         self._bg.append(asyncio.ensure_future(self._pin_sweep_loop()))
+        self._bg.append(asyncio.ensure_future(self._flush_object_events_loop()))
         self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         cfg = get_config()
@@ -1334,10 +1368,15 @@ class NodeAgent:
                 except Exception:
                     continue
                 self.store._spilled_external[oid] = uri
+                self.store._ext_sizes[oid] = len(data)
                 m = external_spill.spill_metrics()
                 if m is not None:
                     m["bytes"].inc_key(external_spill.KEY_TIER_EXTERNAL,
                                        len(data))
+                object_explain.ledger_record(object_explain.KEY_RE_HOME,
+                                             len(data))
+                self._obj_event(oid, object_explain.ObjectEvent.RE_HOMED,
+                                to=uri, tier="external", size=len(data))
                 if owner:
                     # awaited (not the background _location_update): the
                     # registration must land before this node dies or the
@@ -1363,6 +1402,12 @@ class NodeAgent:
                             owner=owner, _timeout=30.0)
                     except Exception:
                         continue
+                    object_explain.ledger_record(
+                        object_explain.KEY_RE_HOME, len(data))
+                    self._obj_event(oid,
+                                    object_explain.ObjectEvent.RE_HOMED,
+                                    to=peer.address, tier="peer",
+                                    size=len(data))
                     if owner:
                         try:
                             await self.worker_clients.get(
@@ -1642,6 +1687,7 @@ class NodeAgent:
                 del kinds[kind]
             if not kinds:
                 per.pop(object_id, None)
+                self._pin_first_ts.pop((pinner, object_id), None)
                 if not per:
                     self._read_pins.pop(pinner, None)
             await self._unpin_and_chain(object_id, kind)
@@ -1718,6 +1764,7 @@ class NodeAgent:
         if not consumer_addr:
             return
         for oid, kinds in self._read_pins.pop(consumer_addr, {}).items():
+            self._pin_first_ts.pop((consumer_addr, oid), None)
             for kind, count in kinds.items():
                 for _ in range(count):
                     await self._unpin_and_chain(oid, kind)
@@ -1757,6 +1804,144 @@ class NodeAgent:
         for r in rows:
             r["node_id"] = self.node_id.hex()
         return rows
+
+    # -------------------------------------- object-plane flight recorder
+
+    def _buffer_object_event(self, object_id: ObjectID, event: str,
+                             detail: dict):
+        """Store-hook target + agent-originated stamp point: one bounded
+        append per lifecycle transition; the flush loop ships batches to
+        the GCS object-event ring.  Callers (the store's ``_event`` and
+        ``_obj_event`` below) already checked the kill switch."""
+        if len(self._object_events) >= 10_000:
+            self._object_events_dropped += 1
+            return
+        self._object_events.append({
+            "object_id": object_id.hex(), "event": event,
+            "ts": time.time(), "node": self.node_id.hex()[:12], **detail})
+
+    def _obj_event(self, object_id: ObjectID, event: str, **detail):
+        """Agent-side transition stamp (pull landings, proxy attaches,
+        re-homes, pin grants) — same trail as the store's transitions."""
+        if not object_explain.enabled():
+            return
+        self._buffer_object_event(object_id, event, detail)
+
+    async def _flush_object_events_loop(self):
+        while not self._shutting_down:
+            await asyncio.sleep(1.0)
+            if not self._object_events or self.gcs is None:
+                continue
+            batch, self._object_events = self._object_events, []
+            dropped, self._object_events_dropped = \
+                self._object_events_dropped, 0
+            try:
+                await self.gcs.call_retry("add_object_events",
+                                          events=batch, dropped=dropped)
+            except Exception:
+                pass
+
+    def _record_transfer(self, object_id: ObjectID, size: int, kind: str,
+                         t0: float, status: str, source: str = "",
+                         stats: Optional[dict] = None):
+        """Append one completed/failed pull's end-state to the bounded
+        per-agent flight-recorder ring (``state.transfers()``)."""
+        if not object_explain.enabled():
+            return
+        rec = {"object_id": object_id.hex(), "bytes": size, "kind": kind,
+               "status": status, "node": self.node_id.hex()[:12],
+               "ts": t0, "duration_s": round(time.time() - t0, 6)}
+        if source:
+            rec["source"] = source
+        if stats:
+            rec.update(stats)
+        self._transfer_ring.append(rec)
+
+    async def handle_transfers(self, limit: int = 100):
+        """Tail of this agent's per-pull flight-recorder ring, newest
+        first: per-source bytes/chunks/failures, steals, retries, relay
+        fraction — the post-hoc answer to "how did this object get
+        here"."""
+        out = []
+        for rec in reversed(self._transfer_ring):
+            out.append(rec)
+            if len(out) >= max(1, limit):
+                break
+        return out
+
+    def _leak_suspects_cheap(self, ttl_s: float, now: float) -> list:
+        """The probe-free half of the leak report (also sampled into
+        ``raytpu_mem_leak_suspects``): read pins held past the TTL by
+        consumers the liveness sweep still believes alive, and deferred
+        frees stuck behind pins no ledger entry accounts for (the holder
+        vanished without a drain — nothing will ever complete the free)."""
+        leaks = []
+        for (pinner, oid), t0 in list(self._pin_first_ts.items()):
+            age = now - t0
+            if age < ttl_s:
+                continue
+            kinds = self._read_pins.get(pinner, {}).get(oid, {})
+            leaks.append({"kind": "pin_ttl", "object_id": oid.hex(),
+                          "holder": pinner, "age_s": round(age, 1),
+                          "pins": sum(kinds.values())})
+        # ledger-accounted pin totals per object (read pins only; an
+        # in-flight pull legitimately holds an unledgered transfer pin)
+        accounted: Dict[ObjectID, int] = {}
+        for per in self._read_pins.values():
+            for oid, kinds in per.items():
+                accounted[oid] = accounted.get(oid, 0) + sum(kinds.values())
+        for oid, e in list(self.store._entries.items()):
+            if not e.freed or e.pinned <= 0:
+                continue
+            if oid in self._inflight_pulls:
+                continue  # transfer pin: the pull's unpin completes it
+            if accounted.get(oid, 0) < e.pinned:
+                leaks.append({
+                    "kind": "vanished_pin", "object_id": oid.hex(),
+                    "pins": e.pinned, "accounted": accounted.get(oid, 0),
+                    "age_s": round(time.monotonic() - e.last_access, 1),
+                    "size": e.size})
+        return leaks
+
+    async def handle_store_leaks(self, pin_ttl_s: Optional[float] = None):
+        """Ref-debt / leak report for this node (``raytpu memory
+        --leaks``): pin-TTL and vanished-pin suspects from the cheap
+        sweep, plus sole-copy entries whose OWNER process no longer
+        answers a ping — durable bytes no reachable borrower can ever
+        free (the owner-side refcount died with the owner)."""
+        ttl = pin_ttl_s if pin_ttl_s is not None \
+            else get_config().object_pin_leak_ttl_s
+        leaks = self._leak_suspects_cheap(ttl, time.time())
+        # owner-lost probe: one concurrent short ping per distinct owner
+        owners: Dict[str, List[ObjectID]] = {}
+        for oid, e in list(self.store._entries.items()):
+            if e.sealed and not e.freed and e.owner:
+                owners.setdefault(e.owner, []).append(oid)
+
+        async def _probe(addr):
+            try:
+                await asyncio.wait_for(
+                    self.worker_clients.get(addr).call("ping"), 2.0)
+                return addr, True
+            except asyncio.TimeoutError:
+                return addr, True  # alive-but-busy is not owner loss
+            except Exception:
+                return addr, False
+
+        for addr, alive in await asyncio.gather(
+                *(_probe(a) for a in owners)):
+            if alive:
+                continue
+            for oid in owners[addr]:
+                e = self.store._entries.get(oid)
+                if e is None:
+                    continue
+                leaks.append({"kind": "owner_lost", "object_id": oid.hex(),
+                              "owner": addr, "size": e.size,
+                              "pins": e.pinned})
+        for rec in leaks:
+            rec["node"] = self.node_id.hex()[:12]
+        return leaks
 
     # -------------------------------------------------------- object transfer
 
@@ -1824,7 +2009,16 @@ class NodeAgent:
         if kind and pinner:
             kinds = self._read_pins.setdefault(pinner, {}).setdefault(
                 object_id, {})
+            first = not kinds
             kinds[kind] = kinds.get(kind, 0) + 1
+            if first:
+                # transitions-only stamping: this consumer's FIRST pin on
+                # the object (further pins on the same grant are silent);
+                # the timestamp feeds the pin-TTL leak detector
+                self._pin_first_ts.setdefault((pinner, object_id),
+                                              time.time())
+                self._obj_event(object_id, object_explain.ObjectEvent.PINNED,
+                                holder=pinner)
         return res
 
     async def _locate_or_pull(self, object_id: ObjectID, size: int,
@@ -1993,6 +2187,15 @@ class NodeAgent:
                         m = transfer_metrics()
                         if m is not None:
                             m["bytes"].inc_key(KEY_PROXY_IN, info["size"])
+                        object_explain.ledger_record(
+                            object_explain.KEY_TRANSFER_PROXY, info["size"])
+                        self._obj_event(
+                            object_id,
+                            object_explain.ObjectEvent.TRANSFERRED,
+                            source=addr, size=info["size"], zero_copy=True)
+                        self._record_transfer(
+                            object_id, info["size"], "proxy", t_pin, "ok",
+                            source=addr)
                         self._trace_transfer(
                             kind="proxy_attach", object=object_id.hex()[:12],
                             source=addr, bytes=info["size"],
@@ -2170,6 +2373,8 @@ class NodeAgent:
                 # so freeing the segment cannot race a late chunk write
                 if registered and owner:
                     self._deregister_object_location(owner, object_id)
+                self._record_transfer(object_id, size, "chunked", t_pull,
+                                      "cancelled")
                 self.store.free(object_id)  # defers under our pin
                 raise
             except BaseException as e:  # noqa: BLE001
@@ -2177,6 +2382,8 @@ class NodeAgent:
                     # withdraw the early partial registration — the owner
                     # must not keep routing pullers at a freed segment
                     self._deregister_object_location(owner, object_id)
+                self._record_transfer(object_id, size, "chunked", t_pull,
+                                      "failed")
                 self.store.free(object_id)  # defers under our pin
                 raise RuntimeError(
                     f"failed to fetch {object_id} from {sources}: {e}"
@@ -2187,6 +2394,12 @@ class NodeAgent:
             # during the pull (our own failure free above, or an
             # owner-side free that raced the broadcast)
             self.store.unpin(object_id)
+        object_explain.ledger_record(object_explain.KEY_TRANSFER_LAND, size)
+        self._obj_event(object_id, object_explain.ObjectEvent.TRANSFERRED,
+                        size=size, sources=stats.get("sources_used"),
+                        chunks=stats.get("chunks_done"))
+        self._record_transfer(object_id, size, "chunked", t_pull, "ok",
+                              stats=stats)
         self._trace_transfer(
             kind="pull_summary", object=object_id.hex()[:12], bytes=size,
             t0=t_pull, t1=time.time(), **stats)
@@ -2450,20 +2663,39 @@ class NodeAgent:
         if g is None:
             return
         tags = {"node": self.node_id.hex()[:12]}
-        st = self.store.stats()
         g["workers"].set(len(self.workers), tags)
         g["workers_leased"].set(
             sum(1 for w in self.workers.values() if w.state == "LEASED"),
             tags)
         g["lease_queue"].set(len(self.lease_queue), tags)
-        used = st.get("used", 0)
-        cap = st.get("capacity", 0)
-        g["store_used"].set(used, tags)
-        g["store_capacity"].set(cap, tags)
-        g["store_free"].set(max(0, cap - used), tags)
-        g["store_largest_free"].set(st.get("largest_free_block", 0), tags)
-        g["store_objects"].set(st.get("num_objects", 0), tags)
-        g["store_pinned"].set(st.get("num_pinned", 0), tags)
+        if object_explain.enabled():
+            # every raytpu_object_* / raytpu_mem_* series hangs off the ONE
+            # object-plane kill switch (A/B discipline: off means zero
+            # series, not zero-valued series)
+            st = self.store.stats()
+            used = st.get("used", 0)
+            cap = st.get("capacity", 0)
+            g["store_used"].set(used, tags)
+            g["store_capacity"].set(cap, tags)
+            g["store_free"].set(max(0, cap - used), tags)
+            g["store_largest_free"].set(st.get("largest_free_block", 0),
+                                        tags)
+            g["store_objects"].set(st.get("num_objects", 0), tags)
+            g["store_pinned"].set(st.get("num_pinned", 0), tags)
+            g["mem_frag"].set(st.get("frag_fraction", 0.0), tags)
+            hist = st.get("free_block_hist") or {}
+            g["mem_free_blocks"].set(hist.get("num_free_blocks", 0), tags)
+            for tier, bkey, okey in (
+                    ("local", "spilled_local_bytes", "num_spilled_local"),
+                    ("external", "spilled_external_bytes",
+                     "num_spilled_external")):
+                ttags = {"node": tags["node"], "tier": tier}
+                g["mem_spill_bytes"].set(st.get(bkey, 0), ttags)
+                g["mem_spill_objects"].set(st.get(okey, 0), ttags)
+            g["mem_leaks"].set(
+                len(self._leak_suspects_cheap(
+                    get_config().object_pin_leak_ttl_s, time.time())),
+                tags)
         g["read_pins"].set(
             sum(count for per in self._read_pins.values()
                 for kinds in per.values() for count in kinds.values()),

@@ -28,11 +28,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
-from . import external_spill
+from . import external_spill, object_explain
 from .config import get_config
 from .external_spill import (KEY_TIER_EXTERNAL, KEY_TIER_LOCAL,
                              spill_metrics)
 from .ids import ObjectID
+from .object_explain import KEY_RESTORE, KEY_SPILL, ObjectEvent
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
 
@@ -296,6 +297,18 @@ class NodeObjectStore:
         #: agent hook, called (object_id, uri, owner) off-loop once an
         #: external spill write LANDS — registers the URI with the owner
         self.on_external_spill = None
+        #: flight-recorder hook, called (object_id, event, detail) on the
+        #: store's lifecycle transitions (SEALED/SPILLED/RESTORED/FREED/
+        #: FREE_DEFERRED) — the agent buffers these and flushes them to
+        #: the GCS object-event ring.  Only fired when the object plane's
+        #: kill switch is on; None outside an agent.
+        self.on_object_event = None
+        #: spill-tier size ledgers: byte sizes of this store's local-disk
+        #: and external-tier copies (the entry record dies with the evict,
+        #: so the tier totals `memory_summary` reports need their own
+        #: bookkeeping).
+        self._spilled_sizes: Dict[ObjectID, int] = {}
+        self._ext_sizes: Dict[ObjectID, int] = {}
         # Native arena (C++ first-fit allocator over ONE shm mapping — the
         # plasma design): per-object create cost drops from
         # open+ftruncate+mmap+page-zero to an allocator call.  Falls back to
@@ -354,6 +367,17 @@ class NodeObjectStore:
 
         threading.Thread(target=_prefault, name="store-prefault",
                          daemon=True).start()
+
+    def _event(self, object_id: ObjectID, event: str, **detail):
+        """Stamp one lifecycle transition onto the flight recorder (via
+        the agent's buffer).  One boolean check when the plane is off."""
+        cb = self.on_object_event
+        if cb is None or not object_explain.enabled():
+            return
+        try:
+            cb(object_id, event, detail)
+        except Exception:
+            pass
 
     # -- creation ---------------------------------------------------------
 
@@ -420,6 +444,7 @@ class NodeObjectStore:
         ev = self._sealed_events.pop(object_id, None)
         if ev:
             ev.set()
+        self._event(object_id, ObjectEvent.SEALED, size=e.size)
 
     def mark_available(self, object_id: ObjectID, offset: int, length: int):
         """Publish one landed chunk of an in-progress pull: ``read_chunk``
@@ -614,8 +639,12 @@ class NodeObjectStore:
                 e.freed = True
             if p is not None:
                 p.freed = True
+            self._event(object_id, ObjectEvent.FREE_DEFERRED,
+                        pins=(e.pinned if e is not None else 0)
+                        + (p.pinned if p is not None else 0))
             # The spilled copy has no readers — reclaim it now.
             spilled = self._spilled.pop(object_id, None)
+            self._spilled_sizes.pop(object_id, None)
             if spilled:
                 try:
                     os.unlink(spilled)
@@ -630,6 +659,7 @@ class NodeObjectStore:
         write is still in flight, deletion chains behind its completion
         (free-during-spill race: the copy must not survive the free)."""
         uri = self._spilled_external.pop(object_id, None)
+        self._ext_sizes.pop(object_id, None)
         if uri is None:
             return
         if object_id in self._ext_writes:
@@ -656,11 +686,13 @@ class NodeObjectStore:
         # tier, or several at once.
         spilled = self._spilled.pop(object_id, None)
         self._spilled_owners.pop(object_id, None)
+        self._spilled_sizes.pop(object_id, None)
         if spilled:
             try:
                 os.unlink(spilled)
             except OSError:
                 pass
+        had_external = object_id in self._spilled_external
         if drop_external:
             self._drop_external(object_id)
         e = self._entries.pop(object_id, None)
@@ -671,6 +703,12 @@ class NodeObjectStore:
         ev = self._sealed_events.pop(object_id, None)
         if ev:
             ev.set()
+        if e is not None or proxy is not None or spilled is not None \
+                or had_external:
+            # stamp only when this store actually held SOMETHING: the
+            # owner's free fans out to every listed location, including
+            # nodes whose copy is already gone
+            self._event(object_id, ObjectEvent.FREED)
         if e is None:
             return proxy.source_addr if proxy else None
         self.used -= e.size
@@ -724,6 +762,7 @@ class NodeObjectStore:
         with open(path, "wb") as f:
             f.write(e.segment.view())
         self._spilled.setdefault(object_id, path)
+        self._spilled_sizes[object_id] = e.size
         if e.owner:
             # the entry record dies with the evict; the drain path still
             # needs to know whom to tell when it re-homes this file
@@ -731,6 +770,9 @@ class NodeObjectStore:
         m = spill_metrics()
         if m is not None:
             m["bytes"].inc_key(KEY_TIER_LOCAL, e.size)
+        object_explain.ledger_record(KEY_SPILL, e.size)
+        self._event(object_id, ObjectEvent.SPILLED, tier="local",
+                    size=e.size)
 
     def _spill_external(self, object_id: ObjectID, e: _Entry):
         if (object_id in self._spilled_external
@@ -743,6 +785,10 @@ class NodeObjectStore:
         data = bytes(e.segment.view())
         uri = external_spill.object_uri(self.external_uri, object_id)
         self._spilled_external[object_id] = uri
+        self._ext_sizes[object_id] = len(data)
+        object_explain.ledger_record(KEY_SPILL, len(data))
+        self._event(object_id, ObjectEvent.SPILLED, tier="external",
+                    size=len(data), uri=uri)
         fut = self._ext_executor().submit(external_spill.write, uri, data)
         self._ext_writes[object_id] = fut
         fut.add_done_callback(
@@ -771,6 +817,7 @@ class NodeObjectStore:
             # evicted; without this the sole copy is simply gone while the
             # owner still routes pullers here)
             self._spilled_external.pop(object_id, None)
+            self._ext_sizes.pop(object_id, None)
             if object_id in self._ext_drop_after_write:
                 self._ext_drop_after_write.discard(object_id)
                 return  # freed mid-write: nothing to preserve
@@ -784,6 +831,10 @@ class NodeObjectStore:
                     with open(path, "wb") as f:
                         f.write(data)
                     self._spilled[object_id] = path
+                    self._spilled_sizes[object_id] = len(data)
+                    self._event(object_id, ObjectEvent.SPILLED,
+                                tier="local", size=len(data),
+                                fallback=True)
                     if owner:
                         self._spilled_owners[object_id] = owner
                     m = spill_metrics()
@@ -860,10 +911,14 @@ class NodeObjectStore:
         if object_id in self._entries:
             return
         self.create_and_write(object_id, data)
+        object_explain.ledger_record(KEY_RESTORE, len(data))
+        self._event(object_id, ObjectEvent.RESTORED, tier="external",
+                    size=len(data))
 
     def _maybe_restore(self, object_id: ObjectID):
         path = self._spilled.pop(object_id, None)
         if path is not None:
+            self._spilled_sizes.pop(object_id, None)
             t0 = time.monotonic()
             with open(path, "rb") as f:
                 data = f.read()
@@ -874,6 +929,9 @@ class NodeObjectStore:
             m = spill_metrics()
             if m is not None:
                 m["restore_seconds"].observe(time.monotonic() - t0)
+            object_explain.ledger_record(KEY_RESTORE, len(data))
+            self._event(object_id, ObjectEvent.RESTORED, tier="local",
+                        size=len(data))
             return
         # External tier: wait out an in-flight spill write (the reader
         # raced the evict), then read the URI back into the store.  The
@@ -904,18 +962,58 @@ class NodeObjectStore:
             return
         self._ext_backoff.pop(object_id, None)
         self.create_and_write(object_id, data)
+        object_explain.ledger_record(KEY_RESTORE, len(data))
+        self._event(object_id, ObjectEvent.RESTORED, tier="external",
+                    size=len(data))
 
-    def stats(self) -> dict:
-        largest_free = 0
+    def arena_report(self) -> dict:
+        """Arena introspection: free bytes, largest free block, the
+        fragmentation fraction (1 - largest_free/free: 0 = one contiguous
+        free region, ->1 = free space shredded into slivers), and a
+        coarse free-block size histogram when the native pool exposes
+        block enumeration."""
+        free = max(0, self.capacity - self.used)
+        largest_free = free if self.pool is None else 0
+        hist = None
         if self.pool is not None:
             try:
                 largest_free = self.pool.largest_free
             except Exception:
-                pass
+                largest_free = 0
+            blocks = []
+            try:
+                blocks = self.pool.free_blocks()
+            except Exception:
+                blocks = []
+            if blocks:
+                # power-of-4 buckets from 64 KiB: bounded (8 buckets),
+                # readable, and enough to see sliver accumulation
+                bounds = [64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20,
+                          64 << 20, 256 << 20]
+                hist = [0] * (len(bounds) + 1)
+                for b in blocks:
+                    i = 0
+                    while i < len(bounds) and b > bounds[i]:
+                        i += 1
+                    hist[i] += 1
+                hist = {"bounds": bounds, "counts": hist,
+                        "num_free_blocks": len(blocks)}
+        frag = 0.0
+        if free > 0 and largest_free > 0:
+            frag = max(0.0, 1.0 - largest_free / free)
+        elif free > 0 and self.pool is not None:
+            frag = 1.0  # free capacity exists but no allocatable block
+        return {"free": free, "largest_free_block": largest_free,
+                "frag_fraction": round(frag, 4), "free_block_hist": hist}
+
+    def stats(self) -> dict:
+        arena = self.arena_report()
         return {
             "capacity": self.capacity,
             "used": self.used,
-            "largest_free_block": largest_free,
+            "largest_free_block": arena["largest_free_block"],
+            "frag_fraction": arena["frag_fraction"],
+            "free_block_hist": arena["free_block_hist"],
             "num_objects": len(self._entries),
             "num_proxies": len(self._proxies),
             "num_creates": self.num_creates,
@@ -928,6 +1026,11 @@ class NodeObjectStore:
             + sum(1 for p in self._proxies.values() if p.freed),
             "num_spilled_local": len(self._spilled),
             "num_spilled_external": len(self._spilled_external),
+            # spill-tier byte totals (the external tier was invisible to
+            # memory_summary before — only the spill counter saw it)
+            "spilled_local_bytes": sum(self._spilled_sizes.get(oid, 0)
+                                       for oid in self._spilled),
+            "spilled_external_bytes": sum(self._ext_sizes.values()),
         }
 
     def objects(self) -> list:
@@ -944,13 +1047,15 @@ class NodeObjectStore:
                          "freed": p.freed, "kind": "proxy",
                          "path": p.path, "source": p.source_addr})
         for oid, path in self._spilled.items():
-            rows.append({"object_id": oid.hex(), "size": None,
+            rows.append({"object_id": oid.hex(),
+                         "size": self._spilled_sizes.get(oid),
                          "sealed": True, "pinned": 0, "freed": False,
                          "kind": "spilled", "path": path})
         for oid, uri in self._spilled_external.items():
             if oid in self._entries:
                 continue  # restored: already reported as "local"
-            rows.append({"object_id": oid.hex(), "size": None,
+            rows.append({"object_id": oid.hex(),
+                         "size": self._ext_sizes.get(oid),
                          "sealed": True, "pinned": 0, "freed": False,
                          "kind": "external", "path": uri})
         return rows

@@ -577,12 +577,21 @@ class StripedPull:
         m = transfer_metrics()
         if m is not None:
             m["pull_sources"].observe(len(used))
+        total_b = sum(s.bytes for s in used) or 0
+        relay_b = sum(s.bytes for s in used if s.ranges is not None)
         return {
             "sources_used": sorted(s.addr for s in used),
             "per_source": {
                 s.addr: {"chunks": s.chunks, "bytes": s.bytes,
-                         "failures": s.failures, "dead": s.dead}
+                         "failures": s.failures, "dead": s.dead,
+                         # partial holder = a relay of the broadcast (it
+                         # advertised ranges, not a full copy)
+                         "partial": s.ranges is not None}
                 for s in self.sources.values()},
+            # fraction of chunk bytes served by partial (relay) holders —
+            # the pipeline-efficiency number the broadcast bench reports
+            # offline, now on every pull record
+            "relay_fraction": round(relay_b / total_b, 4) if total_b else 0.0,
             **self.ledger.stats(),
         }
 

@@ -7,7 +7,11 @@ Reference endpoints mirrored (dashboard/modules/*):
   GET  /api/actors             actor table (actor module)
   GET  /api/tasks              task events (state module)
   GET  /api/tasks/summarize    task state counts
-  GET  /api/objects            object table
+  GET  /api/objects            Objects/Memory view: object rows, per-node
+                               store stats (arena frag, spill tiers),
+                               transfer flight records (?leaks=1 adds the
+                               ref-debt report)
+  GET  /api/objects/{id}       one object's lifecycle flight-recorder trail
   GET  /api/placement_groups   PG table
   GET  /api/jobs               submitted jobs (job module)
   POST /api/jobs               submit a job {entrypoint, env?, metadata?}
@@ -122,9 +126,32 @@ class DashboardHead:
         from ray_tpu.util import state
         return _json(await _off(state.summarize_tasks))
 
-    async def objects(self, _req):
+    async def objects(self, req):
+        """Objects/Memory view: owner-side object rows, per-node store
+        stats (arena fragmentation, spill tiers), the per-pull transfer
+        flight records, and — with ``?leaks=1`` — the ref-debt report
+        (the probe pings owners, so it is opt-in per request)."""
         from ray_tpu.util import state
-        return _json(await _off(state.list_objects))
+
+        want_leaks = req.query.get("leaks") in ("1", "true")
+
+        def collect():
+            out = {
+                "objects": state.list_objects(),
+                "memory": state.memory_summary(),
+                "transfers": state.transfers(limit=50),
+            }
+            if want_leaks:
+                out["leaks"] = state.memory_leaks()
+            return out
+
+        return _json(await _off(collect))
+
+    async def object_detail(self, req):
+        """One object's flight-recorder lifecycle trail."""
+        from ray_tpu.util import state
+        oid = req.match_info["object_id"]
+        return _json(await _off(lambda: state.explain_object(oid)))
 
     async def placement_groups(self, _req):
         from ray_tpu.util import state
@@ -537,6 +564,8 @@ class DashboardHead:
         r.add_get("/api/sched", self.sched)
         r.add_get("/api/tasks/summarize", self.tasks_summarize)
         r.add_get("/api/objects", self.objects)
+        r.add_get("/api/objects/{object_id:[0-9a-f]{8,}}",
+                  self.object_detail)
         r.add_get("/api/placement_groups", self.placement_groups)
         r.add_get("/api/jobs", self.jobs)
         r.add_post("/api/jobs", self.submit_job)

@@ -52,6 +52,14 @@ function fmtRes(res) {
   return Object.entries(res || {}).map(([k, v]) => `${k}:${Math.round(v * 100) / 100}`).join(" ");
 }
 
+function fmtB(n) {
+  if (n == null) return "?";
+  for (const u of ["B", "KiB", "MiB", "GiB"]) {
+    if (n < 1024 || u === "GiB") return `${Math.round(n * 10) / 10}${u}`;
+    n /= 1024;
+  }
+}
+
 const pages = {
   async overview() {
     const c = await api("cluster");
@@ -137,13 +145,6 @@ const pages = {
     /* Per-node runtime telemetry + task-stage latency percentiles: the
        self-instrumentation plane's aggregate view (/api/telemetry). */
     const data = await api("telemetry");
-    const fmtB = (n) => {
-      if (n == null) return "?";
-      for (const u of ["B", "KiB", "MiB", "GiB"]) {
-        if (n < 1024 || u === "GiB") return `${Math.round(n * 10) / 10}${u}`;
-        n /= 1024;
-      }
-    };
     const ms = (v) => `${Math.round(v * 1e5) / 100} ms`;
     const nodes = Object.entries(data.nodes || {});
     const stages = Object.entries(data.stage_latency || {}).filter(([, s]) => s);
@@ -197,6 +198,47 @@ const pages = {
           Object.entries(r.rejected || {}).slice(0, 4)
             .map(([n, c]) => `${n.slice(0, 8)}=${c}`).join(" "),
           r.task_count ?? ""])));
+  },
+
+  async objects() {
+    /* Object-plane view (/api/objects): per-node store/arena stats
+       (fragmentation, spill tiers), per-object memory rows, and the
+       transfer flight-recorder tail. */
+    const d = await api("objects");
+    const mem = d.memory || {};
+    const nodes = Object.entries(mem.nodes || {});
+    const rows = (mem.objects || []).slice(0, 200);
+    const transfers = (d.transfers || []).slice(0, 40);
+    return h("div", {},
+      h("h2", {}, "Object stores"),
+      table(["node", "used", "capacity", "frag", "objects", "pinned",
+        "deferred frees", "spilled local", "spilled external"],
+        nodes.map(([nid, s]) => [nid.slice(0, 12), fmtB(s.used),
+          fmtB(s.capacity),
+          s.frag_fraction == null ? "-"
+            : `${Math.round(s.frag_fraction * 100)}%`,
+          s.num_objects ?? "?", s.num_pinned ?? "?",
+          s.num_deferred_frees ?? 0,
+          `${s.num_spilled_local ?? 0} (${fmtB(s.spilled_local_bytes || 0)})`,
+          `${s.num_spilled_external ?? 0} (${fmtB(s.spilled_external_bytes || 0)})`])),
+      h("h2", {}, `Objects (${(mem.objects || []).length}, first 200)`),
+      table(["object id", "kind", "size", "pins", "refs l/s/b", "node"],
+        rows.map((r) => [
+          h("a", { class: "plain", href: `#object/${r.object_id || ""}` },
+            (r.object_id || "").slice(0, 14)),
+          r.kind || "", fmtB(r.size), r.pinned ?? 0,
+          r.refs ? `${r.refs.local}/${r.refs.submitted}/${r.refs.borrowers}` : "-",
+          (r.node_id || "").slice(0, 12) + (r.freed ? " (freed:deferred)" : "")])),
+      h("h2", {}, `Transfers (${transfers.length} newest)`),
+      table(["time", "object", "kind", "status", "bytes", "dur", "sources",
+        "steals", "retries", "relay", "node"],
+        transfers.map((t) => [
+          new Date((t.ts || 0) * 1000).toLocaleTimeString(),
+          (t.object_id || "").slice(0, 12), t.kind || "", badge(t.status),
+          fmtB(t.bytes), `${Math.round((t.duration_s || 0) * 1000)}ms`,
+          (t.sources_used || (t.source ? [t.source] : [])).length,
+          t.stolen ?? "", t.retried ?? "", t.relay_fraction ?? "",
+          t.node || ""])));
   },
 
   async pgs() {
@@ -476,6 +518,29 @@ async function taskDetail(taskId) {
         e.span_id || ""])));
 }
 
+async function objectDetail(objectId) {
+  /* One object's flight-recorder lifecycle trail (/api/objects/{id}). */
+  const d = await api(`objects/${objectId}`);
+  const events = d.events || [];
+  const t0 = events.length ? events[0].ts || 0 : 0;
+  return h("div", {},
+    h("h2", {}, `Object ${(d.id || objectId).slice(0, 14)}`),
+    h("div", { class: "cards" },
+      card("state", badge(d.state)),
+      card("size", d.size == null ? "?" : d.size),
+      card("owner", d.owner || "?"),
+      card("nodes", (d.nodes || []).join(" ") || "—"),
+      card("tiers", (d.tiers || []).join(" ") || "—")),
+    h("h2", {}, `Lifecycle (${events.length} events)`),
+    table(["t+", "event", "node", "tier", "size", "detail"],
+      events.map((e) => [
+        `${Math.round(((e.ts || 0) - t0) * 1000) / 1000}s`,
+        badge(e.event), e.node || "", e.tier || "", e.size ?? "",
+        ["source", "sources", "to", "holder", "uri", "zero_copy"]
+          .filter((k) => e[k] != null)
+          .map((k) => `${k}=${JSON.stringify(e[k])}`).join(" ")])));
+}
+
 async function jobDetail(jobId) {
   const info = await api(`jobs/${jobId}`).catch(() => ({}));
   const logs = await api(`jobs/${jobId}/logs`).catch(() => "");
@@ -502,6 +567,7 @@ async function render() {
     else if (hash.startsWith("actor/")) view = await actorDetail(hash.slice(6));
     else if (hash.startsWith("task/")) view = await taskDetail(hash.slice(5));
     else if (hash.startsWith("node/")) view = await nodeDetail(hash.slice(5));
+    else if (hash.startsWith("object/")) view = await objectDetail(hash.slice(7));
     else view = await (pages[hash] || pages.overview)();
     $("#refresh-state").textContent = "updated " + new Date().toLocaleTimeString();
   } catch (e) {

@@ -79,7 +79,10 @@ def load_shm_pool() -> Optional[ctypes.CDLL]:
                 ("rt_pool_block_size", ctypes.c_uint64,
                  [ctypes.c_void_p, ctypes.c_uint64]),
                 ("rt_pool_largest_free", ctypes.c_uint64,
-                 [ctypes.c_void_p])):
+                 [ctypes.c_void_p]),
+                ("rt_pool_free_blocks", ctypes.c_uint64,
+                 [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                  ctypes.c_uint64])):
             fn = getattr(lib, sym, None)
             if fn is not None:
                 fn.restype = res
@@ -186,6 +189,17 @@ class ShmPool:
         """Largest free block — the arena's fragmentation signal."""
         fn = getattr(self._lib, "rt_pool_largest_free", None)
         return int(fn(self._handle)) if fn is not None else 0
+
+    def free_blocks(self, max_n: int = 4096) -> list:
+        """Sizes of up to ``max_n`` free blocks (the fragmentation
+        histogram's raw data); [] when the cached .so predates the
+        introspection symbol."""
+        fn = getattr(self._lib, "rt_pool_free_blocks", None)
+        if fn is None or not self._handle:
+            return []
+        buf = (ctypes.c_uint64 * max_n)()
+        n = int(fn(self._handle, buf, max_n))
+        return [int(buf[i]) for i in range(min(n, max_n))]
 
     def close(self, unlink: bool = True):
         if self._handle:

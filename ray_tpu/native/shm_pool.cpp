@@ -154,6 +154,22 @@ uint64_t rt_pool_largest_free(void* handle) {
     return best;
 }
 
+// Free-block sizes, written into the caller's buffer (up to max_n).
+// Returns the TOTAL number of free blocks, which may exceed max_n — the
+// caller then knows its histogram is a sample.  Feeds the arena
+// fragmentation report (`raytpu memory`, raytpu_mem_arena_frag_fraction).
+uint64_t rt_pool_free_blocks(void* handle, uint64_t* out, uint64_t max_n) {
+    auto* p = static_cast<Pool*>(handle);
+    if (p == nullptr) return 0;
+    uint64_t n = 0;
+    for (const auto& kv : p->blocks) {
+        if (!kv.second.free) continue;
+        if (out != nullptr && n < max_n) out[n] = kv.second.size;
+        ++n;
+    }
+    return n;
+}
+
 void rt_pool_destroy(void* handle, int unlink_file) {
     auto* p = static_cast<Pool*>(handle);
     if (p == nullptr) return;

@@ -276,11 +276,22 @@ def cmd_explain(args):
     from ray_tpu.util import state as state_api
 
     report = state_api.explain(args.id)
+    if report.get("kind") is None:
+        # not a task/actor/pg: try the object-plane flight recorder (the
+        # explain CLI covers every id kind the runtime can explain)
+        obj = state_api.explain_object(args.id)
+        if obj.get("kind") is not None:
+            if getattr(args, "json", False):
+                print(json.dumps(obj, indent=2, default=str))
+            else:
+                _render_object_explain(obj)
+            return
     if getattr(args, "json", False):
         print(json.dumps(report, indent=2, default=str))
         return
     if report.get("kind") is None:
-        print(f"no task/actor/pg with id {args.id!r} in the event window")
+        print(f"no task/actor/pg/object with id {args.id!r} "
+              "in the event window")
         return
     kind = report["kind"]
     name = report.get("name") or (report.get("actor") or {}).get(
@@ -337,6 +348,77 @@ def cmd_explain(args):
         print("  (no records — was the id right, and did it age out?)")
 
 
+def _render_object_explain(report):
+    """``raytpu explain <object_id>`` — the object's lifecycle trail:
+    every flight-recorder transition (created/sealed/spilled/restored/
+    transferred/re-homed/freed) with node, tier and size history.  The
+    leaked/slow-object debugging entry point (see README "Debugging a
+    leaked / slow object")."""
+    head = f"object ({report['id'][:16]}) — {report.get('state', '?')}"
+    if report.get("size") is not None:
+        head += f"  {_fmt_bytes(report['size'])}"
+    print(head)
+    if report.get("owner"):
+        print(f"  owner={report['owner']}")
+    if report.get("nodes"):
+        print(f"  nodes seen: {', '.join(report['nodes'])}")
+    if report.get("tiers"):
+        print(f"  spill tiers touched: {', '.join(report['tiers'])}")
+    events = report.get("events") or []
+    if not events:
+        print("  (no events — was the id right, and did it age out?)")
+        return
+    t0 = events[0].get("ts", 0.0)
+    print("lifecycle trail:")
+    for ev in events:
+        line = (f"  +{ev.get('ts', 0.0) - t0:8.3f}s  "
+                f"{ev.get('event', '?'):<14}")
+        for k in ("node", "tier", "size", "source", "sources", "to",
+                  "holder", "pins", "uri", "zero_copy"):
+            if ev.get(k) is not None:
+                v = ev[k]
+                if k == "size":
+                    v = _fmt_bytes(v)
+                line += f" {k}={str(v)[:48]}"
+        print(line)
+
+
+def cmd_transfers(args):
+    """``raytpu transfers`` — completed-pull flight records from every
+    node's bounded ring: per-source stripe stats, steal/retry counts and
+    relay fraction per chunked pull, plus zero-copy proxy attaches."""
+    _connect()
+    from ray_tpu.util import state as state_api
+
+    rows = state_api.transfers(limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    if not rows:
+        print("no recorded transfers (ring empty — pulls happen on "
+              "cross-node reads; is object_metrics_enabled on?)")
+        return
+    print(f"{'OBJECT_ID':<16} {'KIND':<8} {'STATUS':<9} {'BYTES':>10} "
+          f"{'DUR':>8} {'SRCS':>4} {'STEAL':>5} {'RETRY':>5} {'RELAY':>6}  "
+          f"NODE")
+    for r in rows:
+        srcs = len(r.get("sources_used", []) or ([r["source"]]
+                                                 if r.get("source") else []))
+        relay = r.get("relay_fraction")
+        print(f"{r['object_id'][:14]:<16} {r.get('kind', '?'):<8} "
+              f"{r.get('status', '?'):<9} {_fmt_bytes(r.get('bytes')):>10} "
+              f"{r.get('duration_s', 0):>7.3f}s {srcs:>4} "
+              f"{r.get('stolen', 0):>5} {r.get('retried', 0):>5} "
+              f"{relay if relay is not None else '-':>6}  "
+              f"{r.get('node', '?')}")
+        for addr, src in sorted((r.get("per_source") or {}).items()):
+            print(f"    {addr:<28} chunks={src.get('chunks', 0):<5} "
+                  f"bytes={_fmt_bytes(src.get('bytes', 0)):<10} "
+                  f"failures={src.get('failures', 0)}"
+                  + (" partial" if src.get("partial") else "")
+                  + (" DEAD" if src.get("dead") else ""))
+
+
 def cmd_list(args):
     rt = _connect()
     from ray_tpu.util import state as state_api
@@ -368,6 +450,30 @@ def cmd_memory(args):
     _connect()
     from ray_tpu.util import state as state_api
 
+    if getattr(args, "leaks", False):
+        leaks = state_api.memory_leaks(pin_ttl_s=args.pin_ttl)
+        if args.json:
+            print(json.dumps(leaks, indent=2, default=str))
+            return
+        if not leaks:
+            print("no leak suspects")
+            return
+        print(f"{len(leaks)} leak suspect(s):")
+        for r in leaks:
+            line = (f"  {r.get('kind', '?'):<14} {r['object_id'][:16]:<18} "
+                    f"node={r.get('node', '?')}")
+            for k in ("holder", "owner", "age_s", "pins", "accounted",
+                      "size"):
+                if r.get(k) is not None:
+                    v = _fmt_bytes(r[k]) if k == "size" else r[k]
+                    line += f" {k}={v}"
+            refs = r.get("refs")
+            if refs:
+                line += (f" refs(l/s/b)={refs['local']}/{refs['submitted']}"
+                         f"/{refs['borrowers']}")
+            print(line)
+        return
+
     report = state_api.memory_summary()
     if args.json:
         print(json.dumps(report, indent=2, default=str))
@@ -380,7 +486,16 @@ def cmd_memory(args):
                 f"{st['num_deferred_frees']} deferred frees")
         if st.get("largest_free_block"):
             line += f", largest free {_fmt_bytes(st['largest_free_block'])}"
+        if st.get("frag_fraction"):
+            line += f", frag {st['frag_fraction']:.0%}"
         print(line)
+        # spill tiers: external bytes/objects used to be invisible here
+        # (only the cumulative spill counter saw them)
+        if st.get("num_spilled_local") or st.get("num_spilled_external"):
+            print(f"  spilled: local {st.get('num_spilled_local', 0)} obj "
+                  f"({_fmt_bytes(st.get('spilled_local_bytes', 0))}), "
+                  f"external {st.get('num_spilled_external', 0)} obj "
+                  f"({_fmt_bytes(st.get('spilled_external_bytes', 0))})")
     rows = report["objects"]
     if not rows:
         print("no tracked objects")
@@ -538,6 +653,46 @@ def _render_top(store, alive_nodes) -> str:
     else:
         lines.append("TRAIN  (no raytpu_train_* series; is a run live and "
                      "train_metrics_enabled on?)")
+
+    # object-plane rollup: copy amplification (bytes_copied/bytes_moved
+    # over the raytpu_object_bytes_total ledger — delegated to the
+    # canonical object_explain.copy_amplification so the weighting lives
+    # in ONE place), worst arena fragmentation, spill-tier residency and
+    # leak suspects
+    import re as _re
+
+    from ray_tpu.core.object_explain import copy_amplification
+
+    frag, spill_b, leak_n = [], 0.0, 0.0
+    ledger: dict = {}
+    name = "raytpu_object_bytes_total"
+    for nid, _row in alive_nodes:
+        s = latest.get(nid) or {}
+        if "error" in s:
+            continue
+        frag += find_samples(s, "raytpu_mem_arena_frag_fraction")
+        spill_b += sum(find_samples(s, "raytpu_mem_spill_bytes"))
+        leak_n += sum(find_samples(s, "raytpu_mem_leak_suspects"))
+        for key, val in s.items():
+            if key != name and not key.startswith(name + "{"):
+                continue
+            tags = tuple(sorted(
+                (m.group(1), m.group(2)) for m in
+                _re.finditer(r'(\w+)="([^"]*)"', key)
+                if m.group(1) in ("path", "copies")))
+            ledger[tags] = ledger.get(tags, 0.0) + val
+    if ledger or frag:
+        amp = copy_amplification(ledger)
+        lines.append(
+            "OBJECT "
+            + (f"copy_amp={amp:.2f}  " if amp is not None
+               else "copy_amp=-  ")
+            + (f"arena_frag={max(frag):.0%}  " if frag else "arena_frag=-  ")
+            + f"spilled={_fmt_bytes(spill_b)}  "
+            + f"leak_suspects={int(leak_n)}")
+    else:
+        lines.append("OBJECT (no raytpu_object_* series; is "
+                     "object_metrics_enabled on?)")
 
     # serve rollup
     req_s, ttft = 0.0, []
@@ -834,20 +989,36 @@ def main(argv=None):
     s.add_argument("--state", default=None)
     s.set_defaults(fn=cmd_down)
 
-    s = sub.add_parser("explain", help="decision trail for one task/actor/"
-                       "pg id: pending reason transitions + scheduler "
-                       "decision records (why is it not running?)")
-    s.add_argument("id", help="task / actor / placement-group id (hex)")
+    s = sub.add_parser("explain", help="decision/lifecycle trail for one "
+                       "task/actor/pg/object id: pending reason or object "
+                       "lifecycle transitions + decision records (why is "
+                       "it not running / where did its bytes go?)")
+    s.add_argument("id", help="task / actor / placement-group / object "
+                              "id (hex)")
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_explain)
+
+    s = sub.add_parser("transfers", help="per-pull flight records: "
+                       "per-source stripe stats, steals/retries, relay "
+                       "fraction (why was this broadcast slow?)")
+    s.add_argument("--limit", type=int, default=50)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_transfers)
 
     s = sub.add_parser("list", help="state API listings")
     s.add_argument("kind")
     s.set_defaults(fn=cmd_list)
 
-    s = sub.add_parser("memory", help="per-object store/refcount report")
+    s = sub.add_parser("memory", help="per-object store/refcount report "
+                                      "(+ --leaks ref-debt suspects)")
     s.add_argument("--json", action="store_true",
                    help="machine-readable full report")
+    s.add_argument("--leaks", action="store_true",
+                   help="ref-debt report: pins past TTL, deferred frees "
+                        "stuck behind vanished pins, owner-lost objects")
+    s.add_argument("--pin-ttl", type=float, default=None,
+                   help="--leaks pin-age threshold in seconds "
+                        "(default: config object_pin_leak_ttl_s)")
     s.set_defaults(fn=cmd_memory)
 
     s = sub.add_parser("top", help="live cluster view: per-node cpu/shm/"

@@ -152,6 +152,66 @@ def explain(id: str) -> Dict[str, Any]:
     return _gcs_call("explain", id=id)
 
 
+def explain_object(id: str) -> Dict[str, Any]:
+    """The object-plane flight recorder's trail for ONE object id (hex):
+    lifecycle transition events (CREATED/INLINED/SEALED/PINNED/SPILLED/
+    RESTORED/TRANSFERRED/RE_HOMED/FREED) with owner + node + tier history,
+    oldest first, plus the latest state — the programmatic face of
+    ``raytpu explain <object_id>``."""
+    return _gcs_call("explain_object", id=id)
+
+
+def object_events(limit: int = 200, id: str | None = None,
+                  event: str | None = None) -> List[Dict[str, Any]]:
+    """Tail of the GCS object lifecycle event ring, newest first."""
+    return _gcs_call("get_object_events", limit=limit, id=id, event=event)
+
+
+def transfers(limit: int = 100) -> List[Dict[str, Any]]:
+    """Completed-pull flight records from every alive node's bounded
+    transfer ring, newest first: per-source bytes/chunks/failures,
+    steal/retry counts and relay fraction per chunked pull, plus
+    zero-copy proxy attaches — the post-hoc "how did this object get
+    here / why was this broadcast slow" surface (``raytpu transfers``)."""
+    w = global_worker()
+    view = _gcs_call("get_cluster_view")
+    out: List[Dict[str, Any]] = []
+    for _nid, info in view.items():
+        if not info.get("alive", True):
+            continue
+        client = w.agent_clients.get(info["address"])
+        try:
+            out.extend(run_async(client.call("transfers", limit=limit)))
+        except Exception:
+            continue
+    out.sort(key=lambda r: r.get("ts", 0.0), reverse=True)
+    return out[:limit]
+
+
+def memory_leaks(pin_ttl_s: float | None = None) -> List[Dict[str, Any]]:
+    """Ref-debt / leak suspects from every alive node's agent sweep
+    (``raytpu memory --leaks``): read pins held past the TTL by live
+    consumers, deferred frees stuck behind vanished pins, and sole-copy
+    objects whose owner process no longer answers — annotated with this
+    driver's refcounts where it holds references."""
+    w = global_worker()
+    view = _gcs_call("get_cluster_view")
+    leaks: List[Dict[str, Any]] = []
+    for _nid, info in view.items():
+        if not info.get("alive", True):
+            continue
+        client = w.agent_clients.get(info["address"])
+        try:
+            leaks.extend(run_async(client.call(
+                "store_leaks", pin_ttl_s=pin_ttl_s)))
+        except Exception:
+            continue
+    refs = w.reference_counter.summary()
+    for r in leaks:
+        r["refs"] = refs.get(r["object_id"])
+    return leaks
+
+
 def sched_stats() -> Dict[str, Any]:
     """Control-plane saturation rollup from the GCS: per-handler
     cumulative busy seconds (time each handler blocked the GCS loop),

@@ -102,6 +102,8 @@ def test_node_failure_task_retry(ray_start_cluster):
     assert ray_tpu.get(steady.remote(10), timeout=60) == 11
 
 
+@pytest.mark.slow  # ~140 s: the single heaviest tier-1 test (r12 budget
+# sweep); the single-loss reconstruction path stays tier-1 above
 @pytest.mark.timeout(240)
 def test_lineage_reconstruction_repeated_node_loss(ray_start_cluster):
     """Kill the node holding a lineage-reconstructable object TWICE (a

@@ -17,13 +17,23 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu.core import object_explain
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import NodeObjectStore, ObjectStoreFullError
 from ray_tpu.core.rpc import run_async
-from ray_tpu.util.metrics import copy_stats
+from ray_tpu.util.metrics import copy_stats, get_metric
 from ray_tpu.utils.testing import CPU_WORKER_ENV
 
 MB = 1 << 20
+
+
+def _ledger_value(key: tuple) -> float:
+    """Current raytpu_object_bytes_total value for one precomputed
+    path/copies key (0.0 before the series exists)."""
+    m = get_metric("raytpu_object_bytes_total")
+    if m is None:
+        return 0.0
+    return m.snapshot()["values"].get(key, 0.0)
 
 
 # ---------------------------------------------------------------- put path
@@ -31,14 +41,23 @@ MB = 1 << 20
 def test_put_exactly_one_copy_and_no_flatten(ray_start_regular):
     """Regression gate: a large-array put must write the payload into the
     arena exactly once (``object_write``) and never materialize it through
-    an intermediate full-payload ``bytes`` (``serialize_flatten``)."""
+    an intermediate full-payload ``bytes`` (``serialize_flatten``).
+
+    The runtime copy-amplification ledger must agree: the put path
+    accounts its bytes under ``{path="put", copies="1"}`` — the declared
+    1-copy class PROFILE_CORE.md measured offline, now asserted at
+    runtime (the zero-copy-put rewrite moves this to copies="0" and
+    updates COPY_CLASS, failing here if it forgets)."""
     big = np.random.default_rng(0).integers(0, 255, 8 * MB, np.uint8)
     copy_stats.reset()
+    put_before = _ledger_value(object_explain.KEY_PUT)
     ref = ray_tpu.put(big)
     assert copy_stats.count("object_write") == 1
     assert copy_stats.bytes("object_write") >= big.nbytes
     assert copy_stats.count("serialize_flatten") == 0, \
         "put path re-introduced an intermediate bytes materialization"
+    assert object_explain.COPY_CLASS["put"] == object_explain.COPY_ONE
+    assert _ledger_value(object_explain.KEY_PUT) - put_before >= big.nbytes
     del ref
 
 
@@ -61,6 +80,8 @@ def test_get_same_host_zero_copy(ray_start_regular):
     big = np.arange(4 * MB, dtype=np.uint8)
     ref = ray_tpu.put(big)
     copy_stats.reset()
+    get0_before = _ledger_value(object_explain.KEY_GET)
+    get1_before = _ledger_value(object_explain.KEY_GET_COPY)
     out = ray_tpu.get(ref)
     np.testing.assert_array_equal(out, big)
     # zero data copies: the array is a readonly view over the pinned mmap
@@ -68,6 +89,11 @@ def test_get_same_host_zero_copy(ray_start_regular):
     assert copy_stats.count("get_zero_copy") == 1
     assert not out.flags.writeable
     assert not out.flags.owndata
+    # ledger agreement: the bytes moved landed on the declared ZERO-copy
+    # get path, and the 1-copy fallback path saw none of them
+    assert object_explain.COPY_CLASS["get"] == object_explain.COPY_ZERO
+    assert _ledger_value(object_explain.KEY_GET) - get0_before >= big.nbytes
+    assert _ledger_value(object_explain.KEY_GET_COPY) == get1_before
     del out, ref
     gc.collect()
 

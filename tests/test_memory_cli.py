@@ -30,6 +30,44 @@ def test_list_memory_reports_plasma_object(ray_start_regular):
     del ref
 
 
+def test_memory_summary_reports_external_tier(tmp_path, capsys):
+    """Satellite (ISSUE 12): the external spill tier is part of
+    ``memory_summary()``/``raytpu memory`` — per-node external bytes and
+    object counts were previously invisible (only the cumulative
+    ``raytpu_spill_bytes_total`` counter saw them)."""
+    MB = 1 << 20
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * MB,
+                 _system_config={
+                     "object_spilling_external_uri":
+                         f"file://{tmp_path}/ext"})
+    try:
+        from ray_tpu.util import state as state_api
+
+        a = ray_tpu.put(np.arange(10 * MB, dtype=np.uint8))
+        b = ray_tpu.put(np.ones(10 * MB, np.uint8))  # evicts a -> external
+        import time
+        deadline = time.monotonic() + 15
+        st = {}
+        while time.monotonic() < deadline:
+            st = next(iter(state_api.memory_summary()["nodes"].values()))
+            if st.get("num_spilled_external", 0) >= 1:
+                break
+            time.sleep(0.1)
+        assert st["num_spilled_external"] >= 1, st
+        assert st["spilled_external_bytes"] >= 10 * MB, st
+        # the external copy also appears as an object row with its size
+        rows = state_api.memory_summary()["objects"]
+        ext = [r for r in rows if r["kind"] == "external"]
+        assert ext and ext[0]["size"] >= 10 * MB
+        # and the CLI prints the tier line
+        cli.main(["memory"])
+        out = capsys.readouterr().out
+        assert "external" in out
+        del a, b
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_memory_cli_smoke(ray_start_regular, capsys):
     ref = ray_tpu.put(np.zeros(1 << 20, np.uint8))
     cli.main(["memory"])

@@ -267,6 +267,131 @@ def test_sched_metric_tag_keys_are_bounded():
     assert seen >= 4, f"only {seen} sched/loop/gcs metrics found"
 
 
+# ------------------------------------------------- object-plane cardinality
+
+#: the label-set bound for the object/memory plane: path (the declared
+#: copy-path vocabulary), copies (the copy classes), tier (local/external)
+#: and node ONLY — never an object id, owner address, or URI.
+ALLOWED_OBJECT_TAG_KEYS = {"path", "copies", "tier", "node"}
+OBJECT_PREFIXES = ("raytpu_object_", "raytpu_mem_")
+
+
+def test_object_metric_tag_keys_are_bounded():
+    """Every ``raytpu_object_*`` / ``raytpu_mem_*`` metric anywhere in the
+    runtime declares only allowlisted tag keys (path/copies/tier/node)."""
+    problems = []
+    seen = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "metrics.py" and path.parent.name == "util":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for call, cls in _metric_calls(tree):
+            name_node = call.args[0] if call.args else None
+            if not (isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str)
+                    and name_node.value.startswith(OBJECT_PREFIXES)):
+                continue
+            seen += 1
+            where = f"{path.relative_to(PKG_ROOT.parent)}:{call.lineno}"
+            for kw in call.keywords:
+                if kw.arg != "tag_keys" or not isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    continue
+                for el in kw.value.elts:
+                    if (isinstance(el, ast.Constant)
+                            and el.value not in ALLOWED_OBJECT_TAG_KEYS):
+                        problems.append(
+                            f"{where}: {cls} {name_node.value!r} declares "
+                            f"tag key {el.value!r} outside "
+                            f"{sorted(ALLOWED_OBJECT_TAG_KEYS)}")
+    assert not problems, "\n".join(problems)
+    # store gauges + bytes ledger + frag/spill/leak gauges at minimum
+    assert seen >= 8, f"only {seen} object/mem metrics found"
+
+
+def test_copy_ledger_call_sites_use_declared_keys():
+    """Every ``ledger_record(...)`` call site passes a ``KEY_*`` constant
+    from core/object_explain — the copy-CLASS declaration lint: a new
+    byte-moving store/transfer path cannot account bytes without first
+    declaring its copy class in COPY_CLASS (an inline tuple or computed
+    key would be an unaudited copy and an unbounded label value)."""
+    import ray_tpu.core.object_explain as oe
+    key_names = {n for n in dir(oe) if n.startswith("KEY_")}
+    problems = []
+    sites = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "object_explain.py":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "ledger_record")
+                         or (isinstance(node.func, ast.Name)
+                             and node.func.id == "ledger_record"))):
+                continue
+            sites += 1
+            where = f"{path.relative_to(PKG_ROOT.parent)}:{node.lineno}"
+            key_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "key"), None)
+            ok = (isinstance(key_arg, ast.Name)
+                  and key_arg.id in key_names) \
+                or (isinstance(key_arg, ast.Attribute)
+                    and key_arg.attr in key_names)
+            if not ok:
+                problems.append(
+                    f"{where}: ledger_record key is not a KEY_* constant "
+                    "from core/object_explain (declare the path's copy "
+                    "class in COPY_CLASS first)")
+    assert not problems, "\n".join(problems)
+    # put/put_inline/get/get_copy/promote/transfer x2/spill/restore/
+    # re_home at minimum
+    assert sites >= 10, f"only {sites} ledger_record call sites found"
+
+
+def test_object_event_stamps_use_typed_vocabulary():
+    """Every object-event stamp site passes an ``ObjectEvent.<CONSTANT>``
+    (or a string literal in the closed set) — free-form event names would
+    be states nothing else understands."""
+    import ray_tpu.core.object_explain as oe
+    allowed = set(oe.ObjectEvent.ALL)
+    stamp_fns = {"object_event": 1, "_obj_event": 1, "_event": 1}
+    problems = []
+    stamps = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "object_explain.py":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in stamp_fns):
+                continue
+            idx = stamp_fns[node.func.attr]
+            if len(node.args) <= idx:
+                continue  # forwarding plumbing / unrelated _event method
+            ev = node.args[idx]
+            is_enum = (isinstance(ev, ast.Attribute)
+                       and ev.attr in allowed
+                       and isinstance(ev.value, (ast.Name, ast.Attribute)))
+            is_literal = (isinstance(ev, ast.Constant)
+                          and ev.value in allowed)
+            if not (is_enum or is_literal):
+                # tolerate non-object _event methods (other classes): only
+                # flag when the arg LOOKS like an event string
+                if isinstance(ev, ast.Constant) and isinstance(
+                        ev.value, str):
+                    problems.append(
+                        f"{path.relative_to(PKG_ROOT.parent)}:"
+                        f"{node.lineno}: {node.func.attr}() event "
+                        f"{ev.value!r} is not in ObjectEvent.ALL")
+                continue
+            stamps += 1
+    assert not problems, "\n".join(problems)
+    # seal/spill x2/restore x2/free x2 in the store + agent + owner sites
+    assert stamps >= 10, f"only {stamps} object-event stamps found"
+
+
 # ---------------------------------------------- pending-reason stamp lint
 
 #: call names whose "reason" argument becomes an event field / rollup key

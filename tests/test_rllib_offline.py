@@ -48,6 +48,7 @@ def test_bc_clones_expert(expert_corpus):
     assert score > 120, (score, m)
 
 
+@pytest.mark.slow  # ~22 s learning-threshold test (r12 wall-time budget)
 def test_marwil_beats_bc_on_mixed_data(tmp_path):
     """On a transition-balanced expert+random corpus the advantage
     weighting (beta>0) must up-weight expert transitions: MARWIL's eval
