@@ -570,6 +570,114 @@ def run_ab_submit_batching(S: float, pairs: int) -> dict:
             "off_config": SUBMIT_BATCH_OFF, "ratio_on_off": ratio}
 
 
+#: the "off" arm of the horizontal-control-plane A/B (PR-13): the PRE-PR
+#: submission/completion plane — per-result push frames, per-ref get
+#: waits, 16-task push batches, one GCS process (gcs_table_shards=1), one
+#: connection, no shard processes, no serialization pool, no lanes.
+CPSHARD_OFF = {
+    "completion_batching_enabled": False,
+    "max_tasks_in_flight_per_worker": 16,
+    "gcs_table_shards": 1,
+    "gcs_shard_processes": 0,
+    "gcs_client_connections": 1,
+    "agent_client_connections": 1,
+    "owner_serialize_threads": 0,
+    "control_plane_io_lanes": False,
+}
+
+#: the "on" arm: the shipped defaults (completion batching, 64-task push
+#: batches) plus 4 GCS shard processes fronted by the router and 2
+#: parallel GCS connections.  Worker-connection lanes and the owner
+#: serialization pool ship OFF by default: measured net-negative for
+#: these workloads on a GIL interpreter (see ARCHITECTURE.md
+#: "Horizontal control plane"), they exist for free-threaded builds and
+#: multi-driver topologies.
+CPSHARD_ON = {
+    "gcs_shard_processes": 4,
+    "gcs_client_connections": 2,
+}
+
+
+def _measure_cpshard(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster measurement of the control-plane A/B metrics:
+    tasks_async + pg_create_remove (the acceptance gates), a 50k-task
+    drain (the scale proxy), and the fast paths that must NOT regress
+    (get_small, put_gbps)."""
+    import numpy as np
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=8, object_store_memory=2 << 30,
+                 _system_config=system_config or None)
+    out = {}
+
+    @ray_tpu.remote
+    def noop(_x=None):
+        return None
+
+    try:
+        ray_tpu.get([noop.remote() for _ in range(8)])
+        n = int(1000 * S)
+        out["tasks_async"] = max(timeit(
+            lambda: ray_tpu.get([noop.remote() for _ in range(n)]), n))
+
+        n = max(int(20 * S), 5)
+
+        def pg_cycle():
+            for _ in range(n):
+                pg = ray_tpu.placement_group([{"CPU": 1}])
+                pg.ready(timeout=30)
+                ray_tpu.remove_placement_group(pg)
+
+        out["pg_create_remove"] = max(timeit(pg_cycle, n))
+
+        nd = int(50_000 * S)
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(nd)]
+        for i in range(0, nd, 10_000):
+            ray_tpu.get(refs[i:i + 10_000], timeout=900)
+        out["drain_tasks_per_s"] = round(nd / (time.perf_counter() - t0), 1)
+
+        small = ray_tpu.put(np.zeros(16))
+        n = int(2000 * S)
+        out["get_small"] = max(timeit(
+            lambda: [ray_tpu.get(small) for _ in range(n)], n))
+
+        big = np.zeros(64 * 1024 * 1024, np.uint8)
+        n = max(int(8 * S), 2)
+
+        def put_big():
+            for _ in range(n):
+                ray_tpu.put(big)
+
+        out["put_gbps"] = max(ops * big.nbytes / 1e9
+                              for ops in timeit(put_big, n))
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_cpshard(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: the horizontal control plane (GCS shard
+    processes + completion batching + bigger push batches) vs the pre-PR
+    single-process, single-lane plane (the ISSUE-13 acceptance gate)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_cpshard(S, dict(CPSHARD_ON)))
+        off_runs.append(_measure_cpshard(S, dict(CPSHARD_OFF)))
+        print(f"# cpshard ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "on_config": CPSHARD_ON, "off_config": CPSHARD_OFF,
+            "ratio_on_off": ratio,
+            "vs_baseline_on": {
+                k: round(med([r[k] for r in on_runs]) / BASELINE[k], 3)
+                for k in on_runs[0] if k in BASELINE}}
+
+
 def run_ab_fastpath(S: float, pairs: int) -> dict:
     """Interleaved same-box A/B: fast path ON vs OFF, alternating fresh
     clusters so box drift lands evenly on both arms."""
@@ -622,6 +730,11 @@ def main():
                         "sched_metrics_enabled on vs off (tasks_async + "
                         "submit_burst; the scheduler-observability "
                         "overhead gate)")
+    p.add_argument("--ab-cpshard", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of the "
+                        "horizontal control plane (GCS shard processes + "
+                        "completion batching) on vs the pre-PR "
+                        "single-process single-lane plane")
     p.add_argument("--ab-object", type=int, default=0, metavar="PAIRS",
                    help="also run PAIRS interleaved A/B pairs of "
                         "object_metrics_enabled on vs off (put GB/s, "
@@ -678,6 +791,8 @@ def main():
     if args.ab_object > 0:
         out["object_obs_ab"] = run_ab_object_obs(args.scale,
                                                  args.ab_object)
+    if args.ab_cpshard > 0:
+        out["cpshard_ab"] = run_ab_cpshard(args.scale, args.ab_cpshard)
     line = json.dumps(out)
     print(line)
     if args.out:

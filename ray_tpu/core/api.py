@@ -38,6 +38,10 @@ class _GlobalState:
         self.worker: Optional[CoreWorker] = None
         self.gcs_address: Optional[str] = None
         self.session_dir: Optional[str] = None
+        # IO-loop lanes the embedded control plane runs on (config
+        # control_plane_io_lanes; 0 = the shared default loop)
+        self.gcs_lane = 0
+        self.agent_lane = 0
 
 
 _state = _GlobalState()
@@ -92,9 +96,16 @@ def init(address: Optional[str] = None,
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
     _state.session_dir = session_dir
 
+    # With control_plane_io_lanes the embedded GCS and agent each get
+    # their OWN IO-loop thread: GCS handlers, agent lease/store handlers,
+    # and the owner submission path stop contending for one loop (the
+    # single-process head's structural ceiling — ROADMAP item 5).
+    use_lanes = get_config().control_plane_io_lanes
+    _state.gcs_lane = "cp-gcs" if use_lanes else 0
+    _state.agent_lane = "cp-agent" if use_lanes else 0
     if address in (None, "local"):
-        gcs = GcsServer()
-        run_async(gcs.start())
+        gcs = GcsServer(session_dir=session_dir)
+        run_async(gcs.start(), lane=_state.gcs_lane)
         _state.gcs_server = gcs
         gcs_address = gcs.address
     else:
@@ -113,7 +124,7 @@ def init(address: Optional[str] = None,
                           resources=resources, labels=labels,
                           session_dir=session_dir, worker_env=worker_env,
                           object_store_memory=object_store_memory)
-        run_async(agent.start())
+        run_async(agent.start(), lane=_state.agent_lane)
         _state.node_agent = agent
 
     worker = CoreWorker(mode="driver", gcs_address=gcs_address,
@@ -222,16 +233,20 @@ def shutdown():
         _state.worker = None
     if _state.node_agent is not None:
         try:
-            run_async(_state.node_agent.stop(), timeout=5)
+            run_async(_state.node_agent.stop(), timeout=5,
+                      lane=_state.agent_lane)
         except Exception:
             pass
         _state.node_agent = None
+        _state.agent_lane = 0
     if _state.gcs_server is not None:
         try:
-            run_async(_state.gcs_server.stop(), timeout=5)
+            run_async(_state.gcs_server.stop(), timeout=5,
+                      lane=_state.gcs_lane)
         except Exception:
             pass
         _state.gcs_server = None
+        _state.gcs_lane = 0
     try:
         atexit.unregister(shutdown)
     except Exception:

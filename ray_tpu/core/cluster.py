@@ -36,12 +36,12 @@ class Cluster:
 
     def __init__(self, initialize_head: bool = True,
                  head_node_args: Optional[dict] = None):
-        self.gcs = GcsServer()
-        run_async(self.gcs.start())
-        self.nodes: List[ClusterNode] = []
         self.session_dir = os.path.join(
             "/tmp/raytpu", f"cluster-{int(time.time() * 1000)}-{os.getpid()}")
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.gcs = GcsServer(session_dir=self.session_dir)
+        run_async(self.gcs.start())
+        self.nodes: List[ClusterNode] = []
         if initialize_head:
             self.add_node(**(head_node_args or {}))
 

@@ -69,8 +69,13 @@ class Config:
     #: (plasma pre-touches its dlmalloc arena the same way).
     object_store_prefault: bool = True
     #: Max tasks sent to one leased worker in a single batched push RPC
-    #: (reference: ``max_tasks_in_flight_per_worker``).
-    max_tasks_in_flight_per_worker: int = 16
+    #: (reference: ``max_tasks_in_flight_per_worker``).  64 (up from 16):
+    #: with per-tick result-push coalescing, bigger batches amortize the
+    #: owner-loop per-batch costs without serializing whole-node
+    #: parallelism (the pump still splits the queue over expected
+    #: capacity) — measured +20% on the 100k-task drain on an 8-worker
+    #: box.
+    max_tasks_in_flight_per_worker: int = 64
     #: Max actor calls coalesced into one batched submission RPC per handle.
     actor_call_pipeline: int = 32
 
@@ -132,6 +137,49 @@ class Config:
     #: pauses are bounded by the largest shard and maintenance scans can
     #: yield between shards (core/sharded_table.py).
     gcs_table_shards: int = 16
+    # -- horizontal control plane (multi-process GCS + submission lanes) ---
+    #: Number of GCS shard PROCESSES (core/gcs_shard.py): the hot,
+    #: key-partitionable control-plane traffic (KV by namespace, task/
+    #: object/sched event fan-in) is served by N subprocesses — each with
+    #: its own event loop, RPC server, and snapshot file — fronted by the
+    #: router (core/gcs.py), which keeps the globally-ordered concerns
+    #: (nodes, jobs, actors, PG 2PC, pubsub).  0 disables (single-process
+    #: GCS, exactly the pre-shard behavior).  Changing this count between
+    #: incarnations of a persisted GCS is NOT supported: shard snapshot
+    #: files restore by shard index (see ARCHITECTURE.md "Horizontal
+    #: control plane").
+    gcs_shard_processes: int = 0
+    #: Parallel client connections to the GCS router/shards per process
+    #: (the owner's kv + event-flush traffic fans over these; each extra
+    #: connection lives on its own IO-loop lane thread).  1 = the single
+    #: shared connection (historical behavior).
+    gcs_client_connections: int = 1
+    #: IO-loop lanes for the owner's worker/agent connections: addresses
+    #: are spread (sticky) over this many loop threads, so the per-frame
+    #: pickle/unpickle and socket syscalls of different peers' connections
+    #: overlap on separate OS threads instead of serializing on one loop.
+    #: Per-lane FIFO ordering is preserved (an address keeps its lane).
+    #: 1 = everything on the default loop (historical behavior).
+    agent_client_connections: int = 1
+    #: Completion batching (the PR-13 drain fast path): workers coalesce
+    #: same-tick task results into one ``task_result_batch`` push frame,
+    #: and owned-ref batch gets wait on ONE shared future instead of a
+    #: per-ref coroutine + Event gather.  The A/B off arm restores the
+    #: per-result frame / per-ref wait plane.
+    completion_batching_enabled: bool = True
+    #: Owner-side serialization thread pool: spec wire-encoding (template
+    #: cache + args pickling) for push batches runs on this many pool
+    #: threads instead of the RPC loop, overlapping pickle time with the
+    #: loop's socket work.  0 encodes inline on the loop (historical).
+    owner_serialize_threads: int = 0
+    #: Run the EMBEDDED control plane (the GCS server and node agent that
+    #: ``init(address=None)`` boots inside the driver process) on their
+    #: own IO-loop threads instead of the driver's shared loop — the
+    #: single-loop ceiling fix for the one-process head: GCS handlers,
+    #: agent lease/store handlers, and the owner submission path stop
+    #: contending for one thread.  Off by default (tests may reach into
+    #: embedded components assuming loop-0 confinement).
+    control_plane_io_lanes: bool = False
     #: Per-topic pubsub log length at the GCS.  Each topic keeps its own
     #: seq-ordered log (polls bisect past their cursor instead of scanning
     #: global traffic); a subscriber lagging more than this many events on

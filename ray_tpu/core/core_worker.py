@@ -872,12 +872,13 @@ class LeasePool:
         through the template cache (invariant portion by hash; args + ids
         per call).  The connection is established FIRST so the encoder's
         delivered-set tracks the connection these frames ride."""
-        await client._ensure_connected()
+        await client.ensure_connected()
         # serialization-time attribution (sched_metrics_enabled) rides
         # _timed_encode: the owner-side pickling cost per push batch is
         # one of the candidate ceilings on the single-loop submit path
-        # (ROADMAP 5)
-        payloads = self.w._timed_encode(client, specs)
+        # (ROADMAP 5).  With owner_serialize_threads the encode runs on
+        # the serialization pool instead of blocking this loop.
+        payloads = await self.w._encode_offloaded(client, specs)
         if (len(specs) == 1
                 and specs[0].num_returns != STREAMING_RETURNS):
             return [await client.call("push_task", spec=payloads[0],
@@ -1055,12 +1056,33 @@ class CoreWorker:
         self.server = RpcServer(self, "127.0.0.1", 0)
         self.gcs: Optional[RpcClient] = None
         self.agent: Optional[RpcClient] = None
-        self.agent_clients = ClientPool()
+        cfg_boot = get_config()
+        # Submission lanes (ROADMAP 5): worker/agent connections spread
+        # (sticky per address) over agent_client_connections IO-loop
+        # threads, so different peers' frame codecs and socket syscalls
+        # overlap on separate OS threads.  Owner STATE stays lane-0
+        # confined: laned clients' pushes hop back via _on_peer_push_routed.
+        lanes = max(1, cfg_boot.agent_client_connections)
+        self.agent_clients = ClientPool(lanes=lanes)
         # Worker peers stream per-task results as pushes on the batch
         # connection (see handle_push_task_batch): route them straight into
         # the task manager so a consumer elsewhere in the same batch can
         # resolve its dependency without waiting for the batch reply.
-        self.worker_clients = ClientPool(push_handler=self._on_peer_push)
+        # Single-lane pools skip the thread-routing shim entirely.
+        self.worker_clients = ClientPool(
+            push_handler=(self._on_peer_push if lanes == 1
+                          else self._on_peer_push_routed),
+            lanes=lanes)
+        # Owner-side serialization pool (owner_serialize_threads): spec
+        # wire-encoding for push batches runs here instead of on the RPC
+        # loop, overlapping pickle time with the loop's socket work.
+        if cfg_boot.owner_serialize_threads > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            self._ser_pool = ThreadPoolExecutor(
+                cfg_boot.owner_serialize_threads,
+                thread_name_prefix="raytpu-ser")
+        else:
+            self._ser_pool = None
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(self)
         # result-object id -> [(contained oid, owner)] borrows registered at
@@ -1151,7 +1173,14 @@ class CoreWorker:
 
     async def _start(self):
         await self.server.start()
-        self.gcs = RpcClient(self.gcs_address)
+        # Shard-aware control-plane client (core/gcs_router.py): hot
+        # per-task traffic (kv, task/object/sched event flushes) goes
+        # client->shard direct by key once the shard map arrives; the
+        # globally-ordered methods go to the router.  With sharding off
+        # this degrades to exactly the old single connection.
+        from .gcs_router import ShardedGcsClient
+        self.gcs = ShardedGcsClient(self.gcs_address,
+                                    identity=self.worker_id.hex())
         if self.agent_address:
             self.agent = self.agent_clients.get(self.agent_address)
         if get_config().task_events_enabled or object_explain.enabled():
@@ -1202,6 +1231,8 @@ class CoreWorker:
         self._shutdown = True
         if getattr(self, "_loop_monitor", None):
             self._loop_monitor.stop()
+        if self._ser_pool is not None:
+            self._ser_pool.shutdown(wait=False)
 
         async def _stop():
             for t in self._bg:
@@ -1274,6 +1305,17 @@ class CoreWorker:
         if om is not None:
             om["serialize"].observe(time.perf_counter() - t0)
         return payloads
+
+    async def _encode_offloaded(self, client, specs: List[TaskSpec]) -> list:
+        """Wire-encode a push batch, on the serialization pool when
+        configured (owner_serialize_threads — the submission-lane split:
+        pickling overlaps the loop's socket work) or inline otherwise.
+        Single-spec batches stay inline: the executor hop costs more than
+        a warm one-spec encode."""
+        if self._ser_pool is not None and len(specs) > 1:
+            return await asyncio.get_event_loop().run_in_executor(
+                self._ser_pool, self._timed_encode, client, specs)
+        return self._timed_encode(client, specs)
 
     def pending_reason(self, spec: TaskSpec, reason: str, **detail):
         """Stamp a typed pending-reason transition onto the task-event
@@ -1527,6 +1569,26 @@ class CoreWorker:
 
     async def get_async_many(self, refs: List[ObjectRef],
                              timeout: Optional[float] = None) -> List[Any]:
+        # Batched wait for OWNED refs (the drain hot path): one shared
+        # future wakes when the last result lands (MemoryStore.wait_many)
+        # instead of a gather over per-ref coroutines + Events — the
+        # owner-loop get machinery was one of the measured single-loop
+        # ceilings (ROADMAP 5).  Borrowed refs keep the per-ref path
+        # (owner round trips are genuinely per-ref).
+        if (get_config().completion_batching_enabled
+                and all(r.owner in ("", self.address) for r in refs)):
+            ok = await self.memory_store.wait_many(
+                [r.id for r in refs], timeout)
+            if not ok:
+                raise GetTimeoutError(
+                    f"timed out waiting for {len(refs)} objects")
+            records = [self.memory_store.get_if_exists(r.id) for r in refs]
+            if any(isinstance(rec, PlasmaRecord) for rec in records):
+                return list(await asyncio.gather(
+                    *[self._record_to_value(r, rec)
+                      for r, rec in zip(refs, records)]))
+            return [self._inline_record_to_value(r, rec)
+                    for r, rec in zip(refs, records)]
         return list(await asyncio.gather(*[self.get_async(r, timeout) for r in refs]))
 
     async def get_async(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
@@ -2041,8 +2103,8 @@ class CoreWorker:
                 # METHOD descriptor (actor id, method name, options) interns
                 # once per handle; each call ships args + ids.  Connect
                 # first so the delivered-set tracks this connection.
-                await client._ensure_connected()
-                payloads = self._timed_encode(client, specs)
+                await client.ensure_connected()
+                payloads = await self._encode_offloaded(client, specs)
                 if (len(specs) == 1
                         and specs[0].num_returns != STREAMING_RETURNS):
                     # Single non-streaming call: token'd retry.  A reply
@@ -2553,8 +2615,27 @@ class CoreWorker:
         moment it finishes (req_id -1 frame on the batch connection).  This
         is what makes batching deadlock-free: a consumer later in the batch
         (or holding the producer's ref indirectly) can resolve it at the
-        owner without waiting for the whole batch to reply."""
+        owner without waiting for the whole batch to reply.
+
+        Results completing in the same loop tick COALESCE into one
+        ``task_result_batch`` push frame (one pickle + one frame per tick
+        instead of per task) — the per-result frame overhead was one of
+        the measured owner/worker-loop ceilings on big drains."""
         from .rpc import _encode, coalesced_write
+
+        def _flush():
+            buf = getattr(writer, "_raytpu_result_buf", None)
+            writer._raytpu_result_buf = None
+            if not buf:
+                return
+            try:
+                # Same coalescing as the reply path: every frame on this
+                # writer must queue through coalesced_write or interleaved
+                # direct writes would reorder against buffered ones.
+                coalesced_write(writer, _encode(
+                    (-1, "task_result_batch", {"results": buf})))
+            except Exception:
+                pass  # connection gone: the batch reply path handles it
 
         def _cb(fut):
             # A streaming task that failed before its generator body ran
@@ -2562,21 +2643,60 @@ class CoreWorker:
             # (the one chokepoint every batch-dispatched task passes).
             self._gen_emitters.pop(task_id, None)
             try:
-                # Same coalescing as the reply path: every frame on this
-                # writer must queue through coalesced_write or interleaved
-                # direct writes would reorder against buffered ones.
-                coalesced_write(writer, _encode((-1, "task_result",
-                                                 {"task_id": task_id,
-                                                  "results": fut.result()})))
+                results = fut.result()
             except Exception:
-                pass  # connection gone: the batch reply path handles it
+                return
+            if not get_config().completion_batching_enabled:
+                # A/B off arm: one push frame per result, as before
+                try:
+                    coalesced_write(writer, _encode(
+                        (-1, "task_result",
+                         {"task_id": task_id, "results": results})))
+                except Exception:
+                    pass
+                return
+            buf = getattr(writer, "_raytpu_result_buf", None)
+            if buf is None:
+                buf = writer._raytpu_result_buf = []
+                try:
+                    asyncio.get_event_loop().call_soon(_flush)
+                except RuntimeError:
+                    writer._raytpu_result_buf = None
+                    try:
+                        coalesced_write(writer, _encode(
+                            (-1, "task_result",
+                             {"task_id": task_id, "results": results})))
+                    except Exception:
+                        pass
+                    return
+            buf.append((task_id, results))
 
         return _cb
+
+    def _on_peer_push_routed(self, topic: str, payload: dict):
+        """Push-handler shim for laned connections: completion bookkeeping
+        (task manager, memory store, streams) is lane-0-confined state, so
+        pushes arriving on a submission lane's read loop hop home first.
+        call_soon_threadsafe is FIFO per calling thread, and a connection
+        lives wholly on one lane — per-connection ordering (yield index
+        order, yields-before-final-result) is preserved."""
+        loop0 = get_loop()
+        try:
+            on_home = asyncio.get_running_loop() is loop0
+        except RuntimeError:
+            on_home = False
+        if on_home:
+            self._on_peer_push(topic, payload)
+        else:
+            loop0.call_soon_threadsafe(self._on_peer_push, topic, payload)
 
     def _on_peer_push(self, topic: str, payload: dict):
         if topic == "task_result":
             self.task_manager.complete(payload["task_id"],
                                        payload["results"])
+        elif topic == "task_result_batch":
+            for task_id, results in payload["results"]:
+                self.task_manager.complete(task_id, results)
         elif topic == "gen_yield":
             self._on_gen_yield(payload["task_id"], payload["index"],
                                payload["result"], payload["worker"])

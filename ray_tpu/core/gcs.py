@@ -45,10 +45,26 @@ from .sharded_table import SecondaryIndex, ShardedTable
 
 
 class GcsServer:
+    """The control-plane ROUTER: owns everything that needs global
+    ordering (node table, jobs, actor registration + scheduling, PG 2PC,
+    pubsub seq space) and fronts the optional GCS shard processes
+    (``gcs_shard_processes > 0``, core/gcs_shard.py) that serve the hot
+    key-partitionable traffic.  With shards enabled, shard-routable
+    handlers here PROXY to the owning shard — so legacy clients keep
+    working — while shard-aware clients (core/gcs_router.ShardedGcsClient)
+    go client->shard direct by key."""
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 persistence_path: Optional[str] = None):
+                 persistence_path: Optional[str] = None,
+                 session_dir: Optional[str] = None):
         self.server = RpcServer(self, host, port)
         cfg = get_config()
+        self.session_dir = session_dir
+        # shard-process plane (started in start() when configured)
+        self._shard_procs: List = []          # Popen per shard index
+        self._shard_addrs: List[str] = []
+        self._shard_clients: List = []        # RpcClient per shard index
+        self._shard_map_version = 0
         self.nodes: Dict[str, NodeView] = {}
         self.node_last_seen: Dict[str, float] = {}
         # Pubsub: PER-TOPIC seq-ordered logs (a poll for topic T touches
@@ -56,7 +72,13 @@ class GcsServer:
         # topic's traffic), fanned out once per loop tick (_fanout_tick).
         self._topic_logs: Dict[str, List[Tuple[int, dict]]] = {}
         self._event_seq = 0
-        self._event_waiters: List[asyncio.Event] = []
+        # parked pubsub polls: event -> the topic set it waits on.  Fanout
+        # is TOPIC-AWARE: a tick's publishes wake only the subscribers of
+        # the touched topics — waking every parked poll on every publish
+        # made each control-plane transition (PG create, actor state) cost
+        # an extra poll round trip per unrelated subscriber.
+        self._event_waiters: Dict[asyncio.Event, frozenset] = {}
+        self._fanout_topics: set = set()
         self._fanout_scheduled = False
         # Hot tables are hash-sharded (bounded rehash pauses, per-shard
         # iteration) with O(1)-maintained reverse indexes replacing every
@@ -114,6 +136,7 @@ class GcsServer:
             # spending its time on" half of the explain plane
             self.server.busy_cb = self._on_handler_busy
         await self.server.start()
+        await self._start_shards()
         self._restart_pending_pgs()
         self._restart_pending_actors()
         self._bg.append(asyncio.ensure_future(self._health_check_loop()))
@@ -136,8 +159,149 @@ class GcsServer:
             self._loop_monitor.stop()
         for t in self._bg:
             t.cancel()
+        for c in self._shard_clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for proc in self._shard_procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+
+        def _reap(procs=list(self._shard_procs)):
+            # blocking waits belong OFF the loop: a shard wedged in a
+            # synchronous snapshot write must not freeze every other
+            # coroutine here for its grace period
+            for proc in procs:
+                try:
+                    proc.wait(timeout=5)
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+
+        if self._shard_procs:
+            await asyncio.get_event_loop().run_in_executor(None, _reap)
         await self.agent_clients.close_all()
         await self.server.stop()
+
+    # ------------------------------------------------------- shard processes
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._shard_addrs)
+
+    async def _start_shards(self):
+        n = get_config().gcs_shard_processes
+        if n <= 0:
+            return
+        from .gcs_shard import spawn_shard_processes
+        from .rpc import RpcClient
+        # Shards ALWAYS get a snapshot file when any directory exists to
+        # put one in: a supervised shard respawn must restore its slice of
+        # the KV (function registry, workflow commits) even when the
+        # router itself runs without persistence — a single-process GCS
+        # only loses its KV by dying wholesale, and sharding must not
+        # weaken that.
+        self._shard_persist_base = self.persistence_path or (
+            os.path.join(self.session_dir, "gcs.snap")
+            if self.session_dir else None)
+        # subprocess spawn + the stdout handshake block; keep the loop live
+        spawned = await asyncio.get_event_loop().run_in_executor(
+            None, spawn_shard_processes, n, self._shard_persist_base,
+            self.session_dir)
+        self._shard_procs = [p for p, _a in spawned]
+        self._shard_addrs = [a for _p, a in spawned]
+        self._shard_clients = [RpcClient(a) for a in self._shard_addrs]
+        self._shard_map_version += 1
+        self._bg.append(asyncio.ensure_future(self._shard_watch_loop()))
+
+    async def _shard_watch_loop(self):
+        """Shard supervision: a dead shard process is respawned at the
+        same index, restoring from its own snapshot file — the router is
+        the shard fleet's supervisor the way an agent supervises its
+        workers.  Clients holding the stale address fail fast with
+        ConnectionLost and fall back to the router proxy until they
+        refresh the map (heartbeat piggyback / get_shard_map)."""
+        from .gcs_shard import spawn_shard_processes
+        from .rpc import RpcClient
+        while True:
+            await asyncio.sleep(0.5)
+            for i, proc in enumerate(self._shard_procs):
+                if proc.poll() is None:
+                    continue
+                try:
+                    spawned = await asyncio.get_event_loop().run_in_executor(
+                        None, spawn_shard_processes,
+                        len(self._shard_procs), self._shard_persist_base,
+                        self.session_dir, i)
+                except Exception:
+                    continue
+                (newproc, addr), = spawned
+                try:
+                    await self._shard_clients[i].close()
+                except Exception:
+                    pass
+                self._shard_procs[i] = newproc
+                self._shard_addrs[i] = addr
+                self._shard_clients[i] = RpcClient(addr)
+                self._shard_map_version += 1
+                self._publish("gcs_shards",
+                              {"version": self._shard_map_version,
+                               "shards": list(self._shard_addrs)})
+
+    async def handle_get_shard_map(self):
+        """Shard address list for shard-aware clients (gcs_router
+        facade).  Empty when sharding is off — the facade then routes
+        everything here."""
+        return {"version": self._shard_map_version,
+                "shards": list(self._shard_addrs)}
+
+    def _shard_client_for(self, key: str):
+        """Proxy-side shard pick — THE partition helper, same as clients."""
+        from .gcs_router import shard_index
+        return self._shard_clients[shard_index(key, len(self._shard_clients))]
+
+    async def _shard_call(self, shard_key: str, method: str,
+                          _idempotent: bool = True, **kwargs):
+        """Proxy one call to the shard owning ``shard_key``, riding
+        through a shard-process restart: transport failures re-resolve
+        the CURRENT client (the supervisor swaps in the replacement's
+        address) and retry until the standard call deadline — a shard
+        respawn costs proxied callers latency, never an error."""
+        from .rpc import RemoteError, RpcError
+        deadline = time.monotonic() + get_config().rpc_call_timeout_s
+        while True:
+            client = self._shard_client_for(shard_key)
+            try:
+                return await client.call_retry(
+                    method, _idempotent=_idempotent,
+                    _timeout=max(1.0, deadline - time.monotonic()), **kwargs)
+            except RemoteError:
+                raise  # application error from the shard handler
+            except (ConnectionError, OSError, RpcError,
+                    asyncio.TimeoutError):
+                if time.monotonic() >= deadline:
+                    raise
+                await asyncio.sleep(0.1)
+
+    async def _shard_call_all(self, method: str, **kwargs) -> List:
+        """Fan one read out to every shard; unreachable shards contribute
+        nothing (their supervisor is already respawning them)."""
+        if not self._shard_clients:
+            return []
+
+        async def _one(c):
+            try:
+                return await c.call(method, _timeout=10, **kwargs)
+            except Exception:
+                return None
+
+        return [r for r in await asyncio.gather(
+            *[_one(c) for c in self._shard_clients]) if r is not None]
 
     # ------------------------------------------------------------- persistence
 
@@ -271,6 +435,7 @@ class GcsServer:
         # tick (an actor wave, a node death cascade) wakes each parked
         # subscriber once, not N times — wake cost is O(subscribers) per
         # tick instead of O(subscribers x publishes).
+        self._fanout_topics.add(topic)
         if not self._fanout_scheduled:
             self._fanout_scheduled = True
             try:
@@ -284,8 +449,11 @@ class GcsServer:
 
     def _fanout_tick(self):
         self._fanout_scheduled = False
-        for ev in self._event_waiters:
-            ev.set()
+        touched = self._fanout_topics
+        self._fanout_topics = set()
+        for ev, topics in self._event_waiters.items():
+            if not topics.isdisjoint(touched):
+                ev.set()
 
     async def handle_publish(self, topic: str, payload: dict):
         """Generic topic publish (reference: src/ray/pubsub Publisher) — used
@@ -313,14 +481,13 @@ class GcsServer:
         if got:
             return self._event_seq, got
         ev = asyncio.Event()
-        self._event_waiters.append(ev)
+        self._event_waiters[ev] = frozenset(topics)
         try:
             await asyncio.wait_for(ev.wait(), timeout)
         except asyncio.TimeoutError:
             pass
         finally:
-            if ev in self._event_waiters:
-                self._event_waiters.remove(ev)
+            self._event_waiters.pop(ev, None)
         return self._event_seq, pending()
 
     # ---------------------------------------------------------------- chaos
@@ -361,7 +528,9 @@ class GcsServer:
                                        dict(resources), labels, True, 0)
         self.node_last_seen[node_id] = time.monotonic()
         self._publish("nodes", {"event": "alive", "node_id": node_id, "address": address})
-        return {"node_id": node_id, "cluster_view": self._view_payload()}
+        return {"node_id": node_id, "cluster_view": self._view_payload(),
+                "shard_map": {"version": self._shard_map_version,
+                              "shards": list(self._shard_addrs)}}
 
     async def handle_update_node_resources(self, node_id: str,
                                            total: Dict[str, float],
@@ -384,7 +553,8 @@ class GcsServer:
                                queued_demands: List[Dict[str, float]] | None = None,
                                total: Dict[str, float] | None = None,
                                chaos_version: int | None = None,
-                               draining: bool = False):
+                               draining: bool = False,
+                               shard_map_version: int | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             return {"unknown": True}  # agent should re-register
@@ -416,6 +586,12 @@ class GcsServer:
             # missed the pubsub broadcast (or restarted) converge anyway
             res["chaos"] = {"version": self._chaos_version,
                             "spec": self._chaos_spec}
+        if (shard_map_version is not None
+                and shard_map_version != self._shard_map_version):
+            # same convergence pattern for the shard map: a respawned
+            # shard's new address reaches every agent within a heartbeat
+            res["shard_map"] = {"version": self._shard_map_version,
+                                "shards": list(self._shard_addrs)}
         return res
 
     async def handle_drain_node(self, node_id: str):
@@ -497,8 +673,16 @@ class GcsServer:
 
     # ------------------------------------------------------------------- KV
 
+    # With shard processes enabled, the KV lives IN the shards (by
+    # namespace); these handlers become the compat PROXY for clients that
+    # don't hold the shard map — shard-aware clients skip the hop.
+
     async def handle_kv_put(self, ns: str, key: str, value: bytes,
                             overwrite: bool = True):
+        if self._shard_clients:
+            return await self._shard_call(
+                ns, "kv_put", ns=ns, key=key, value=value,
+                overwrite=overwrite)
         k = (ns, key)
         if not overwrite and k in self.kv:
             return False
@@ -508,12 +692,20 @@ class GcsServer:
         return True
 
     async def handle_kv_get(self, ns: str, key: str):
+        if self._shard_clients:
+            return await self._shard_call(ns, "kv_get", ns=ns, key=key,
+                                          _idempotent=False)
         return self.kv.get((ns, key))
 
     async def handle_kv_multi_get(self, ns: str, keys: List[str]):
+        if self._shard_clients:
+            return await self._shard_call(ns, "kv_multi_get", ns=ns,
+                                          keys=keys, _idempotent=False)
         return {k: self.kv[(ns, k)] for k in keys if (ns, k) in self.kv}
 
     async def handle_kv_del(self, ns: str, key: str):
+        if self._shard_clients:
+            return await self._shard_call(ns, "kv_del", ns=ns, key=key)
         existed = self.kv.pop((ns, key), None) is not None
         if existed:
             self._kv_ns_index.discard(ns, key)
@@ -521,10 +713,16 @@ class GcsServer:
         return existed
 
     async def handle_kv_keys(self, ns: str, prefix: str = ""):
+        if self._shard_clients:
+            return await self._shard_call(ns, "kv_keys", ns=ns, prefix=prefix,
+                                          _idempotent=False)
         # per-namespace index: listing one ns never scans the others
         return [k for k in self._kv_ns_index.get(ns) if k.startswith(prefix)]
 
     async def handle_kv_exists(self, ns: str, key: str):
+        if self._shard_clients:
+            return await self._shard_call(ns, "kv_exists", ns=ns, key=key,
+                                          _idempotent=False)
         return (ns, key) in self.kv
 
     # ---------------------------------------------------------------- actors
@@ -999,6 +1197,14 @@ class GcsServer:
             out.append(ev)
             if len(out) >= limit:
                 break
+        if self._shard_clients:
+            # shard-aware writers append to their own shard's ring; the
+            # state API sees ONE merged, newest-first stream
+            for slice_ in await self._shard_call_all(
+                    "list_task_events", limit=limit, filters=filters):
+                out.extend(slice_)
+            out.sort(key=lambda e: e.get("ts", 0.0), reverse=True)
+            del out[limit:]
         return out
 
     # ------------------------------------------------------- scheduler explain
@@ -1011,7 +1217,11 @@ class GcsServer:
         if hist is not None:
             key = self._gcs_hist_keys.get(method)
             if key is None:
-                key = self._gcs_hist_keys[method] = (("method", method),)
+                # shard="router" marks this process's slice of the (now
+                # bounded-by-process-count) shard tag; shard processes
+                # observe shard="<index>" (gcs_shard._on_handler_busy)
+                key = self._gcs_hist_keys[method] = (
+                    ("method", method), ("shard", "router"))
             hist.observe_key(key, busy_s)
 
     def _prune_decisions(self):
@@ -1049,6 +1259,12 @@ class GcsServer:
             out.append(rec)
             if len(out) >= limit:
                 break
+        if self._shard_clients:
+            for slice_ in await self._shard_call_all(
+                    "get_sched_decisions", limit=limit, id=id, kind=kind):
+                out.extend(slice_)
+            out.sort(key=lambda r: r.get("ts", 0.0), reverse=True)
+            del out[limit:]
         return out
 
     @staticmethod
@@ -1068,6 +1284,10 @@ class GcsServer:
         # task events: reason transitions + lifecycle, oldest first
         events = [ev for ev in self.task_events
                   if ev.get("task_id") == id or ev.get("actor_id") == id]
+        if self._shard_clients:
+            for slice_ in await self._shard_call_all("find_task_events",
+                                                     id=id):
+                events.extend(slice_)
         events.sort(key=lambda e: e.get("ts", 0.0))
         if events:
             out["kind"] = "task"
@@ -1098,6 +1318,10 @@ class GcsServer:
         decisions = [rec for rec in self.sched_decisions
                      if self._decision_mentions(rec, id)
                      or (label is not None and rec.get("label") == label)]
+        if self._shard_clients:
+            for slice_ in await self._shard_call_all(
+                    "get_sched_decisions", id=id, limit=100):
+                decisions.extend(slice_)
         decisions.sort(key=lambda r: r.get("ts", 0.0))
         out["decisions"] = decisions[-100:]
         return out
@@ -1136,6 +1360,12 @@ class GcsServer:
             out.append(rec)
             if len(out) >= limit:
                 break
+        if self._shard_clients:
+            for slice_ in await self._shard_call_all(
+                    "get_object_events", limit=limit, id=id, event=event):
+                out.extend(slice_)
+            out.sort(key=lambda r: r.get("ts", 0.0), reverse=True)
+            del out[limit:]
         return out
 
     async def handle_explain_object(self, id: str):
@@ -1147,6 +1377,10 @@ class GcsServer:
         self._prune_object_events()
         events = [ev for ev in self.object_events
                   if ev.get("object_id") == id]
+        if self._shard_clients:
+            for slice_ in await self._shard_call_all(
+                    "get_object_events", id=id, limit=1000):
+                events.extend(slice_)
         events.sort(key=lambda e: e.get("ts", 0.0))
         out: Dict[str, object] = {"id": id, "kind": None, "events": events}
         if self.object_events_dropped:
@@ -1176,7 +1410,7 @@ class GcsServer:
         mon = getattr(self, "_loop_monitor", None)
         busy = {m: round(s, 6) for m, s in self._handler_busy.items()}
         top = sorted(busy.items(), key=lambda kv: kv[1], reverse=True)
-        return {
+        out = {
             "handler_busy_s": busy,
             "handler_calls": dict(self._handler_calls),
             "top_handlers": top[:10],
@@ -1188,6 +1422,22 @@ class GcsServer:
             "object_event_ring_len": len(self.object_events),
             "sched_metrics_enabled": sched_explain.enabled(),
         }
+        if self._shard_clients:
+            # per-shard rollup: there is no longer ONE GCS loop — status
+            # surfaces (raytpu status / top, bench_scale) read each shard
+            # process's busy fraction + handler attribution from here
+            shards = {}
+            for st in await self._shard_call_all("shard_stats"):
+                shards[str(st.get("shard"))] = st
+            out["shards"] = shards
+            out["shard_busy_fractions"] = {
+                f"gcs_shard:{k}": v.get("loop_busy_fraction")
+                for k, v in shards.items()}
+            out["task_events_dropped"] += sum(
+                v.get("task_events_dropped") or 0 for v in shards.values())
+            out["object_events_dropped"] += sum(
+                v.get("object_events_dropped") or 0 for v in shards.values())
+        return out
 
     # ------------------------------------------------------------- debug/info
 

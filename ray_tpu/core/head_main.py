@@ -39,7 +39,7 @@ def main():
         "/tmp/raytpu", f"head-{int(time.time() * 1000)}-{os.getpid()}")
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
 
-    gcs = GcsServer()
+    gcs = GcsServer(session_dir=session_dir)
     run_async(gcs.start())
     agent = NodeAgent(gcs.address,
                       num_cpus=args.num_cpus, num_tpus=args.num_tpus,

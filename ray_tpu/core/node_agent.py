@@ -311,7 +311,12 @@ class NodeAgent:
         if get_config().metrics_export_enabled:
             # before registration: the endpoint port rides the node labels
             await self._start_metrics_endpoint()
-        self.gcs = RpcClient(self.gcs_address)
+        # Shard-aware control-plane client (core/gcs_router.py): this
+        # agent's hot fan-in traffic (object-event flushes) goes direct to
+        # its shard; register/heartbeat/lease concerns stay on the router.
+        from .gcs_router import ShardedGcsClient
+        self.gcs = ShardedGcsClient(self.gcs_address,
+                                    identity=self.node_id.hex())
         # retried registration with an idempotency token: a lost reply (GCS
         # blip, chaos drop) must not register this node twice
         res = await self.gcs.call_retry(
@@ -319,6 +324,7 @@ class NodeAgent:
             address=self.server.address,
             resources=self.total.to_dict(), labels=self.labels)
         self._apply_view(res["cluster_view"])
+        self.gcs.apply_shard_map(res.get("shard_map"))
         # config/env chaos spec: arm the kill schedule (if any) at boot
         self._arm_chaos_schedule()
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -391,15 +397,22 @@ class NodeAgent:
                     queued_demands=self._aggregate_demands(),
                     store_stats=self.store.stats(),
                     chaos_version=self._chaos_version,
-                    draining=self._draining)
+                    draining=self._draining,
+                    shard_map_version=self.gcs.shard_map_version)
                 if res.get("unknown"):
                     res2 = await self.gcs.call_retry(
                         "register_node", node_id=self.node_id.hex(),
                         address=self.server.address,
                         resources=self.total.to_dict(), labels=self.labels)
                     self._apply_view(res2["cluster_view"])
+                    self.gcs.apply_shard_map(res2.get("shard_map"))
                 elif "view" in res:
                     self._apply_view(res["view"])
+                if "shard_map" in res:
+                    # a shard respawned (or sharding just turned on):
+                    # converge via the same piggyback pattern as chaos —
+                    # independent of the view above (a reply can carry both)
+                    self.gcs.apply_shard_map(res["shard_map"])
                 if "chaos" in res:
                     # runtime chaos spec changed at the GCS (chaos_set /
                     # chaos_clear): converge via the heartbeat piggyback

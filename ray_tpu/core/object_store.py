@@ -1243,12 +1243,25 @@ class MemoryStore:
     def __init__(self):
         self._values: Dict[ObjectID, object] = {}
         self._events: Dict[ObjectID, asyncio.Event] = {}
+        # Batch waiters (wait_many): object id -> [waiter, ...] where a
+        # waiter is a [remaining_count, future] pair shared by every id of
+        # one batched get.  put() decrements O(1); the future resolves
+        # when the LAST id lands — one future + one wakeup per batch
+        # instead of one Event + one wait_for coroutine per ref (the
+        # owner-loop cost that capped big drains; ROADMAP 5).
+        self._batch_waiters: Dict[ObjectID, list] = {}
 
     def put(self, object_id: ObjectID, record) -> None:
         self._values[object_id] = record
         ev = self._events.pop(object_id, None)
         if ev:
             ev.set()
+        waiters = self._batch_waiters.pop(object_id, None)
+        if waiters:
+            for w in waiters:
+                w[0] -= 1
+                if w[0] <= 0 and not w[1].done():
+                    w[1].set_result(True)
 
     def contains(self, object_id: ObjectID) -> bool:
         return object_id in self._values
@@ -1262,6 +1275,25 @@ class MemoryStore:
         ev = self._events.setdefault(object_id, asyncio.Event())
         try:
             await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def wait_many(self, object_ids, timeout: float | None = None) -> bool:
+        """Await ALL of ``object_ids`` being present — one shared future
+        for the whole batch (see _batch_waiters).  Timed-out waiters are
+        left registered but done; put() skips them, and the entry list is
+        popped whenever the id eventually lands (bounded by in-flight
+        batches, not history)."""
+        missing = [oid for oid in object_ids if oid not in self._values]
+        if not missing:
+            return True
+        fut = asyncio.get_event_loop().create_future()
+        waiter = [len(missing), fut]
+        for oid in missing:
+            self._batch_waiters.setdefault(oid, []).append(waiter)
+        try:
+            await asyncio.wait_for(fut, timeout)
             return True
         except asyncio.TimeoutError:
             return False

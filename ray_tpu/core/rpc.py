@@ -30,15 +30,23 @@ from .chaos import ChaosFault
 from .config import get_config
 
 _loop_lock = threading.Lock()
-_loop_thread: Optional[threading.Thread] = None
-_loop: Optional[asyncio.AbstractEventLoop] = None
+# IO-loop LANES: lane 0 is the process's default background loop (the
+# historical single "raytpu-io" thread every component shares); additional
+# lanes are extra loop threads that carry their own subset of connections —
+# the submission-lane / control-plane-lane substrate (ROADMAP item 5: one
+# driver's submit path spread over multiple OS threads so socket syscalls,
+# frame codecs and read loops overlap instead of serializing on one loop).
+# Keys are small ints or short strings (("lane", i) tuples, "cp-gcs", ...).
+_lanes: Dict[Any, tuple] = {}  # lane key -> (loop, thread)
 
 
-def get_loop() -> asyncio.AbstractEventLoop:
-    """The process-wide background event loop (started lazily)."""
-    global _loop, _loop_thread
+def get_loop(lane: Any = 0) -> asyncio.AbstractEventLoop:
+    """The process-wide background event loop for ``lane`` (started
+    lazily).  ``get_loop()`` is the default lane every existing caller
+    uses; other lanes are opt-in via the lane-aware clients."""
     with _loop_lock:
-        if _loop is None or _loop.is_closed():
+        ent = _lanes.get(lane)
+        if ent is None or ent[0].is_closed():
             loop = asyncio.new_event_loop()
             started = threading.Event()
 
@@ -47,17 +55,19 @@ def get_loop() -> asyncio.AbstractEventLoop:
                 loop.call_soon(started.set)
                 loop.run_forever()
 
-            t = threading.Thread(target=_run, name="raytpu-io", daemon=True)
+            name = "raytpu-io" if lane == 0 else f"raytpu-io-{lane}"
+            t = threading.Thread(target=_run, name=name, daemon=True)
             t.start()
             started.wait()
-            _loop, _loop_thread = loop, t
-        return _loop
+            _lanes[lane] = (loop, t)
+        return _lanes[lane][0]
 
 
-def run_async(coro, timeout: float | None = None):
-    """Run a coroutine on the IO loop from a synchronous caller."""
-    loop = get_loop()
-    if threading.current_thread() is _loop_thread:
+def run_async(coro, timeout: float | None = None, lane: Any = 0):
+    """Run a coroutine on the IO loop of ``lane`` from a synchronous
+    caller."""
+    loop = get_loop(lane)
+    if threading.current_thread() is _lanes[lane][1]:
         raise RuntimeError("run_async called from the IO loop thread (would deadlock)")
     fut = asyncio.run_coroutine_threadsafe(coro, loop)
     return fut.result(timeout)
@@ -217,11 +227,26 @@ def coalesced_write(writer: "asyncio.StreamWriter", data: bytes) -> None:
     syscall, preserving FIFO order PROVIDED every frame on a given writer
     goes through this function (mixing with direct writer.write would
     reorder).  Flow control: callers in coroutine context should
-    ``await drain_if_needed(writer)`` after queueing."""
+    ``await drain_if_needed(writer)`` after queueing.
+
+    The FIRST frame of a tick writes through immediately (nothing is
+    queued ahead of it, so FIFO holds): a single request/reply stops
+    paying a +1-tick latency to an empty coalescing buffer — sequential
+    RPC chains (sync task calls, the PG 2PC) were loop-tick-bound, not
+    syscall-bound (ROADMAP 5).  A burst still batches frames 2..N of the
+    tick into one write."""
     buf = getattr(writer, "_raytpu_buf", None)
     if buf is None:
         buf = writer._raytpu_buf = []
         writer._raytpu_buf_bytes = 0
+    if not buf and not getattr(writer, "_raytpu_flush_scheduled", False):
+        writer._raytpu_flush_scheduled = True
+        asyncio.get_event_loop().call_soon(_flush_writer, writer)
+        try:
+            writer.write(data)
+        except Exception:
+            pass  # connection died; the read loop surfaces it
+        return
     buf.append(data)
     writer._raytpu_buf_bytes += len(data)
     if not getattr(writer, "_raytpu_flush_scheduled", False):
@@ -739,10 +764,19 @@ class RpcServer:
 
 
 class RpcClient:
-    """Persistent connection to one RpcServer; safe to share across coroutines."""
+    """Persistent connection to one RpcServer; safe to share across coroutines.
 
-    def __init__(self, address: str):
+    ``lane`` pins this client's connection, read loop, and frame codecs to
+    a specific IO-loop thread (``get_loop(lane)``).  Lane-0 clients (the
+    default) keep the historical behavior — their coroutines run on
+    whatever loop awaits them.  Laned clients trampoline foreign-loop
+    callers onto their home lane (``run_coroutine_threadsafe``), so the
+    per-frame pickle/unpickle and socket syscalls of different connections
+    land on different OS threads — the owner submission-lane substrate."""
+
+    def __init__(self, address: str, lane: Any = 0):
         self.address = address
+        self._lane = lane
         host, port = address.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self._reader: asyncio.StreamReader | None = None
@@ -764,8 +798,33 @@ class RpcClient:
         self._push_handler: Callable[[str, dict], None] | None = None
 
     def on_push(self, fn: Callable[[str, dict], None]):
-        """Register a callback for server-initiated one-way messages."""
+        """Register a callback for server-initiated one-way messages.
+        On a laned client the callback fires on the LANE's loop thread —
+        handlers that touch loop-0-confined state must hop themselves."""
         self._push_handler = fn
+
+    def _foreign_home(self) -> Optional[asyncio.AbstractEventLoop]:
+        """The home-lane loop when the caller is on a different loop (or
+        no loop); None for lane-0 clients and on-lane callers — the
+        zero-overhead common case is one int compare."""
+        if self._lane == 0:
+            return None
+        home = get_loop(self._lane)
+        try:
+            if asyncio.get_running_loop() is home:
+                return None
+        except RuntimeError:
+            pass
+        return home
+
+    async def ensure_connected(self):
+        """Public connect (lane-aware): laned clients connect on their
+        home lane so the connection's read loop lives there."""
+        home = self._foreign_home()
+        if home is not None:
+            return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self._ensure_connected(), home))
+        return await self._ensure_connected()
 
     async def _ensure_connected(self):
         if self._connect_lock is None:
@@ -873,6 +932,13 @@ class RpcClient:
         is a view over that memory."""
         if self._closed:
             raise RpcError("client closed")
+        if self._foreign_home() is not None:
+            # call_start hands back a future bound to ONE loop; awaiting
+            # it from another loop is undefined — laned clients must be
+            # driven via call/call_retry/notify from foreign loops.
+            raise RuntimeError(
+                "call_start on a laned RpcClient from a foreign loop "
+                "(use call/call_retry, which trampoline)")
         inj, delay = self._chaos_pre(method)
         await self._ensure_connected()
         writer, pending, sinks = self._writer, self._pending, self._sinks
@@ -927,6 +993,10 @@ class RpcClient:
         return fut
 
     async def call(self, method: str, _timeout: float | None = None, **kwargs) -> Any:
+        home = self._foreign_home()
+        if home is not None:
+            return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self.call(method, _timeout=_timeout, **kwargs), home))
         fut = await self.call_start(method, **kwargs)
         timeout = _timeout if _timeout is not None else get_config().rpc_call_timeout_s
         return await asyncio.wait_for(fut, timeout)
@@ -995,6 +1065,14 @@ class RpcClient:
         with deadline remaining, and ChaosFault RemoteErrors (injected
         failures are retryable by definition).  Application errors
         propagate immediately."""
+        home = self._foreign_home()
+        if home is not None:
+            # the whole retry loop (backoff sleeps included) runs on the
+            # home lane; the caller just awaits its outcome
+            return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self.call_retry(method, _timeout=_timeout,
+                                _attempts=_attempts,
+                                _idempotent=_idempotent, **kwargs), home))
         cfg = get_config()
         attempts = (_attempts if _attempts is not None
                     else cfg.rpc_retry_max_attempts)
@@ -1030,6 +1108,10 @@ class RpcClient:
             f"{method}: deadline exhausted before first attempt")
 
     async def notify(self, method: str, **kwargs):
+        home = self._foreign_home()
+        if home is not None:
+            return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self.notify(method, **kwargs), home))
         inj, delay = self._chaos_pre(method)
         await self._ensure_connected()
         writer = self._writer
@@ -1055,6 +1137,15 @@ class RpcClient:
 
     async def close(self):
         self._closed = True
+        home = self._foreign_home()
+        if home is not None:
+            # flush + transport close must run on the loop that owns the
+            # connection
+            return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self._close_local(), home))
+        await self._close_local()
+
+    async def _close_local(self):
         if self._writer:
             try:
                 _flush_writer(self._writer)  # don't drop coalesced frames
@@ -1068,16 +1159,38 @@ class ClientPool:
     """Cache of RpcClients keyed by address (reference: rpc client pools).
 
     ``push_handler(topic, payload)``, when given, is installed on every
-    client so server-initiated pushes (streamed task results) are routed."""
+    client so server-initiated pushes (streamed task results) are routed.
 
-    def __init__(self, push_handler: Callable[[str, dict], None] | None = None):
+    ``lanes > 1`` spreads addresses over that many IO-loop threads
+    (sticky: an address keeps its lane for the pool's lifetime, so
+    per-connection ordering — actor seq_nos, streamed yields — is
+    unchanged; lane index 0 is the default loop, the rest are dedicated
+    submission-lane threads).  Push handlers fire on the owning lane's
+    thread — pass a thread-safe handler when lanes > 1."""
+
+    def __init__(self, push_handler: Callable[[str, dict], None] | None = None,
+                 lanes: int = 1):
         self._clients: Dict[str, RpcClient] = {}
         self._push_handler = push_handler
+        self._num_lanes = max(1, int(lanes))
+        self._lane_rr = 0
+        self._lane_of: Dict[str, Any] = {}
+
+    def _lane_for(self, address: str) -> Any:
+        if self._num_lanes <= 1:
+            return 0
+        lane = self._lane_of.get(address)
+        if lane is None:
+            i = self._lane_rr % self._num_lanes
+            self._lane_rr += 1
+            lane = 0 if i == 0 else ("lane", i)
+            self._lane_of[address] = lane
+        return lane
 
     def get(self, address: str) -> RpcClient:
         c = self._clients.get(address)
         if c is None or c._closed:
-            c = RpcClient(address)
+            c = RpcClient(address, lane=self._lane_for(address))
             if self._push_handler is not None:
                 c.on_push(self._push_handler)
             self._clients[address] = c

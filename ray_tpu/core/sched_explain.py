@@ -177,9 +177,10 @@ def _build_gcs_handler_hist():
     return Histogram(
         "raytpu_gcs_handler_seconds",
         "GCS handler BUSY seconds per invocation (synchronous-segment "
-        "time the handler blocked the GCS loop; awaits excluded, so "
-        "long-polls read near zero)",
-        tag_keys=("method",))
+        "time the handler blocked that GCS process's loop; awaits "
+        "excluded, so long-polls read near zero).  ``shard`` is bounded "
+        "by the process count: \"router\" or the shard index.",
+        tag_keys=("method", "shard"))
 
 
 _gcs_hist_get = None

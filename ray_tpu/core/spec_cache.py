@@ -37,6 +37,7 @@ from __future__ import annotations
 import collections
 import hashlib
 import pickle
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -103,22 +104,28 @@ class SpecEncoder:
     seen the hash yet."""
 
     def __init__(self):
-        # template key -> (hash, blob); LRU by move-to-end on hit
+        # template key -> (hash, blob); LRU by move-to-end on hit.  The
+        # lock covers the OrderedDict relinks: with owner_serialize_threads
+        # the encoder runs on pool threads concurrently, and move_to_end/
+        # popitem are not atomic under the GIL.
         self._lru: "collections.OrderedDict[tuple, Tuple[bytes, bytes]]" = \
             collections.OrderedDict()
+        self._lock = threading.Lock()
 
     def _template_for(self, spec: TaskSpec) -> Tuple[bytes, bytes]:
         key = _template_key(spec)
-        hit = self._lru.get(key)
-        if hit is not None:
-            self._lru.move_to_end(key)
-            return hit
+        with self._lock:
+            hit = self._lru.get(key)
+            if hit is not None:
+                self._lru.move_to_end(key)
+                return hit
         blob = pickle.dumps(_template_fields(spec), protocol=5)
         thash = hashlib.blake2b(blob, digest_size=16).digest()
-        self._lru[key] = (thash, blob)
-        cap = max(get_config().spec_cache_max_entries, 8)
-        while len(self._lru) > cap:
-            self._lru.popitem(last=False)
+        with self._lock:
+            self._lru[key] = (thash, blob)
+            cap = max(get_config().spec_cache_max_entries, 8)
+            while len(self._lru) > cap:
+                self._lru.popitem(last=False)
         return thash, blob
 
     @staticmethod

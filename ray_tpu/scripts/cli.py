@@ -181,7 +181,14 @@ def _print_sched_summary():
     busy = stats.get("loop_busy_fraction")
     parts = []
     if busy is not None:
-        parts.append(f"gcs loop busy={busy * 100:.0f}%")
+        parts.append(f"gcs router busy={busy * 100:.0f}%")
+    shard_busy = stats.get("shard_busy_fractions") or {}
+    if shard_busy:
+        # horizontal control plane: there is no longer ONE GCS loop —
+        # show each shard process's busy fraction next to the router's
+        parts.append("shards " + " ".join(
+            f"{name.split(':', 1)[1]}={(b or 0) * 100:.0f}%"
+            for name, b in sorted(shard_busy.items())))
     top = [(m, s) for m, s in (stats.get("top_handlers") or [])[:3] if s]
     if top:
         parts.append("top handlers: " + ", ".join(
@@ -715,6 +722,27 @@ def _render_top(store, alive_nodes) -> str:
                      + f"req/s={req_s:.1f}  "
                      + (f"ttft_avg={t * 1e3:.1f}ms" if t is not None
                         else "ttft_avg=-"))
+
+    # control-plane rollup: the BUSY column above shows each NODE's worst
+    # loop; with the horizontal control plane the GCS is router + N shard
+    # processes, whose busy fractions come from sched_stats, not a node
+    # scrape — one line names each loop so "which control-plane process
+    # is pegged" is answerable from top.
+    try:
+        from ray_tpu.util import state as _state_api
+        stats = _state_api.sched_stats()
+    except Exception:
+        stats = None
+    if stats:
+        parts = []
+        b = stats.get("loop_busy_fraction")
+        if b is not None:
+            parts.append(f"router={b * 100:.0f}%")
+        for name, b in sorted((stats.get("shard_busy_fractions")
+                               or {}).items()):
+            parts.append(f"{name.split(':', 1)[1]}={(b or 0) * 100:.0f}%")
+        if parts:
+            lines.append("CONTROL  busy: " + "  ".join(parts))
     return "\n".join(lines)
 
 

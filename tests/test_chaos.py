@@ -232,6 +232,89 @@ def test_chaos_smoke_drop_frames_and_worker_kill():
 
 
 @pytest.mark.chaos
+@pytest.mark.timeout(240)
+def test_chaos_sharded_control_plane_shard_restart():
+    """The PR-13 horizontal-control-plane chaos arm: seeded frame drops +
+    one scheduled worker kill over a real workload on a SHARDED GCS
+    (gcs_table_shards=4, 2 shard processes), with a shard PROCESS killed
+    mid-workload.  The supervisor respawns it at the same index, the
+    replacement restores its KV slice from its own snapshot (the function
+    registry lives in sharded KV — a respawn must not lose it), clients
+    fall back through the router proxy meanwhile, and exactly-once
+    registration holds (the named actor appears once despite retried
+    RPCs)."""
+    from ray_tpu.core.api import _state
+    from ray_tpu.core.gcs_router import shard_index
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+    spec = {"seed": 5,
+            "rules": [{"kind": "drop_request", "prob": 0.03},
+                      {"kind": "drop_reply", "prob": 0.03}],
+            "kills": [{"after_s": 2.0, "target": "worker"}]}
+    spec_json = json.dumps(spec)
+    os.environ["RAYTPU_CHAOS_SPEC"] = spec_json
+    try:
+        ray_tpu.init(num_cpus=2, worker_env=dict(CPU_WORKER_ENV),
+                     _system_config={"chaos_spec": spec_json,
+                                     "gcs_table_shards": 4,
+                                     "gcs_shard_processes": 2})
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        ctr = Counter.options(name="shard-chaos-singleton").remote()
+        assert ray_tpu.get(ctr.bump.remote(), timeout=60) == 1
+
+        @ray_tpu.remote(max_retries=5)
+        def double(i):
+            return i * 2
+
+        refs = [double.remote(i) for i in range(80)]
+        time.sleep(1.0)  # workload underway
+
+        # kill the shard process that owns the FUNCTION REGISTRY slice —
+        # the worst-case victim: lose it and no new worker can load defs
+        gcs = _state.gcs_server
+        victim_idx = shard_index("funcs", len(gcs._shard_addrs))
+        victim = gcs._shard_procs[victim_idx]
+        victim.kill()
+
+        assert ray_tpu.get(refs, timeout=150) == [i * 2 for i in range(80)]
+        # the supervisor respawned the shard at the same index
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (gcs._shard_procs[victim_idx] is not victim
+                    and gcs._shard_procs[victim_idx].poll() is None):
+                break
+            time.sleep(0.2)
+        assert gcs._shard_procs[victim_idx] is not victim
+        # the replacement restored its KV slice (function registry keys)
+        from ray_tpu.core.core_worker import global_worker
+        w = global_worker()
+        fn_keys = run_async(w.gcs.call_retry("kv_keys", ns="funcs",
+                                             _idempotent=False))
+        assert fn_keys, "function registry lost across shard restart"
+        # exactly-once across the chaos: one named actor, still alive
+        assert ray_tpu.get(ctr.bump.remote(), timeout=60) == 2
+        actors = run_async(w.gcs.call_retry("list_actors",
+                                            _idempotent=False))
+        singles = [a for a in actors
+                   if a.get("name") == "shard-chaos-singleton"]
+        assert len(singles) == 1, singles
+        inj = chaos.injector()
+        assert inj is not None and sum(inj.injected_counts().values()) > 0
+    finally:
+        os.environ.pop("RAYTPU_CHAOS_SPEC", None)
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
 @pytest.mark.timeout(280)
 def test_chaos_acceptance_drops_kill_and_gcs_restart(tmp_path):
     """The acceptance run: a seeded chaos spec (5% frame drop + 1 scheduled

@@ -227,9 +227,10 @@ def test_train_metric_tag_keys_are_bounded():
 
 #: the label-set bound for the control-plane saturation metrics: process
 #: (one per runtime process kind), method (GCS handler names), reason
-#: (the typed backpressure/pending vocabulary) and node — nothing that can
+#: (the typed backpressure/pending vocabulary), node, and shard (bounded
+#: by gcs_shard_processes: "router" or a shard index) — nothing that can
 #: carry a task id, address or other unbounded value.
-ALLOWED_SCHED_TAG_KEYS = {"process", "method", "reason", "node"}
+ALLOWED_SCHED_TAG_KEYS = {"process", "method", "reason", "node", "shard"}
 SCHED_PREFIXES = ("raytpu_sched_", "raytpu_loop_", "raytpu_gcs_")
 
 
@@ -484,6 +485,67 @@ def test_pending_reason_stamps_use_typed_enum():
     # the scan must actually see the stamp sites (gate, lease pool,
     # actor path, spec-cache resend at minimum)
     assert stamps >= 6, f"only {stamps} pending-reason stamps found"
+
+
+# ----------------------------------------------- shard partitioning lint
+
+#: hash-producing callables whose result must never be hand-moduloed into
+#: a shard pick outside the partition helper
+_HASHERS = {"crc32", "adler32", "md5", "sha1", "sha256", "blake2b", "hash"}
+
+
+def test_cross_shard_routing_uses_partition_helper():
+    """Every cross-process shard pick goes through
+    ``gcs_router.shard_index`` — the ONE place client, router proxy, and
+    shard snapshot assignment can agree.  A hand-hashed ``crc32(key) %
+    num_shards`` anywhere else would silently diverge (e.g. a process
+    using the salted builtin ``hash``) and serve misrouted keys.  The
+    lint rejects any ``<hasher>(...) % <expr mentioning 'shard'>`` in
+    core/ outside gcs_router.py (sharded_table.py's in-PROCESS dict
+    partition legitimately uses ``hash()`` — it never crosses a process
+    boundary — and is exempt)."""
+    core = PKG_ROOT / "core"
+    exempt = {"gcs_router.py", "sharded_table.py"}
+    problems = []
+    users = set()
+    for path in sorted(core.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            # positive coverage: who calls the helper
+            if (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Name)
+                          and node.func.id == "shard_index")
+                         or (isinstance(node.func, ast.Attribute)
+                             and node.func.attr == "shard_index"))):
+                users.add(path.name)
+            if path.name in exempt:
+                continue
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mod)):
+                continue
+            left, right = node.left, node.right
+            left_hashes = any(
+                isinstance(sub, ast.Call)
+                and ((isinstance(sub.func, ast.Name)
+                      and sub.func.id in _HASHERS)
+                     or (isinstance(sub.func, ast.Attribute)
+                         and sub.func.attr in _HASHERS))
+                for sub in ast.walk(left))
+            right_shardish = any(
+                (isinstance(sub, ast.Name) and "shard" in sub.id.lower())
+                or (isinstance(sub, ast.Attribute)
+                    and "shard" in sub.attr.lower())
+                for sub in ast.walk(right))
+            if left_hashes and right_shardish:
+                problems.append(
+                    f"{path.relative_to(PKG_ROOT.parent)}:{node.lineno}: "
+                    "hand-hashed shard pick — route through "
+                    "gcs_router.shard_index")
+    assert not problems, "\n".join(problems)
+    # the helper must actually be in use on both sides of the wire
+    assert "gcs_router.py" in users or users, "shard_index never used?"
+    assert "gcs.py" in users, (
+        "router proxy no longer routes through gcs_router.shard_index")
 
 
 def test_all_runtime_metrics_use_raytpu_namespace():
