@@ -62,6 +62,7 @@ def _collect_timeline(trace_dir: str, origin: str) -> tuple:
     # (the multi-source stripe's evidence — who actually carried the
     # broadcast, at what rate)
     per_source = {}
+    socks_of = {}
     for e in chunks:
         row = per_source.setdefault(
             e["source"], {"bytes": 0, "chunks": 0, "stolen": 0,
@@ -71,9 +72,13 @@ def _collect_timeline(trace_dir: str, origin: str) -> tuple:
         row["stolen"] += 1 if e.get("stolen") else 0
         row["t0"] = min(row["t0"], e["t0"])
         row["t1"] = max(row["t1"], e["t1"])
-    for row in per_source.values():
+        socks_of.setdefault(e["source"], set()).add(e.get("socket", 0))
+    for src, row in per_source.items():
         span = max(row.pop("t1") - row.pop("t0"), 1e-9)
         row["gbps"] = round(row["bytes"] / span / 1e9, 3)
+        # distinct transfer sockets this source actually served over
+        # (the multi-socket plane's evidence; transfer_sockets_per_source)
+        row["sockets"] = len(socks_of.get(src, {0}))
     # ledger-state breakdown aggregated over every pull_summary event
     ledger = {"pulls": len(pulls), "chunks_done": 0, "retried": 0,
               "stolen": 0, "short": 0,
@@ -93,6 +98,12 @@ def _collect_timeline(trace_dir: str, origin: str) -> tuple:
         "peak_concurrent_transfers": peak,
         "per_source": per_source,
         "ledger": ledger,
+        # the adaptive controller's growth evidence: per-request byte
+        # sizes in start order (runs of base chunks grow geometrically
+        # under clean completions toward object_transfer_chunk_max)
+        "chunk_bytes_trajectory": [e["bytes"] for e in chunks[:256]],
+        "sockets_per_source": max(
+            (p.get("sockets_per_source", 1) for p in pulls), default=None),
         "mean_attach_ms": round(1000 * float(np.mean(
             [e["t1"] - e["t0"] for e in attaches])), 2) if attaches else None,
         "mean_chunk_ms": round(1000 * float(np.mean(

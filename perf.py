@@ -547,6 +547,36 @@ def run_ab_object_obs(S: float, pairs: int) -> dict:
             "off_config": OBJECT_OBS_OFF, "ratio_on_off": ratio}
 
 
+#: the "off" arm of the zero-copy-put + wire-rate-transfer A/B: the exact
+#: pre-PR data plane — classic serialize-then-copy put (one write_into
+#: memcpy), one socket per (puller, source) pair, fixed chunk size (no
+#: adaptive growth).
+ZCPUT_OFF = {"zero_copy_put_enabled": False,
+             "transfer_sockets_per_source": 1,
+             "object_transfer_chunk_bytes": 8 * 1024 * 1024,
+             "object_transfer_chunk_max": 0}
+
+
+def run_ab_zcput(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: zero-copy put + multi-socket adaptive
+    transfer ON vs the prior 1-copy/fixed-chunk plane (the ISSUE-14
+    gates: put_gbps >= 1.5x with the ledger showing put/copies=0, and the
+    off arm's put_gbps/get_big within the <=5% regression envelope of
+    PERF_r13)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_object_obs(S, None))
+        off_runs.append(_measure_object_obs(S, dict(ZCPUT_OFF)))
+        print(f"# zcput ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": ZCPUT_OFF, "ratio_on_off": ratio}
+
+
 #: the "off" arm of the batched-submission A/B: one task per push RPC, one
 #: lease per request RPC, one actor call per batch — the unbatched
 #: submission plane the scale-envelope work replaced.
@@ -735,6 +765,11 @@ def main():
                         "horizontal control plane (GCS shard processes + "
                         "completion batching) on vs the pre-PR "
                         "single-process single-lane plane")
+    p.add_argument("--ab-zcput", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of the "
+                        "zero-copy put + multi-socket adaptive transfer "
+                        "plane on vs the prior 1-copy/fixed-chunk plane "
+                        "(put GB/s, large get, 8-way arg fan-out)")
     p.add_argument("--ab-object", type=int, default=0, metavar="PAIRS",
                    help="also run PAIRS interleaved A/B pairs of "
                         "object_metrics_enabled on vs off (put GB/s, "
@@ -791,6 +826,8 @@ def main():
     if args.ab_object > 0:
         out["object_obs_ab"] = run_ab_object_obs(args.scale,
                                                  args.ab_object)
+    if args.ab_zcput > 0:
+        out["zcput_ab"] = run_ab_zcput(args.scale, args.ab_zcput)
     if args.ab_cpshard > 0:
         out["cpshard_ab"] = run_ab_cpshard(args.scale, args.ab_cpshard)
     line = json.dumps(out)

@@ -27,8 +27,44 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     #: Capacity of the per-node shared-memory store in bytes (0 = 30% of RAM).
     object_store_memory: int = 0
-    #: Chunk size for node-to-node object transfer.
-    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    #: Zero-copy put (reserve-then-write): large puts reserve an arena
+    #: range up front from a cheap size estimate and the pickler's
+    #: out-of-band buffers land DIRECTLY into the reserved segment
+    #: (parallel memoryview gather-write, no intermediate ``bytes``
+    #: anywhere) — the ledger's ``put/copies=0`` class.  False restores
+    #: the exact prior path (serialize, then one ``write_into`` memcpy):
+    #: the ``--ab-zcput`` off arm and the production kill switch.
+    zero_copy_put_enabled: bool = True
+    #: Gather-write lanes for the zero-copy put landing: buffers >= the
+    #: stripe threshold are striped over this many copier threads (numpy
+    #: ``copyto`` releases the GIL, so the landing runs at aggregate
+    #: memory bandwidth instead of the single-thread memcpy ceiling).
+    #: 0 = auto (min(8, cpu count)); 1 = serial landing.
+    put_gather_threads: int = 0
+    #: BASE chunk size for node-to-node object transfer — the chunk
+    #: ledger's bookkeeping/steal/partial-serving unit.  The adaptive
+    #: controller claims RUNS of consecutive base chunks (see
+    #: ``object_transfer_chunk_max``), so this stays small enough for
+    #: fine-grained striping (late-folded relays of a broadcast must
+    #: still find claimable chunks) without capping per-request size —
+    #: growth recovers large requests on healthy links.
+    object_transfer_chunk_bytes: int = 2 * 1024 * 1024
+    #: Adaptive per-request ceiling: a source's claim run grows
+    #: geometrically under clean completions toward this many bytes and
+    #: shrinks on timeout/steal — replacing the fixed chunk size on the
+    #: wire.  Growth re-clamps against the receiver's ``largest_free``
+    #: arena block so a grown request can never force a spill mid-pull.
+    #: <= object_transfer_chunk_bytes disables growth (fixed chunks).
+    object_transfer_chunk_max: int = 64 * 1024 * 1024
+    #: Parallel sockets per (puller, source) pair: in-flight chunk
+    #: requests to one source spread (sticky per chunk) over this many
+    #: DEDICATED bulk-channel connections (core/bulk_transfer.py —
+    #: threaded blocking sockets, sendall/recv_into straight between shm
+    #: and the kernel), so multi-MB replies stream concurrently instead
+    #: of serializing head-of-line on one socket and one event loop.
+    #: 1 = the historical single shared asyncio connection per peer (the
+    #: --ab-zcput off arm).
+    transfer_sockets_per_source: int = 4
     #: TOTAL in-flight chunks per object pull, across all sources (the
     #: chunk-ledger stripe's global window).
     object_transfer_parallelism: int = 16
@@ -46,8 +82,12 @@ class Config:
     #: Chunk-fetch failures before a source is dropped from the stripe.
     object_transfer_max_source_failures: int = 3
     #: Mid-pull source refresh period: re-poll the owner's location view
-    #: and re-probe partial sources' advertised ranges this often.
-    object_transfer_source_refresh_s: float = 0.25
+    #: and re-probe partial sources' advertised ranges this often.  (The
+    #: hot case — a paused relay whose ranges just widened — is probed
+    #: event-driven with a 50 ms debounce; this tick only folds in newly
+    #: REGISTERED sources, so a broadcast engages relays within its first
+    #: chunk-times.)
+    object_transfer_source_refresh_s: float = 0.1
     #: Fail a pull that lands NO chunk for this long (all sources dead /
     #: unreachable and the owner offers nothing new).
     object_transfer_stall_timeout_s: float = 60.0

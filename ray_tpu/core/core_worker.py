@@ -1469,9 +1469,62 @@ class CoreWorker:
 
     async def put_async(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
+        if await self._try_zero_copy_put(oid, value):
+            return ObjectRef(oid, owner=self.address)
         so = serialization.serialize(value)
         await self._store_serialized(oid, so)
         return ObjectRef(oid, owner=self.address)
+
+    async def _try_zero_copy_put(self, oid: ObjectID, value: Any) -> bool:
+        """Reserve-then-write put (the ledger's ``put/copies=0`` class):
+        estimate the flat size WITHOUT pickling, reserve the arena range,
+        and serialize straight into it — the pickler's out-of-band
+        buffers land by parallel gather-write, the inband stream and
+        header follow, and seal happens in place (no intermediate bytes,
+        no serial post-hoc memcpy; see core/serialization.py).
+
+        False when the value is small / not estimable / not
+        buffer-dominated, when ``zero_copy_put_enabled`` is off, or on a
+        size-estimate miss (the reservation is released) — the caller
+        then takes the classic 1-copy path unchanged."""
+        cfg = get_config()
+        if not cfg.zero_copy_put_enabled or self.agent is None:
+            return False
+        bounds = serialization.estimate_flat_size(value)
+        # the inline-vs-plasma threshold compares the LOWER bound: a value
+        # whose exact flat size would still inline must not be pushed into
+        # the shm store by a pessimistic reservation estimate
+        if bounds is None or bounds[1] <= cfg.max_direct_call_object_size:
+            return False
+        est = bounds[0]
+        res = await self.agent.call_retry("store_create", object_id=oid,
+                                          size=est, owner=self.address)
+        seg = ShmSegment(res["path"], est, create=False)
+        try:
+            landed = serialization.serialize_into(value, seg.view())
+        finally:
+            seg.close()
+        if landed is None:
+            # estimate miss: release the reservation; nothing depends on
+            # the partial landing (the entry was never sealed)
+            try:
+                await self.agent.call_retry("store_free", object_ids=[oid])
+            except Exception:
+                pass
+            return False
+        object_explain.ledger_record(object_explain.KEY_PUT_ZC, landed.used)
+        self.object_event(oid, ObjectEvent.CREATED, size=landed.used,
+                          node=(self.node_id or "")[:12] or None,
+                          zero_copy=True)
+        # seal TRUNCATES to the exact bytes written: readers/transfers/
+        # spills must never touch the reservation's slack tail (recycled
+        # arena memory — another object's stale bytes)
+        await self.agent.notify("store_seal", object_id=oid,
+                                size=landed.used)
+        self.memory_store.put(
+            oid, PlasmaRecord(landed.used,
+                              [(self.node_id, self.agent_address)]))
+        return True
 
     async def _store_serialized(self, oid: ObjectID, so: serialization.SerializedObject):
         cfg = get_config()
@@ -1643,43 +1696,69 @@ class CoreWorker:
         """-> (buffer, pin | None): the flattened object bytes, zero-copy
         over the pinned store mapping when the agent granted a read pin."""
         if self.agent is None:
-            # Driver without an agent (shouldn't happen) — pull directly.
-            # The location list may now contain PARTIAL holders (they
-            # register after their first chunk) and can shrink (failed
-            # pulls deregister): try every location, skip the unusable,
-            # reject short replies (silent corruption otherwise).
-            last: Optional[BaseException] = None
-            from . import external_spill
-            for node_id, addr in list(record.locations):
-                if external_spill.is_external_address(addr):
-                    try:
-                        data = await asyncio.get_event_loop() \
-                            .run_in_executor(None, external_spill.timed_read,
-                                             addr)
-                    except Exception as e:  # noqa: BLE001 — try next
-                        last = e
-                        continue
-                    if len(data) != record.size:
-                        last = ObjectLostError(
-                            ref.id, f"external copy at {addr} has "
-                                    f"{len(data)} of {record.size} B")
-                        continue
-                    return data, None
-                client = self.agent_clients.get(addr)
+            return await self._driver_fetch_plasma(ref, record)
+        return await self._agent_fetch_plasma(ref, record)
+
+    async def _driver_fetch_plasma(self, ref: ObjectRef,
+                                   record: PlasmaRecord):
+        """Agent-less driver fetch (a driver not colocated with a node
+        agent): pull the whole object over RPC, landing every chunk
+        readinto-style into ONE preallocated buffer via ``call_into`` —
+        the reply's out-of-band bytes drain from the stream buffer
+        straight into their final resting place instead of accumulating a
+        ``bytes`` per reply and paying a full extra copy per object.
+
+        The location list may contain PARTIAL holders (they register
+        after their first chunk; their uncovered ranges raise a typed
+        ChunkNotAvailable) and can shrink (failed pulls deregister): try
+        every location, skip the unusable, reject short replies (silent
+        corruption otherwise)."""
+        last: Optional[BaseException] = None
+        from . import external_spill
+        buf = bytearray(record.size)
+        mv = memoryview(buf)
+        chunk = max(1, get_config().object_transfer_chunk_bytes)
+        for node_id, addr in list(record.locations):
+            if external_spill.is_external_address(addr):
                 try:
-                    data = await client.call("read_chunk", object_id=ref.id,
-                                             offset=0, length=record.size)
-                except Exception as e:  # noqa: BLE001 — try next holder
+                    data = await asyncio.get_event_loop() \
+                        .run_in_executor(None, external_spill.timed_read,
+                                         addr)
+                except Exception as e:  # noqa: BLE001 — try next
                     last = e
                     continue
                 if len(data) != record.size:
                     last = ObjectLostError(
-                        ref.id, f"short read_chunk reply: {len(data)} of "
-                                f"{record.size} B from {addr}")
+                        ref.id, f"external copy at {addr} has "
+                                f"{len(data)} of {record.size} B")
                     continue
                 return data, None
-            raise ObjectLostError(
-                ref.id, f"no usable location for {ref.id}: {last}")
+            client = self.agent_clients.get(addr)
+            try:
+                off = 0
+                while off < record.size:
+                    n = min(chunk, record.size - off)
+                    got = await client.call_into(
+                        "read_chunk", mv[off:off + n], object_id=ref.id,
+                        offset=off, length=n)
+                    landed = got.nbytes if isinstance(got, memoryview) \
+                        else len(got)
+                    if landed != n:
+                        raise ObjectLostError(
+                            ref.id, f"short read_chunk reply: {landed} of "
+                                    f"{n} B at offset {off} from {addr}")
+                    if not isinstance(got, memoryview):
+                        mv[off:off + landed] = got  # small in-band reply
+                    off += n
+            except Exception as e:  # noqa: BLE001 — try next holder
+                last = e
+                continue
+            return buf, None
+        raise ObjectLostError(
+            ref.id, f"no usable location for {ref.id}: {last}")
+
+    async def _agent_fetch_plasma(self, ref: ObjectRef,
+                                  record: PlasmaRecord):
         try:
             # idempotent retry: a pin GRANTED on an attempt whose reply was
             # lost must come back as the same grant (one ledger entry), not
@@ -3003,33 +3082,17 @@ class CoreWorker:
         if v is None and inline_limit > 0:
             # ubiquitous for side-effect calls: skip the pickler
             return ("inline", serialization.none_bytes(), [])
+        if cfg.zero_copy_put_enabled and self.agent is not None:
+            bounds = serialization.estimate_flat_size(v)
+            # floor comparison: an at-threshold value must still inline
+            # (the reservation estimate is an upper bound)
+            if bounds is not None and bounds[1] > max(
+                    inline_limit, cfg.max_direct_call_object_size):
+                desc = self._zero_copy_result(spec, v, index, bounds[0])
+                if desc is not None:
+                    return desc
         so = serialization.serialize(v)
-        # Ship descriptors of any ObjectRefs inside the value so the
-        # caller can register its borrows at receipt (see
-        # TaskManager.complete).  For refs owned ELSEWHERE, place an
-        # ACKED escrow hold with the owner before this result ships:
-        # our own counts may hit zero right after the reply, and the
-        # hold keeps the object alive until the consumer registers its
-        # borrow and releases (no timing window; reference:
-        # reference_count.cc WaitForRefRemoved).
-        contained = []
-        for r in so.contained_refs:
-            r_owner = r.owner or self.address
-            hold_id = f"{self.worker_id.hex()[:12]}:{next(self._hold_seq)}"
-            if r_owner == self.address:
-                # We own it: hold locally — our last local ref may die
-                # the moment this function returns, and the consumer's
-                # borrow note is still in flight.
-                self._escrow_holds.setdefault(r.id, {})[hold_id] = (
-                    time.monotonic()
-                    + get_config().escrow_hold_expiry_s)
-            else:
-                try:
-                    run_async(self.worker_clients.get(r_owner).call_retry(
-                        "escrow_hold", object_id=r.id, hold_id=hold_id))
-                except Exception:
-                    hold_id = None  # owner gone: nothing to protect
-            contained.append((r.id.binary(), r_owner, hold_id))
+        contained = self._escrow_contained(so.contained_refs)
         size = so.flat_size()
         if size <= inline_limit or self.agent is None:
             return ("inline", so.to_bytes(), contained)
@@ -3052,6 +3115,69 @@ class CoreWorker:
             seg.close()
         run_async(self.agent.notify("store_seal", object_id=oid))
         return ("plasma", size,
+                [(self.node_id, self.agent_address)], contained)
+
+    def _escrow_contained(self, contained_refs) -> list:
+        """Ship descriptors of any ObjectRefs inside a result value so the
+        caller can register its borrows at receipt (see
+        TaskManager.complete).  For refs owned ELSEWHERE, place an ACKED
+        escrow hold with the owner before the result ships: our own
+        counts may hit zero right after the reply, and the hold keeps the
+        object alive until the consumer registers its borrow and releases
+        (no timing window; reference: reference_count.cc
+        WaitForRefRemoved)."""
+        contained = []
+        for r in contained_refs:
+            r_owner = r.owner or self.address
+            hold_id = f"{self.worker_id.hex()[:12]}:{next(self._hold_seq)}"
+            if r_owner == self.address:
+                # We own it: hold locally — our last local ref may die
+                # the moment this function returns, and the consumer's
+                # borrow note is still in flight.
+                self._escrow_holds.setdefault(r.id, {})[hold_id] = (
+                    time.monotonic()
+                    + get_config().escrow_hold_expiry_s)
+            else:
+                try:
+                    run_async(self.worker_clients.get(r_owner).call_retry(
+                        "escrow_hold", object_id=r.id, hold_id=hold_id))
+                except Exception:
+                    hold_id = None  # owner gone: nothing to protect
+            contained.append((r.id.binary(), r_owner, hold_id))
+        return contained
+
+    def _zero_copy_result(self, spec: TaskSpec, v, index: int,
+                          est: int) -> Optional[tuple]:
+        """Reserve-then-write landing of one large task result — the same
+        zero-copy put pipeline as ``_try_zero_copy_put``, executor-side
+        (sync thread, RPCs via run_async).  Returns the plasma descriptor,
+        or None on a size-estimate miss (the reservation is released and
+        the caller falls back to the classic serialize-then-copy path)."""
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        res = run_async(self.agent.call_retry("store_create", object_id=oid,
+                                              size=est,
+                                              owner=spec.owner or None))
+        seg = ShmSegment(res["path"], est, create=False)
+        try:
+            landed = serialization.serialize_into(v, seg.view())
+        finally:
+            seg.close()
+        if landed is None:
+            try:
+                run_async(self.agent.call_retry("store_free",
+                                                object_ids=[oid]))
+            except Exception:
+                pass
+            return None
+        contained = self._escrow_contained(landed.contained_refs)
+        object_explain.ledger_record(object_explain.KEY_PUT_ZC, landed.used)
+        self.object_event(oid, ObjectEvent.CREATED, size=landed.used,
+                          node=(self.node_id or "")[:12] or None,
+                          task=spec.task_id.hex()[:16], zero_copy=True)
+        # seal-truncate to the exact bytes written (see _try_zero_copy_put)
+        run_async(self.agent.notify("store_seal", object_id=oid,
+                                    size=landed.used))
+        return ("plasma", landed.used,
                 [(self.node_id, self.agent_address)], contained)
 
     def _run_generator(self, spec: TaskSpec, out) -> List[tuple]:

@@ -45,6 +45,25 @@ from .transfer import (KEY_CHUNK_OUT, KEY_PROXY_IN, ChunkCrcError,
                        ChunkLedger, StripedPull, chunk_checksum,
                        transfer_metrics)
 
+#: True when the asyncio selector transport COPIES unsent write() bytes
+#: into its own buffer before returning (<= 3.11).  3.12+ retains the
+#: caller's buffer in a zero-copy write queue across loop ticks, so a
+#: shm view handed to write() could dangle past an arena recycle.
+_TRANSPORT_COPIES_WRITES = sys.version_info < (3, 12)
+
+
+def _owned_reply_buffer(view: memoryview) -> memoryview:
+    """The RPC chunk reply's out-of-band buffer: the zero-copy store view
+    itself where the transport consumes writes synchronously (the
+    same-tick no-recycle argument in handle_read_chunk), else a
+    DELIBERATE defensive copy — on 3.12+ transports the unsent remainder
+    of a reply stays a live view across loop ticks, and serving a
+    recycled arena range would ship another object's bytes.  The bulk
+    channel (pin-protected sends) is the zero-copy path either way."""
+    if _TRANSPORT_COPIES_WRITES:
+        return view
+    return memoryview(bytes(view))
+
 # Lazy singleton: node telemetry gauges (reference: metric_defs.cc core
 # metrics).  Module-level so in-process multi-agent clusters (tests, the
 # driver-embedded head) share one registry entry per name — each agent's
@@ -187,7 +206,7 @@ class NodeAgent:
                  object_store_memory: int = 0):
         self.node_id = NodeID.from_random()
         self.gcs_address = gcs_address
-        self.server = RpcServer(self, host, port)
+        self.server = RpcServer(self, host, port, bulk_replies=True)
         self.total = ResourceSet(detect_node_resources(num_cpus, num_tpus, resources))
         self.available = ResourceSet(self.total.to_dict())
         self.labels = dict(labels or {})
@@ -281,6 +300,15 @@ class NodeAgent:
             maxlen=max(16, get_config().object_transfer_ring_len))
         self._pin_first_ts: Dict[Tuple[str, ObjectID], float] = {}
         self.store.on_object_event = self._buffer_object_event
+        # Bulk transfer channel (core/bulk_transfer.py): threaded
+        # blocking-socket chunk serving/landing beside the asyncio RPC
+        # plane.  Server started in start(); client sockets + the landing
+        # executor are lazy.  _bulk_addrs caches peer bulk addresses
+        # (None = resolution in flight, False = peer has none).
+        self._bulk_server = None
+        self._bulk_pool = None
+        self._bulk_addrs: Dict[str, object] = {}
+        self._transfer_pool = None
 
     # ------------------------------------------------------------------ boot
 
@@ -308,6 +336,20 @@ class NodeAgent:
 
         self.store.on_external_spill = _on_ext_spill
         await self.server.start()
+        try:
+            from .bulk_transfer import BulkServer
+
+            def _on_bulk_sent(nbytes: int):
+                m = transfer_metrics()
+                if m is not None:  # Counter.inc_key is lock-protected
+                    m["bytes"].inc_key(KEY_CHUNK_OUT, nbytes)
+
+            self._bulk_server = BulkServer(self._bulk_acquire,
+                                           self._bulk_release, loop,
+                                           host=self.server.host,
+                                           on_sent=_on_bulk_sent)
+        except Exception:
+            self._bulk_server = None  # peers fall back to the RPC path
         if get_config().metrics_export_enabled:
             # before registration: the endpoint port rides the node labels
             await self._start_metrics_endpoint()
@@ -362,6 +404,12 @@ class NodeAgent:
         await self.agent_clients.close_all()
         if self.gcs:
             await self.gcs.close()
+        if self._bulk_server is not None:
+            self._bulk_server.close()
+        if self._bulk_pool is not None:
+            self._bulk_pool.close()
+        if self._transfer_pool is not None:
+            self._transfer_pool.shutdown(wait=False)
         await self.server.stop()
         self.store.shutdown()
 
@@ -1370,7 +1418,10 @@ class NodeAgent:
                     data = await loop.run_in_executor(None, _read_spill,
                                                       spill_path)
                 else:
-                    data = bytes(self.store._entries[oid].segment.view())
+                    # [:size]: a seal-truncated entry's segment is the
+                    # larger reservation; the tail is not data
+                    ent = self.store._entries[oid]
+                    data = bytes(ent.segment.view()[:ent.size])
             except Exception:
                 continue
             if self.store.external_uri:
@@ -1585,8 +1636,12 @@ class NodeAgent:
             raise e
         return {"path": path}
 
-    async def handle_store_seal(self, object_id: ObjectID):
-        self.store.seal(object_id)
+    async def handle_store_seal(self, object_id: ObjectID,
+                                size: Optional[int] = None):
+        """``size`` (reserve-then-write puts): the exact byte count
+        written — the entry truncates to it so the reservation's slack
+        tail never serves, ships, or spills."""
+        self.store.seal(object_id, truncate_to=size)
         return True
 
     async def handle_store_put(self, object_id: ObjectID, data: bytes,
@@ -1968,11 +2023,21 @@ class NodeAgent:
         an uncovered range raises a typed ChunkNotAvailable the puller
         re-stripes).
 
-        The copy out of the store is deliberate (the reply flushes a loop
-        tick later, and eviction must not be able to mutate in-flight
-        bytes); the PickleBuffer wrapper makes that copy the LAST one on
-        this side — the RPC layer ships it as an out-of-band vectored
-        frame instead of re-copying it through the pickle stream.
+        SENDER-SIDE ZERO-COPY: the reply carries a memoryview straight
+        over the shm mapping — no intermediate ``bytes`` slice on this
+        side (the hot-path lint pins that).  This is safe on
+        interpreters whose transport write() CONSUMES the buffer before
+        returning (<= 3.11: the selector transport sends what it can and
+        copies the remainder into its own bytearray): the dispatch
+        writes the reply synchronously after the handler returns,
+        vectored frames flush immediately, and eviction/free run on this
+        same loop, so no arena recycle can interleave.  On 3.12+ the
+        transport RETAINS caller buffers across loop ticks
+        (zero-copy write queue), so the view is defensively materialized
+        by ``_owned_reply_buffer`` — a dangling view over a recycled
+        arena range would otherwise ship another object's bytes.  No
+        ``await`` may be added between the view read and the handler's
+        return.
 
         ``with_crc`` adds a per-chunk checksum (native CRC-32C / zlib) the
         puller verifies before marking the chunk landed."""
@@ -1982,15 +2047,129 @@ class NodeAgent:
             # external tier: restore off-loop first, never inline on the
             # serving loop
             await self._restore_external(object_id)
-        data = self.store.read_chunk(object_id, offset, length)
+        view = _owned_reply_buffer(
+            self.store.read_chunk_view(object_id, offset, length))
         m = transfer_metrics()
         if m is not None:
-            m["bytes"].inc_key(KEY_CHUNK_OUT, len(data))
+            m["bytes"].inc_key(KEY_CHUNK_OUT, view.nbytes)
         if with_crc:
-            crc, algo = chunk_checksum(data)
+            crc, algo = chunk_checksum(view)
             return {"crc": crc, "algo": algo,
-                    "data": _pickle.PickleBuffer(data)}
-        return _pickle.PickleBuffer(data)
+                    "data": _pickle.PickleBuffer(view)}
+        return _pickle.PickleBuffer(view)
+
+    # -- bulk transfer channel (core/bulk_transfer.py) --------------------
+
+    async def handle_bulk_info(self):
+        """The bulk transfer channel's address on this node (None when the
+        channel failed to start — peers keep the RPC chunk path)."""
+        if self._bulk_server is None:
+            return {"address": None}
+        return {"address": f"{self.server.host}:{self._bulk_server.port}"}
+
+    async def _bulk_acquire(self, object_id: ObjectID, offset: int,
+                            length: int):
+        """Runs on the agent loop for a bulk serving THREAD: resolve a
+        pinned view like handle_read_chunk, but pin-protected — the
+        thread pushes the view into the kernel outside this loop, so the
+        same-tick no-recycle argument does not apply; the pin makes
+        eviction skip the record and defers frees instead.
+
+        -> (view, kind, full): sealed entries/proxies grant the WHOLE
+        object (full=True) so the serving connection caches ONE pinned
+        grant per object instead of marshalling onto this loop per chunk;
+        partial holders grant per-chunk (their covered ranges change
+        every chunk-time)."""
+        if self.store.external_only(object_id):
+            await self._restore_external(object_id)
+        e = self.store._entries.get(object_id)
+        full = (e is not None and e.sealed and not e.freed) or (
+            e is None and object_id in self.store._proxies)
+        if full:
+            size = (e.size if e is not None
+                    else self.store._proxies[object_id].size)
+            view = self.store.read_chunk_view(object_id, 0, size)
+        else:
+            view = self.store.read_chunk_view(object_id, offset, length)
+        kind = self.store.pin_for_serve(object_id)
+        return view, kind, full
+
+    async def _bulk_release(self, object_id: ObjectID,
+                            kind: Optional[str]):
+        if kind is not None:
+            await self._unpin_and_chain(object_id, kind)
+
+    def _transfer_executor(self):
+        if self._transfer_pool is None:
+            import concurrent.futures
+            self._transfer_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(4,
+                                get_config().object_transfer_parallelism),
+                thread_name_prefix="bulk-land")
+        return self._transfer_pool
+
+    def _get_bulk_pool(self):
+        if self._bulk_pool is None:
+            from .bulk_transfer import BulkPool
+            self._bulk_pool = BulkPool()
+        return self._bulk_pool
+
+    def _bulk_addr_for(self, addr: str) -> Optional[str]:
+        """The peer's bulk-channel address, cached per agent.  Unknown
+        peers kick ONE background resolution (``bulk_info`` RPC) and the
+        caller uses the asyncio chunk path meanwhile — the next chunk
+        rides the bulk channel."""
+        cached = self._bulk_addrs.get(addr, "unresolved")
+        if isinstance(cached, str) and cached != "unresolved":
+            return cached
+        if cached != "unresolved":
+            return None  # in flight (None) or peer has none (False)
+        self._bulk_addrs[addr] = None
+
+        async def _resolve():
+            try:
+                info = await self.agent_clients.get(addr).call(
+                    "bulk_info", _timeout=5.0)
+                self._bulk_addrs[addr] = info.get("address") or False
+            except Exception:
+                self._bulk_addrs.pop(addr, None)  # retry on a later chunk
+
+        t = asyncio.ensure_future(_resolve())
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return None
+
+    async def _bulk_fetch_chunk(self, object_id: ObjectID, addr: str,
+                                bulk_addr: str, stripe: int,
+                                sink: memoryview, off: int, n: int,
+                                with_crc: bool, timeout_s: float) -> int:
+        """Run one bulk fetch on the landing executor.  The finally block
+        restores the no-late-write guarantee the asyncio path gets from
+        call_into: if this coroutine is cancelled or times out while the
+        executor thread is still landing into ``sink``, the socket is
+        killed and the thread WAITED OUT before control returns — the
+        caller may recycle the arena range behind ``sink`` right after."""
+        import concurrent.futures
+        pool = self._get_bulk_pool()
+        cfut = self._transfer_executor().submit(
+            pool.fetch, addr, bulk_addr, stripe, object_id, off, n, sink,
+            with_crc, timeout_s)
+        try:
+            return await asyncio.wait_for(asyncio.wrap_future(cfut),
+                                          timeout_s + 5.0)
+        finally:
+            # the guarantee must actually HOLD, not be attempted once: a
+            # thread still inside create_connection registers its socket
+            # only after connecting (one drop would miss it), so drop
+            # again each round until the future is genuinely done — with
+            # the socket dead, recv/sendall fail within one syscall,
+            # bounding the loop to the connect timeout.  Only THIS
+            # stripe's socket dies: the other stripes' healthy in-flight
+            # fetches from the same source must not become collateral.
+            while not cfut.done():
+                pool.drop_stripe(bulk_addr, stripe)
+                await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: concurrent.futures.wait([cfut], 5.0))
 
     async def handle_fetch_object(self, object_id: ObjectID, size: int,
                                   locations: List[Tuple[str, str]],
@@ -2322,6 +2501,28 @@ class NodeAgent:
                              order=order)
         partial = cfg.object_transfer_partial_serving
         registered = False
+        # wire-rate knobs: parallel sockets per source (sticky per chunk)
+        # and adaptive per-request growth in base-chunk runs
+        sock_n = max(1, cfg.transfer_sockets_per_source)
+        run_max = max(1, cfg.object_transfer_chunk_max
+                      // max(1, cfg.object_transfer_chunk_bytes))
+        sock_rr: Dict[str, int] = {}
+        chunk_subs: Dict[int, int] = {}
+
+        def clamp_run_chunks() -> int:
+            # receiver-side re-clamp: a grown request must never exceed
+            # the largest free arena block of THIS (receiving) store —
+            # any transfer-plane landing that needs a contiguous arena
+            # range (checksum scratch, restore) must fit without forcing
+            # an eviction/spill mid-pull
+            pool = self.store.pool
+            if pool is None:
+                return run_max
+            try:
+                lf = pool.largest_free
+            except Exception:
+                return 1
+            return max(1, lf // max(1, cfg.object_transfer_chunk_bytes))
 
         def on_chunk(i, off, n, addr, t0, t1, stolen):
             nonlocal registered
@@ -2331,14 +2532,25 @@ class NodeAgent:
                 self.store.mark_available(object_id, off, n)
             self._trace_transfer(
                 kind="chunk", object=object_id.hex()[:12], source=addr,
-                offset=off, bytes=n, t0=t0, t1=t1, stolen=stolen)
+                offset=off, bytes=n, t0=t0, t1=t1, stolen=stolen,
+                socket=chunk_subs.pop(off, 0))
             if partial and not registered and owner:
                 registered = True
                 self._register_object_location(owner, object_id)
 
         async def fetch_chunk(addr, off, n):
+            # sock_n == 1 keeps the historical single shared connection
+            # (stripe 0); > 1 spreads chunks sticky over DEDICATED bulk
+            # stripes 1..sock_n (big socket buffers, large reads) so
+            # multi-MB replies stream concurrently instead of serializing
+            # head-of-line with each other and the control traffic
+            sub = 0
+            if sock_n > 1 and not is_external_address(addr):
+                sub = 1 + (sock_rr.get(addr, -1) + 1) % sock_n
+                sock_rr[addr] = sock_rr.get(addr, -1) + 1
+            chunk_subs[off] = sub
             return await self._fetch_chunk(object_id, seg, addr, off, n,
-                                           cfg)
+                                           cfg, sub)
 
         async def probe_source(addr):
             if is_external_address(addr):
@@ -2376,7 +2588,9 @@ class NodeAgent:
             steal_after_s=cfg.object_transfer_steal_after_s,
             max_source_failures=cfg.object_transfer_max_source_failures,
             refresh_period_s=cfg.object_transfer_source_refresh_s,
-            stall_timeout_s=cfg.object_transfer_stall_timeout_s)
+            stall_timeout_s=cfg.object_transfer_stall_timeout_s,
+            run_max_chunks=run_max,
+            clamp_run_chunks=clamp_run_chunks if run_max > 1 else None)
         t_pull = time.time()
         try:
             try:
@@ -2415,7 +2629,9 @@ class NodeAgent:
                               stats=stats)
         self._trace_transfer(
             kind="pull_summary", object=object_id.hex()[:12], bytes=size,
-            t0=t_pull, t1=time.time(), **stats)
+            t0=t_pull, t1=time.time(), sockets_per_source=sock_n,
+            chunk_max_bytes=run_max * cfg.object_transfer_chunk_bytes,
+            **stats)
         if owner:
             self._register_object_location(owner, object_id)
         located = self.store.get_path(object_id)
@@ -2427,15 +2643,18 @@ class NodeAgent:
         return {"path": path, "size": sz}
 
     async def _fetch_chunk(self, object_id: ObjectID, seg, addr: str,
-                           off: int, n: int, cfg) -> int:
-        """Land one chunk from ``addr`` into the destination segment.
+                           off: int, n: int, cfg, sub: int = 0) -> int:
+        """Land one chunk (or a grown run of base chunks) from ``addr``
+        into the destination segment.
 
         The reply's out-of-band buffer lands DIRECTLY into the segment
         view (``call_into`` readinto-style receive) — no intermediate
         ``bytes``, no slice-assign: zero extra copies on this side beyond
-        the socket read itself.  Returns the byte count landed; the engine
-        rejects short chunks (a truncated reply must never seal a corrupt
-        object)."""
+        the socket read itself.  ``sub`` picks the parallel transfer
+        socket to ``addr`` (sticky per chunk; see
+        ``transfer_sockets_per_source``).  Returns the byte count landed;
+        the engine rejects short chunks (a truncated reply must never
+        seal a corrupt object)."""
         sink = seg.view()[off:off + n]
         if is_external_address(addr):
             # external-tier chunk source: range-read the URI off-loop and
@@ -2447,8 +2666,34 @@ class NodeAgent:
             if landed <= n:
                 sink[:landed] = data
             return landed
-        client = self.agent_clients.get(addr)
+        # a grown run carries proportionally more bytes than the base
+        # chunk the timeout was tuned for: scale it, bounded
+        timeout_s = min(
+            cfg.object_transfer_chunk_timeout_s
+            * max(1, -(-n // max(1, cfg.object_transfer_chunk_bytes))),
+            max(cfg.object_transfer_chunk_timeout_s,
+                cfg.object_transfer_stall_timeout_s * 2))
         with_crc = cfg.object_transfer_checksum
+        if sub > 0:
+            # multi-socket mode: ride the threaded bulk channel when the
+            # peer advertises one (sendall/recv_into straight between shm
+            # mappings and the kernel, GIL released — the asyncio RPC
+            # path below stays as the fallback and the sockets=1 arm)
+            bulk_addr = self._bulk_addr_for(addr)
+            if bulk_addr:
+                try:
+                    return await self._bulk_fetch_chunk(
+                        object_id, addr, bulk_addr, sub - 1, sink, off, n,
+                        with_crc, timeout_s)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    # the peer may have restarted with a NEW bulk port at
+                    # the same RPC address: drop the cached bulk address
+                    # so the next chunk re-resolves (riding the RPC path
+                    # meanwhile) instead of permanently hammering a dead
+                    # port until the source is declared dead
+                    self._bulk_addrs.pop(addr, None)
+                    raise
+        client = self.agent_clients.get_striped(addr, sub)
         if with_crc:
             # Checksum mode trades the zero-copy landing for soundness: a
             # work-steal hedge means a straggler duplicate reply can arrive
@@ -2459,7 +2704,7 @@ class NodeAgent:
             try:
                 res = await client.call(
                     "read_chunk",
-                    _timeout=cfg.object_transfer_chunk_timeout_s,
+                    _timeout=timeout_s,
                     object_id=object_id, offset=off, length=n,
                     with_crc=True)
             except RemoteError as e:
@@ -2480,7 +2725,7 @@ class NodeAgent:
         try:
             res = await client.call_into(
                 "read_chunk", sink,
-                _timeout=cfg.object_transfer_chunk_timeout_s,
+                _timeout=timeout_s,
                 object_id=object_id, offset=off, length=n)
         except RemoteError as e:
             if isinstance(e.cause, ChunkNotAvailable):

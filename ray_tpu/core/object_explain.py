@@ -112,8 +112,12 @@ COPY_N = "n"
 #: constants derived from this table, so adding a path means declaring
 #: its class first.
 COPY_CLASS: Dict[str, str] = {
-    # owner serialize -> arena mapping (the single put memcpy PROFILE_CORE
-    # measured at ~78% of the box memcpy ceiling)
+    # classic/fallback put: owner serialize -> one write_into memcpy into
+    # the arena mapping (the single put memcpy PROFILE_CORE round 6
+    # measured at ~78% of the box memcpy ceiling).  The DEFAULT large-put
+    # path is now the reserve-then-write zero-copy put, declared by
+    # COPY_CLASS_ZC below and recorded under KEY_PUT_ZC — this row keeps
+    # the 1-copy class of the estimate-miss / kill-switch fallback.
     "put": COPY_ONE,
     # small value -> owner memory store (one encode into the inline blob)
     "put_inline": COPY_ONE,
@@ -136,11 +140,25 @@ COPY_CLASS: Dict[str, str] = {
     "re_home": COPY_N,
 }
 
+#: Alternate declared classes: a path whose DEFAULT pipeline differs from
+#: its fallback declares both (same path label, different ``copies`` tag —
+#: the ledger separates them by construction).  "put" class 0 is the
+#: reserve-then-write zero-copy put (core/serialization.py
+#: ``serialize_into``): the pickler targets the reserved arena range
+#: directly, so no payload byte is ever materialized outside its source
+#: and the store — the plasma/Arrow zero-copy-put convention.  Its
+#: fallback (estimate miss, ``zero_copy_put_enabled=False``) stays the
+#: 1-copy class above, pinned separately by tests/test_copy_discipline.py.
+COPY_CLASS_ZC: Dict[str, str] = {
+    "put": COPY_ZERO,
+}
+
 #: precomputed sorted tag-key tuples (Counter.inc_key discipline): one per
 #: declared path, named KEY_<PATH>.  Call sites MUST use these constants —
 #: the lint rejects inline tuples/strings (an undeclared path would be an
 #: unbounded label value and an unaudited copy).
 KEY_PUT = (("copies", COPY_CLASS["put"]), ("path", "put"))
+KEY_PUT_ZC = (("copies", COPY_CLASS_ZC["put"]), ("path", "put"))
 KEY_PUT_INLINE = (("copies", COPY_CLASS["put_inline"]), ("path", "put_inline"))
 KEY_GET = (("copies", COPY_CLASS["get"]), ("path", "get"))
 KEY_GET_COPY = (("copies", COPY_CLASS["get_copy"]), ("path", "get_copy"))

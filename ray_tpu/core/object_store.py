@@ -204,6 +204,11 @@ def shm_path_for(store_name: str, object_id: ObjectID) -> str:
 class _Entry:
     segment: ShmSegment
     size: int
+    #: bytes actually ALLOCATED in the arena (>= size: a reserve-then-
+    #: write put may seal-truncate ``size`` to the exact encoding, but
+    #: the allocator range — and this store's ``used`` accounting —
+    #: stays the reservation until free)
+    alloc: int = 0
     sealed: bool = False
     pinned: int = 0          # pin count: live reader views + peer transfers
     freed: bool = False      # owner freed it while pins were live (deferred)
@@ -408,7 +413,8 @@ class NodeObjectStore:
             except FileExistsError:
                 os.unlink(path)
                 seg = ShmSegment(path, size, create=True)
-        self._entries[object_id] = _Entry(segment=seg, size=size, owner=owner)
+        self._entries[object_id] = _Entry(segment=seg, size=size,
+                                          alloc=size, owner=owner)
         self.used += size
         self.num_creates += 1
         return seg.path
@@ -437,9 +443,17 @@ class NodeObjectStore:
         self.seal(object_id)
         return path
 
-    def seal(self, object_id: ObjectID):
+    def seal(self, object_id: ObjectID, truncate_to: Optional[int] = None):
+        """Seal; ``truncate_to`` shrinks the entry's DATA size to the
+        exact bytes written (reserve-then-write puts reserve an upper
+        bound): readers, transfers and spills then never touch the
+        ``[used, reserved)`` tail — which is recycled arena memory, i.e.
+        another object's stale bytes.  The allocator range (and ``used``
+        accounting) stays the reservation until free."""
         e = self._entries[object_id]
         e.sealed = True
+        if truncate_to is not None and 0 < truncate_to < e.size:
+            e.size = truncate_to
         e.avail = None  # full: range map no longer meaningful
         ev = self._sealed_events.pop(object_id, None)
         if ev:
@@ -515,7 +529,17 @@ class NodeObjectStore:
         e.last_access = time.monotonic()
         return e.segment.path, e.size
 
-    def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
+    def read_chunk_view(self, object_id: ObjectID, offset: int,
+                        length: int) -> memoryview:
+        """ZERO-COPY chunk serving: a view straight over the shm mapping
+        (sealed entry, same-host proxy slice, or a covered range of an
+        in-progress pull).  The caller must consume the view WITHIN the
+        current event-loop tick — the vectored reply path flushes
+        synchronously and the asyncio transport copies any unsent
+        remainder into its own buffer before returning, and eviction/free
+        run on this same loop, so no recycle can interleave with a
+        same-tick consumer.  Holding the view across an ``await`` would
+        break that invariant."""
         e = self._entries.get(object_id)
         if e is None:
             # Same-host proxy holders ARE byte sources: serve straight off
@@ -523,8 +547,8 @@ class NodeObjectStore:
             # pullers that can't zero-copy attach still get the bytes).
             p = self._proxies.get(object_id)
             if p is not None and not p.freed:
-                return bytes(self._attach_view(p.path, p.size)
-                             [offset:offset + length])
+                return self._attach_view(p.path, p.size)[
+                    offset:offset + length]
             self._maybe_restore(object_id)
             e = self._entries[object_id]
         if e.freed:
@@ -541,7 +565,13 @@ class NodeObjectStore:
                     f"object {object_id}: [{offset}, {offset + length}) "
                     f"not yet held (have {e.avail or []})")
         e.last_access = time.monotonic()
-        return bytes(e.segment.view()[offset:offset + length])
+        return e.segment.view()[offset:offset + length]
+
+    def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
+        """Copying chunk read (non-RPC consumers; the serving hot path is
+        :meth:`read_chunk_view`)."""
+        view = self.read_chunk_view(object_id, offset, length)
+        return view.tobytes()
 
     def _attach_view(self, path: str, size: int) -> memoryview:
         """Attach-mode view over a path this store does not own (proxy
@@ -592,6 +622,26 @@ class NodeObjectStore:
         if e is not None and e.sealed and not e.freed:
             e.pinned += 1
             return "local"
+        return None
+
+    def pin_for_serve(self, object_id: ObjectID) -> Optional[str]:
+        """Pin the record :meth:`read_chunk_view` just served a view of —
+        the bulk-transfer server's bracket: its serving THREADS push the
+        view into the kernel outside the store's loop, so the view must
+        be pin-protected for the send's duration (unlike the same-tick
+        RPC reply path).  Mirrors read_chunk_view's service order (entry
+        first, proxy only when no entry) and, unlike
+        :meth:`pin_for_read`, also pins UNSEALED partial entries (their
+        landed ranges are servable).  Returns the kind for
+        :meth:`unpin`."""
+        e = self._entries.get(object_id)
+        if e is not None and not e.freed:
+            e.pinned += 1
+            return "local"
+        p = self._proxies.get(object_id)
+        if p is not None and not p.freed:
+            p.pinned += 1
+            return "proxy"
         return None
 
     def unpin(self, object_id: ObjectID, kind: Optional[str] = None) -> Optional[str]:
@@ -711,7 +761,7 @@ class NodeObjectStore:
             self._event(object_id, ObjectEvent.FREED)
         if e is None:
             return proxy.source_addr if proxy else None
-        self.used -= e.size
+        self.used -= e.alloc or e.size
         e.segment.close()
         e.segment.unlink()
         return proxy.source_addr if proxy else None
@@ -733,8 +783,8 @@ class NodeObjectStore:
             if self.spill_dir or self.external_uri:
                 self._spill(oid, e)
             self._entries.pop(oid)
-            self.used -= e.size
-            freed += e.size
+            self.used -= e.alloc or e.size
+            freed += e.alloc or e.size
             e.segment.close()
             e.segment.unlink()
             self.num_evictions += 1
@@ -760,7 +810,9 @@ class NodeObjectStore:
         self._write_spill_marker()
         path = os.path.join(self.spill_dir, f"{self.name}-{object_id.hex()}.spill")
         with open(path, "wb") as f:
-            f.write(e.segment.view())
+            # [:e.size]: a seal-truncated entry's segment is the (larger)
+            # reservation — the tail is recycled arena bytes, never data
+            f.write(e.segment.view()[:e.size])
         self._spilled.setdefault(object_id, path)
         self._spilled_sizes[object_id] = e.size
         if e.owner:
@@ -782,7 +834,7 @@ class NodeObjectStore:
             # content is immutable once sealed, so re-uploading the whole
             # object (and re-firing the owner registration) is pure waste
             return
-        data = bytes(e.segment.view())
+        data = bytes(e.segment.view()[:e.size])
         uri = external_spill.object_uri(self.external_uri, object_id)
         self._spilled_external[object_id] = uri
         self._ext_sizes[object_id] = len(data)

@@ -507,10 +507,16 @@ class RpcServer:
     IDEM_CACHE_MAX = 4096
     IDEM_CACHE_MAX_BYTES = 64 << 20
 
-    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
+                 bulk_replies: bool = False):
         self.handler = handler
         self.host = host
         self.port = port
+        #: servers that stream multi-MB reply frames (node agents serving
+        #: read_chunk) raise SO_SNDBUF on every accepted connection — a
+        #: buffer CAP, not committed memory — so a vectored chunk reply
+        #: moves in a few large sends instead of dozens of partial ones
+        self.bulk_replies = bulk_replies
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
         #: optional per-handler BUSY-seconds attribution callback
@@ -546,6 +552,15 @@ class RpcServer:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._conns.add(writer)
+        if self.bulk_replies:
+            try:
+                import socket as _socket
+                sock = writer.get_extra_info("socket")
+                if sock is not None:
+                    sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF,
+                                    RpcClient.BULK_SOCK_BUF)
+            except Exception:
+                pass
         peer = writer.get_extra_info("peername")
         if hasattr(self.handler, "on_connect"):
             await self.handler.on_connect(peer, writer)
@@ -774,9 +789,20 @@ class RpcClient:
     per-frame pickle/unpickle and socket syscalls of different connections
     land on different OS threads — the owner submission-lane substrate."""
 
-    def __init__(self, address: str, lane: Any = 0):
+    #: socket tuning applied to BULK (transfer-stripe) connections: big
+    #: kernel buffers (caps, not committed memory) let an 8 MB reply
+    #: frame move with far fewer partial sends, and a larger per-wakeup
+    #: read size cuts the receiver's syscall + loop-iteration count per
+    #: chunk.  Only dedicated transfer connections get this — on a
+    #: control-plane connection a multi-MB recv allocation per 100-byte
+    #: frame would be pure waste.
+    BULK_SOCK_BUF = 8 << 20
+    BULK_READ_SIZE = 2 << 20
+
+    def __init__(self, address: str, lane: Any = 0, bulk: bool = False):
         self.address = address
         self._lane = lane
+        self._bulk = bulk
         host, port = address.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self._reader: asyncio.StreamReader | None = None
@@ -837,6 +863,20 @@ class RpcClient:
                 asyncio.open_connection(self._host, self._port,
                                         limit=16 << 20),
                 timeout=cfg.rpc_connect_timeout_s)
+            if self._bulk:
+                try:
+                    import socket as _socket
+                    sock = self._writer.get_extra_info("socket")
+                    if sock is not None:
+                        sock.setsockopt(_socket.SOL_SOCKET,
+                                        _socket.SO_SNDBUF,
+                                        self.BULK_SOCK_BUF)
+                        sock.setsockopt(_socket.SOL_SOCKET,
+                                        _socket.SO_RCVBUF,
+                                        self.BULK_SOCK_BUF)
+                    self._writer.transport.max_size = self.BULK_READ_SIZE
+                except Exception:
+                    pass
             self._pending = {}
             self._sinks = {}
             if self._connected_once:
@@ -1196,13 +1236,39 @@ class ClientPool:
             self._clients[address] = c
         return c
 
+    def get_striped(self, address: str, stripe: int) -> RpcClient:
+        """A PARALLEL connection to ``address``: stripe 0 is the pool's
+        regular client, stripes >= 1 are extra sockets cached under a
+        derived key (the bulk-transfer substrate: multi-MB reply frames
+        to one peer stream over ``transfer_sockets_per_source``
+        connections instead of serializing head-of-line on one).  Stripe
+        assignment is the CALLER's — sticky per in-flight chunk — and a
+        stripe keeps its connection (and its lane) for the pool's
+        lifetime, so per-connection FIFO ordering still holds within a
+        stripe."""
+        if stripe <= 0:
+            return self.get(address)
+        key = f"{address}\x00stripe{stripe}"
+        c = self._clients.get(key)
+        if c is None or c._closed:
+            c = RpcClient(address, lane=self._lane_for(key), bulk=True)
+            if self._push_handler is not None:
+                c.on_push(self._push_handler)
+            self._clients[key] = c
+        return c
+
     async def close(self, address: str):
-        """Drop one connection; its pending futures fail with
-        ConnectionLost (used to force-surface a peer the caller KNOWS is
-        dead without waiting on EOF delivery)."""
+        """Drop one connection — including its transfer stripes; their
+        pending futures fail with ConnectionLost (used to force-surface a
+        peer the caller KNOWS is dead without waiting on EOF delivery)."""
         c = self._clients.pop(address, None)
         if c is not None:
             await c.close()
+        prefix = f"{address}\x00stripe"
+        for key in [k for k in self._clients if k.startswith(prefix)]:
+            sc = self._clients.pop(key, None)
+            if sc is not None:
+                await sc.close()
 
     async def close_all(self):
         for c in self._clients.values():

@@ -189,6 +189,64 @@ class ChunkLedger:
         self.fetchers[best] += 1
         return best
 
+    def claim_run(self, source: str, covered: Callable[[int, int], bool],
+                  rank: Optional[Callable[[int], int]] = None,
+                  max_chunks: int = 1) -> Optional[List[int]]:
+        """Claim a RUN: :meth:`claim`'s pick plus up to ``max_chunks - 1``
+        offset-consecutive PENDING chunks the source also covers, all
+        marked INFLIGHT as one fetch unit (one request on the wire).
+
+        This is the adaptive chunk-growth substrate: the LEDGER keeps its
+        fixed base-chunk bookkeeping (steal, retry, partial publishing
+        stay chunk-granular), while the per-request size on the wire
+        grows to the run — fewer round trips, same failure granularity:
+        a failed run requeues per base chunk."""
+        first = self.claim(source, covered, rank)
+        if first is None or max_chunks <= 1:
+            return None if first is None else [first]
+        run = [first]
+        t0 = self.started[first]
+        # STAGGER the per-chunk start stamps across the run by the median
+        # completed-chunk time: the steal clock compares per-chunk ages
+        # against a per-base-chunk threshold, and a healthy 32-chunk run
+        # stamped wholesale at t0 would look 32 chunk-times "slow" by its
+        # tail — systematically hedged and its source shrunk for being
+        # fast.  Staggered, chunk k of a run only ages once its expected
+        # service time has actually passed.
+        per = (self.chunk_times[len(self.chunk_times) // 2]
+               if self.chunk_times else 0.25)
+        i = first + 1
+        n = len(self.offsets)
+        while len(run) < max_chunks and i < n and self.state[i] == PENDING \
+                and covered(self.offsets[i], self.chunk_len(i)):
+            self.state[i] = INFLIGHT
+            self.assigned[i] = source
+            self.started[i] = t0 + len(run) * per
+            self.fetchers[i] += 1
+            run.append(i)
+            i += 1
+        return run
+
+    def run_span(self, run: List[int]) -> tuple:
+        """(offset, length) of one offset-consecutive claimed run."""
+        off = self.offsets[run[0]]
+        end = self.offsets[run[-1]] + self.chunk_len(run[-1])
+        return off, end - off
+
+    def complete_run(self, run: List[int], elapsed_s: float) -> bool:
+        """Mark every chunk of a run DONE (per-chunk time = the run's
+        mean).  True if ANY chunk was first-landed by this run."""
+        per = elapsed_s / max(1, len(run))
+        first = False
+        for i in run:
+            if self.complete(i, per):
+                first = True
+        return first
+
+    def fail_run(self, run: List[int]):
+        for i in run:
+            self.fail(i)
+
     def steal(self, source: str, covered: Callable[[int, int], bool],
               threshold_s: float) -> Optional[int]:
         """Hedge the SLOWEST in-flight chunk another source has held longer
@@ -264,12 +322,23 @@ class SourceState:
     last_fail_t: float = 0.0
     dead: bool = False
     #: set after ChunkNotAvailable: don't re-claim against stale ranges
-    #: until the next refresh re-probes this source
+    #: until a re-probe refreshes them (event-driven when a prober
+    #: exists — see StripedPull._probe_soon — else the refresh tick)
     wait_probe: bool = False
+    #: monotonic time of the last issued probe (the event-driven probe's
+    #: debounce clock) and whether one is currently in flight
+    last_probe_t: float = 0.0
+    probe_inflight: bool = False
     chunks: int = 0
     bytes: int = 0
     t_first: float = 0.0
     t_last: float = 0.0
+    #: adaptive per-request size, in base chunks: grows geometrically
+    #: under clean completions (see StripedPull._grow/_shrink), shrinks
+    #: on failure/timeout and when another source steals this one's
+    #: in-flight work (slowness evidence)
+    run_len: int = 1
+    clean: int = 0
 
     FAIL_DEBOUNCE_S = 0.1
 
@@ -317,7 +386,9 @@ class StripedPull:
                  steal_after_s: float = 0.0,
                  max_source_failures: int = 3,
                  refresh_period_s: float = 0.5,
-                 stall_timeout_s: float = 60.0):
+                 stall_timeout_s: float = 60.0,
+                 run_max_chunks: int = 1,
+                 clamp_run_chunks: Optional[Callable[[], int]] = None):
         self.ledger = ledger
         self._fetch_chunk = fetch_chunk
         self._probe_source = probe_source
@@ -329,8 +400,20 @@ class StripedPull:
         self.max_source_failures = max(1, max_source_failures)
         self.refresh_period_s = refresh_period_s
         self.stall_timeout_s = stall_timeout_s
+        #: adaptive chunk growth: per-request runs of base chunks grow
+        #: toward this many chunks under clean completions (1 = fixed
+        #: chunks, the pre-adaptive behavior)
+        self.run_max_chunks = max(1, run_max_chunks)
+        #: receiver-side clamp, re-queried per claim: the largest run (in
+        #: base chunks) the receiving arena can absorb — grown requests
+        #: must never outgrow the receiver's largest free block, or a
+        #: landing could force a spill mid-pull
+        self._clamp_run_chunks = clamp_run_chunks
         self.sources: Dict[str, SourceState] = {}
         self._slots: List[asyncio.Task] = []
+        #: ephemeral event-driven probe tasks (self-pruning; separate
+        #: from _slots so fetch-slot bookkeeping stays bounded)
+        self._probes: set = set()
         self._last_progress = time.monotonic()
         self._done = asyncio.Event()
         #: wakes idle slots when claimable work may exist (chunk requeued,
@@ -389,23 +472,52 @@ class StripedPull:
 
         return rank
 
+    def _run_budget(self, s: SourceState) -> int:
+        """Chunks this source's next claim may take: its adaptive run
+        length, bounded by the engine max and the receiver-side clamp."""
+        n = min(s.run_len, self.run_max_chunks)
+        if self._clamp_run_chunks is not None:
+            try:
+                n = min(n, self._clamp_run_chunks())
+            except Exception:
+                n = 1
+        return max(1, n)
+
+    def _grow(self, s: SourceState):
+        s.clean += 1
+        if s.clean >= 2 and s.run_len < self.run_max_chunks:
+            s.run_len = min(self.run_max_chunks, s.run_len * 2)
+            s.clean = 0
+
+    def _shrink(self, s: SourceState):
+        s.clean = 0
+        s.run_len = max(1, s.run_len // 2)
+
     async def _slot(self, s: SourceState):
         ledger = self.ledger
         while not ledger.done and not s.dead and self._fatal is None:
             worked = False
             async with self._window:
-                i = stolen = None
+                run = None
+                stolen = False
                 if not s.wait_probe:
-                    i = ledger.claim(s.addr, s.covers,
-                                     self._coverage_rank(s))
-                    if i is None:
+                    run = ledger.claim_run(s.addr, s.covers,
+                                           self._coverage_rank(s),
+                                           self._run_budget(s))
+                    if run is None:
                         i = ledger.steal(
                             s.addr, s.covers,
                             ledger.steal_threshold(self.steal_after_s))
-                        stolen = i is not None
-                if i is not None:
+                        if i is not None:
+                            run, stolen = [i], True
+                            # slowness evidence against the victim: its
+                            # next requests should shrink, not grow
+                            victim = self.sources.get(ledger.assigned[i])
+                            if victim is not None and victim is not s:
+                                self._shrink(victim)
+                if run is not None:
                     worked = True
-                    await self._fetch_one(s, i, bool(stolen))
+                    await self._fetch_one(s, run, stolen)
             if ledger.done:
                 break
             if not worked:
@@ -422,9 +534,10 @@ class StripedPull:
         if ledger.done:
             self._done.set()
 
-    async def _fetch_one(self, s: SourceState, i: int, stolen: bool):
+    async def _fetch_one(self, s: SourceState, run: List[int],
+                         stolen: bool):
         ledger = self.ledger
-        off, n = ledger.offsets[i], ledger.chunk_len(i)
+        off, n = ledger.run_span(run)
         t0 = time.time()
         tm0 = time.monotonic()
         s.inflight += 1
@@ -438,35 +551,43 @@ class StripedPull:
                     f"at offset {off}")
         except ChunkNotAvailable:
             # partial holder that doesn't (yet) cover this range: requeue
-            # the chunk and — when a prober exists to clear the flag —
-            # stop claiming against its stale range map until the refresh
-            # loop re-probes it (without a prober the pause would be
-            # permanent, so just back off briefly instead)
-            s.wait_probe = self._probe_source is not None
-            ledger.fail(i)
-            self._kick.set()  # the requeued chunk is claimable elsewhere
+            # the chunks and — when a prober exists to clear the flag —
+            # stop claiming against its stale range map until a re-probe
+            # widens it.  The re-probe is EVENT-DRIVEN (debounced), not
+            # left to the refresh tick: in a fast broadcast a relay's
+            # ranges widen every few chunk-times, and a tick-period pause
+            # would idle the relay for most of the transfer (without a
+            # prober the pause would be permanent, so just back off
+            # briefly instead).
+            if self._probe_source is not None:
+                s.wait_probe = True
+                self._probe_soon(s)
+            ledger.fail_run(run)
+            self._kick.set()  # the requeued chunks are claimable elsewhere
             await asyncio.sleep(0.01)
         except asyncio.CancelledError:
-            ledger.fail(i)
+            ledger.fail_run(run)
             raise
         except BaseException:
-            ledger.fail(i)
+            ledger.fail_run(run)
+            self._shrink(s)  # timeout/transport fault: smaller requests
             if m is not None:
                 m["chunk_seconds"].observe_key(KEY_FAIL,
                                                time.monotonic() - tm0)
             if s.note_failure() >= self.max_source_failures:
                 s.dead = True
-            self._kick.set()  # the requeued chunk is claimable elsewhere
+            self._kick.set()  # the requeued chunks are claimable elsewhere
             # brief backoff so a fast-failing source can't hot-spin the
             # claim/fail cycle on the event loop
             await asyncio.sleep(0.01)
         else:
             elapsed = time.monotonic() - tm0
             s.failures = 0  # consecutive-failure semantics
-            first = ledger.complete(i, elapsed)
+            first = ledger.complete_run(run, elapsed)
+            self._grow(s)
             if first:
                 self._last_progress = time.monotonic()
-                s.chunks += 1
+                s.chunks += len(run)
                 s.bytes += n
                 if not s.t_first:
                     s.t_first = t0
@@ -476,14 +597,51 @@ class StripedPull:
                     m["chunk_seconds"].observe_key(KEY_OK, elapsed)
                 if self._on_chunk is not None:
                     try:
-                        self._on_chunk(i, off, n, s.addr, t0, time.time(),
-                                       stolen)
+                        self._on_chunk(run[0], off, n, s.addr, t0,
+                                       time.time(), stolen)
                     except Exception:
                         pass
             if ledger.done:
                 self._done.set()
         finally:
             s.inflight -= 1
+
+    #: event-driven probe debounce: a paused source is re-probed at most
+    #: this often (a relay lands ~one chunk per chunk-time; probing much
+    #: faster than that only burns RPCs)
+    PROBE_DEBOUNCE_S = 0.05
+
+    def _probe_soon(self, s: SourceState):
+        """Schedule one debounced probe of a paused (wait_probe) source so
+        its range map widens at chunk-time granularity instead of
+        refresh-tick granularity."""
+        if self._probe_source is None or s.probe_inflight or s.dead:
+            return
+        s.probe_inflight = True
+
+        async def _go():
+            try:
+                delay = (s.last_probe_t + self.PROBE_DEBOUNCE_S
+                         - time.monotonic())
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                s.last_probe_t = time.monotonic()
+                try:
+                    info = await self._probe_source(s.addr)
+                except Exception:
+                    info = None
+                if info is not None:
+                    s.ranges = (None if info.get("full")
+                                else [list(r) for r in
+                                      info.get("ranges", [])])
+                    s.wait_probe = False
+                    self._kick.set()  # widened ranges: wake idle slots
+            finally:
+                s.probe_inflight = False
+
+        t = asyncio.ensure_future(_go())
+        self._probes.add(t)
+        t.add_done_callback(self._probes.discard)
 
     # -- refresh / stall watchdog ------------------------------------------
 
@@ -567,9 +725,10 @@ class StripedPull:
             await self._done.wait()
         finally:
             refresher.cancel()
-            for t in self._slots:
+            probes = list(self._probes)  # snapshot: done-callbacks mutate
+            for t in probes + self._slots:
                 t.cancel()
-            await asyncio.gather(refresher, *self._slots,
+            await asyncio.gather(refresher, *probes, *self._slots,
                                  return_exceptions=True)
         if self._fatal is not None and not self.ledger.done:
             raise self._fatal

@@ -38,40 +38,109 @@ def _ledger_value(key: tuple) -> float:
 
 # ---------------------------------------------------------------- put path
 
-def test_put_exactly_one_copy_and_no_flatten(ray_start_regular):
-    """Regression gate: a large-array put must write the payload into the
-    arena exactly once (``object_write``) and never materialize it through
-    an intermediate full-payload ``bytes`` (``serialize_flatten``).
+def test_put_zero_copy_and_no_flatten(ray_start_regular):
+    """Regression gate for the ZERO-copy put (reserve-then-write): a
+    large-array put must serialize DIRECTLY into the reserved arena range
+    (one ``object_write_direct`` landing) — no separate ``object_write``
+    memcpy, and never an intermediate full-payload ``bytes``
+    (``serialize_flatten``).
 
-    The runtime copy-amplification ledger must agree: the put path
-    accounts its bytes under ``{path="put", copies="1"}`` — the declared
-    1-copy class PROFILE_CORE.md measured offline, now asserted at
-    runtime (the zero-copy-put rewrite moves this to copies="0" and
-    updates COPY_CLASS, failing here if it forgets)."""
+    The runtime copy-amplification ledger must agree: the default put
+    path accounts its bytes under ``{path="put", copies="0"}`` (the
+    declared zero-copy class), and the 1-copy fallback class
+    ``{path="put", copies="1"}`` sees none of them."""
     big = np.random.default_rng(0).integers(0, 255, 8 * MB, np.uint8)
     copy_stats.reset()
-    put_before = _ledger_value(object_explain.KEY_PUT)
+    put0_before = _ledger_value(object_explain.KEY_PUT_ZC)
+    put1_before = _ledger_value(object_explain.KEY_PUT)
     ref = ray_tpu.put(big)
-    assert copy_stats.count("object_write") == 1
-    assert copy_stats.bytes("object_write") >= big.nbytes
+    assert copy_stats.count("object_write_direct") == 1
+    assert copy_stats.bytes("object_write_direct") >= big.nbytes
+    assert copy_stats.count("object_write") == 0, \
+        "zero-copy put re-introduced the separate serialize-then-copy memcpy"
     assert copy_stats.count("serialize_flatten") == 0, \
         "put path re-introduced an intermediate bytes materialization"
+    assert object_explain.COPY_CLASS_ZC["put"] == object_explain.COPY_ZERO
     assert object_explain.COPY_CLASS["put"] == object_explain.COPY_ONE
-    assert _ledger_value(object_explain.KEY_PUT) - put_before >= big.nbytes
+    assert _ledger_value(object_explain.KEY_PUT_ZC) - put0_before \
+        >= big.nbytes
+    assert _ledger_value(object_explain.KEY_PUT) == put1_before
+    # round trip: the reserve-then-write layout parses back byte-exactly
+    np.testing.assert_array_equal(ray_tpu.get(ref), big)
+    # seal-truncation: the recorded object size is the EXACT encoding,
+    # not the reservation upper bound — the ~16 KB slack tail (recycled
+    # arena bytes) must never be part of the object
+    from ray_tpu.core.core_worker import global_worker
+    rec = global_worker().memory_store.get_if_exists(ref.id)
+    assert rec.size < big.nbytes + 8 * 1024, \
+        f"object size {rec.size} includes reservation slack"
     del ref
 
 
-def test_put_structured_payload_still_one_copy(ray_start_regular):
+def test_put_structured_payload_still_zero_copy(ray_start_regular):
     """Multiple out-of-band buffers in one value still mean one
-    ``object_write`` event (the scatter-gather lands them all in a single
-    arena slice) and no flatten."""
+    ``object_write_direct`` landing (the gather-write lands them all in a
+    single arena slice) and no flatten — and the value round-trips."""
     val = {"a": np.zeros(2 * MB, np.uint8), "b": np.ones(MB, np.float32),
            "meta": list(range(100))}
     copy_stats.reset()
     ref = ray_tpu.put(val)
-    assert copy_stats.count("object_write") == 1
+    assert copy_stats.count("object_write_direct") == 1
+    assert copy_stats.count("object_write") == 0
     assert copy_stats.count("serialize_flatten") == 0
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out["a"], val["a"])
+    np.testing.assert_array_equal(out["b"], val["b"])
+    assert out["meta"] == val["meta"]
+    del ref, out
+
+
+class _Opaque:
+    """A shape the size estimator refuses (custom class): the put must
+    fall back to the classic 1-copy path, not fail."""
+
+    def __init__(self, arr):
+        self.arr = arr
+
+
+def test_put_estimate_miss_falls_back_one_copy(ray_start_regular):
+    """A value the reserve-then-write estimator cannot bound takes the
+    classic serialize-then-copy path: exactly one ``object_write``, no
+    flatten, bytes accounted under the declared 1-copy fallback class —
+    and the value still round-trips."""
+    val = _Opaque(np.random.default_rng(1).integers(0, 255, 4 * MB,
+                                                    np.uint8))
+    copy_stats.reset()
+    put1_before = _ledger_value(object_explain.KEY_PUT)
+    ref = ray_tpu.put(val)
+    assert copy_stats.count("object_write") == 1
+    assert copy_stats.count("object_write_direct") == 0
+    assert copy_stats.count("serialize_flatten") == 0
+    assert _ledger_value(object_explain.KEY_PUT) - put1_before \
+        >= val.arr.nbytes
+    np.testing.assert_array_equal(ray_tpu.get(ref).arr, val.arr)
     del ref
+
+
+def test_zero_copy_put_kill_switch_restores_prior_path():
+    """``zero_copy_put_enabled=False`` restores the exact prior pipeline:
+    serialize, then ONE ``object_write`` memcpy into the arena — no
+    ``object_write_direct`` landings anywhere (the --ab-zcput off arm)."""
+    import ray_tpu as rt
+    rt.init(num_cpus=2, object_store_memory=256 * MB,
+            worker_env=dict(CPU_WORKER_ENV),
+            _system_config={"zero_copy_put_enabled": False})
+    try:
+        big = np.random.default_rng(2).integers(0, 255, 8 * MB, np.uint8)
+        copy_stats.reset()
+        ref = rt.put(big)
+        assert copy_stats.count("object_write") == 1
+        assert copy_stats.count("object_write_direct") == 0
+        assert copy_stats.count("serialize_flatten") == 0
+        np.testing.assert_array_equal(rt.get(ref), big)
+        del ref
+    finally:
+        rt.shutdown()
 
 
 # ---------------------------------------------------------------- get path

@@ -103,3 +103,56 @@ def test_spec_cache_is_wired_into_the_hot_path():
     src = (CORE / "core_worker.py").read_text()
     assert "spec_cache.decode" in src and ".encode(client" in src
     assert "SpecEncoder" in (CORE / "spec_cache.py").read_text()
+
+
+#: transfer SEND/LANDING hot functions, per file: the zero-copy byte path
+#: (sender serves memoryviews over the pinned shm mapping into the
+#: vectored writev path; the receiver lands readinto-style into the
+#: destination segment; the zero-copy put gathers source views straight
+#: into the arena).  A ``bytes(...)`` / ``.tobytes()`` creeping into any
+#: of these re-introduces a full-payload copy per chunk/put.
+TRANSFER_HOT_FUNCTIONS = {
+    "node_agent.py": {"handle_read_chunk", "_fetch_chunk"},
+    "object_store.py": {"read_chunk_view"},
+    "rpc.py": {"_read_buffer_into"},
+    "serialization.py": {"land", "_land_buffer", "write_into"},
+}
+
+
+def test_transfer_hot_path_does_not_materialize_bytes():
+    """The transfer/landing hot path must stay zero-copy: no
+    ``bytes(...)`` construction and no ``.tobytes()`` flatten inside the
+    named send/landing functions (memoryview slicing, PickleBuffer
+    wrapping, readinto landings and gather-writes only).  Alias-proof the
+    same way as the pickle lint: the found-set assertion means a rename
+    cannot silently drop a function out of the lint."""
+    problems = []
+    for fname, wanted in TRANSFER_HOT_FUNCTIONS.items():
+        path = CORE / fname
+        tree = ast.parse(path.read_text(), filename=str(path))
+        found = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                    or node.name not in wanted:
+                continue
+            found.add(node.name)
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                if isinstance(f, ast.Name) and f.id == "bytes":
+                    problems.append(
+                        f"{path.name}:{call.lineno}: {node.name} calls "
+                        "bytes(...) on the transfer hot path — serve/land "
+                        "memoryviews, never materialize the payload")
+                elif isinstance(f, ast.Attribute) and f.attr == "tobytes":
+                    problems.append(
+                        f"{path.name}:{call.lineno}: {node.name} calls "
+                        ".tobytes() on the transfer hot path")
+        missing = wanted - found
+        assert not missing, (
+            f"{fname}: transfer hot-path functions renamed/removed without "
+            f"updating the lint: {sorted(missing)}")
+    assert not problems, "transfer hot-path copy violations:\n" + \
+        "\n".join(problems)

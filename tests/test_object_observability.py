@@ -352,11 +352,17 @@ def test_every_ledger_key_declares_its_copy_class():
         tags = dict(key)
         assert set(tags) == {"path", "copies"}, (name, tags)
         assert tags["path"] in object_explain.COPY_CLASS, name
-        assert tags["copies"] == object_explain.COPY_CLASS[tags["path"]], \
-            f"{name} disagrees with COPY_CLASS[{tags['path']!r}]"
-    # and every declared path has a key (no unstamped declarations)
+        declared = {object_explain.COPY_CLASS[tags["path"]],
+                    object_explain.COPY_CLASS_ZC.get(tags["path"])}
+        assert tags["copies"] in declared, \
+            f"{name} disagrees with COPY_CLASS[_ZC][{tags['path']!r}]"
+    # and every declared path has a key (no unstamped declarations);
+    # alternate (zero-copy) classes only refine paths declared in the
+    # primary table
     key_paths = {dict(k)["path"] for k in keys.values()}
     assert key_paths == set(object_explain.COPY_CLASS)
+    assert set(object_explain.COPY_CLASS_ZC) <= set(
+        object_explain.COPY_CLASS)
 
 
 def test_copy_amplification_rollup():

@@ -208,6 +208,192 @@ def test_partial_source_narrow_then_widened_ranges():
 
 
 @pytest.mark.timeout(60)
+def test_adaptive_runs_grow_under_clean_completions():
+    """With run growth enabled, a healthy source's per-request size grows
+    geometrically (1 -> 2 -> 4 ... base chunks) under clean completions —
+    the engine issues FEWER, BIGGER fetches while the ledger keeps its
+    base-chunk bookkeeping."""
+    size, chunk = 256 * 1024, 4 * 1024          # 64 base chunks
+    payload, dest = _payload(size), bytearray(size)
+    sizes = []
+
+    async def fetch(addr, off, n):
+        sizes.append(n)
+        dest[off:off + n] = payload[off:off + n]
+        return n
+
+    ledger = ChunkLedger(size, chunk)
+    eng = StripedPull(ledger, fetch_chunk=fetch, per_source_window=1,
+                      total_window=4, refresh_period_s=0.05,
+                      stall_timeout_s=10.0, run_max_chunks=16)
+    asyncio.run(eng.run(["s1"]))
+    assert bytes(dest) == payload
+    assert max(sizes) > chunk, "runs never grew past the base chunk"
+    assert max(sizes) <= 16 * chunk
+    # growth means fewer requests than chunks
+    assert len(sizes) < 64
+    assert eng.sources["s1"].run_len > 1
+
+
+@pytest.mark.timeout(60)
+def test_adaptive_runs_shrink_on_failure():
+    """A failing fetch halves the source's run length (and requeues every
+    base chunk of the failed run chunk-granularly)."""
+    size, chunk = 64 * 1024, 4 * 1024
+    payload, dest = _payload(size), bytearray(size)
+    fails = [0]
+
+    async def fetch(addr, off, n):
+        # fail exactly once, after growth started
+        if n > chunk and not fails[0]:
+            fails[0] += 1
+            raise ConnectionError("transient")
+        dest[off:off + n] = payload[off:off + n]
+        return n
+
+    ledger = ChunkLedger(size, chunk)
+    eng = StripedPull(ledger, fetch_chunk=fetch, per_source_window=1,
+                      total_window=4, refresh_period_s=0.05,
+                      stall_timeout_s=10.0, max_source_failures=10,
+                      run_max_chunks=8)
+    asyncio.run(eng.run(["s1"]))
+    assert bytes(dest) == payload
+    assert fails[0] == 1
+    assert ledger.retries >= 1          # the failed run's chunks requeued
+
+
+@pytest.mark.timeout(60)
+def test_adaptive_run_clamped_by_receiver_largest_free():
+    """The receiver-side re-clamp: with a fragmented receiving arena
+    (small largest_free), grown runs are capped so no single request ever
+    exceeds what the receiver's arena could absorb — chunk growth must
+    never be able to force a spill mid-pull."""
+    size, chunk = 256 * 1024, 4 * 1024
+    payload, dest = _payload(size), bytearray(size)
+    sizes = []
+
+    async def fetch(addr, off, n):
+        sizes.append(n)
+        dest[off:off + n] = payload[off:off + n]
+        return n
+
+    clamp_chunks = 3                     # "largest_free" = 3 base chunks
+
+    ledger = ChunkLedger(size, chunk)
+    eng = StripedPull(ledger, fetch_chunk=fetch, per_source_window=1,
+                      total_window=4, refresh_period_s=0.05,
+                      stall_timeout_s=10.0, run_max_chunks=16,
+                      clamp_run_chunks=lambda: clamp_chunks)
+    asyncio.run(eng.run(["s1"]))
+    assert bytes(dest) == payload
+    assert max(sizes) <= clamp_chunks * chunk, \
+        "a grown run exceeded the receiver's largest free block"
+
+
+@pytest.mark.timeout(180)
+def test_clamp_regression_fragmented_receiving_arena(ray_start_cluster,
+                                                     tmp_path, monkeypatch):
+    """End-to-end clamp regression: a receiving store whose arena is
+    FRAGMENTED (largest_free far below object_transfer_chunk_max) pulls a
+    multi-chunk object with adaptive growth on — every grown request
+    stays within the receiver's largest free arena block, and the pull
+    never evicts or spills an unrelated object mid-pull."""
+    trace = str(tmp_path / "trace")
+    os.makedirs(trace)
+    base = 64 * 1024
+    monkeypatch.setenv("RAYTPU_DISABLE_ZERO_COPY", "1")
+    monkeypatch.setenv("RAYTPU_TRANSFER_TRACE_DIR", trace)
+    monkeypatch.setenv("RAYTPU_OBJECT_TRANSFER_CHUNK_BYTES", str(base))
+    monkeypatch.setenv("RAYTPU_OBJECT_TRANSFER_CHUNK_MAX",
+                       str(16 * 1024 * 1024))
+
+    cluster = ray_start_cluster
+    origin = cluster.add_node(num_cpus=1,
+                              object_store_memory=128 * 1024 * 1024)
+    receiver = cluster.add_node(num_cpus=1,
+                                object_store_memory=64 * 1024 * 1024)
+    cluster.wait_for_nodes(2)
+    cluster.connect_driver()
+
+    import ray_tpu
+    from ray_tpu.core.common import NodeAffinitySchedulingStrategy
+    from ray_tpu.core.ids import ObjectID as OID
+    from ray_tpu.core.rpc import RpcClient, run_async
+
+    agent = RpcClient(receiver.address)
+    if run_async(agent.call("store_stats")).get(
+            "largest_free_block", 0) <= 0:
+        pytest.skip("native arena unavailable: no largest_free to clamp on")
+
+    def mk_filler(size):
+        oid = OID.from_random()
+        run_async(agent.call("store_create", object_id=oid, size=size))
+        run_async(agent.call("store_seal", object_id=oid))
+        return oid
+
+    # the 8 MB payload is PRODUCED on the origin node (its task result
+    # lands in that node's store), so the receiver must chunk-pull it
+    mb = 1024 * 1024
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.random.default_rng(5).integers(0, 255, 8 * mb,
+                                                 dtype=np.uint8)
+
+    ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        origin.node_id, soft=False)).remote()
+    expect = int(np.random.default_rng(5).integers(
+        0, 255, 8 * mb, dtype=np.uint8).sum())
+
+    # fragment the RECEIVING arena: [pin 20M][hole ~9M][pin 20M][pin 13M]
+    # -> largest_free ~= the 9 MB hole; once the 8 MB pull destination
+    # lands there, largest_free collapses to ~1 MB slivers while the
+    # adaptive ceiling (16 MB) stays far above them
+    pinned = []
+    hole = None
+    for size, pin in ((20 * mb, True), (9 * mb, False), (20 * mb, True),
+                      (13 * mb, True)):
+        oid = mk_filler(size)
+        if pin:
+            run_async(agent.call("pin_object", object_id=oid))
+            pinned.append(oid)
+        else:
+            hole = oid
+    run_async(agent.call("store_free", object_ids=[hole]))
+    st0 = run_async(agent.call("store_stats"))
+    assert st0["largest_free_block"] < 16 * mb, \
+        f"arena not fragmented enough: {st0}"
+    evictions_before = st0["num_evictions"]
+
+    @ray_tpu.remote(num_cpus=1)
+    def check(obj):
+        return int(obj.sum())
+
+    task = check.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        receiver.node_id, soft=False)).remote(ref)
+    assert ray_tpu.get(task, timeout=120) == expect
+
+    st1 = run_async(agent.call("store_stats"))
+    assert st1["num_evictions"] == evictions_before, \
+        "adaptive chunk growth forced an eviction/spill mid-pull"
+    # every request the receiver issued stayed within what its arena
+    # could absorb AFTER the destination landed (the live clamp bound)
+    events = []
+    for p in glob.glob(os.path.join(trace, "transfer-*.jsonl")):
+        with open(p) as f:
+            events += [json.loads(l) for l in f if l.strip()]
+    sizes = [e["bytes"] for e in events if e["kind"] == "chunk"]
+    assert sizes, "no chunk events traced"
+    bound = max(st1["largest_free_block"], base)
+    assert max(sizes) <= bound, \
+        (f"grown request {max(sizes)} B exceeds the receiver's largest "
+         f"free block {st1['largest_free_block']} B")
+    for oid in pinned:
+        run_async(agent.call("unpin_object", object_id=oid))
+    run_async(agent.close())
+
+
+@pytest.mark.timeout(60)
 def test_all_sources_dead_raises_stall():
     size, chunk = 16 * 1024, 4 * 1024
     payload, dest = _payload(size), bytearray(size)
@@ -314,6 +500,94 @@ def test_free_of_unsealed_entry_wakes_seal_waiters():
     asyncio.run(run())
 
 
+# ------------------------------------------------------- unit: bulk channel
+
+@pytest.mark.timeout(60)
+def test_bulk_channel_round_trip_partial_and_crc():
+    """The threaded bulk transfer channel (core/bulk_transfer.py): sealed
+    objects serve through a cached pinned full-object grant, covered
+    ranges of partial holders serve per-chunk, uncovered ranges raise the
+    typed ChunkNotAvailable, CRC replies verify — and every pin taken by
+    the serving side is released afterwards."""
+    import time as _time
+
+    from ray_tpu.core.bulk_transfer import BulkPool, BulkServer
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import NodeObjectStore
+    from ray_tpu.core.rpc import get_loop
+
+    store = NodeObjectStore("bulk-test", 64 * 1024 * 1024)
+    payload = _payload(4 * 1024 * 1024)
+    sealed = ObjectID.from_random()
+    store.create(sealed, len(payload))
+    store._entries[sealed].segment.view()[:len(payload)] = payload
+    store.seal(sealed)
+    part = ObjectID.from_random()
+    store.create(part, len(payload))
+    store._entries[part].segment.view()[0:65536] = payload[0:65536]
+    store.mark_available(part, 0, 65536)
+
+    loop = get_loop()
+
+    async def acquire(oid, off, n):
+        e = store._entries.get(oid)
+        full = e is not None and e.sealed and not e.freed
+        view = store.read_chunk_view(oid, 0, e.size) if full \
+            else store.read_chunk_view(oid, off, n)
+        return view, store.pin_for_serve(oid), full
+
+    async def release(oid, kind):
+        store.unpin(oid, kind)
+
+    server = BulkServer(acquire, release, loop)
+    pool = BulkPool()
+    bulk_addr = f"127.0.0.1:{server.port}"
+    try:
+        sink = bytearray(len(payload))
+        mv = memoryview(sink)
+        # two chunks of the sealed object: the second rides the cached
+        # grant (one acquire round trip for both)
+        assert pool.fetch("rpc:0", bulk_addr, 0, sealed, 0, 1 << 20,
+                          mv[0:1 << 20], False, 10.0) == 1 << 20
+        assert pool.fetch("rpc:0", bulk_addr, 0, sealed, 1 << 20,
+                          len(payload) - (1 << 20),
+                          mv[1 << 20:], False, 10.0) \
+            == len(payload) - (1 << 20)
+        assert bytes(sink) == payload
+        # CRC round trip verifies
+        sink2 = bytearray(65536)
+        assert pool.fetch("rpc:0", bulk_addr, 1, sealed, 0, 65536,
+                          memoryview(sink2), True, 10.0) == 65536
+        assert bytes(sink2) == payload[:65536]
+        # partial holder: covered range serves, uncovered is typed
+        sink3 = bytearray(65536)
+        assert pool.fetch("rpc:0", bulk_addr, 0, part, 0, 65536,
+                          memoryview(sink3), False, 10.0) == 65536
+        assert bytes(sink3) == payload[:65536]
+        with pytest.raises(ChunkNotAvailable):
+            pool.fetch("rpc:0", bulk_addr, 0, part, 65536, 65536,
+                       memoryview(bytearray(65536)), False, 10.0)
+        # pins drain once the grants are released (partial grants release
+        # per chunk; the cached sealed grant releases on close below)
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            if store._entries[part].pinned == 0:
+                break
+            _time.sleep(0.02)
+        assert store._entries[part].pinned == 0
+    finally:
+        pool.close()
+        server.close()
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline:
+        if store._entries[sealed].pinned == 0:
+            break
+        _time.sleep(0.02)
+    assert store._entries[sealed].pinned == 0, \
+        "cached grant's pin leaked past connection close"
+    store.shutdown()
+
+
 # ------------------------------------------------ unit: sink (readinto) RPC
 
 @pytest.mark.timeout(60)
@@ -417,13 +691,15 @@ def test_chunked_pull_timeline_schema(ray_start_cluster, tmp_path,
     chunks = [e for e in events if e["kind"] == "chunk"]
     assert chunks, "chunked path emitted no chunk events"
     for e in chunks:
-        for k in ("source", "offset", "bytes", "t0", "t1", "stolen"):
+        for k in ("source", "offset", "bytes", "t0", "t1", "stolen",
+                  "socket"):
             assert k in e, (k, e)
     summaries = [e for e in events if e["kind"] == "pull_summary"]
     assert summaries, "no pull_summary events"
     for s in summaries:
         for k in ("sources_used", "per_source", "chunks_done", "retried",
-                  "stolen", "short"):
+                  "stolen", "short", "sockets_per_source",
+                  "chunk_max_bytes"):
             assert k in s, (k, s)
     origin = chunks[0]["source"]
     summary, _ = _collect_timeline(trace, origin)
@@ -433,9 +709,17 @@ def test_chunked_pull_timeline_schema(ray_start_cluster, tmp_path,
     assert summary["chunk_pulls"] == len(chunks)
     assert isinstance(summary["per_source"], dict) and summary["per_source"]
     for addr, row in summary["per_source"].items():
-        assert {"bytes", "chunks", "gbps"} <= set(row), row
+        assert {"bytes", "chunks", "gbps", "sockets"} <= set(row), row
+        assert row["sockets"] >= 1
     assert {"chunks_done", "retried", "stolen", "short"} \
         <= set(summary["ledger"]), summary["ledger"]
+    # adaptive-chunk + multi-socket schema: the trajectory lists every
+    # request's byte size in start order, sockets_per_source surfaces the
+    # plane's socket fan-out
+    assert summary["chunk_bytes_trajectory"], summary
+    assert all(isinstance(b, int) and b > 0
+               for b in summary["chunk_bytes_trajectory"])
+    assert summary["sockets_per_source"] >= 1
 
 
 # --------------------------------------------------- cluster: chaos drops
