@@ -78,6 +78,13 @@ def main():
     p.add_argument("--max-tokens", type=int, default=64)
     p.add_argument("--num-slots", type=int, default=16)
     p.add_argument("--max-len", type=int, default=1024)
+    p.add_argument("--storm", action="store_true",
+                   help="add an open-loop arrival-spike phase (paged "
+                        "config, serve/loadgen burst schedule + "
+                        "heavy-tailed prompt lengths): how TTFT behaves "
+                        "through a burst at fixed chip capacity")
+    p.add_argument("--storm-rate", type=float, default=2.0,
+                   help="storm base arrivals/s (spike is 4x)")
     args = p.parse_args()
 
     import ray_tpu
@@ -126,7 +133,42 @@ def main():
             t.join()
         return request_rollup(samples, time.time() - t0)
 
-    def run_serve(paged: bool, make_prompt, label: str):
+    def drive_storm(handle):
+        """Open-loop burst phase (serve/loadgen): arrivals fire on a
+        seeded schedule regardless of completion pace, so queueing delay
+        shows in TTFT instead of slowing the client.  Heavy-tailed
+        prompt/decode lengths stress the prefill buckets + paged KV the
+        way production traffic would."""
+        from ray_tpu.serve import loadgen
+
+        srng = random.Random(1)
+        warm_s, spike_s, cool_s = 5.0, 10.0, 5.0
+        total = warm_s + spike_s + cool_s
+        arrivals = loadgen.burst_arrivals(
+            args.storm_rate, 4.0, warm_s, warm_s + spike_s, total, srng)
+
+        def payload(idx: int):
+            return loadgen.llm_payload(
+                1, idx, prompt_median=args.prompt_len // 2,
+                prompt_lo=args.prompt_len // 4, prompt_hi=args.prompt_len,
+                decode_median=args.max_tokens // 2,
+                decode_hi=args.max_tokens)
+
+        runner = loadgen.StormRunner(
+            loadgen.stream_fire(handle, payload, timeout_s=600.0),
+            max_outstanding=256)
+        t0 = time.time()
+        storm_samples = runner.run(arrivals)
+        wall = time.time() - t0
+        ok = [s.rollup_tuple() for s in storm_samples if s.ok]
+        out = request_rollup(ok, wall) if ok else {"n_requests": 0}
+        out["n_errors"] = sum(1 for s in storm_samples if not s.ok)
+        out["arrivals"] = loadgen.arrival_rate_series(arrivals)
+        out["ttft_p95_series"] = loadgen.windowed_p95_series(storm_samples)
+        return out
+
+    def run_serve(paged: bool, make_prompt, label: str,
+                  storm: bool = False):
         """One full cluster lifecycle per configuration: the TPU is held
         exclusively by the replica process, so the next configuration's
         replica can only initialize after a complete teardown."""
@@ -140,7 +182,7 @@ def main():
                                "paged": paged})
             h = serve.run(dep, timeout_s=900)
             list(h.stream({"tokens": make_prompt(), "max_tokens": 4}))
-            res = drive(h, make_prompt)
+            res = drive_storm(h) if storm else drive(h, make_prompt)
             # engine-side serving picture: batch occupancy/padding waste,
             # KV page utilization, prefix-cache hit rate (LLMServer.stats
             # -> LLMEngine.breakdown)
@@ -177,6 +219,11 @@ def main():
         dense = phase("dense", False, mixed_prompt, "dense")
         paged = phase("paged", True, mixed_prompt, "paged")
         prefix = phase("paged_prefix", True, prefix_prompt, "paged+prefix")
+        storm = None
+        if args.storm:
+            # checkpointed like every phase: a tunnel death after the
+            # headline numbers must not lose them
+            storm = phase("storm", True, mixed_prompt, "storm", True)
         print(json.dumps({
             "metric": "serve_llm_req_per_s",
             "value": paged["req_per_s"],
@@ -187,6 +234,7 @@ def main():
             "dense": dense,
             "paged": paged,
             "paged_prefix_hit": prefix,
+            **({"storm": storm} if storm is not None else {}),
             "model": args.preset,
             "clients": args.clients, "requests": args.requests,
             "prompt_mix": [args.prompt_len // 4, args.prompt_len],

@@ -1,0 +1,270 @@
+"""Load-storm benchmark: SLO-autoscaled serving through a 10x arrival
+spike (and, with --chaos, a seeded mid-storm node preemption).
+
+The composition the last four rounds built toward: `serve.slo_signal()`
+(the PR-6 autoscaler input) drives the `policy="slo"` autoscaler
+(serve/slo_autoscaler.py), the PR-8 graceful-drain path retires replicas
+after the storm, and the open-loop harness (serve/loadgen.py) measures
+queueing delay honestly — TTFT from SCHEDULED arrival, so a melting
+deployment cannot hide behind a slowed client.
+
+Timeline (one burst schedule, three phases):
+
+  warm (base rate) | storm (base * spike) | cooldown (base rate)
+                         ^-- optional seeded preempt_node here
+
+Writes ONE JSON (default BENCH_STORM.json): per-phase request rollups
+(bench_llm.request_rollup schema), the {arrival rate, TTFT-p95, replica
+count} time series, every autoscale decision record, and the acceptance
+summary (scale-up latency, TTFT recovery, graceful drain-down, request
+error count, SLO-signal gaps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+
+from bench_llm import request_rollup
+
+
+def noop_deployment(service_ms: float, autoscaling: dict):
+    from ray_tpu import serve
+
+    # max_concurrent_queries bounds per-replica concurrency (the actor's
+    # max_concurrency), so one replica's capacity is concurrency /
+    # service time — the spike must exceed it or there is no storm
+    @serve.deployment(name="storm", max_concurrent_queries=4,
+                      health_check_period_s=0.25,
+                      health_check_timeout_s=2.0,
+                      graceful_shutdown_timeout_s=30.0,
+                      autoscaling_config=autoscaling)
+    class Storm:
+        async def __call__(self, _x=None):
+            await asyncio.sleep(service_ms / 1000.0)
+            return b"ok"
+
+    return Storm
+
+
+def llm_tiny_deployment(autoscaling: dict):
+    from ray_tpu.serve.llm import llm_deployment
+    return llm_deployment(
+        "tiny", num_slots=8, max_concurrent_queries=64,
+        health_check_period_s=0.5, graceful_shutdown_timeout_s=60.0,
+        autoscaling_config=autoscaling,
+        engine_kwargs={"paged": True})
+
+
+def split_phase(samples, t0: float, t1: float):
+    return [s for s in samples if t0 <= s.t_sched < t1]
+
+
+def rollup_or_empty(samples, wall_s: float) -> dict:
+    ok = [s.rollup_tuple() for s in samples if s.ok]
+    out = (request_rollup(ok, wall_s) if ok
+           else {"n_requests": 0, "req_per_s": 0.0})
+    out["n_errors"] = sum(1 for s in samples if not s.ok)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["noop", "llm-tiny"], default="noop")
+    p.add_argument("--base-rate", type=float, default=6.0,
+                   help="steady open-loop arrivals/s")
+    p.add_argument("--spike", type=float, default=10.0,
+                   help="storm multiplier over the base rate")
+    p.add_argument("--warm-s", type=float, default=8.0)
+    p.add_argument("--storm-s", type=float, default=15.0)
+    p.add_argument("--cool-s", type=float, default=25.0)
+    p.add_argument("--service-ms", type=float, default=150.0,
+                   help="noop handler service time (one replica's capacity "
+                        "= max_concurrent_queries(4) / this)")
+    p.add_argument("--ttft-target-ms", type=float, default=400.0,
+                   help="the SLO; leave headroom over the service floor "
+                        "(p95 at max_concurrent=4 x service-ms is ~2x the "
+                        "service time even with zero queueing)")
+    p.add_argument("--max-replicas", type=int, default=6)
+    p.add_argument("--chaos", action="store_true",
+                   help="seeded preempt_node of the second node mid-storm")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="BENCH_STORM.json")
+    args = p.parse_args()
+
+    import os
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.serve import loadgen
+
+    # shrink the replicas' rolling SLO window so post-storm recovery is
+    # visible inside the cooldown phase (node subprocesses inherit this
+    # env; the 60s default would pin TTFT-p95 at storm levels long after
+    # the storm ends and block the drain-down the benchmark proves)
+    os.environ.setdefault("RAYTPU_SERVE_SLO_WINDOW_S", "10")
+
+    rng = random.Random(args.seed)
+    total_s = args.warm_s + args.storm_s + args.cool_s
+    storm_t0, storm_t1 = args.warm_s, args.warm_s + args.storm_s
+    arrivals = loadgen.burst_arrivals(args.base_rate, args.spike,
+                                      storm_t0, storm_t1, total_s, rng)
+
+    autoscaling = dict(
+        policy="slo", min_replicas=1, max_replicas=args.max_replicas,
+        target_ongoing_requests=2.0, ttft_p95_target_ms=args.ttft_target_ms,
+        upscale_delay_s=1.0, downscale_delay_s=5.0, min_window_n=8)
+
+    cluster = Cluster(initialize_head=False)
+    chaos_rec = None
+    try:
+        cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(1)
+        cluster.connect_driver()
+
+        if args.mode == "noop":
+            dep = noop_deployment(args.service_ms, autoscaling)
+            name = "storm"
+        else:
+            dep = llm_tiny_deployment(autoscaling)
+            name = "llm-tiny"
+        h = serve.run(dep, timeout_s=300)
+
+        # second node AFTER the control plane landed on node A: the storm
+        # scales onto B, and --chaos preempts B (never the controller)
+        node_b = cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(2)
+
+        if args.mode == "noop":
+            fire = loadgen.unary_fire(h, lambda _i: None)
+        else:
+            def payload(idx: int):
+                return loadgen.llm_payload(args.seed, idx, prompt_median=48,
+                                           prompt_lo=8, prompt_hi=256,
+                                           decode_median=16)
+            fire = loadgen.stream_fire(h, payload, timeout_s=300.0)
+
+        runner = loadgen.StormRunner(fire, max_outstanding=512)
+        sampler = loadgen.SignalSampler(name, period_s=0.25, runner=runner)
+        sampler.start()
+
+        chaos_at = storm_t0 + args.storm_s / 2
+        if args.chaos:
+            def arm_chaos():
+                spec = {"seed": args.seed, "kills": [
+                    {"kind": "preempt_node", "after_s": 0.0, "notice_s": 1.0,
+                     "node": node_b.node_id[:8]}]}
+                from ray_tpu.core.core_worker import global_worker
+                from ray_tpu.core.rpc import run_async
+                run_async(global_worker().gcs.call("chaos_set", spec=spec))
+                return spec
+
+            import threading
+
+            def chaos_thread():
+                time.sleep(chaos_at)
+                arm_chaos()
+
+            ct = threading.Thread(target=chaos_thread, daemon=True)
+            ct.start()
+            chaos_rec = {"kind": "preempt_node", "node": node_b.node_id[:8],
+                         "at_s": chaos_at, "notice_s": 1.0,
+                         "seed": args.seed}
+
+        print(f"# storm: {len(arrivals)} arrivals over {total_s:.0f}s "
+              f"(base {args.base_rate}/s, spike x{args.spike} in "
+              f"[{storm_t0:.0f}, {storm_t1:.0f}))", flush=True)
+        t_wall = time.time()
+        samples = runner.run(arrivals)
+        wall = time.time() - t_wall
+
+        # let the autoscaler drain back down before sampling the end state
+        deadline = time.monotonic() + args.cool_s + 30
+        final_running = None
+        while time.monotonic() < deadline:
+            sig = serve.slo_signal().get(name) or {}
+            final_running = sig.get("running_replicas")
+            if final_running == autoscaling["min_replicas"] and \
+                    sig.get("queue_depth", 0) == 0:
+                break
+            time.sleep(0.5)
+        series = sampler.stop()
+        decisions = serve.autoscale_decisions(limit=100)
+
+        phases = {
+            "warm": rollup_or_empty(split_phase(samples, 0, storm_t0),
+                                    args.warm_s),
+            "storm": rollup_or_empty(split_phase(samples, storm_t0, storm_t1),
+                                     args.storm_s),
+            "cooldown": rollup_or_empty(split_phase(samples, storm_t1,
+                                                    total_s), args.cool_s),
+        }
+
+        ups = [d for d in decisions if d["direction"] == "up"]
+        downs = [d for d in decisions if d["direction"] == "down"]
+        peak_running = max(((s.get("running") or 0) for s in series
+                            if "gap" not in s), default=0)
+        p95_series = loadgen.windowed_p95_series(samples, window_s=2.0)
+        late_storm = [w for w in p95_series
+                      if storm_t1 - 4.0 <= w["t"] < storm_t1 + 2.0]
+        acceptance = {
+            "errors": sum(1 for s in samples if not s.ok),
+            "n_requests": len(samples),
+            "scale_up_decisions": len(ups),
+            "scale_down_decisions": len(downs),
+            "peak_running_replicas": peak_running,
+            "final_running_replicas": final_running,
+            "scaled_down_to_min": final_running ==
+            autoscaling["min_replicas"],
+            "ttft_p95_late_storm_ms": (min(w["ttft_p95_ms"]
+                                           for w in late_storm)
+                                       if late_storm else None),
+            "ttft_target_ms": args.ttft_target_ms,
+            "ttft_recovered_below_target": bool(
+                late_storm and min(w["ttft_p95_ms"] for w in late_storm)
+                < args.ttft_target_ms),
+            "signal_gaps": sampler.gaps(),
+            "capped_decisions": [d for d in decisions if d["capped"]],
+        }
+
+        out = {
+            "metric": "serve_storm",
+            "mode": args.mode,
+            "seed": args.seed,
+            "wall_s": round(wall, 2),
+            "config": {"base_rate": args.base_rate, "spike": args.spike,
+                       "warm_s": args.warm_s, "storm_s": args.storm_s,
+                       "cool_s": args.cool_s, "service_ms": args.service_ms,
+                       "autoscaling": autoscaling},
+            "phases": phases,
+            "series": {
+                "arrivals": loadgen.arrival_rate_series(arrivals),
+                "ttft_p95": p95_series,
+                "signal": series,
+            },
+            "decisions": decisions,
+            "chaos": chaos_rec,
+            "acceptance": acceptance,
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"metric": "serve_storm", "phases": phases,
+                          "acceptance": {k: v for k, v in acceptance.items()
+                                         if k != "signal_gaps"},
+                          "signal_gaps": len(acceptance["signal_gaps"])}))
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -338,6 +338,77 @@ def run_ab_serve_metrics(S: float, pairs: int) -> dict:
             "off_config": off_cfg, "ratio_on_off": ratio}
 
 
+def _measure_autoscale_reqs(S: float, slo_policy: bool) -> dict:
+    """One fresh-cluster serve request-throughput measurement for the
+    autoscaler A/B: a steady 2-replica noop deployment — the ON arm runs
+    the policy="slo" control loop (targets high enough that steady load
+    never trips a scale event: the measured cost is the per-reconcile
+    signal rollup + policy tick, not replica churn), the OFF arm pins
+    num_replicas=2 with no autoscaling at all."""
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(num_cpus=8)
+    out = {}
+    try:
+        opts = dict(max_concurrent_queries=64)
+        if slo_policy:
+            opts["autoscaling_config"] = dict(
+                policy="slo", min_replicas=2, max_replicas=4,
+                target_ongoing_requests=1000.0, ttft_p95_target_ms=60_000.0,
+                upscale_delay_s=3.0, downscale_delay_s=30.0)
+        else:
+            opts["num_replicas"] = 2
+
+        @serve.deployment(**opts)
+        def anoop(_x=None):
+            return b"ok"
+
+        h = serve.run(anoop)
+        for _ in range(20):
+            h.remote().result()
+        n = int(300 * S)
+        out["serve_noop_req_s"] = max(timeit(
+            lambda: [h.remote().result() for _ in range(n)], n))
+        n = int(600 * S)
+
+        def pipelined():
+            rs = [h.remote() for _ in range(n)]
+            for r in rs:
+                r.result()
+
+        out["serve_pipelined_req_s"] = max(timeit(pipelined, n))
+        # the A/B is only valid if the policy held steady: a scale event
+        # mid-measurement would be measuring replica churn, not overhead
+        if slo_policy:
+            reps = serve.status()["anoop"]["replicas"]
+            out["replicas_end"] = len(
+                [r for r in reps if r["state"] == "RUNNING"])
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_autoscale(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: SLO autoscaler policy on vs no
+    autoscaling, over a steady noop deployment (the ISSUE-15 acceptance
+    gate: <= 5% request-throughput overhead for the control loop)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_autoscale_reqs(S, True))
+        off_runs.append(_measure_autoscale_reqs(S, False))
+        print(f"# autoscale ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    keys = [k for k in on_runs[0] if k in off_runs[0]]
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in keys}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": "num_replicas=2, autoscaling=None",
+            "ratio_on_off": ratio}
+
+
 #: the "off" arm of the train-observability A/B: the kill switch sheds the
 #: step/stage histograms, MFU/goodput gauges, memory sampling AND the
 #: per-step trace spans — isolating exactly what train_metrics_enabled
@@ -770,6 +841,11 @@ def main():
                         "zero-copy put + multi-socket adaptive transfer "
                         "plane on vs the prior 1-copy/fixed-chunk plane "
                         "(put GB/s, large get, 8-way arg fan-out)")
+    p.add_argument("--ab-autoscale", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of the SLO "
+                        "autoscaler policy on vs no autoscaling over a "
+                        "steady noop deployment (the control-loop "
+                        "overhead gate)")
     p.add_argument("--ab-object", type=int, default=0, metavar="PAIRS",
                    help="also run PAIRS interleaved A/B pairs of "
                         "object_metrics_enabled on vs off (put GB/s, "
@@ -823,6 +899,9 @@ def main():
                                                args.ab_train_obs)
     if args.ab_sched > 0:
         out["sched_obs_ab"] = run_ab_sched_obs(args.scale, args.ab_sched)
+    if args.ab_autoscale > 0:
+        out["autoscale_ab"] = run_ab_autoscale(args.scale,
+                                               args.ab_autoscale)
     if args.ab_object > 0:
         out["object_obs_ab"] = run_ab_object_obs(args.scale,
                                                  args.ab_object)

@@ -20,6 +20,8 @@ Reference endpoints mirrored (dashboard/modules/*):
   POST /api/jobs/{id}/stop     stop a job
   GET  /api/serve              serve app status + per-deployment SLO rollup
   GET  /api/serve/signal       SLO autoscaler signal (queue depth, TTFT pXX)
+  GET  /api/serve/autoscale    autoscale decision ring tail
+                               (?deployment=<name>&limit=N)
   GET  /api/sched              scheduler explain plane: pending reasons,
                                decision-ring tail, GCS handler busy seconds
                                (?limit=N&id=<task|actor|pg>)
@@ -219,6 +221,24 @@ class DashboardHead:
                 return {}
 
         return _json(await _off(_signal))
+
+    async def serve_autoscale(self, req):
+        """Tail of the autoscaler decision ring: every scale event with
+        direction/reason/from->to and the signal snapshot it acted on —
+        see ServeController.get_autoscale_decisions.
+        ``?deployment=<name>&limit=N``."""
+        from ray_tpu import serve as serve_api
+        deployment = req.query.get("deployment") or None
+        limit = int(req.query.get("limit", 50))
+
+        def _decisions():
+            try:
+                return serve_api.autoscale_decisions(deployment=deployment,
+                                                     limit=limit)
+            except Exception:
+                return []
+
+        return _json(await _off(_decisions))
 
     async def serve_deploy(self, req):
         """Declarative deploy over REST (reference:
@@ -574,6 +594,7 @@ class DashboardHead:
         r.add_post("/api/jobs/{job_id}/stop", self.job_stop)
         r.add_get("/api/serve", self.serve_status)
         r.add_get("/api/serve/signal", self.serve_signal)
+        r.add_get("/api/serve/autoscale", self.serve_autoscale)
         r.add_post("/api/serve/deploy", self.serve_deploy)
         r.add_get("/api/stacks", self.stacks)
         r.add_get("/api/timeline", self.timeline)

@@ -300,6 +300,21 @@ const pages = {
         table(["deployment", "replica", "state", "ongoing", "ttft p95",
           "window n"], reps));
     }
+    // autoscale decision ring: why the replica count moved (incl.
+    // "wanted N, cluster capped at M" capacity records)
+    const decisions = await api("serve/autoscale?limit=20");
+    if (decisions.length) {
+      view.append(h("h2", {}, "Autoscale decisions"),
+        table(["when", "deployment", "dir", "replicas", "reason", "signal"],
+          decisions.slice().reverse().map((d) => {
+            const sig = d.signal || {};
+            let detail = `queue=${sig.queue_depth ?? 0} p95=${sig.ttft_p95_ms ?? "-"}ms`;
+            if (d.capped) detail += ` [wanted ${d.wanted}, capped at ${d.to_replicas}]`;
+            return [new Date(d.ts * 1000).toLocaleTimeString(),
+              d.deployment, d.direction,
+              `${d.from_replicas}→${d.to_replicas}`, d.reason, detail];
+          })));
+    }
     return view;
   },
 

@@ -945,6 +945,7 @@ def cmd_serve(args):
             print(json.dumps(status, indent=2, default=str))
         else:
             _print_serve_status(status)
+            _print_autoscale_decisions(args.decisions)
     elif args.action == "shutdown":
         from ray_tpu import serve as serve_api
         serve_api.shutdown()
@@ -953,18 +954,21 @@ def cmd_serve(args):
 
 def _print_serve_status(status: dict):
     """Per-deployment table with the SLO signal surface: replica counts,
-    live queue depth, and the rolling TTFT percentiles each replica
-    piggybacks on its health-check heartbeat (worst replica wins) — the
-    exact per-deployment signal the SLO autoscaler consumes."""
+    live queue depth, the rolling TTFT percentiles each replica
+    piggybacks on its health-check heartbeat (worst fresh replica wins;
+    STALE counts heartbeats the staleness guard dropped), and the
+    autoscaling policy driving the target — the exact per-deployment
+    signal the SLO autoscaler consumes."""
     print(f"{'DEPLOYMENT':<20} {'STATUS':<10} {'REPLICAS':>8} "
           f"{'QUEUE':>6} {'TTFT p50':>9} {'TTFT p95':>9} "
-          f"{'TTFT p99':>9} {'WINDOW':>7}")
+          f"{'TTFT p99':>9} {'WINDOW':>7} {'STALE':>5} {'POLICY':>8}")
 
     def ms(v):
         return f"{v:.1f}ms" if v is not None else "-"
 
     for name, d in sorted(status.items()):
         slo = d.get("slo") or {}
+        auto = d.get("autoscale") or {}
         running = len([r for r in d.get("replicas", [])
                        if r.get("state") == "RUNNING"])
         print(f"{name:<20} {d.get('status', '?'):<10} "
@@ -973,7 +977,38 @@ def _print_serve_status(status: dict):
               f"{ms(slo.get('ttft_p50_ms')):>9} "
               f"{ms(slo.get('ttft_p95_ms')):>9} "
               f"{ms(slo.get('ttft_p99_ms')):>9} "
-              f"{slo.get('window_n', 0):>7}")
+              f"{slo.get('window_n', 0):>7} "
+              f"{slo.get('stale_replicas', 0):>5} "
+              f"{auto.get('policy', '-'):>8}")
+
+
+def _print_autoscale_decisions(limit: int):
+    """Tail of the autoscaler decision ring: one line per scale event —
+    WHY the replica count moved (or why a wanted surge was capped)."""
+    if limit <= 0:
+        return
+    from ray_tpu import serve as serve_api
+    try:
+        decisions = serve_api.autoscale_decisions(limit=limit)
+    except Exception:
+        return
+    if not decisions:
+        return
+    print(f"\n{'WHEN':<9} {'DEPLOYMENT':<20} {'DIR':<5} {'REPLICAS':>9} "
+          f"{'REASON':<12} {'SIGNAL'}")
+    now = time.time()
+    for d in decisions:
+        sig = d.get("signal") or {}
+        detail = (f"queue={sig.get('queue_depth', 0)} "
+                  f"p95={sig.get('ttft_p95_ms', '-')}ms "
+                  f"stale={sig.get('stale_replicas', 0)}")
+        if d.get("capped"):
+            detail += f"  [wanted {d['wanted']}, cluster capped at " \
+                      f"{d['to_replicas']}]"
+        print(f"{now - d['ts']:>7.1f}s {d['deployment']:<20} "
+              f"{d['direction']:<5} "
+              f"{d['from_replicas']:>3}->{d['to_replicas']:<3} "
+              f"{d['reason']:<12} {detail}")
 
 
 # ------------------------------------------------------------------ main
@@ -1107,6 +1142,9 @@ def main(argv=None):
     s.add_argument("--no-wait", action="store_true")
     s.add_argument("--json", action="store_true",
                    help="status: raw JSON instead of the SLO table")
+    s.add_argument("--decisions", type=int, default=10, metavar="N",
+                   help="status: show the last N autoscale decision "
+                        "records under the SLO table (0 = hide)")
     s.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)

@@ -9,8 +9,9 @@ gRPC ingress (``grpc_proxy.py``, schema in ``protos/serve.proto``) — plus
 a TPU-first continuous-batching LLM deployment (``ray_tpu.serve.llm``).
 """
 
-from .api import (delete, get_deployment_handle, grpc_config, http_config,
-                  run, shutdown, slo_signal, start, status)
+from .api import (autoscale_decisions, delete, get_deployment_handle,
+                  grpc_config, http_config, run, shutdown, slo_signal, start,
+                  status)
 from .asgi import ASGIApp, ASGIRequest, ingress
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -26,6 +27,7 @@ __all__ = [
     "delete", "shutdown", "get_deployment_handle", "http_config",
     "multiplexed", "get_multiplexed_model_id", "DAGDriver",
     "ingress", "ASGIApp", "ASGIRequest", "grpc_config", "slo_signal",
+    "autoscale_decisions",
 ]
 
 # Usage telemetry: which libraries a cluster actually uses (reference:

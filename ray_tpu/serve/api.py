@@ -127,11 +127,25 @@ def status() -> dict:
 
 def slo_signal() -> dict:
     """Per-deployment SLO signal (queue depth + rolling p50/p95/p99 TTFT
-    from the replicas' heartbeat windows) — the documented input contract
-    for SLO-driven autoscaling.  Same data ``raytpu serve status`` tables
-    and ``/api/serve`` embed."""
+    from the replicas' heartbeat windows, with stale snapshots dropped
+    and counted as ``stale_replicas``) — the documented input contract
+    for SLO-driven autoscaling, consumed by the ``policy="slo"``
+    autoscaler (serve/slo_autoscaler.py).  Same data ``raytpu serve
+    status`` tables and ``/api/serve`` embed."""
     ctrl = _get_controller()
     return ray_tpu.get(ctrl.get_serve_signal.remote(), timeout=30)
+
+
+def autoscale_decisions(deployment: Optional[str] = None,
+                        limit: int = 50) -> list:
+    """Tail of the autoscaler's bounded decision ring (newest last): one
+    record per scale event — {ts, deployment, policy, direction, reason,
+    from_replicas, to_replicas, wanted, capped, signal} — including
+    capacity-capped asks ("wanted N, cluster capped at M").  Also
+    surfaced by ``raytpu serve status`` and ``GET /api/serve/autoscale``."""
+    ctrl = _get_controller()
+    return ray_tpu.get(ctrl.get_autoscale_decisions.remote(
+        deployment=deployment, limit=limit), timeout=30)
 
 
 def http_config() -> Optional[dict]:

@@ -11,10 +11,26 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 
+#: autoscaling policies: "ongoing" is the original ongoing-requests
+#: heuristic; "slo" is the serve/slo_autoscaler.py control loop driven by
+#: the serve.slo_signal() contract (TTFT-p95 vs target + queue depth per
+#: replica, hysteresis, capacity-aware clamping, drain-aware scale-down)
+POLICY_ONGOING = "ongoing"
+POLICY_SLO = "slo"
+
+
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Queue-depth autoscaling (reference: _private/autoscaling_policy.py):
-    target ongoing requests per replica drives the replica count."""
+    """Replica autoscaling.  ``policy="ongoing"`` is the queue-depth
+    heuristic (reference: _private/autoscaling_policy.py — target ongoing
+    requests per replica drives the count); ``policy="slo"`` drives the
+    count from the ``serve.slo_signal()`` contract instead (see
+    serve/slo_autoscaler.py): scale up fast when TTFT-p95 breaches
+    ``ttft_p95_target_ms`` or queue depth per replica exceeds
+    ``target_ongoing_requests``, scale down slowly (one replica at a time,
+    emptiest first, through the graceful-drain path) once the signal has
+    stayed under ``downscale_low_water`` of both targets for
+    ``downscale_delay_s``."""
     min_replicas: int = 1
     max_replicas: int = 8
     target_ongoing_requests: float = 2.0
@@ -22,6 +38,21 @@ class AutoscalingConfig:
     downscale_delay_s: float = 30.0
     # smoothing factor applied to the raw desired count
     smoothing_factor: float = 1.0
+    policy: str = POLICY_ONGOING
+    #: SLO policy: TTFT-p95 above this is a breach (None = queue-only)
+    ttft_p95_target_ms: Optional[float] = None
+    #: SLO policy: don't trust TTFT percentiles computed over fewer
+    #: rolling-window samples than this (a single slow request must not
+    #: trigger a surge)
+    min_window_n: int = 4
+    #: SLO policy: downscale only when queue/replica AND TTFT-p95 sit
+    #: below this fraction of their targets — the deadband between the
+    #: upscale and downscale thresholds is the anti-flap hysteresis
+    downscale_low_water: float = 0.5
+    #: SLO policy: per-decision surge cap — one upscale step may at most
+    #: multiply the replica count by this (breach ratio beyond it waits
+    #: for the next control period, after the new replicas report in)
+    upscale_surge_max: float = 2.0
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -30,6 +61,12 @@ class AutoscalingConfig:
             # deployment could never wake up).  Reject rather than brick.
             raise ValueError("min_replicas must be >= 1 (scale-to-zero is "
                              "not supported: routing is direct-to-replica)")
+        if self.policy not in (POLICY_ONGOING, POLICY_SLO):
+            raise ValueError(f"unknown autoscaling policy {self.policy!r} "
+                             f"(choose {POLICY_ONGOING!r} or {POLICY_SLO!r})")
+        if not 0.0 < self.downscale_low_water < 1.0:
+            raise ValueError("downscale_low_water must be in (0, 1) — it is "
+                             "the hysteresis deadband's lower edge")
 
 
 @dataclasses.dataclass

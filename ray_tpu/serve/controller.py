@@ -29,9 +29,11 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
-from .config import (DEPLOYING, DELETING, HEALTHY, UNHEALTHY, UPDATING,
-                     DeploymentConfig)
+from .config import (DEPLOYING, DELETING, HEALTHY, POLICY_SLO, UNHEALTHY,
+                     UPDATING, DeploymentConfig)
 from .deployment import Deployment
+from .slo_autoscaler import (AutoscaleLedger, SLOPolicy,
+                             capacity_max_replicas)
 
 CONTROLLER_NAME = "serve:controller"
 
@@ -46,7 +48,7 @@ HEALTH_FAILURE_THRESHOLD = 3
 class _Replica:
     __slots__ = ("name", "handle", "version", "state", "failures",
                  "started_at", "last_ongoing", "code_hash", "last_probe",
-                 "last_slo")
+                 "last_slo", "last_slo_ts")
 
     def __init__(self, name: str, handle, version: str,
                  code_hash: Optional[str] = None):
@@ -63,6 +65,11 @@ class _Replica:
         #: ({queue_depth, ttft_p50/p95/p99_ms, window_n} — serve/
         #: observability.slo_snapshot)
         self.last_slo: dict = {}
+        #: monotonic stamp of the last SUCCESSFUL snapshot delivery — the
+        #: staleness guard drops snapshots older than 3x the heartbeat
+        #: period from the deployment rollup (a wedged replica's frozen
+        #: p95 must not pollute the aggregate forever)
+        self.last_slo_ts = 0.0
 
 
 class _DeploymentState:
@@ -76,6 +83,11 @@ class _DeploymentState:
         self.autoscale_target: Optional[int] = None
         self._scale_pending_since: Optional[float] = None
         self._scale_pending_dir = 0
+        #: SLO-policy control state (serve/slo_autoscaler.SLOPolicy),
+        #: created lazily on the first slo-policy reconcile tick and
+        #: replaced when the deployment's autoscaling config changes
+        self.slo_policy: Optional[SLOPolicy] = None
+        self.last_decision: Optional[dict] = None
 
     @property
     def config(self) -> DeploymentConfig:
@@ -95,24 +107,50 @@ class _DeploymentState:
                 if r.state == RUNNING
                 and (version is None or r.version == version)]
 
-    def slo_rollup(self) -> dict:
+    def slo_rollup(self, now: Optional[float] = None) -> dict:
         """Deployment-level SLO signal from the replicas' heartbeat
         snapshots: total queue depth, and the WORST replica's rolling TTFT
         percentiles (the conservative scaling signal — one hot replica is
-        exactly what an SLO autoscaler must react to)."""
+        exactly what an SLO autoscaler must react to).
+
+        Staleness guard: snapshots older than 3x the heartbeat period are
+        dropped from the rollup and counted as ``stale_replicas`` — a
+        wedged replica's frozen p95 would otherwise pollute the aggregate
+        (and hold the worst-replica percentile) forever.  The horizon
+        never undercuts a legitimately slow ping: one probe is in flight
+        per replica, so the worst honest gap between stamps is a full
+        ``health_check_timeout_s`` plus a period — a busy-but-healthy
+        replica must not be counted stale for a ping it is still allowed
+        to be answering."""
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        horizon = now - max(3.0 * cfg.health_check_period_s,
+                            cfg.health_check_timeout_s
+                            + cfg.health_check_period_s)
         running = self.running()
+        fresh = [r for r in running if r.last_slo_ts >= horizon]
         out = {
             "queue_depth": sum(
                 int(r.last_slo.get("queue_depth", r.last_ongoing))
-                for r in running),
+                for r in fresh),
             "window_n": sum(int(r.last_slo.get("window_n", 0))
-                            for r in running),
+                            for r in fresh),
+            "stale_replicas": len(running) - len(fresh),
         }
         for p in ("p50", "p95", "p99"):
             key = f"ttft_{p}_ms"
-            vals = [r.last_slo[key] for r in running if key in r.last_slo]
+            vals = [(r.last_slo[key], int(r.last_slo.get("window_n", 0)))
+                    for r in fresh if key in r.last_slo]
             if vals:
-                out[key] = max(vals)
+                v, wn = max(vals)
+                out[key] = v
+                if p == "p95":
+                    # the autoscaler's min_window_n gate must judge the
+                    # WINDOW that produced the worst p95, not the
+                    # deployment-wide sample sum — one replica's single
+                    # slow request would otherwise read as a surge-worthy
+                    # percentile backed by everyone else's samples
+                    out["ttft_p95_window_n"] = wn
         return out
 
     def status(self) -> str:
@@ -146,6 +184,16 @@ class ServeController:
         # and graceful_shutdown awaits them so detached replicas are never
         # orphaned past controller death
         self._drain_tasks: set = set()
+        #: bounded ring of autoscale decision records (every scale event,
+        #: incl. capacity-capped asks) + raytpu_autoscale_* metric stamps
+        self._autoscale_ledger = AutoscaleLedger()
+        #: one in-flight health ping per replica name (background tasks —
+        #: a wedged ping must not stall the other replicas' heartbeats)
+        self._probe_tasks: Dict[str, asyncio.Task] = {}
+        # cluster-view cache for capacity-aware scale-up (refreshed at
+        # most once a second — the reconcile loop must not hammer the GCS)
+        self._capacity_view: Optional[dict] = None
+        self._capacity_view_ts = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -169,6 +217,9 @@ class ServeController:
         self._shutting_down = True
         if self._loop_task is not None:
             self._loop_task.cancel()
+        for t in list(self._probe_tasks.values()):
+            t.cancel()
+        self._probe_tasks.clear()
         for ds in self._deployments.values():
             for r in list(ds.replicas):
                 await self._stop_replica(ds, r, graceful=True)
@@ -281,17 +332,37 @@ class ServeController:
                      "ongoing": r.last_ongoing, "slo": r.last_slo}
                     for r in ds.replicas],
             }
+            if ds.config.autoscaling is not None:
+                out[name]["autoscale"] = {
+                    "policy": ds.config.autoscaling.policy,
+                    "target": ds.target_count(),
+                    "min_replicas": ds.config.autoscaling.min_replicas,
+                    "max_replicas": ds.config.autoscaling.max_replicas,
+                    "last_decision": ds.last_decision,
+                }
         return out
+
+    async def get_autoscale_decisions(self, deployment: Optional[str] = None,
+                                      limit: int = 50):
+        """Tail of the bounded autoscale decision ring (newest last):
+        every scale event — direction, reason, from/to replica counts,
+        the signal snapshot it acted on, and capacity caps ("wanted N,
+        cluster capped at M")."""
+        return self._autoscale_ledger.tail(limit=limit,
+                                           deployment=deployment)
 
     async def get_serve_signal(self):
         """The SLO autoscaler input contract, one row per deployment:
         ``{deployment: {queue_depth, ttft_p50_ms?, ttft_p95_ms?,
-        ttft_p99_ms?, window_n, running_replicas, target_replicas, ts}}``.
-        Queue depth is the live total across RUNNING replicas; TTFT
-        percentiles are the worst replica's rolling window (absent until a
-        replica has served a request inside the window).  Consumed by
-        ``raytpu serve status``, ``/api/serve`` dashboards, and the future
-        SLO-driven autoscaling policy."""
+        ttft_p99_ms?, window_n, stale_replicas, running_replicas,
+        target_replicas, ts}}``.  Queue depth is the live total across
+        RUNNING replicas with a FRESH heartbeat snapshot (stale ones —
+        older than 3x the heartbeat period — are dropped and counted in
+        ``stale_replicas``); TTFT percentiles are the worst fresh
+        replica's rolling window (absent until a replica has served a
+        request inside the window).  Consumed by the SLO autoscaling
+        policy (serve/slo_autoscaler.py), ``raytpu serve status``, and
+        ``/api/serve`` dashboards."""
         now = time.time()
         out = {}
         for name, ds in self._deployments.items():
@@ -323,6 +394,9 @@ class ServeController:
         for r in list(ds.replicas):
             if r.name == replica:
                 ds.replicas.remove(r)
+                t = self._probe_tasks.pop(r.name, None)
+                if t is not None:
+                    t.cancel()
                 self._bump_table()
                 await self._kill_replica(r)
                 return True
@@ -352,7 +426,10 @@ class ServeController:
     async def _reconcile_one(self, ds: _DeploymentState) -> bool:
         changed = await self._probe_health(ds)
         if ds.config.autoscaling is not None and not ds.deleting:
-            self._autoscale(ds)
+            if ds.config.autoscaling.policy == POLICY_SLO:
+                await self._autoscale_slo(ds)
+            else:
+                self._autoscale(ds)
         target = ds.target_count()
         current = [r for r in ds.replicas if r.version == ds.version
                    and r.state in (STARTING, RUNNING)]
@@ -372,28 +449,46 @@ class ServeController:
             await self._stop_replica(ds, victim, graceful=True)
             changed = True
 
-        # Scale down (autoscaling or lowered num_replicas / deletion).
+        # Scale down (autoscaling or lowered num_replicas / deletion):
+        # drain-aware victim order — STARTING replicas first (nothing in
+        # flight to drain), then the EMPTIEST running replica (fewest
+        # ongoing requests = shortest graceful drain, newest breaks
+        # ties); every victim rides the graceful path (stop accepting,
+        # finish in-flight, then kill — never mid-request).
         excess = len(current) - target
-        for r in sorted(current, key=lambda r: -r.started_at)[:max(0, excess)]:
+        victims = sorted(
+            current,
+            key=lambda r: (0 if r.state == STARTING else 1,
+                           int(r.last_slo.get("queue_depth", r.last_ongoing)),
+                           -r.started_at))
+        for r in victims[:max(0, excess)]:
             await self._stop_replica(ds, r, graceful=True)
             changed = True
         return changed
 
     async def _probe_health(self, ds: _DeploymentState) -> bool:
-        """Ping replicas; promote STARTING->RUNNING, cull repeated failures."""
+        """Ping replicas; promote STARTING->RUNNING, cull repeated failures.
+
+        Pings run as INDEPENDENT background tasks (one in flight per
+        replica), not a gathered pass: a dead replica's ping rides out the
+        full health_check_timeout_s, and awaiting it inline would stall
+        every healthy replica's heartbeat stamp behind it — exactly when a
+        node dies mid-storm, the survivors' SLO snapshots would all go
+        stale and the autoscaler would fly blind (observed in the storm
+        bench before this went background)."""
         import ray_tpu
         changed = False
         now = time.monotonic()
         # STARTING replicas are probed every pass (fast promotion); RUNNING
         # ones at the configured cadence — user check_health hooks can be
         # expensive (reference honors health_check_period_s the same way)
-        due = [r for r in ds.replicas if r.state == STARTING
-               or (r.state == RUNNING
-                   and now - r.last_probe >= ds.config.health_check_period_s)]
+        due = [r for r in ds.replicas
+               if (r.state == STARTING
+                   or (r.state == RUNNING and now - r.last_probe
+                       >= ds.config.health_check_period_s))
+               and r.name not in self._probe_tasks]
 
         async def ping(r: _Replica):
-            nonlocal changed
-            r.last_probe = now
             try:
                 res = await asyncio.wait_for(
                     self._aget(r.handle.health_check.remote()),
@@ -401,18 +496,29 @@ class ServeController:
                 r.failures = 0
                 r.last_ongoing = int(res.get("ongoing", 0))
                 r.last_slo = res.get("slo") or {}
+                r.last_slo_ts = time.monotonic()
                 if r.state == STARTING:
                     r.state = RUNNING
-                    changed = True
+                    self._bump_table()
             except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
                 r.failures = HEALTH_FAILURE_THRESHOLD  # dead: cull now
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 r.failures += 1
+            finally:
+                self._probe_tasks.pop(r.name, None)
 
-        await asyncio.gather(*[ping(r) for r in due])
+        for r in due:
+            r.last_probe = now
+            self._probe_tasks[r.name] = \
+                asyncio.get_event_loop().create_task(ping(r))
         for r in list(ds.replicas):
             if r.failures >= HEALTH_FAILURE_THRESHOLD:
                 ds.replicas.remove(r)
+                t = self._probe_tasks.pop(r.name, None)
+                if t is not None:
+                    t.cancel()
                 await self._kill_replica(r)
                 changed = True
         return changed
@@ -423,6 +529,18 @@ class ServeController:
         cfg = ds.config.autoscaling
         running = ds.running()
         if not running:
+            # Scale-up-from-zero: an empty running set used to bail here,
+            # so a deployment whose replicas all died (or whose
+            # min_replicas floor was freshly breached) never recovered —
+            # there is no ongoing-request signal without a replica to
+            # carry it.  Treat zero running as desired=max(min_replicas,1)
+            # immediately (no decision delay: waiting out a timer on a
+            # dead deployment is deadlock-by-policy).
+            desired = max(cfg.min_replicas, 1)
+            if (ds.autoscale_target or 0) < desired:
+                ds.autoscale_target = desired
+                ds._scale_pending_since = None
+                ds._scale_pending_dir = 0
             return
         total_ongoing = sum(r.last_ongoing for r in running)
         raw = total_ongoing / max(cfg.target_ongoing_requests, 1e-9)
@@ -444,6 +562,61 @@ class ServeController:
             ds.autoscale_target = desired
             ds._scale_pending_since = None
             ds._scale_pending_dir = 0
+
+    async def _autoscale_slo(self, ds: _DeploymentState):
+        """One SLO-policy control tick: staleness-guarded signal in,
+        (possibly) a new ``autoscale_target`` + a decision record out."""
+        cfg = ds.config.autoscaling
+        if ds.slo_policy is None or ds.slo_policy.cfg is not cfg:
+            ds.slo_policy = SLOPolicy(cfg)
+        signal = ds.slo_rollup()
+        signal["running_replicas"] = len(ds.running())
+        current = ds.target_count()
+        # capacity-aware clamp: desired replicas the scheduler cannot
+        # place would park STARTING forever while the record claims the
+        # storm was handled — ask the cluster view what fits, and stamp
+        # "wanted N, cluster capped at M" when it caps the ask
+        alive = len([r for r in ds.replicas
+                     if r.state in (STARTING, RUNNING)])
+        cpus = float(ds.config.ray_actor_options.get("num_cpus", 1) or 1)
+        cap = capacity_max_replicas(await self._cluster_view(), alive, cpus)
+        dec = ds.slo_policy.decide(signal, current, time.monotonic(),
+                                   capacity_max=cap)
+        if dec is None:
+            return
+        last = ds.last_decision
+        if (dec.desired == current and dec.capped and last is not None
+                and last.get("capped")
+                and last.get("to_replicas") == dec.desired
+                and last.get("wanted") == dec.wanted
+                and last.get("reason") == dec.reason):
+            # an ONGOING identical capacity cap: one record per episode —
+            # re-recording every delay period would flood the shared ring
+            # and evict every other deployment's real scale history
+            return
+        ds.last_decision = self._autoscale_ledger.record(
+            ds.deployment.name, dec, current, signal, cfg.policy)
+        if dec.desired != current:
+            ds.autoscale_target = dec.desired
+
+    async def _cluster_view(self) -> Optional[dict]:
+        """Cached GCS cluster view for capacity-aware scale-up (refreshed
+        at most once a second; None — don't clamp — when unavailable)."""
+        now = time.monotonic()
+        if now - self._capacity_view_ts < 1.0:
+            return self._capacity_view
+        self._capacity_view_ts = now
+        try:
+            from ray_tpu.core import rpc
+            from ray_tpu.core.core_worker import global_worker
+            w = global_worker()
+            fut = asyncio.run_coroutine_threadsafe(
+                w.gcs.call("get_cluster_view"), rpc.get_loop())
+            self._capacity_view = await asyncio.wait_for(
+                asyncio.wrap_future(fut), 5.0)
+        except Exception:  # view unavailable: scale decisions go unclamped
+            self._capacity_view = None
+        return self._capacity_view
 
     # ------------------------------------------------- replica start/stop
 

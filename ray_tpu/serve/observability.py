@@ -23,7 +23,8 @@ surfaces, one kill switch (``serve_metrics_enabled``):
   samples; ``slo_snapshot`` rolls it into p50/p95/p99 + queue depth, which
   rides the health-check heartbeat to the controller — the per-deployment
   signal ``serve.status()`` / ``raytpu serve status`` / ``/api/serve``
-  report and the future SLO autoscaler consumes.
+  report and the ``policy="slo"`` autoscaler (serve/slo_autoscaler.py)
+  consumes.
 
 Hot-path discipline follows PR 2: metrics are lazy-constructed once, tag
 keys are precomputed per (deployment, ...) and cached, and every record

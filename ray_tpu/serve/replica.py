@@ -61,6 +61,14 @@ class ReplicaActor:
         self.started_at = time.time()
         self._streams: Dict[str, list] = {}
         self._stream_done: Dict[str, bool] = {}
+        #: streams the CLIENT abandoned (stream timeout) -> cancel ts:
+        #: the generator stops buffering and the finally path must not
+        #: resurrect the done-flag entry — an unclaimed buffer would
+        #: block drain() forever and leak per-stream memory.  A dict
+        #: (not a set) so tombstones that are never consumed (cancel
+        #: raced a completed-and-popped stream; ids are fresh uuids) age
+        #: out instead of accumulating for the replica's lifetime.
+        self._cancelled_streams: Dict[str, float] = {}
         if user_config is not None:
             self._apply_user_config(user_config)
 
@@ -143,6 +151,12 @@ class ReplicaActor:
                                        method: Optional[str] = None) -> None:
         """Run a (async) generator endpoint, buffering chunks for the caller
         to drain via next_chunks() — streaming over the actor RPC plane."""
+        if stream_id in self._cancelled_streams:
+            # cancel raced ahead of a queued start: never register (and
+            # consume the tombstone BEFORE the draining check — either
+            # refusal must not leave it behind)
+            self._cancelled_streams.pop(stream_id, None)
+            raise RuntimeError(f"stream {stream_id} cancelled before start")
         if self._draining:
             raise RuntimeError(f"replica {self.replica_id} is draining")
         self.num_ongoing += 1
@@ -154,24 +168,45 @@ class ReplicaActor:
         try:
             fn = self._resolve(method)
             out = fn(*args, **kwargs)
+
+            def buf():
+                # None once the client cancelled (stream timeout): stop
+                # generating instead of appending into a popped buffer
+                return self._streams.get(stream_id)
+
             if inspect.isasyncgen(out):
                 async for chunk in out:
                     if first_at is None:
                         first_at = time.monotonic()
-                    self._streams[stream_id].append(chunk)
+                    b = buf()
+                    if b is None:
+                        break
+                    b.append(chunk)
             elif inspect.isgenerator(out):
                 for chunk in out:
                     if first_at is None:
                         first_at = time.monotonic()
-                    self._streams[stream_id].append(chunk)
+                    b = buf()
+                    if b is None:
+                        break
+                    b.append(chunk)
                     await asyncio.sleep(0)  # let pollers interleave
             else:
                 if inspect.iscoroutine(out):
                     out = await out
-                self._streams[stream_id].append(out)
+                b = buf()
+                if b is not None:
+                    b.append(out)
             ok = True
         finally:
-            self._stream_done[stream_id] = True
+            if stream_id in self._cancelled_streams:
+                # abandoned: every trace of the stream is already gone —
+                # resurrecting the done flag would leak an entry forever
+                self._cancelled_streams.pop(stream_id, None)
+                self._streams.pop(stream_id, None)
+                self._stream_done.pop(stream_id, None)
+            else:
+                self._stream_done[stream_id] = True
             self.num_ongoing -= 1
             self.num_processed += 1
             self._obs_end(begin, first_token_at=first_at, ok=ok,
@@ -215,6 +250,28 @@ class ReplicaActor:
             self.num_processed += 1
             self._obs_end(begin, first_token_at=first_at, ok=ok,
                           window=method is None)
+
+    async def cancel_stream(self, stream_id: str) -> bool:
+        """Client abandoned the stream (``stream(timeout_s=...)`` hit its
+        deadline): drop the buffer and stop the generator so drain()
+        never waits on chunks nobody will claim.  The tombstone covers
+        both orderings — a still-running handler consumes it in its
+        finally, a not-yet-started one at registration; a finished
+        stream (done flag True) needs only the pops."""
+        now = time.monotonic()
+        # prune tombstones nobody consumed (cancel raced a stream that
+        # had already completed and been popped — its fresh-uuid id will
+        # never be seen again); 120s far exceeds any legitimate gap
+        # between a cancel and the handler's finally
+        for sid, ts in list(self._cancelled_streams.items()):
+            if now - ts > 120.0:
+                self._cancelled_streams.pop(sid, None)
+        done = self._stream_done.get(stream_id)
+        self._streams.pop(stream_id, None)
+        self._stream_done.pop(stream_id, None)
+        if done is not True:
+            self._cancelled_streams[stream_id] = now
+        return True
 
     async def next_chunks(self, stream_id: str, cursor: int) -> tuple:
         """Poll a stream: returns (new_chunks, next_cursor, done)."""

@@ -310,16 +310,52 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return DeploymentResponse(self.deployment, args, kwargs, self.method)
 
-    def stream(self, *args, **kwargs):
-        """Synchronous chunk iterator over a streaming endpoint."""
+    def stream(self, *args, timeout_s: Optional[float] = None, **kwargs):
+        """Synchronous chunk iterator over a streaming endpoint.
+
+        ``timeout_s`` bounds the WHOLE stream: a replica that stops
+        yielding without erroring (wedged engine, lost stream buffer)
+        would otherwise pin the consumer in the next_chunks long-poll
+        forever — open-loop load harnesses pass this so one wedged
+        request cannot hang a whole benchmark run."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
         router = get_router()
         name, stream_id, ref = router.start_stream(self.deployment, args,
                                                    kwargs, self.method)
         h = router._replica_handle(name)
         cursor, done = 0, False
         while not done:
-            chunks, cursor, done = ray_tpu.get(
-                h.next_chunks.remote(stream_id, cursor), timeout=60)
+            poll_timeout = 60.0
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # abandon server-side too: an unclaimed buffer would
+                    # block the replica's graceful drain forever
+                    try:
+                        h.cancel_stream.remote(stream_id)
+                    except Exception:
+                        pass
+                    raise TimeoutError(f"stream from {self.deployment!r} "
+                                       f"exceeded {timeout_s}s")
+                poll_timeout = min(poll_timeout, remaining + 1.0)
+            try:
+                chunks, cursor, done = ray_tpu.get(
+                    h.next_chunks.remote(stream_id, cursor),
+                    timeout=poll_timeout)
+            except Exception:
+                # a WEDGED replica never returns the long-poll at all —
+                # the bounded get converts that into the same abandon
+                # path instead of overshooting the budget by 60s
+                if deadline is not None and time.monotonic() >= deadline:
+                    try:
+                        h.cancel_stream.remote(stream_id)
+                    except Exception:
+                        pass
+                    raise TimeoutError(
+                        f"stream from {self.deployment!r} exceeded "
+                        f"{timeout_s}s") from None
+                raise
             yield from chunks
         # surface errors from the generator body
         ray_tpu.get(ref, timeout=60)

@@ -73,8 +73,17 @@ def build_application(app_cfg: Dict[str, Any]) -> Deployment:
     if ov:
         fields = {k: v for k, v in ov.items()
                   if k in {"num_replicas", "max_concurrent_queries",
-                           "autoscaling_config", "health_check_period_s",
+                           "health_check_period_s",
                            "user_config"} and v is not None}
+        # the DeploymentConfig field is `autoscaling`; the config-file key
+        # keeps the reference's `autoscaling_config` spelling (a dict,
+        # e.g. {policy: slo, ttft_p95_target_ms: 500})
+        if ov.get("autoscaling_config") is not None:
+            from .config import AutoscalingConfig
+            ac = ov["autoscaling_config"]
+            fields["autoscaling"] = (
+                ac if isinstance(ac, AutoscalingConfig)
+                else AutoscalingConfig(**ac))
         cfg = dataclasses.replace(cfg, **fields)
     if app_cfg.get("route_prefix"):
         cfg = dataclasses.replace(cfg, route_prefix=app_cfg["route_prefix"])

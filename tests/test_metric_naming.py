@@ -184,6 +184,93 @@ def test_serve_metric_tag_keys_are_bounded():
     assert seen >= 8, f"only {seen} raytpu_serve_ metrics found"
 
 
+# ---------------------------------------------------- autoscale cardinality
+
+#: the label-set bound for the autoscaler plane: deployment (config-
+#: derived), direction (up/down) and reason (the closed ALL_REASONS
+#: vocabulary in serve/slo_autoscaler.py) ONLY — a replica name or node
+#: id in a tag would multiply the series space by churn.
+ALLOWED_AUTOSCALE_TAG_KEYS = {"deployment", "direction", "reason"}
+
+
+def test_autoscale_metric_tag_keys_are_bounded():
+    """Every ``raytpu_autoscale_*`` metric anywhere in the runtime
+    declares only allowlisted tag keys (deployment/direction/reason)."""
+    problems = []
+    seen = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "metrics.py" and path.parent.name == "util":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for call, cls in _metric_calls(tree):
+            name_node = call.args[0] if call.args else None
+            if not (isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str)
+                    and name_node.value.startswith("raytpu_autoscale_")):
+                continue
+            seen += 1
+            where = f"{path.relative_to(PKG_ROOT.parent)}:{call.lineno}"
+            for kw in call.keywords:
+                if kw.arg != "tag_keys" or not isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    continue
+                for el in kw.value.elts:
+                    if (isinstance(el, ast.Constant)
+                            and el.value not in ALLOWED_AUTOSCALE_TAG_KEYS):
+                        problems.append(
+                            f"{where}: {cls} {name_node.value!r} declares "
+                            f"tag key {el.value!r} outside "
+                            f"{sorted(ALLOWED_AUTOSCALE_TAG_KEYS)}")
+    assert not problems, "\n".join(problems)
+    # decisions counter + target gauge + capped gauge at minimum
+    assert seen >= 3, f"only {seen} raytpu_autoscale_ metrics found"
+
+
+def test_autoscale_reasons_are_closed_vocabulary():
+    """Every Decision construction in serve/slo_autoscaler.py passes a
+    REASON_* constant (reasons become metric tag values and decision-
+    record fields — a free-form string would be an unbounded label)."""
+    import ray_tpu.serve.slo_autoscaler as sa
+    assert set(sa.ALL_REASONS) == {
+        sa.REASON_SLO_BREACH, sa.REASON_QUEUE_DEPTH, sa.REASON_RECOVERY,
+        sa.REASON_ZERO_RUNNING}
+    tree = ast.parse((PKG_ROOT / "serve" / "slo_autoscaler.py").read_text())
+    reason_names = {n for n in dir(sa) if n.startswith("REASON_")}
+    problems, sites = [], 0
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Decision"):
+            continue
+        sites += 1
+        reason = node.args[2] if len(node.args) > 2 else next(
+            (kw.value for kw in node.keywords if kw.arg == "reason"), None)
+        ok = (isinstance(reason, ast.Name) and reason.id in reason_names) \
+            or (isinstance(reason, ast.IfExp)
+                and isinstance(reason.body, ast.Name)
+                and reason.body.id in reason_names
+                and isinstance(reason.orelse, ast.Name)
+                and reason.orelse.id in reason_names) \
+            or (isinstance(reason, ast.Name))  # local bound below
+        if isinstance(reason, ast.Name) and reason.id not in reason_names:
+            # locals must be provably bound to REASON_* (the policy binds
+            # `reason = REASON_X if ... else REASON_Y`)
+            ok = any(
+                isinstance(a, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == reason.id
+                        for t in a.targets)
+                and all(isinstance(v, ast.Name) and v.id in reason_names
+                        for v in ([a.value.body, a.value.orelse]
+                                  if isinstance(a.value, ast.IfExp)
+                                  else [a.value]))
+                for a in ast.walk(tree) if isinstance(a, ast.Assign))
+        if not ok:
+            problems.append(f"slo_autoscaler.py:{node.lineno}: Decision "
+                            "reason is not a REASON_* constant")
+    assert not problems, "\n".join(problems)
+    assert sites >= 3, f"only {sites} Decision sites found"
+
+
 # -------------------------------------------------------- train cardinality
 
 TRAIN_OBS_FILE = PKG_ROOT / "train" / "observability.py"

@@ -1,0 +1,614 @@
+"""SLO autoscaler (ISSUE 15): table-driven policy units (no cluster),
+loadgen schedule units, the slo_signal staleness guard, and the storm
+acceptance tests — a 10x open-loop arrival spike scales the deployment
+up, TTFT-p95 recovers while the storm continues, the post-storm
+scale-down drains gracefully (zero mid-request kills), and a seeded
+mid-storm node preemption is absorbed without SLO-signal gaps.
+"""
+
+import random
+import time
+
+import pytest
+
+from ray_tpu.serve.config import AutoscalingConfig
+from ray_tpu.serve.slo_autoscaler import (AutoscaleLedger, Decision,
+                                          REASON_QUEUE_DEPTH,
+                                          REASON_RECOVERY, REASON_SLO_BREACH,
+                                          REASON_ZERO_RUNNING, SLOPolicy,
+                                          capacity_max_replicas)
+
+# ------------------------------------------------------------ policy units
+
+
+def _cfg(**kw):
+    base = dict(policy="slo", min_replicas=1, max_replicas=8,
+                target_ongoing_requests=2.0, ttft_p95_target_ms=100.0,
+                upscale_delay_s=1.0, downscale_delay_s=5.0, min_window_n=4)
+    base.update(kw)
+    return AutoscalingConfig(**base)
+
+
+def _sig(queue=0, p95=None, n=10, running=1, stale=0):
+    s = {"queue_depth": queue, "window_n": n, "running_replicas": running,
+         "stale_replicas": stale}
+    if p95 is not None:
+        s["ttft_p95_ms"] = p95
+    return s
+
+
+def test_policy_table():
+    """One row per contract clause: (signal, current, tick times) ->
+    expected decision after the hysteresis delay."""
+    cases = [
+        # TTFT breach: sustained upscale_delay -> up, reason slo_breach
+        (_sig(queue=1, p95=300.0, running=2), 2, "up", REASON_SLO_BREACH),
+        # queue growth alone (no TTFT data yet): up, reason queue_depth
+        (_sig(queue=20, running=2, n=0), 2, "up", REASON_QUEUE_DEPTH),
+        # quiet signal: down by exactly ONE replica, reason recovery
+        (_sig(queue=0, p95=10.0, running=4), 4, "down", REASON_RECOVERY),
+    ]
+    for sig, current, direction, reason in cases:
+        p = SLOPolicy(_cfg())
+        delay = 1.0 if direction == "up" else 5.0
+        assert p.decide(sig, current, 10.0) is None, (sig, "no instant fire")
+        dec = p.decide(sig, current, 10.0 + delay + 0.1)
+        assert dec is not None and dec.direction == direction, (sig, dec)
+        assert dec.reason == reason
+        if direction == "down":
+            assert dec.desired == current - 1, "downscale is one at a time"
+        else:
+            assert dec.desired > current
+
+
+def test_policy_breach_must_be_sustained_and_deadband_resets():
+    """A breach that clears before upscale_delay_s never fires, and the
+    timer re-arms from zero on the next excursion (flap guard)."""
+    p = SLOPolicy(_cfg())
+    breach = _sig(queue=1, p95=300.0, running=2)
+    mid = _sig(queue=3, p95=80.0, running=2)  # deadband: neither direction
+    assert p.decide(breach, 2, 0.0) is None
+    assert p.decide(mid, 2, 0.5) is None      # excursion over -> reset
+    assert p.decide(breach, 2, 0.9) is None   # re-armed at 0.9 ...
+    assert p.decide(breach, 2, 1.5) is None   # ... 0.6s in: still pending
+    dec = p.decide(breach, 2, 2.0)            # 1.1s sustained -> fire
+    assert dec is not None and dec.direction == "up"
+
+
+def test_policy_flapping_guard_blocks_down_after_up():
+    """Right after an upscale, a quiet signal must wait a FULL
+    downscale_delay_s measured from the scale event."""
+    p = SLOPolicy(_cfg())
+    breach = _sig(queue=30, p95=400.0, running=2)
+    quiet = _sig(queue=0, p95=10.0, running=8)
+    p.decide(breach, 2, 0.0)
+    up = p.decide(breach, 2, 1.1)
+    assert up is not None and up.direction == "up"
+    # quiet immediately after: blocked until the event guard AND the
+    # sustained-quiet timer both pass
+    assert p.decide(quiet, 8, 1.2) is None
+    assert p.decide(quiet, 8, 5.0) is None          # event guard active
+    assert p.decide(quiet, 8, 7.0) is None          # quiet timer re-armed
+    dec = p.decide(quiet, 8, 12.2)                  # sustained past delay
+    assert dec is not None and dec.direction == "down" and dec.desired == 7
+
+
+def test_policy_small_window_does_not_surge():
+    """TTFT percentiles over fewer than min_window_n samples are not
+    trusted — one slow request must not double the fleet."""
+    p = SLOPolicy(_cfg(min_window_n=8))
+    sig = _sig(queue=1, p95=900.0, n=3, running=2)
+    assert p.decide(sig, 2, 0.0) is None
+    assert p.decide(sig, 2, 5.0) is None
+
+
+def test_policy_capacity_clamp_records_wanted_vs_capped():
+    """'wanted N, cluster capped at M': the ask is clamped to placeable
+    capacity but the decision still records the unclamped want."""
+    p = SLOPolicy(_cfg())
+    sig = _sig(queue=40, p95=300.0, running=2)
+    p.decide(sig, 2, 0.0, capacity_max=3)
+    dec = p.decide(sig, 2, 1.1, capacity_max=3)
+    assert dec is not None and dec.capped
+    assert dec.desired == 3 and dec.wanted >= 8
+    # capped down to the CURRENT count: still a (rate-limited) record so
+    # the cap is observable, but no replica movement
+    p2 = SLOPolicy(_cfg())
+    p2.decide(sig, 2, 0.0, capacity_max=2)
+    hold = p2.decide(sig, 2, 1.1, capacity_max=2)
+    assert hold is not None and hold.capped and hold.desired == 2
+    # ... and rate-limited: the very next tick does not re-record
+    assert p2.decide(sig, 2, 1.3, capacity_max=2) is None
+
+
+def test_policy_zero_running_recovers_immediately():
+    """An empty running set bypasses hysteresis: nothing can produce the
+    signal that would scale it, so the delay would deadlock."""
+    p = SLOPolicy(_cfg(min_replicas=2))
+    dec = p.decide({"queue_depth": 0, "running_replicas": 0}, 0, 0.0)
+    assert dec is not None and dec.reason == REASON_ZERO_RUNNING
+    assert dec.desired == 2
+    # already targeting enough: no event
+    assert p.decide({"queue_depth": 0, "running_replicas": 0}, 2, 0.1) is None
+
+
+def test_policy_downscale_respects_queue_floor():
+    """Quiet TTFT but a queue that still needs the fleet: no downscale
+    below ceil(queue / target_per_replica)."""
+    p = SLOPolicy(_cfg())
+    sig = _sig(queue=6, p95=10.0, running=8)  # q_per=0.75 < 1.0 low water
+    for t in (0.0, 6.0):
+        dec = p.decide(sig, 8, t)
+    assert dec is not None and dec.desired == 7
+    # at 3 replicas the floor (ceil(6/2)=3) blocks further shrink
+    p2 = SLOPolicy(_cfg())
+    assert p2.decide(sig, 3, 0.0) is None
+    assert p2.decide(sig, 3, 10.0) is None
+
+
+def test_ledger_ring_bounded_and_filterable():
+    led = AutoscaleLedger(ring_len=8)
+    for i in range(20):
+        led.record(f"dep{i % 2}", Decision(2, "up", REASON_QUEUE_DEPTH, 3),
+                   1, _sig(queue=5), "slo")
+    assert len(led.tail(limit=100)) == 8
+    only0 = led.tail(limit=100, deployment="dep0")
+    assert only0 and all(r["deployment"] == "dep0" for r in only0)
+    rec = only0[-1]
+    for k in ("ts", "direction", "reason", "from_replicas", "to_replicas",
+              "wanted", "capped", "signal", "policy"):
+        assert k in rec, f"decision record missing {k}"
+
+
+def test_capacity_view_excludes_dead_and_draining():
+    view = {
+        "a": {"alive": True, "available": {"CPU": 3.0}},
+        "b": {"alive": True, "draining": True, "available": {"CPU": 8.0}},
+        "c": {"alive": False, "available": {"CPU": 8.0}},
+    }
+    assert capacity_max_replicas(view, alive_replicas=2,
+                                 cpus_per_replica=1.0) == 5
+    assert capacity_max_replicas(view, 2, 2.0) == 3
+    assert capacity_max_replicas(None, 2, 1.0) is None
+
+
+def test_policy_holds_when_all_snapshots_stale():
+    """Blind is not quiet: with every replica's snapshot stale the rollup
+    reads queue=0 / no percentiles, which must HOLD, never downscale —
+    the real queue is invisible, not empty."""
+    p = SLOPolicy(_cfg())
+    blind = {"queue_depth": 0, "window_n": 0, "running_replicas": 4,
+             "stale_replicas": 4}
+    for t in (0.0, 6.0, 12.0, 30.0):
+        assert p.decide(blind, 4, t) is None, t
+    # data returns -> the quiet timer starts FRESH (no credit for the
+    # blind interval)
+    quiet = _sig(queue=0, p95=10.0, running=4)
+    assert p.decide(quiet, 4, 31.0) is None
+    dec = p.decide(quiet, 4, 36.1)
+    assert dec is not None and dec.direction == "down"
+
+
+def test_policy_queue_per_replica_uses_fresh_count():
+    """Partial staleness: queue_depth sums FRESH replicas only, so the
+    per-replica load divides by the fresh count — spreading one
+    reporting replica's queue over the whole (mostly-blind) fleet would
+    suppress the breach exactly mid-node-death."""
+    p = SLOPolicy(_cfg(ttft_p95_target_ms=None, target_ongoing_requests=4.0))
+    sig = {"queue_depth": 10, "window_n": 5, "running_replicas": 4,
+           "stale_replicas": 3}  # one fresh replica carrying 10 ongoing
+    p.decide(sig, 4, 0.0)
+    dec = p.decide(sig, 4, 1.1)
+    assert dec is not None and dec.direction == "up", \
+        "10 queued on ONE fresh replica (target 4) must breach"
+    # same totals with everyone fresh: 10 / 4 = 2.5 < 4 -> no breach
+    p2 = SLOPolicy(_cfg(ttft_p95_target_ms=None,
+                        target_ongoing_requests=4.0))
+    ok = {"queue_depth": 10, "window_n": 5, "running_replicas": 4,
+          "stale_replicas": 0}
+    assert p2.decide(ok, 4, 0.0) is None
+    assert p2.decide(ok, 4, 5.0) is None
+
+
+def test_policy_p95_window_gate_uses_supplier_window():
+    """The worst-p95 replica's OWN window gates the surge: a deployment-
+    wide sample sum must not lend credibility to one replica's single
+    slow request."""
+    p = SLOPolicy(_cfg(min_window_n=4))
+    sig = _sig(queue=1, p95=900.0, n=20, running=2)
+    sig["ttft_p95_window_n"] = 1  # the slow replica served ONE request
+    assert p.decide(sig, 2, 0.0) is None
+    assert p.decide(sig, 2, 5.0) is None
+    sig["ttft_p95_window_n"] = 6  # a real window behind the percentile
+    p2 = SLOPolicy(_cfg(min_window_n=4))
+    p2.decide(sig, 2, 0.0)
+    assert p2.decide(sig, 2, 1.1) is not None
+
+
+# ----------------------------------------------------- staleness guard unit
+
+
+def test_slo_rollup_drops_stale_snapshots():
+    """A wedged replica's frozen p95 ages out of the deployment rollup
+    after 3x the heartbeat period and is counted in stale_replicas."""
+    from ray_tpu.serve.config import DeploymentConfig
+    from ray_tpu.serve.controller import RUNNING, _DeploymentState, _Replica
+    from ray_tpu.serve.deployment import Deployment
+
+    ds = _DeploymentState(Deployment(
+        func_or_class=len, name="d",
+        config=DeploymentConfig(health_check_period_s=2.0,
+                                health_check_timeout_s=2.0)))
+    now = 1000.0
+    fresh = _Replica("r1", None, ds.version)
+    fresh.state = RUNNING
+    fresh.last_slo = {"queue_depth": 3, "ttft_p95_ms": 50.0, "window_n": 10}
+    fresh.last_slo_ts = now - 1.0
+    wedged = _Replica("r2", None, ds.version)
+    wedged.state = RUNNING
+    wedged.last_slo = {"queue_depth": 9, "ttft_p95_ms": 9000.0,
+                       "window_n": 50}
+    wedged.last_slo_ts = now - 7.0  # > max(3 * 2.0, 2.0 + 2.0) = 6s
+    ds.replicas = [fresh, wedged]
+
+    roll = ds.slo_rollup(now=now)
+    assert roll["stale_replicas"] == 1
+    assert roll["queue_depth"] == 3, "stale queue depth must not pollute"
+    assert roll["ttft_p95_ms"] == 50.0, "frozen p95 must not win worst-of"
+    assert roll["window_n"] == 10
+    # the worst-p95 supplier's own window rides along for the surge gate
+    assert roll["ttft_p95_window_n"] == 10
+    # a ping still inside health_check_timeout_s is NOT stale: the
+    # horizon never undercuts a legitimately slow probe
+    slow_cfg = DeploymentConfig(health_check_period_s=0.25,
+                                health_check_timeout_s=2.0)
+    ds.deployment = Deployment(func_or_class=len, name="d", config=slow_cfg)
+    wedged.last_slo_ts = now - 1.5  # > 3 * 0.25 but < 2.0 + 0.25
+    roll = ds.slo_rollup(now=now)
+    assert roll["stale_replicas"] == 0 and roll["ttft_p95_ms"] == 9000.0
+    assert roll["ttft_p95_window_n"] == 50
+
+
+def test_ongoing_autoscale_scales_up_from_zero():
+    """The 'ongoing' policy's empty-running-set bail is gone: zero
+    running replicas is treated as desired=max(min_replicas, 1)."""
+    from ray_tpu.serve.config import DeploymentConfig
+    from ray_tpu.serve.controller import ServeController, _DeploymentState
+    from ray_tpu.serve.deployment import Deployment
+
+    ds = _DeploymentState(Deployment(
+        func_or_class=len, name="d",
+        config=DeploymentConfig(autoscaling=AutoscalingConfig(
+            min_replicas=2, max_replicas=4))))
+    ctrl = ServeController.__new__(ServeController)
+    ctrl._autoscale(ds)
+    assert ds.autoscale_target == 2
+    # an already-higher target is not shrunk by the recovery path
+    ds.autoscale_target = 3
+    ctrl._autoscale(ds)
+    assert ds.autoscale_target == 3
+
+
+def test_cancel_stream_releases_buffer_and_drain():
+    """An abandoned stream (client timeout) must not leave an unclaimed
+    chunk buffer behind — drain() waits on ``self._streams`` and a leak
+    there blocks every graceful scale-down of the replica forever."""
+    import asyncio
+
+    import cloudpickle
+
+    from ray_tpu.serve.replica import ReplicaActor
+
+    def gen(n: int):
+        for i in range(n):
+            yield i
+
+    rep = ReplicaActor("csdep", "serve:csdep:1",
+                       cloudpickle.dumps((gen, (), {})))
+
+    async def drive():
+        # finished-but-unclaimed buffer: cancel drops it, no tombstone
+        await rep.handle_request_streaming("s1", (3,), {})
+        assert rep._streams
+        await rep.cancel_stream("s1")
+        assert not rep._streams and not rep._stream_done
+        assert not rep._cancelled_streams
+        # cancel racing ahead of a queued start: the start is refused and
+        # consumes the tombstone
+        await rep.cancel_stream("s2")
+        try:
+            await rep.handle_request_streaming("s2", (1,), {})
+            raise AssertionError("cancelled-before-start must refuse")
+        except RuntimeError:
+            pass
+        assert not rep._streams and not rep._cancelled_streams
+        assert await rep.drain(timeout_s=2.0)
+
+    asyncio.run(drive())
+
+
+# ------------------------------------------------------------ loadgen units
+
+
+def test_arrival_schedules_are_seeded_and_shaped():
+    from ray_tpu.serve import loadgen
+
+    a1 = loadgen.poisson_arrivals(50.0, 10.0, random.Random(7))
+    a2 = loadgen.poisson_arrivals(50.0, 10.0, random.Random(7))
+    assert a1 == a2, "same seed must replay the same schedule"
+    assert 350 < len(a1) < 650
+    assert all(0 <= t < 10.0 for t in a1) and a1 == sorted(a1)
+
+    burst = loadgen.burst_arrivals(10.0, 10.0, 5.0, 7.0, 12.0,
+                                   random.Random(3))
+    inside = sum(1 for t in burst if 5.0 <= t < 7.0)
+    outside = sum(1 for t in burst if t < 5.0 or t >= 7.0)
+    # ~200 arrivals inside the 2s spike window vs ~100 over the other 10s
+    assert inside > outside, (inside, outside)
+    rate_in = inside / 2.0
+    rate_out = outside / 10.0
+    assert rate_in / rate_out > 5.0, "spike must be ~10x the base rate"
+
+    ramp = loadgen.ramp_arrivals(1.0, 50.0, 10.0, random.Random(5))
+    first_half = sum(1 for t in ramp if t < 5.0)
+    assert first_half < len(ramp) - first_half, "ramp rate must grow"
+
+    lens = [loadgen.heavy_tail_len(random.Random(i), 32, lo=1, hi=4096)
+            for i in range(500)]
+    assert min(lens) >= 1 and max(lens) <= 4096
+    assert max(lens) > 4 * sorted(lens)[len(lens) // 2], "no heavy tail?"
+
+
+def test_storm_runner_is_open_loop():
+    """Arrivals fire on schedule even when every request is slow — the
+    completion pace must not throttle the arrival pace, and TTFT charges
+    from the SCHEDULED arrival."""
+    from ray_tpu.serve import loadgen
+
+    fire_times = []
+
+    def slow_fire(epoch, t_sched, idx):
+        fire_times.append(time.monotonic() - epoch)
+        time.sleep(0.5)  # far slower than the arrival spacing
+        dt = time.monotonic() - epoch - t_sched
+        return loadgen.RequestSample(t_sched, fire_times[-1], dt, dt, 1,
+                                     ok=True)
+
+    arrivals = [i * 0.02 for i in range(25)]  # 50/s for 0.5s
+    runner = loadgen.StormRunner(slow_fire, max_outstanding=64)
+    samples = runner.run(arrivals)
+    runner.shutdown()
+    assert len(samples) == 25 and all(s.ok for s in samples)
+    # open-loop: the LAST arrival fired near its schedule, not after the
+    # first completions (closed-loop would stretch 25 * 0.5s)
+    assert fire_times[-1] < arrivals[-1] + 0.4
+    # the slow service shows up in the measured latency
+    assert all(s.latency_s >= 0.45 for s in samples)
+
+
+def test_windowed_p95_series_tracks_recovery():
+    from ray_tpu.serve import loadgen
+    samples = [loadgen.RequestSample(t, t, 1.0 if t < 5 else 0.05,
+                                     1.0 if t < 5 else 0.05, 1, ok=True)
+               for t in [i * 0.1 for i in range(100)]]
+    series = loadgen.windowed_p95_series(samples, window_s=2.0)
+    assert series[0]["ttft_p95_ms"] > 500
+    assert series[-1]["ttft_p95_ms"] < 100
+
+
+# ----------------------------------------------------- storm acceptance
+
+
+@pytest.fixture
+def storm_cluster():
+    import ray_tpu
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+    ray_tpu.init(num_cpus=8, worker_env=dict(CPU_WORKER_ENV),
+                 _system_config={"serve_slo_window_s": 6.0})
+    yield
+    from ray_tpu import serve
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def _storm_deployment(serve, max_replicas=4, ttft_target_ms=500.0,
+                      service_ms=150.0):
+    @serve.deployment(name="stormdep", max_concurrent_queries=2,
+                      health_check_period_s=0.25,
+                      health_check_timeout_s=2.0,
+                      graceful_shutdown_timeout_s=20.0,
+                      autoscaling_config=dict(
+                          policy="slo", min_replicas=1,
+                          max_replicas=max_replicas,
+                          target_ongoing_requests=2.0,
+                          ttft_p95_target_ms=ttft_target_ms,
+                          upscale_delay_s=0.5, downscale_delay_s=2.0,
+                          min_window_n=6))
+    class StormDep:
+        async def __call__(self, _x=None):
+            import asyncio
+            await asyncio.sleep(service_ms / 1000.0)
+            return b"ok"
+
+    return StormDep
+
+
+def test_storm_scale_up_recover_drain_down(storm_cluster):
+    """The acceptance loop on one box: 10x open-loop spike -> scale-up
+    within the configured delay, TTFT-p95 recovers below target while
+    the storm continues, post-storm scale-down drains gracefully (zero
+    request errors = zero mid-request kills), and every scale event has
+    a queryable decision record."""
+    import random as _random
+
+    from ray_tpu import serve
+    from ray_tpu.serve import loadgen
+
+    h = serve.run(_storm_deployment(serve), timeout_s=60)
+    # warm the window so percentiles exist
+    for _ in range(8):
+        h.remote().result(timeout_s=30)
+
+    rng = _random.Random(0)
+    warm_s, storm_s, cool_s = 2.0, 9.0, 2.0
+    total = warm_s + storm_s + cool_s
+    # base 2/s (one replica: 2 concurrent * 5/s = 10/s capacity), spike
+    # 10x -> 20/s: ~2x over single-replica capacity, queue builds fast
+    arrivals = loadgen.burst_arrivals(2.0, 10.0, warm_s, warm_s + storm_s,
+                                      total, rng)
+    runner = loadgen.StormRunner(
+        loadgen.unary_fire(h, lambda _i: None, timeout_s=60),
+        max_outstanding=256)
+    sampler = loadgen.SignalSampler("stormdep", period_s=0.25, runner=runner)
+    sampler.start()
+    samples = runner.run(arrivals)
+    runner.shutdown()
+
+    # zero mid-request kills / drops through scale-up AND the storm
+    errors = [s for s in samples if not s.ok]
+    assert not errors, f"{len(errors)} failed requests: {errors[:3]}"
+
+    # scale-up happened while the storm ran
+    decisions = serve.autoscale_decisions(deployment="stormdep", limit=100)
+    ups = [d for d in decisions if d["direction"] == "up"]
+    assert ups, f"no scale-up decision: {decisions}"
+    for d in decisions:  # every event is a fully-formed queryable record
+        for k in ("ts", "reason", "from_replicas", "to_replicas", "wanted",
+                  "signal"):
+            assert k in d
+    peak = max((s.get("running") or 0) for s in sampler.series
+               if "gap" not in s)
+    assert peak >= 2, f"never scaled up past 1 replica: {sampler.series}"
+
+    # TTFT-p95 recovered below target while load continued: the last
+    # storm-phase completions are fast again
+    p95_series = loadgen.windowed_p95_series(samples, window_s=2.0)
+    late = [w for w in p95_series if warm_s + storm_s - 3.0 <= w["t"]]
+    assert late and min(w["ttft_p95_ms"] for w in late) < 500.0, p95_series
+
+    # post-storm: drains back down to min_replicas gracefully
+    deadline = time.monotonic() + 45
+    final = None
+    while time.monotonic() < deadline:
+        sig = serve.slo_signal()["stormdep"]
+        final = sig["running_replicas"]
+        if final == 1 and sig["queue_depth"] == 0:
+            break
+        time.sleep(0.3)
+    sampler.stop()
+    assert final == 1, f"never drained back to min_replicas: {final}"
+    downs = [d for d in serve.autoscale_decisions(deployment="stormdep",
+                                                  limit=100)
+             if d["direction"] == "down"]
+    assert downs and all(d["to_replicas"] == d["from_replicas"] - 1
+                         for d in downs), "downscale must be one at a time"
+    assert not sampler.gaps(), f"slo_signal gaps: {sampler.gaps()}"
+
+    # the decision trail reaches every surface: CLI table + trail render
+    # from the same dicts, the dashboard serves the ring over REST, and
+    # the status embed carries the policy + last decision
+    st = serve.status()["stormdep"]
+    assert st["autoscale"]["policy"] == "slo"
+    assert st["autoscale"]["last_decision"]["direction"] == "down"
+    from ray_tpu.scripts.cli import (_print_autoscale_decisions,
+                                     _print_serve_status)
+    _print_serve_status(serve.status())
+    _print_autoscale_decisions(5)
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    port = start_dashboard(port=0)
+    try:
+        recs = requests.get(
+            f"http://127.0.0.1:{port}/api/serve/autoscale?limit=50",
+            timeout=30).json()
+        assert recs and any(r["direction"] == "up" for r in recs)
+        assert all(r["reason"] in {"slo_breach", "queue_depth", "recovery",
+                                   "zero_running"} for r in recs)
+    finally:
+        stop_dashboard()
+
+
+@pytest.mark.chaos
+def test_storm_absorbs_mid_storm_node_preemption(ray_start_cluster):
+    """A seeded preempt_node kill mid-storm: requests ride the router's
+    retry path (no errors), the controller culls the dead replicas and
+    the autoscaler re-places capacity, and serve.slo_signal() answers
+    every sample tick throughout (no SLO-signal gaps) with the staleness
+    guard aging the dead replicas' frozen snapshots out."""
+    import random as _random
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.rpc import run_async
+    from ray_tpu.serve import loadgen
+    from ray_tpu.utils.testing import CPU_WORKER_ENV
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(1)
+    cluster.connect_driver(worker_env=dict(CPU_WORKER_ENV),
+                           _system_config={"serve_slo_window_s": 6.0})
+
+    h = serve.run(_storm_deployment(serve, max_replicas=3), timeout_s=60)
+    for _ in range(8):
+        h.remote().result(timeout_s=30)
+    # second node AFTER the control plane landed on node A: the storm
+    # scales onto B and the preemption takes B out, never the controller
+    node_b = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(2)
+
+    rng = _random.Random(1)
+    warm_s, storm_s = 1.5, 10.0
+    total = warm_s + storm_s + 1.5
+    arrivals = loadgen.burst_arrivals(2.0, 10.0, warm_s, warm_s + storm_s,
+                                      total, rng)
+    runner = loadgen.StormRunner(
+        loadgen.unary_fire(h, lambda _i: None, timeout_s=90),
+        max_outstanding=256)
+    sampler = loadgen.SignalSampler("stormdep", period_s=0.25, runner=runner)
+    sampler.start()
+
+    import threading
+
+    def arm_chaos():
+        time.sleep(warm_s + 4.0)  # mid-storm, after the scale-up
+        from ray_tpu.core.core_worker import global_worker
+        spec = {"seed": 11, "kills": [
+            {"kind": "preempt_node", "after_s": 0.0, "notice_s": 0.5,
+             "node": node_b.node_id[:8]}]}
+        run_async(global_worker().gcs.call("chaos_set", spec=spec))
+
+    ct = threading.Thread(target=arm_chaos, daemon=True)
+    ct.start()
+    samples = runner.run(arrivals)
+    runner.shutdown()
+    ct.join(timeout=10)
+
+    # the preempted node's replicas died mid-storm; every request still
+    # completed (router retry + graceful drain = no mid-request loss)
+    errors = [s for s in samples if not s.ok]
+    assert not errors, f"{len(errors)} failed requests: {errors[:3]}"
+    assert node_b.proc.poll() is not None, "preempt_node never fired"
+
+    # no SLO-signal gaps while the node died: every sampler tick answered
+    series = sampler.stop()
+    assert not [s for s in series if "gap" in s], \
+        f"slo_signal gaps: {[s for s in series if 'gap' in s]}"
+
+    # the deployment is still serving and converges back to health
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        sig = serve.slo_signal()["stormdep"]
+        if (sig["running_replicas"] >= 1 and sig["queue_depth"] == 0
+                and sig["stale_replicas"] == 0):
+            break
+        time.sleep(0.3)
+    assert h.remote().result(timeout_s=30) == b"ok"
+    sig = serve.slo_signal()["stormdep"]
+    assert sig["stale_replicas"] == 0, sig
+    serve.shutdown()
+    ray_tpu.shutdown()
