@@ -267,6 +267,10 @@ def main():
                         "AND a multi-client control-plane saturation bench")
     p.add_argument("--shard-depth", type=int, default=200_000,
                    help="drain depth for each --shard-curve point")
+    p.add_argument("--sample-n", type=int, default=8,
+                   help="after the default-config curve, rerun the deepest "
+                        "drain with task_event_sample_n=N — the at-scale "
+                        "event-sampling config (0/1 skips the extra point)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
@@ -283,10 +287,23 @@ def main():
         },
         "task_curve": [],
     }
-    for d in [int(x) for x in args.depths.split(",") if x.strip()]:
+    depths = [int(x) for x in args.depths.split(",") if x.strip()]
+    for d in depths:
         res = bench_depth(d)
         out["task_curve"].append(res)
         print(f"# depth {d}: {json.dumps(res)}", flush=True)
+    if args.sample_n > 1 and depths:
+        # the deepest drain is GCS event-ingest bound with full trails:
+        # record the same point under the at-scale sampling config so the
+        # curve shows what payload sampling buys (counters stay exact;
+        # terminals still emit — see ARCHITECTURE.md "Native submission
+        # plane")
+        d = max(depths)
+        res = bench_depth(
+            d, system_config={"task_event_sample_n": args.sample_n})
+        out["task_curve"].append(res)
+        print(f"# depth {d} (sample_n={args.sample_n}): {json.dumps(res)}",
+              flush=True)
     shard_counts = [int(x) for x in args.shard_curve.split(",") if x.strip()]
     if shard_counts:
         out["shard_curve"] = []

@@ -779,6 +779,137 @@ def run_ab_cpshard(S: float, pairs: int) -> dict:
                 for k in on_runs[0] if k in BASELINE}}
 
 
+#: the "off" arm of the native-submission-plane A/B: the exact pre-PR
+#: owner hot loop — per-call TaskSpec ctor (no templates, no free-list
+#: recycling), per-spec wire tuples (no packed frames / C encoder), full
+#: 3-events-per-task trails, per-ref refcount locking restored via the
+#: scalar paths' semantics (batch helpers remain but the knobs gate the
+#: allocation/encode/event savings the tentpole added).
+SUBMIT_PLANE_OFF = {"submit_plane_native_enabled": False,
+                    "task_event_sample_n": 0,
+                    "spec_freelist_max": 0}
+
+
+def run_ab_submitplane(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: the native submission plane (slotted/
+    pooled specs + packed C-encoded frames + sampled events) on vs off
+    (the ISSUE-16 acceptance gate: >= 1.5x tasks_async)."""
+    on_cfg = {"task_event_sample_n": 8}
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_submission(S, dict(on_cfg)))
+        off_runs.append(_measure_submission(S, dict(SUBMIT_PLANE_OFF)))
+        print(f"# submitplane ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "on_config": on_cfg, "off_config": SUBMIT_PLANE_OFF,
+            "ratio_on_off": ratio}
+
+
+def run_profile_submit(S: float) -> dict:
+    """Per-stage µs breakdown of one WARM submission: spec build / encode
+    / events / refcount measured in isolation on live runtime objects,
+    serialize+flush attributed from the owner histograms over a clean
+    burst, plus the bare .remote() driver-thread p50 they decompose."""
+    import ray_tpu
+    from ray_tpu.core import common, sched_explain
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.ids import TaskID
+    from ray_tpu.core.remote_function import serialize_args
+
+    ray_tpu.init(num_cpus=8, object_store_memory=2 << 30,
+                 _system_config={"sched_metrics_enabled": True})
+    prof = {}
+
+    @ray_tpu.remote
+    def noop(_x=None):
+        return None
+
+    try:
+        ray_tpu.get([noop.remote() for _ in range(8)])
+        ray_tpu.get([noop.remote() for _ in range(500)])  # warm everything
+        w = global_worker()
+        k = max(int(2000 * S), 500)
+        args_blob, _ = serialize_args((), {})
+        tmpl = noop._spec_tmpl
+        assert tmpl is not None, "warm template missing — submit plane off?"
+
+        # stage: spec build (free-list pop + template slot copy)
+        t0 = time.perf_counter()
+        specs = [common.build_spec_from_template(
+            tmpl, TaskID.from_random(), args_blob, None) for _ in range(k)]
+        prof["spec_build_us"] = round((time.perf_counter() - t0) / k * 1e6, 3)
+
+        # stage: encode (packed batch frame, warm templates, batch of 64)
+        stub = type("C", (), {"_writer": object()})()
+        batch = specs[:64]
+        w.spec_encoder.encode_batch(stub, batch)  # deliver templates once
+        reps = max(k // 64, 8)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            w.spec_encoder.encode_batch(stub, batch)
+        prof["encode_us"] = round(
+            (time.perf_counter() - t0) / (reps * len(batch)) * 1e6, 3)
+
+        # stage: task events (one SUBMITTED stamp per task, current
+        # sampling config; buffers restored afterwards)
+        saved = w._task_events
+        w._task_events = []
+        t0 = time.perf_counter()
+        for s in specs:
+            w.task_event(s, "SUBMITTED")
+        prof["events_us"] = round((time.perf_counter() - t0) / k * 1e6, 3)
+        w._task_events = saved
+        for s in specs:
+            w._submit_ts.pop(s.task_id, None)
+
+        # stage: refcount (one-ref add+remove round trip, batched paths)
+        from ray_tpu.core.ids import ObjectID
+        rc = w.reference_counter
+        oids = [ObjectID.for_task_return(s.task_id, 0) for s in specs]
+        t0 = time.perf_counter()
+        for oid in oids:
+            rc.add_submitted_many((oid,))
+            rc.remove_submitted_many(((oid, w.address),))
+        prof["refcount_us"] = round((time.perf_counter() - t0) / k * 1e6, 3)
+
+        # serialize+flush attribution over a clean burst (owner histograms)
+        om = sched_explain.owner_metrics()
+
+        def hist_totals(h):
+            return (sum(h._sum.values()), sum(h._count.values()))
+
+        s0, f0 = hist_totals(om["serialize"]), hist_totals(om["flush"])
+        nb = int(1000 * S)
+        t_sub = []
+        refs = []
+        t0 = time.perf_counter()
+        for _ in range(nb):
+            c0 = time.perf_counter()
+            refs.append(noop.remote())
+            t_sub.append(time.perf_counter() - c0)
+        ray_tpu.get(refs)
+        wall = time.perf_counter() - t0
+        s1, f1 = hist_totals(om["serialize"]), hist_totals(om["flush"])
+        prof["serialize_us_per_task"] = round((s1[0] - s0[0]) / nb * 1e6, 3)
+        prof["flush_us_per_task"] = round((f1[0] - f0[0]) / nb * 1e6, 3)
+        t_sub.sort()
+        prof["bare_submit_us_p50"] = round(t_sub[len(t_sub) // 2] * 1e6, 3)
+        prof["burst_tasks_per_s"] = round(nb / wall, 1)
+        prof["note"] = ("spec_build/encode/events/refcount measured in "
+                        "isolation on live objects; serialize/flush are "
+                        "owner-histogram deltas over the burst; "
+                        "bare_submit_us_p50 is the driver-thread .remote() "
+                        "cost those stages decompose")
+    finally:
+        ray_tpu.shutdown()
+    return prof
+
+
 def run_ab_fastpath(S: float, pairs: int) -> dict:
     """Interleaved same-box A/B: fast path ON vs OFF, alternating fresh
     clusters so box drift lands evenly on both arms."""
@@ -846,6 +977,14 @@ def main():
                         "autoscaler policy on vs no autoscaling over a "
                         "steady noop deployment (the control-loop "
                         "overhead gate)")
+    p.add_argument("--ab-submitplane", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of the "
+                        "native submission plane (pooled specs + packed "
+                        "C frames + sampled events) on vs off")
+    p.add_argument("--profile-submit", action="store_true",
+                   help="profile one warm submission: per-stage µs "
+                        "(spec build / encode / events / refcount / "
+                        "serialize+flush) plus bare .remote() p50")
     p.add_argument("--ab-object", type=int, default=0, metavar="PAIRS",
                    help="also run PAIRS interleaved A/B pairs of "
                         "object_metrics_enabled on vs off (put GB/s, "
@@ -907,6 +1046,11 @@ def main():
                                                  args.ab_object)
     if args.ab_zcput > 0:
         out["zcput_ab"] = run_ab_zcput(args.scale, args.ab_zcput)
+    if args.ab_submitplane > 0:
+        out["submitplane_ab"] = run_ab_submitplane(args.scale,
+                                                   args.ab_submitplane)
+    if args.profile_submit:
+        out["submit_profile"] = run_profile_submit(args.scale)
     if args.ab_cpshard > 0:
         out["cpshard_ab"] = run_ab_cpshard(args.scale, args.ab_cpshard)
     line = json.dumps(out)

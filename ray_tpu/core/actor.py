@@ -13,12 +13,18 @@ import inspect
 from typing import Any, Dict, List, Optional
 
 from . import serialization
-from .common import TaskSpec
+from .common import (STREAMING_RETURNS, TaskSpec, build_spec_from_template,
+                     copy_spec_into)
+from .config import get_config
 from .ids import ActorID, TaskID
 from .object_ref import ObjectRef
 from .remote_function import (_current_trace_ctx, resolve_pg_strategy,
                               serialize_args)
 from .rpc import run_async
+
+# Bound on first method submit (core_worker imports this module, so a
+# top-level import would be circular).
+_global_worker = None
 
 
 class ActorMethod:
@@ -54,6 +60,10 @@ class ActorHandle:
         object.__setattr__(self, "_method_names", list(method_names))
         object.__setattr__(self, "_max_task_retries", max_task_retries)
         object.__setattr__(self, "_name", name)
+        #: warm-path method-call spec templates: (method, num_returns,
+        #: backpressure) -> (generation_key, template) — bounded by the
+        #: actor's method count (stale generations overwrite in place)
+        object.__setattr__(self, "_spec_tmpls", {})
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -65,27 +75,47 @@ class ActorHandle:
 
     def _submit_method(self, method: str, args, kwargs, num_returns,
                        generator_backpressure: int = 0):
-        from .common import STREAMING_RETURNS
-        from .core_worker import global_worker
-        w = global_worker()
+        global _global_worker
+        if _global_worker is None:  # deferred: core_worker imports us
+            from .core_worker import global_worker as _global_worker
+        w = _global_worker()
+        cfg = get_config()
         if num_returns in ("streaming", "dynamic"):
             num_returns = STREAMING_RETURNS
         args_blob, arg_refs = serialize_args(args, kwargs)
-        spec = TaskSpec(
-            task_id=TaskID.from_random(),
-            job_id=w.job_id,
-            name=f"{method}",
-            fn_id=None,
-            args=args_blob,
-            num_returns=num_returns,
-            owner=w.address,
-            is_actor_task=True,
-            actor_id=ActorID.from_hex(self._actor_id),
-            actor_method=method,
-            max_retries=self._max_task_retries,
-            generator_backpressure=int(generator_backpressure or 0),
-            trace_ctx=_current_trace_ctx(),
-        )
+        # Warm path: the method descriptor (actor id, method name, options)
+        # is call-invariant — clone the cached template (pooled slot copy)
+        # instead of running the TaskSpec ctor per call.  The generation
+        # key pins it to this worker + config object (reinit/set_config
+        # rebuilds in place).
+        key = (method, num_returns, int(generator_backpressure or 0))
+        gen = (w.worker_id, id(cfg))
+        hit = self._spec_tmpls.get(key)
+        if (hit is not None and hit[0] == gen
+                and cfg.submit_plane_native_enabled):
+            spec = build_spec_from_template(
+                hit[1], TaskID.from_random(), args_blob,
+                _current_trace_ctx())
+        else:
+            spec = TaskSpec(
+                task_id=TaskID.from_random(),
+                job_id=w.job_id,
+                name=f"{method}",
+                fn_id=None,
+                args=args_blob,
+                num_returns=num_returns,
+                owner=w.address,
+                is_actor_task=True,
+                actor_id=ActorID.from_hex(self._actor_id),
+                actor_method=method,
+                max_retries=self._max_task_retries,
+                generator_backpressure=int(generator_backpressure or 0),
+                trace_ctx=_current_trace_ctx(),
+            )
+            if cfg.submit_plane_native_enabled:
+                tmpl = TaskSpec.__new__(TaskSpec)
+                copy_spec_into(spec, tmpl)
+                self._spec_tmpls[key] = (gen, tmpl)
         refs = w.submit_actor_task(self._actor_id, spec, arg_refs)
         if num_returns == STREAMING_RETURNS:
             return refs  # an ObjectRefGenerator

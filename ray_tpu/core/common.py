@@ -67,7 +67,7 @@ def _rebuild_task_spec(kw: dict, args_buf) -> "TaskSpec":
     return TaskSpec(**kw)
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskSpec:
     task_id: TaskID
     job_id: JobID
@@ -131,12 +131,95 @@ class TaskSpec:
         # pickle.dumps default) keeps the plain dataclass reduce.
         if protocol >= 5 and isinstance(self.args, bytes) \
                 and len(self.args) >= _VECTORED_ARGS_MIN:
-            import dataclasses
             import pickle as _pickle
-            kw = {f.name: getattr(self, f.name)
-                  for f in dataclasses.fields(self) if f.name != "args"}
+            kw = {n: getattr(self, n) for n in SPEC_FIELDS if n != "args"}
             return (_rebuild_task_spec, (kw, _pickle.PickleBuffer(self.args)))
-        return super().__reduce_ex__(protocol)
+        # object., not super().: @dataclass(slots=True) rebuilds the class,
+        # so the zero-arg super() closure would point at the discarded
+        # pre-slots class and raise on every pickle.
+        return object.__reduce_ex__(self, protocol)
+
+
+#: TaskSpec field names in declaration order — the slotted class has no
+#: ``__dict__``, so everything that used to iterate ``spec.__dict__``
+#: (template split, prototype clone) iterates this tuple instead.
+import dataclasses as _dataclasses
+SPEC_FIELDS: Tuple[str, ...] = tuple(
+    f.name for f in _dataclasses.fields(TaskSpec))
+
+#: Fields that vary per call — everything else is template-invariant for
+#: one (function, options) pair.  The template cache (spec_cache.py) and
+#: the owner's template-clone fast path both key off this split.
+VOLATILE_FIELDS: Tuple[str, ...] = (
+    "task_id", "args", "retry_count", "seq_no", "trace_ctx", "submitted_at")
+
+TEMPLATE_FIELDS: Tuple[str, ...] = tuple(
+    n for n in SPEC_FIELDS if n not in VOLATILE_FIELDS)
+
+# Generated field-by-field copies (slot loads/stores, no dict machinery) —
+# the clone primitives under the receiver's prototype-interner decode and
+# the owner's template-clone submission fast path.  copy_template_into
+# skips the volatile fields its callers store immediately after.
+_ns: Dict[str, Any] = {}
+exec("def copy_spec_into(src, dst):\n"
+     + "".join(f"    dst.{n} = src.{n}\n" for n in SPEC_FIELDS), _ns)
+exec("def copy_template_into(src, dst):\n"
+     + "".join(f"    dst.{n} = src.{n}\n" for n in TEMPLATE_FIELDS), _ns)
+copy_spec_into = _ns["copy_spec_into"]
+copy_template_into = _ns["copy_template_into"]
+del _ns
+
+
+# ---------------------------------------------------------------------------
+# TaskSpec free-list (submission fast path)
+#
+# Submitted specs are recycled at terminal completion (TaskManager.complete,
+# when the spec escaped into neither lineage nor a stream) and re-acquired
+# by the next warm ``.remote()`` — a steady-state submission allocates no
+# new spec object.  deque append/pop are single-bytecode atomic under the
+# GIL, so the driver thread acquires while the IO loop recycles without a
+# lock.  Templates cached on RemoteFunction/ActorMethod handles are built
+# OUTSIDE the free-list and never submitted, so no live template can be
+# handed out twice.
+# ---------------------------------------------------------------------------
+
+_SPEC_FREELIST: List[TaskSpec] = []
+#: exact counters (submission-plane observability: free-list hit rate)
+spec_freelist_hits = 0
+spec_freelist_misses = 0
+
+
+def spec_from_freelist() -> TaskSpec:
+    """A recycled (stale-fielded) spec, or a fresh uninitialized one."""
+    global spec_freelist_hits, spec_freelist_misses
+    try:
+        spec = _SPEC_FREELIST.pop()
+        spec_freelist_hits += 1
+        return spec
+    except IndexError:
+        spec_freelist_misses += 1
+        return TaskSpec.__new__(TaskSpec)
+
+
+def recycle_spec(spec: TaskSpec, limit: int) -> None:
+    if len(_SPEC_FREELIST) < limit:
+        _SPEC_FREELIST.append(spec)
+
+
+def build_spec_from_template(tmpl: TaskSpec, task_id: TaskID, args: bytes,
+                             trace_ctx: Optional[tuple]) -> TaskSpec:
+    """Warm-path spec build: clone the handle's invariant template into a
+    free-list spec and store only the per-call fields — the allocation-free
+    replacement for the 28-kwarg dataclass ctor."""
+    spec = spec_from_freelist()
+    copy_template_into(tmpl, spec)
+    spec.task_id = task_id
+    spec.args = args
+    spec.retry_count = 0
+    spec.seq_no = 0
+    spec.trace_ctx = trace_ctx
+    spec.submitted_at = time.time()
+    return spec
 
 
 @dataclass

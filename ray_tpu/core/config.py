@@ -212,6 +212,27 @@ class Config:
     #: threads instead of the RPC loop, overlapping pickle time with the
     #: loop's socket work.  0 encodes inline on the loop (historical).
     owner_serialize_threads: int = 0
+    #: Native submission plane (the per-task owner fast path): warm-path
+    #: push batches wire-encode into ONE packed binary frame
+    #: (spec_cache.pack_specs — C extension when built, byte-identical
+    #: pure-Python fallback otherwise), submitted TaskSpecs are slotted
+    #: objects recycled through a free-list, and per-ref refcount
+    #: mutations take one lock per batch.  False restores the prior
+    #: per-spec tuple wire path, ctor-built specs, and per-ref locking
+    #: exactly (the ``perf.py --ab-submitplane`` off arm).
+    submit_plane_native_enabled: bool = True
+    #: Task-event payload sampling: histograms and the submission-plane
+    #: counters observe EVERY task; full per-task event trails
+    #: (SUBMITTED/RUNNING records) are emitted for 1-in-N tasks.
+    #: Terminal events (FINISHED/FAILED) are NEVER sampled away, so the
+    #: state rollup still counts every task and ``raytpu explain``
+    #: answers for unsampled tasks from their terminal record.
+    #: 0 or 1 = full trails for every task (historical behavior).
+    task_event_sample_n: int = 0
+    #: Capacity of the TaskSpec free-list (submitted specs are recycled
+    #: at terminal completion instead of re-allocated per call).
+    #: 0 disables recycling.
+    spec_freelist_max: int = 4096
     #: Run the EMBEDDED control plane (the GCS server and node agent that
     #: ``init(address=None)`` boots inside the driver process) on their
     #: own IO-loop threads instead of the driver's shared loop — the

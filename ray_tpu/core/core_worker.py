@@ -45,7 +45,8 @@ from .common import (STREAMING_RETURNS, ActorDiedError, GetTimeoutError,
                      NodeAffinitySchedulingStrategy, ObjectLostError,
                      OutOfMemoryError, PlacementGroupSchedulingStrategy,
                      RayTpuError, TaskError, TaskSpec, WorkerCrashedError,
-                     _TopLevelRef)
+                     _TopLevelRef, recycle_spec)
+from . import common as _common
 from .config import get_config
 from .generator import ObjectRefGenerator, StreamState
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -53,10 +54,16 @@ from .object_ref import ObjectRef
 from .object_store import ErrorRecord, MemoryStore, PlasmaRecord, ShmReader, ShmSegment
 from .rpc import (ClientPool, ConnectionLost, RemoteError, RpcClient,
                   RpcError, RpcServer, get_loop, run_async)
+from .runtime_context import _task_context
 from .scheduling import NodeView, pick_node
+from ray_tpu.util import tracing as _tracing
 
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
+
+# Canonical serialized-empty-args blob, bound on first executor use (the
+# per-task compare in _resolve_args must not re-derive it per call).
+_EMPTY_ARGS_BLOB: Optional[bytes] = None
 
 # Lazy singleton: the task-lifecycle stage histogram (submit->dispatch
 # queueing on the owner side; dep-fetch / arg-deserialize / execute /
@@ -273,6 +280,15 @@ class ReferenceCounter:
         with self._lock:
             self.submitted[oid] += 1
 
+    def add_submitted_many(self, oids) -> None:
+        """Batch increment: ONE lock acquire for a whole arg list (the warm
+        submit path pays this per task; per-ref locking was ~3 acquires on
+        a typical spec)."""
+        with self._lock:
+            submitted = self.submitted
+            for oid in oids:
+                submitted[oid] += 1
+
     def remove_submitted(self, oid: ObjectID, owner: str):
         with self._lock:
             self.submitted[oid] -= 1
@@ -283,6 +299,23 @@ class ReferenceCounter:
                 noted = oid in self._borrow_noted
                 self._borrow_noted.discard(oid)
         if dead:
+            self._dead(oid, owner, noted)
+
+    def remove_submitted_many(self, pairs) -> None:
+        """Batch decrement of ``(oid, owner)`` pairs under one lock acquire;
+        ``_dead`` notifications fire after the lock drops (same ordering as
+        the scalar path — dead refs are already popped from the maps)."""
+        dead_refs = []
+        with self._lock:
+            submitted, local = self.submitted, self.local
+            for oid, owner in pairs:
+                submitted[oid] -= 1
+                if submitted[oid] <= 0 and local.get(oid, 0) <= 0:
+                    submitted.pop(oid, None)
+                    noted = oid in self._borrow_noted
+                    self._borrow_noted.discard(oid)
+                    dead_refs.append((oid, owner, noted))
+        for oid, owner, noted in dead_refs:
             self._dead(oid, owner, noted)
 
     def _dead(self, oid: ObjectID, owner: str, noted: bool):
@@ -368,13 +401,15 @@ class TaskManager:
                     gated: bool = False):
         self.pending[spec.task_id] = PendingTask(spec, spec.max_retries,
                                                  arg_refs, gated=gated)
-        for r in arg_refs:
-            self._w.reference_counter.add_submitted(r.id)
+        if arg_refs:
+            self._w.reference_counter.add_submitted_many(
+                [r.id for r in arg_refs])
 
     def _release_args(self, pt: PendingTask):
-        for r in pt.arg_refs:
-            self._w.reference_counter.remove_submitted(r.id, r.owner)
-        pt.arg_refs = []
+        if pt.arg_refs:
+            self._w.reference_counter.remove_submitted_many(
+                [(r.id, r.owner) for r in pt.arg_refs])
+        pt.arg_refs = ()
 
     def register_result_borrows(self, oid: ObjectID, res: tuple):
         """Register borrows for ObjectRefs serialized inside a result NOW
@@ -401,17 +436,35 @@ class TaskManager:
                     self._w.release_local_hold(ObjectID(idbin), hold_id)
 
     def complete(self, task_id: TaskID, results: List[tuple]):
+        if self._complete_one(task_id, results):
+            self._w.admission_gate.release()
+
+    def complete_many(self, pairs) -> None:
+        """Batch completion: the whole result batch settles with ONE
+        admission-gate release (one lock acquire + one notify) instead of
+        a release per task — gate wakeups coalesce with the peer's
+        completion batching the same way the memory store's batch waiters
+        coalesce get() wakeups."""
+        gated = 0
+        for task_id, results in pairs:
+            gated += self._complete_one(task_id, results)
+        if gated:
+            self._w.admission_gate.release(gated)
+
+    def _complete_one(self, task_id: TaskID, results: List[tuple]) -> int:
+        """Settle one task; returns the number of admission-gate slots the
+        CALLER must release (0 or 1) — deferred so ``complete_many`` can
+        coalesce a batch's releases into one."""
         pt = self.pending.pop(task_id, None)
         self.oom_kill_counts.pop(task_id, None)
         if pt is None:
-            return
-        if pt.gated:
-            self._w.admission_gate.release()
+            return 0
+        gated = 1 if pt.gated else 0
         self._release_args(pt)
         spec = pt.spec
         if results and results[0][0] in ("gen_done", "gen_buffered"):
             self._complete_stream(task_id, spec, results[0])
-            return
+            return gated
         if spec.num_returns == STREAMING_RETURNS and results \
                 and results[0][0] == "error":
             # The generator body raised: the error is the stream's last item
@@ -434,18 +487,31 @@ class TaskManager:
                     self._w.streams.pop(task_id, None)
             self.num_failed += 1
             self._w.task_event(spec, "FAILED")
-            return
+            return gated
         for i, res in enumerate(results):
             oid = ObjectID.for_task_return(task_id, i)
             self._w.store_task_result(oid, res)
             self.register_result_borrows(oid, res)
         self.num_finished += 1
+        in_lineage = False
         if get_config().lineage_reconstruction_enabled and any(
                 r[0] == "plasma" for r in results):
             self.lineage[task_id] = spec
+            in_lineage = True
             while len(self.lineage) > 10000:
                 self.lineage.popitem(last=False)
         self._w.task_event(spec, "FINISHED")
+        # Spec recycling: settled, out of every owner-side structure, never
+        # referenced again past this point — back to the free list for the
+        # next submission to reuse (only plain pooled task specs; lineage
+        # holds the spec for reconstruction, streams/actor-creation specs
+        # have longer lives).
+        cfg = get_config()
+        if (cfg.submit_plane_native_enabled and cfg.spec_freelist_max > 0
+                and not in_lineage and not spec.is_actor_creation
+                and spec.num_returns != STREAMING_RETURNS):
+            recycle_spec(spec, cfg.spec_freelist_max)
+        return gated
 
     def _complete_stream(self, task_id: TaskID, spec: TaskSpec, res: tuple):
         """A streaming task finished: fix the stream's final length.
@@ -1112,6 +1178,18 @@ class CoreWorker:
         # already scheduled an immediate flush for this window.
         self._submit_timer = None
         self._submit_flush_promoted = False
+        # Ref-death coalescing (submit plane): dead oids buffer here and
+        # drain in ONE loop callback + ONE task, so a burst of ObjectRef
+        # finalizers costs one self-pipe wakeup instead of one per ref.
+        self._free_buffer: list = []
+        self._free_lock = threading.Lock()
+        self._free_scheduled = False
+        # Executor->loop reply coalescing (worker side of the same plane):
+        # completed results buffer here; one loop callback resolves the
+        # whole burst's futures.
+        self._reply_buffer: list = []
+        self._reply_lock = threading.Lock()
+        self._reply_scheduled = False
         # Admission control: the waitable in-flight window every public
         # submission passes through (see _AdmissionGate).
         self.admission_gate = _AdmissionGate()
@@ -1138,6 +1216,11 @@ class CoreWorker:
         #: reset), _shed_total the process-lifetime cumulative count
         self._task_events_dropped = 0
         self.task_events_shed_total = 0
+        #: submission-plane observability: event dicts actually emitted vs
+        #: suppressed by task_event_sample_n (exact counters — the sampled
+        #: payload stream is a view, these are the ground truth)
+        self._sp_events_emitted = 0
+        self._sp_events_sampled = 0
         #: owner-side submit timestamps: the "queue" (submit->dispatch) and
         #: "total" (submit->terminal) stage durations are computed from these
         self._submit_ts: Dict[TaskID, float] = {}
@@ -1281,6 +1364,21 @@ class CoreWorker:
             # next pending episode (a retry re-queued by a worker death)
             # gets a fresh reason transition
             self._last_reason.pop(spec.task_id, None)
+        # Sampled event payloads: the histograms and stage stamps above
+        # observed EVERY task; the per-task SUBMITTED/RUNNING event dicts
+        # ship 1-in-N when task_event_sample_n > 1.  Terminal states
+        # (FINISHED/FAILED) and typed PENDING reasons always emit — so
+        # summarize_tasks still counts every task (it keys on the NEWEST
+        # event per task) and `raytpu explain` answers for any task that
+        # reached a terminal or stuck state.  The coin is the task id's
+        # last byte (the 8-byte incrementing counter tail — uniform), so a
+        # task's trail is all-or-nothing, never half-sampled.
+        n = cfg.task_event_sample_n
+        if (n > 1 and state in ("SUBMITTED", "RUNNING")
+                and spec.task_id._bin[-1] % n):
+            self._sp_events_sampled += 1
+            return
+        self._sp_events_emitted += 1
         ev = {
             "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
             "job_id": spec.job_id.hex(), "ts": now,
@@ -1301,7 +1399,17 @@ class CoreWorker:
         must separate from dispatch/flush time."""
         om = sched_explain.owner_metrics()
         t0 = time.perf_counter() if om is not None else 0.0
-        payloads = [self.spec_encoder.encode(client, s) for s in specs]
+        payloads = None
+        if len(specs) > 1:
+            # Warm batches collapse into ONE packed binary frame (native
+            # submission plane) — the RPC pickle sees a single bytes blob
+            # instead of N nested tuples.  Ineligible batches (big args,
+            # actor creations, cache off) fall back to per-spec encode.
+            packed = self.spec_encoder.encode_batch(client, specs)
+            if packed is not None:
+                payloads = packed
+        if payloads is None:
+            payloads = [self.spec_encoder.encode(client, s) for s in specs]
         if om is not None:
             om["serialize"].observe(time.perf_counter() - t0)
         return payloads
@@ -1402,6 +1510,24 @@ class CoreWorker:
             "worker": self.worker_id.hex()[:12],
             "stages": payload})
 
+    def _submit_plane_counters(self) -> dict:
+        """Exact submission-plane counters that piggyback the task-event
+        flush (no extra RPC): the GCS folds the latest snapshot per owner
+        into sched_stats, so ``raytpu status`` shows what sampling hid."""
+        from ..native import submit_plane_loaded
+        cfg = get_config()
+        return {
+            "owner": self.address,
+            "events_emitted": self._sp_events_emitted,
+            "events_sampled": self._sp_events_sampled,
+            "events_shed": self.task_events_shed_total,
+            "freelist_hits": _common.spec_freelist_hits,
+            "freelist_misses": _common.spec_freelist_misses,
+            "native_enabled": bool(cfg.submit_plane_native_enabled),
+            "native_loaded": submit_plane_loaded(),
+            "sample_n": int(cfg.task_event_sample_n),
+        }
+
     async def _flush_task_events_loop(self):
         CHUNK = 10_000  # bound the per-RPC frame, not one giant pickle
         while not self._shutdown:
@@ -1416,7 +1542,9 @@ class CoreWorker:
                     for i in range(0, len(batch), CHUNK):
                         await self.gcs.call_retry(
                             "add_task_events", events=batch[i:i + CHUNK],
-                            dropped=dropped if i == 0 else 0)
+                            dropped=dropped if i == 0 else 0,
+                            counters=self._submit_plane_counters()
+                            if i == 0 else None)
                 except Exception:
                     pass
             if self._object_events and self.gcs:
@@ -1968,6 +2096,15 @@ class CoreWorker:
             self.streams[spec.task_id] = StreamState(
                 spec.task_id, spec.generator_backpressure)
             ret = ObjectRefGenerator(self, spec.task_id)
+        elif spec.num_returns == 1:
+            # dominant case: one return — register against our own counter
+            # directly (skips the per-ref global-worker lookup inside
+            # _ref_created)
+            r = ObjectRef(ObjectID.for_task_return(spec.task_id, 0),
+                          self.address, _register=False)
+            r._registered = True
+            self.reference_counter.add_local_ref(r.id, r.owner)
+            ret = [r]
         else:
             ret = [ObjectRef(oid, owner=self.address)
                    for oid in spec.return_ids()]
@@ -2090,6 +2227,15 @@ class CoreWorker:
             self.streams[spec.task_id] = StreamState(
                 spec.task_id, spec.generator_backpressure)
             ret = ObjectRefGenerator(self, spec.task_id)
+        elif spec.num_returns == 1:
+            # dominant case: one return — register against our own counter
+            # directly (skips the per-ref global-worker lookup inside
+            # _ref_created)
+            r = ObjectRef(ObjectID.for_task_return(spec.task_id, 0),
+                          self.address, _register=False)
+            r._registered = True
+            self.reference_counter.add_local_ref(r.id, r.owner)
+            ret = [r]
         else:
             ret = [ObjectRef(oid, owner=self.address)
                    for oid in spec.return_ids()]
@@ -2296,7 +2442,30 @@ class CoreWorker:
             loop = get_loop()
         except Exception:
             return
+        if get_config().submit_plane_native_enabled:
+            # Coalesced doorbell: run_coroutine_threadsafe costs a self-pipe
+            # write (~40 µs of syscall on a busy loop) plus a Task per ref.
+            # A drain burst of N ref deaths pays for ONE of each.
+            with self._free_lock:
+                self._free_buffer.append(oid)
+                need_wake = not self._free_scheduled
+                self._free_scheduled = True
+            if need_wake:
+                loop.call_soon_threadsafe(self._drain_frees)
+            return
         asyncio.run_coroutine_threadsafe(self._free_owned(oid), loop)
+
+    def _drain_frees(self):
+        with self._free_lock:
+            oids = self._free_buffer
+            self._free_buffer = []
+            self._free_scheduled = False
+        if oids:
+            asyncio.ensure_future(self._free_owned_many(oids))
+
+    async def _free_owned_many(self, oids: list):
+        for oid in oids:
+            await self._free_owned(oid)
 
     async def handle_worker_killed(self, worker_id: str, address: str,
                                    cause: str):
@@ -2774,8 +2943,9 @@ class CoreWorker:
             self.task_manager.complete(payload["task_id"],
                                        payload["results"])
         elif topic == "task_result_batch":
-            for task_id, results in payload["results"]:
-                self.task_manager.complete(task_id, results)
+            # one admission-gate release for the whole batch (the gate's
+            # lock/notify per completion was measurable at drain rates)
+            self.task_manager.complete_many(payload["results"])
         elif topic == "gen_yield":
             self._on_gen_yield(payload["task_id"], payload["index"],
                                payload["result"], payload["worker"])
@@ -2955,8 +3125,28 @@ class CoreWorker:
 
     def _execute_and_reply(self, spec: TaskSpec, fut, loop):
         results = self._execute_one(spec)
+        if get_config().submit_plane_native_enabled:
+            # Coalesced reply doorbell: a burst of completions wakes the
+            # worker's IO loop once, not once per task (each
+            # call_soon_threadsafe costs a self-pipe write).
+            with self._reply_lock:
+                self._reply_buffer.append((fut, results))
+                need_wake = not self._reply_scheduled
+                self._reply_scheduled = True
+            if need_wake:
+                loop.call_soon_threadsafe(self._drain_replies)
+            return
         loop.call_soon_threadsafe(
             lambda: fut.set_result(results) if not fut.done() else None)
+
+    def _drain_replies(self):
+        with self._reply_lock:
+            pairs = self._reply_buffer
+            self._reply_buffer = []
+            self._reply_scheduled = False
+        for fut, results in pairs:
+            if not fut.done():
+                fut.set_result(results)
 
     def _load_function(self, fn_id: bytes, job_id=None):
         if job_id is not None:
@@ -2987,8 +3177,11 @@ class CoreWorker:
 
     def _resolve_args(self, spec: TaskSpec,
                       stages: Optional[Dict[str, list]] = None):
-        from .remote_function import serialize_args
-        if spec.args == serialize_args((), {})[0]:  # canonical empty blob
+        global _EMPTY_ARGS_BLOB
+        if _EMPTY_ARGS_BLOB is None:
+            from .remote_function import serialize_args
+            _EMPTY_ARGS_BLOB = serialize_args((), {})[0]
+        if spec.args == _EMPTY_ARGS_BLOB:  # canonical empty blob
             if stages is not None:
                 now = time.time()
                 stages["arg_deser"] = [now, now]
@@ -3012,7 +3205,6 @@ class CoreWorker:
         return out
 
     def _execute_task(self, spec: TaskSpec):
-        from .runtime_context import _task_context
         if spec.is_actor_task:
             if self.actor_instance is None:
                 raise RuntimeError("actor task on a non-actor worker")
@@ -3032,7 +3224,6 @@ class CoreWorker:
         token = _task_context.set(ctx)
         # Execution joins the submitter's trace: spans opened by the task and
         # any remote calls it makes chain under the task's span id.
-        from ray_tpu.util import tracing as _tracing
         trace_id = (spec.trace_ctx[0] if spec.trace_ctx
                     else spec.task_id.hex()[:12])
         trace_token = _tracing.set_context((trace_id,
@@ -3246,7 +3437,6 @@ class CoreWorker:
     def _execute_actor_creation(self, spec: TaskSpec):
         cls = self._load_function(spec.fn_id, spec.job_id)
         args, kwargs = self._resolve_args(spec)
-        from .runtime_context import _task_context
         ctx = {"task_id": spec.task_id, "job_id": spec.job_id,
                "actor_id": spec.actor_id, "name": spec.name}
         if spec.resources:
@@ -3289,7 +3479,6 @@ class CoreWorker:
             # method — a serve replica's batch_wait/prefill/decode stamps —
             # chain under this task's span id, keeping a proxied request
             # ONE connected trace across processes.
-            from ray_tpu.util import tracing as _tracing
             trace_id = (spec.trace_ctx[0] if spec.trace_ctx
                         else spec.task_id.hex()[:12])
             trace_token = _tracing.set_context((trace_id,

@@ -97,6 +97,9 @@ class GcsServer:
         self.task_events: deque = deque(maxlen=cfg.task_events_max_buffer)
         #: events owners shed at their bounded buffers (observability)
         self.task_events_dropped = 0
+        #: latest submission-plane counter snapshot per owner (piggybacks
+        #: the task-event flush; sched_stats rolls these up)
+        self.submit_plane_counters: Dict[str, dict] = {}
         # Scheduler explain plane: bounded ring of structured decision
         # records (pick_node/pack_bundles outcomes with per-node rejection
         # causes) from this GCS's own scheduling loops AND from owners
@@ -1180,12 +1183,17 @@ class GcsServer:
     # ------------------------------------------------------------ task events
 
     async def handle_add_task_events(self, events: List[dict],
-                                     dropped: int = 0):
+                                     dropped: int = 0,
+                                     counters: dict | None = None):
         self.task_events.extend(events)
         if dropped:
             # owners shed events past their bounded buffer; keep the gap
             # visible (state API completeness caveat) instead of silent
             self.task_events_dropped += dropped
+        if counters:
+            # submission-plane counter snapshot piggybacking the flush
+            # (cumulative per owner — latest wins; sched_stats rolls up)
+            self.submit_plane_counters[counters.get("owner", "?")] = counters
         return True
 
     async def handle_list_task_events(self, limit: int = 1000,
@@ -1421,6 +1429,7 @@ class GcsServer:
             "object_events_dropped": self.object_events_dropped,
             "object_event_ring_len": len(self.object_events),
             "sched_metrics_enabled": sched_explain.enabled(),
+            "submit_plane": dict(self.submit_plane_counters),
         }
         if self._shard_clients:
             # per-shard rollup: there is no longer ONE GCS loop — status
@@ -1437,6 +1446,12 @@ class GcsServer:
                 v.get("task_events_dropped") or 0 for v in shards.values())
             out["object_events_dropped"] += sum(
                 v.get("object_events_dropped") or 0 for v in shards.values())
+            # shard-aware owners flush their task events (and the counter
+            # snapshot riding them) straight to a shard — merge the maps
+            # so sched_stats shows every owner either way
+            for v in shards.values():
+                for owner, c in (v.get("submit_plane") or {}).items():
+                    out["submit_plane"][owner] = c
         return out
 
     # ------------------------------------------------------------- debug/info

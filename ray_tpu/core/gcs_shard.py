@@ -54,6 +54,8 @@ class GcsShardServer:
         self._kv_ns_index = SecondaryIndex()
         self.task_events: deque = deque(maxlen=cfg.task_events_max_buffer)
         self.task_events_dropped = 0
+        #: latest submission-plane counter snapshot per owner
+        self.submit_plane_counters: Dict[str, dict] = {}
         self.sched_decisions: deque = deque(
             maxlen=max(64, cfg.sched_decision_ring_len))
         self.object_events: deque = deque(
@@ -160,10 +162,15 @@ class GcsShardServer:
     # shard's slice — the router merges slices for the state API.
 
     async def handle_add_task_events(self, events: List[dict],
-                                     dropped: int = 0):
+                                     dropped: int = 0,
+                                     counters: dict | None = None):
         self.task_events.extend(events)
         if dropped:
             self.task_events_dropped += dropped
+        if counters:
+            # latest submission-plane snapshot per owner (shard-local;
+            # the router merges shard maps into its sched_stats rollup)
+            self.submit_plane_counters[counters.get("owner", "?")] = counters
         return True
 
     async def handle_list_task_events(self, limit: int = 1000,
@@ -274,6 +281,7 @@ class GcsShardServer:
             "object_event_ring_len": len(self.object_events),
             "object_events_dropped": self.object_events_dropped,
             "decision_ring_len": len(self.sched_decisions),
+            "submit_plane": dict(self.submit_plane_counters),
             "pid": os.getpid(),
         }
 

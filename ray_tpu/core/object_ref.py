@@ -70,15 +70,26 @@ class ObjectRef:
             pass
 
 
+# Bound on first use (core_worker imports this module, so a top-level
+# import would be circular).  These run once per ObjectRef construction
+# and destruction — the repeated `from .core_worker import ...` module
+# machinery showed up in submit-path profiles.
+_global_worker_or_none = None
+
+
 def _ref_created(ref: ObjectRef):
-    from .core_worker import global_worker_or_none
-    w = global_worker_or_none()
+    global _global_worker_or_none
+    if _global_worker_or_none is None:
+        from .core_worker import \
+            global_worker_or_none as _global_worker_or_none
+    w = _global_worker_or_none()
     if w is not None:
         w.reference_counter.add_local_ref(ref.id, ref.owner)
 
 
 def _ref_deleted(ref: ObjectRef):
-    from .core_worker import global_worker_or_none
-    w = global_worker_or_none()
+    if _global_worker_or_none is None:
+        return
+    w = _global_worker_or_none()
     if w is not None:
         w.reference_counter.remove_local_ref(ref.id, ref.owner)

@@ -13,11 +13,16 @@ from typing import Any, Dict, List, Optional
 
 from . import serialization
 from .common import (STREAMING_RETURNS, PlacementGroupSchedulingStrategy,
-                     TaskSpec, _TopLevelRef)
+                     TaskSpec, _TopLevelRef, build_spec_from_template,
+                     copy_spec_into)
 from .config import get_config
 from .ids import TaskID
 from .object_ref import ObjectRef
 from .rpc import run_async
+
+# Bound on first .remote() call (core_worker imports this module, so a
+# top-level import would be circular).
+_global_worker = None
 
 
 def _wrap_args(args, kwargs):
@@ -59,6 +64,14 @@ class RemoteFunction:
         self._fn_id: Optional[bytes] = None
         self._captured_refs: list = []
         self._registered_in: set = set()
+        #: warm-path spec template: every call-invariant field of this
+        #: (function, options) pair, built once on the first .remote() and
+        #: cloned (pooled slot copy + volatile stores) on every later call.
+        #: Keyed to the worker/config generation it was built under —
+        #: reinit or set_config() rebuilds.  options() returns a NEW
+        #: RemoteFunction, so the template is per-(fn, options) by design.
+        self._spec_tmpl: Optional[TaskSpec] = None
+        self._spec_tmpl_key: Optional[tuple] = None
         self.__name__ = getattr(fn, "__name__", "anonymous")
 
     # -- registration ------------------------------------------------------
@@ -93,48 +106,75 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
-        from .core_worker import global_worker
-        w = global_worker()
-        fn_id = self._ensure_registered(w)
-        o = self._opts
-        resources = dict(o.get("resources") or {})
-        resources["CPU"] = float(o.get("num_cpus", 1))
-        if o.get("num_tpus"):
-            resources["TPU"] = float(o["num_tpus"])
-        if o.get("num_gpus"):
-            resources["GPU"] = float(o["num_gpus"])
-        if o.get("memory"):
-            resources["memory"] = float(o["memory"])
-        strategy = o.get("scheduling_strategy", "DEFAULT")
-        strategy = resolve_pg_strategy(strategy)
-        if o.get("runtime_env"):
-            from . import runtime_env as _renv
-            _renv.validate(o["runtime_env"])
+        global _global_worker
+        if _global_worker is None:  # deferred: core_worker imports us
+            from .core_worker import global_worker as _global_worker
+        w = _global_worker()
+        cfg = get_config()
         args_blob, arg_refs = serialize_args(args, kwargs)
         # Closure-captured refs are data dependencies exactly like argument
         # refs: they must be pinned until the task finishes, and the batch
         # scheduler must not coalesce this task with their producers.
         if self._captured_refs:
             arg_refs = arg_refs + self._captured_refs
-        num_returns = o.get("num_returns", 1)
-        if num_returns in ("streaming", "dynamic"):
-            num_returns = STREAMING_RETURNS
-        spec = TaskSpec(
-            task_id=TaskID.from_random(),
-            job_id=w.job_id,
-            name=o.get("name") or self.__name__,
-            fn_id=fn_id,
-            args=args_blob,
-            num_returns=num_returns,
-            resources=resources,
-            owner=w.address,
-            scheduling_strategy=strategy,
-            max_retries=o.get("max_retries", get_config().default_task_max_retries),
-            retry_exceptions=bool(o.get("retry_exceptions", False)),
-            runtime_env=o.get("runtime_env"),
-            generator_backpressure=int(o.get("generator_backpressure", 0)),
-            trace_ctx=_current_trace_ctx(),
-        )
+        # Warm path: every call-invariant field comes from the cached
+        # template via a pooled slot copy — no per-call resources dict, no
+        # option lookups, no TaskSpec ctor.  The key pins the template to
+        # this worker AND config generation (registration happened when the
+        # template was built for this worker; set_config() swaps the config
+        # object, invalidating templates whose fields read old defaults).
+        tmpl = self._spec_tmpl
+        if (tmpl is not None and cfg.submit_plane_native_enabled
+                and self._spec_tmpl_key == (w.worker_id, id(cfg))):
+            spec = build_spec_from_template(
+                tmpl, TaskID.from_random(), args_blob, _current_trace_ctx())
+            num_returns = tmpl.num_returns
+        else:
+            fn_id = self._ensure_registered(w)
+            o = self._opts
+            resources = dict(o.get("resources") or ())
+            resources["CPU"] = float(o.get("num_cpus", 1))
+            if o.get("num_tpus"):
+                resources["TPU"] = float(o["num_tpus"])
+            if o.get("num_gpus"):
+                resources["GPU"] = float(o["num_gpus"])
+            if o.get("memory"):
+                resources["memory"] = float(o["memory"])
+            strategy = o.get("scheduling_strategy", "DEFAULT")
+            strategy = resolve_pg_strategy(strategy)
+            if o.get("runtime_env"):
+                from . import runtime_env as _renv
+                _renv.validate(o["runtime_env"])
+            num_returns = o.get("num_returns", 1)
+            if num_returns in ("streaming", "dynamic"):
+                num_returns = STREAMING_RETURNS
+            spec = TaskSpec(
+                task_id=TaskID.from_random(),
+                job_id=w.job_id,
+                name=o.get("name") or self.__name__,
+                fn_id=fn_id,
+                args=args_blob,
+                num_returns=num_returns,
+                resources=resources,
+                owner=w.address,
+                scheduling_strategy=strategy,
+                max_retries=o.get("max_retries", cfg.default_task_max_retries),
+                retry_exceptions=bool(o.get("retry_exceptions", False)),
+                runtime_env=o.get("runtime_env"),
+                generator_backpressure=int(o.get("generator_backpressure", 0)),
+                trace_ctx=_current_trace_ctx(),
+            )
+            # Cache the template OUTSIDE the free list (never recycled,
+            # never submitted — it only ever sources slot copies).  PG
+            # strategies stay on the cold path: their bundle placement
+            # resolves per call and must not be frozen into a template.
+            if (cfg.submit_plane_native_enabled
+                    and not isinstance(o.get("scheduling_strategy"),
+                                       PlacementGroupSchedulingStrategy)):
+                tmpl = TaskSpec.__new__(TaskSpec)
+                copy_spec_into(spec, tmpl)
+                self._spec_tmpl = tmpl
+                self._spec_tmpl_key = (w.worker_id, id(cfg))
         refs = w.submit_task(spec, arg_refs)
         if num_returns == STREAMING_RETURNS:
             return refs  # an ObjectRefGenerator

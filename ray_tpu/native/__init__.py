@@ -91,6 +91,68 @@ def load_shm_pool() -> Optional[ctypes.CDLL]:
         return _LIB
 
 
+_SP_LIB: Optional[ctypes.CDLL] = None
+_SP_FAILED = False
+
+
+def load_submit_plane() -> Optional[ctypes.CDLL]:
+    """The packed spec-frame packer/scanner (``sp_pack``/``sp_scan``), or
+    None — callers use the byte-identical pure-Python struct path.  A
+    missing compiler, a wedged cached .so, or a stale build lacking the
+    symbols degrades to the fallback with ONE warning; importing this
+    module never fails on native-build problems."""
+    global _SP_LIB, _SP_FAILED
+    if _SP_LIB is not None or _SP_FAILED:
+        return _SP_LIB
+    with _BUILD_LOCK:
+        if _SP_LIB is not None or _SP_FAILED:
+            return _SP_LIB
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "submit_plane.cpp")
+        path = _build_lib(src, "libsubmitplane.so")
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+                lib.sp_pack.restype = ctypes.c_int64
+                lib.sp_pack.argtypes = [
+                    ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+                    ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_uint32)]
+                lib.sp_scan.restype = ctypes.c_int32
+                lib.sp_scan.argtypes = [
+                    ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_uint32)]
+            except (OSError, AttributeError):
+                lib = None
+        if lib is None:
+            _SP_FAILED = True
+            import warnings
+            warnings.warn(
+                "native submit-plane encoder unavailable (build or load "
+                "failed); using the pure-Python packed-frame fallback",
+                RuntimeWarning, stacklevel=2)
+            return None
+        _SP_LIB = lib
+        return _SP_LIB
+
+
+def submit_plane_loaded() -> bool:
+    """Whether the native packer is currently live — pure introspection,
+    never triggers a build (False before first use AND after a failed
+    build; the counters plane reports actual state, not intent)."""
+    return _SP_LIB is not None
+
+
 _CRC_LIB: Optional[ctypes.CDLL] = None
 _CRC_FAILED = False
 

@@ -197,6 +197,24 @@ def _print_sched_summary():
         parts.append(f"events_dropped={stats['task_events_dropped']}")
     if parts:
         print("control plane: " + "  ".join(parts))
+    sp = stats.get("submit_plane") or {}
+    if sp:
+        # submission-plane rollup: exact counters behind the sampled event
+        # stream, plus free-list hit rate and whether the C encoder is live
+        emitted = sum(c.get("events_emitted") or 0 for c in sp.values())
+        sampled = sum(c.get("events_sampled") or 0 for c in sp.values())
+        hits = sum(c.get("freelist_hits") or 0 for c in sp.values())
+        misses = sum(c.get("freelist_misses") or 0 for c in sp.values())
+        native = any(c.get("native_loaded") for c in sp.values())
+        enabled = any(c.get("native_enabled") for c in sp.values())
+        alloc = hits + misses
+        line = (f"submit plane: events emitted={emitted} sampled={sampled}"
+                f"  freelist hit-rate="
+                + (f"{hits / alloc * 100:.0f}%" if alloc else "n/a")
+                + f"  native encoder="
+                + ("on" if (native and enabled) else
+                   "fallback" if enabled else "off"))
+        print(line)
 
 
 def _print_node_telemetry(rt, nodes):

@@ -119,6 +119,81 @@ TRANSFER_HOT_FUNCTIONS = {
 }
 
 
+#: warm-path submit/complete functions that must not BUILD per-task
+#: containers: a dict literal (an options dict, an event payload) or a
+#: multi-element list literal creeping into any of these re-introduces
+#: the per-task allocation churn the pooled/templated submission plane
+#: removed.  Comprehensions stay allowed (they are the batch idiom on
+#: these paths: arg-ref id lists, return-id lists), as do empty/singleton
+#: lists (fixed-size returns, O(1) per task).
+WARM_SUBMIT_FUNCTIONS = {
+    "core_worker.py": {
+        "submit_task", "submit_actor_task", "_enqueue_submit",
+        "add_pending", "_release_args", "complete", "complete_many",
+        "_complete_one",
+    },
+    "remote_function.py": {"remote"},
+    "actor.py": {"_submit_method"},
+    "common.py": {"build_spec_from_template", "spec_from_freelist",
+                  "recycle_spec"},
+}
+
+
+def test_warm_submit_path_builds_no_per_task_containers():
+    problems = []
+    for fname, wanted in WARM_SUBMIT_FUNCTIONS.items():
+        path = CORE / fname
+        tree = ast.parse(path.read_text(), filename=str(path))
+        found = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or node.name not in wanted:
+                continue
+            found.add(node.name)
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Dict, ast.DictComp)):
+                    problems.append(
+                        f"{path.name}:{sub.lineno}: {node.name} builds a "
+                        "dict per task on the warm submit path — use the "
+                        "spec template / pooled slots instead")
+                elif isinstance(sub, ast.List) and len(sub.elts) > 1:
+                    problems.append(
+                        f"{path.name}:{sub.lineno}: {node.name} builds a "
+                        f"{len(sub.elts)}-element list literal per task on "
+                        "the warm submit path")
+        missing = wanted - found
+        assert not missing, (
+            f"{fname}: warm submit-path functions renamed/removed without "
+            f"updating the lint: {sorted(missing)}")
+    assert not problems, "warm-path container violations:\n" + \
+        "\n".join(problems)
+
+
+def test_submit_plane_is_wired_into_the_hot_path():
+    """Positive companions to the container lint — the pooled/native plane
+    is actually in use, so the lint above cannot go vacuous:
+
+    * the owner's push path batches through the packed-frame encoder,
+    * spec recycling feeds the free list at completion,
+    * the warm submit paths clone templates instead of running the ctor,
+    * the native loader is consulted by the pack/scan paths.
+    """
+    cw = (CORE / "core_worker.py").read_text()
+    assert "encode_batch" in cw, "packed-frame batch encode unplugged"
+    assert "recycle_spec(" in cw, "completion-side spec recycling unplugged"
+    common = (CORE / "common.py").read_text()
+    assert "_SPEC_FREELIST" in common and "def spec_from_freelist" in common
+    assert "def build_spec_from_template" in common
+    for f in ("remote_function.py", "actor.py"):
+        assert "build_spec_from_template" in (CORE / f).read_text(), \
+            f"{f}: warm path does not clone spec templates"
+    sc = (CORE / "spec_cache.py").read_text()
+    assert "load_submit_plane" in sc, "native packer not consulted"
+    native = (CORE.parent / "native" / "__init__.py").read_text()
+    assert "def load_submit_plane" in native
+    assert "def submit_plane_loaded" in native
+
+
 def test_transfer_hot_path_does_not_materialize_bytes():
     """The transfer/landing hot path must stay zero-copy: no
     ``bytes(...)`` construction and no ``.tobytes()`` flatten inside the

@@ -1,0 +1,163 @@
+"""Submission-plane invariants: event sampling must never lose accounting.
+
+With ``task_event_sample_n = N``, only 1-in-N tasks ship their
+SUBMITTED/RUNNING event payloads — but the discipline has three hard
+rules this file pins down end to end:
+
+* terminal events (FINISHED/FAILED) ALWAYS emit, so ``summarize_tasks``
+  (which keys on the newest event per task) still counts every task
+  exactly;
+* the sampling coin is the task id's last byte, so a task's whole trail
+  is in or out — ``raytpu explain`` answers for every task that reached
+  a terminal state, sampled-out or not;
+* what sampling hides, counters preserve: the owner's exact
+  emitted/sampled/freelist counters piggyback the event flush into
+  ``sched_stats()["submit_plane"]``.
+
+Plus the off-switch: ``submit_plane_native_enabled=False`` must restore
+the unpooled path with full (unsampled) event trails.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+SAMPLE_N = 8
+# > 1.0s event-flush cadence, with margin for a busy box
+FLUSH_WAIT_S = 1.8
+
+
+def _drain_events():
+    time.sleep(FLUSH_WAIT_S)
+
+
+@pytest.fixture
+def sampled_cluster():
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"task_event_sample_n": SAMPLE_N})
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+def _run_batch(n):
+    @ray_tpu.remote
+    def sp_noop():
+        return 1
+
+    refs = [sp_noop.remote() for _ in range(n)]
+    assert ray_tpu.get(refs) == [1] * n
+    return [r.task_id() for r in refs]
+
+
+def test_sampling_keeps_terminal_accounting_exact(sampled_cluster):
+    N = 120
+    tids = _run_batch(N)
+    _drain_events()
+
+    # Terminals always emit: the rollup counts every task exactly even
+    # though ~7/8 of SUBMITTED/RUNNING payloads were sampled away.
+    summ = state.summarize_tasks()
+    assert summ["cluster"]["sp_noop"].get("FINISHED") == N
+
+    # Per-task: every one of our tasks has a FINISHED event; tasks on the
+    # sampled-out side of the coin have NO SUBMITTED/RUNNING payloads
+    # (all-or-nothing trails), tasks on the emitted side kept theirs.
+    events = state.list_tasks(limit=100_000)
+    by_tid = {}
+    ours = {t.hex() for t in tids}
+    for ev in events:
+        if ev.get("task_id") in ours:
+            by_tid.setdefault(ev["task_id"], set()).add(ev.get("state"))
+    sampled_out = [t for t in tids if t._bin[-1] % SAMPLE_N]
+    emitted = [t for t in tids if not t._bin[-1] % SAMPLE_N]
+    assert sampled_out and emitted, "need both coin classes to test"
+    for t in tids:
+        assert "FINISHED" in by_tid.get(t.hex(), set()), \
+            f"terminal event sampled away for {t.hex()}"
+    for t in sampled_out:
+        assert not by_tid[t.hex()] & {"SUBMITTED", "RUNNING"}, \
+            f"half-sampled trail for {t.hex()}"
+    for t in emitted:
+        assert "SUBMITTED" in by_tid[t.hex()]
+
+    # explain answers for a task whose SUBMITTED/RUNNING was sampled out.
+    trail = state.explain(sampled_out[0].hex())
+    assert trail["kind"] == "task"
+    assert trail["state"] == "FINISHED"
+
+
+def test_counters_surface_what_sampling_hid(sampled_cluster):
+    from ray_tpu.core.core_worker import global_worker
+    N = 64
+    tids = _run_batch(N)
+    _drain_events()
+
+    owner = global_worker().address
+    planes = state.sched_stats().get("submit_plane") or {}
+    assert owner in planes, f"no submit-plane counters for owner {owner}"
+    c = planes[owner]
+    assert c["sample_n"] == SAMPLE_N
+    # every suppressed payload was counted: at least one suppression per
+    # sampled-out task (its SUBMITTED), and every terminal emitted
+    n_out = sum(1 for t in tids if t._bin[-1] % SAMPLE_N)
+    assert c["events_sampled"] >= n_out
+    assert c["events_emitted"] >= N
+    assert c["events_shed"] == 0
+    # the pooled plane actually ran warm: templates + free list hits
+    assert c["native_enabled"] is True
+    assert c["freelist_hits"] > 0
+
+
+def test_disabled_plane_restores_full_event_trails():
+    """The off switch is total: ctor path, per-spec encode, and an
+    UNSAMPLED event trail for every task."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "submit_plane_native_enabled": False,
+        "task_event_sample_n": 1,
+    })
+    try:
+        tids = _run_batch(16)
+        _drain_events()
+        events = state.list_tasks(limit=100_000)
+        ours = {t.hex() for t in tids}
+        by_tid = {}
+        for ev in events:
+            if ev.get("task_id") in ours:
+                by_tid.setdefault(ev["task_id"], set()).add(ev.get("state"))
+        for t in tids:
+            assert {"SUBMITTED", "FINISHED"} <= by_tid.get(t.hex(), set())
+        from ray_tpu.core.core_worker import global_worker
+        planes = state.sched_stats().get("submit_plane") or {}
+        c = planes.get(global_worker().address)
+        if c is not None:
+            assert c["native_enabled"] is False
+            assert c["events_sampled"] == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_calls_sampled_and_counted(sampled_cluster):
+    """Actor method calls ride the same plane: terminals exact under
+    sampling, and the per-handle template path stays correct."""
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    N = 40
+    vals = ray_tpu.get([c.bump.remote() for _ in range(N)])
+    assert vals == list(range(1, N + 1))
+    _drain_events()
+    summ = state.summarize_tasks()
+    assert summ["cluster"]["bump"].get("FINISHED") == N

@@ -1,0 +1,153 @@
+"""The native submit-plane encoder is rebuildable, byte-identical to the
+pure-Python fallback, and can only ever DEGRADE — never break — import or
+submission.
+
+Three properties pinned here (the CI face of the ``ray_tpu/native``
+extension):
+
+* the extension rebuilds from ``submit_plane.cpp`` alone with the stock
+  toolchain (``g++ -O2 -shared -fPIC -std=c++17``) in a scratch dir — no
+  reliance on the checked-in ``.so``;
+* ``sp_pack`` output is byte-for-byte identical to ``_py_pack`` for
+  adversarial record batches (empty args, empty/sticky traces, big
+  blobs), and ``sp_scan``'s decode round-trips both;
+* a wedged or unbuildable ``.so`` degrades to the fallback with exactly
+  ONE RuntimeWarning — ``load_submit_plane`` returns None, stays None,
+  and ``pack_specs`` keeps working.
+"""
+
+import ctypes
+import pathlib
+import shutil
+import subprocess
+import warnings
+
+import pytest
+
+from ray_tpu.core.spec_cache import _py_pack, unpack_specs
+import ray_tpu.native as native
+
+NATIVE_DIR = pathlib.Path(native.__file__).resolve().parent
+CPP = NATIVE_DIR / "submit_plane.cpp"
+
+#: adversarial batch: empty args, empty trace, 1-byte payloads, a blob
+#: crossing typical small-buffer sizes, and repeated hashes
+def _sample_recs():
+    h1 = bytes(range(16))
+    h2 = b"\xff" * 16
+    t = lambda i: i.to_bytes(16, "little")
+    return [
+        (h1, t(1), 0, 1, b"", b""),
+        (h1, t(2), 3, 2, b"x", b""),
+        (h2, t(3), 0, 3, b"args-payload" * 7, b"trace-ctx"),
+        (h2, t(4), 2 ** 32 - 1, 2 ** 64 - 1, b"\x00" * 4096, b"\x01" * 33),
+        (h1, t(5), 1, 10, b"tail", b""),
+    ]
+
+
+def _configure(lib):
+    lib.sp_pack.restype = ctypes.c_int64
+    lib.sp_scan.restype = ctypes.c_int32
+
+
+def _pack_with(lib, recs):
+    n = len(recs)
+    total = 8 + sum(52 + len(a) + len(tr) for _h, _t, _r, _s, a, tr in recs)
+    buf = bytearray(total)
+    wrote = lib.sp_pack(
+        (ctypes.c_char * total).from_buffer(buf),
+        ctypes.c_uint64(total), ctypes.c_uint32(n),
+        b"".join(r[0] for r in recs), b"".join(r[1] for r in recs),
+        (ctypes.c_uint32 * n)(*[r[2] for r in recs]),
+        (ctypes.c_uint64 * n)(*[r[3] for r in recs]),
+        (ctypes.c_char_p * n)(*[r[4] for r in recs]),
+        (ctypes.c_uint32 * n)(*[len(r[4]) for r in recs]),
+        (ctypes.c_char_p * n)(*[r[5] or None for r in recs]),
+        (ctypes.c_uint32 * n)(*[len(r[5]) for r in recs]))
+    assert wrote == total, f"sp_pack wrote {wrote}, frame is {total}"
+    return buf
+
+
+def test_rebuilds_from_source_and_matches_python_packer(tmp_path):
+    """Scratch-dir rebuild from the .cpp + byte-for-byte vs _py_pack +
+    sp_scan round-trip through the shared unpack path."""
+    src = tmp_path / "submit_plane.cpp"
+    shutil.copyfile(CPP, src)
+    so = tmp_path / "libsubmitplane_ci.so"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         str(src), "-o", str(so)],
+        check=True, capture_output=True, timeout=120)
+
+    lib = ctypes.CDLL(str(so))
+    _configure(lib)
+    recs = _sample_recs()
+    native_frame = _pack_with(lib, recs)
+    py_frame = _py_pack(recs)
+    assert bytes(native_frame) == bytes(py_frame), \
+        "fresh native build diverges from the pure-Python packer"
+
+    # scan side: decode both frames through the shared unpack path
+    # (readonly bytes exercises the from_buffer_copy branch too)
+    for frame in (native_frame, bytes(py_frame)):
+        assert unpack_specs(frame) == recs
+
+
+def test_python_fallback_roundtrips_without_native():
+    recs = _sample_recs()
+    frame = _py_pack(recs)
+    assert unpack_specs(bytes(frame)) == recs
+
+
+def _reset_loader(monkeypatch, build_result):
+    """Fresh loader state with _build_lib forced to `build_result`."""
+    monkeypatch.setattr(native, "_SP_LIB", None)
+    monkeypatch.setattr(native, "_SP_FAILED", False)
+    monkeypatch.setattr(native, "_build_lib",
+                        lambda *a, **k: build_result)
+
+
+def test_wedged_so_degrades_with_one_warning(monkeypatch, tmp_path):
+    """A cached .so full of garbage (half-written build, wrong arch) must
+    not break anything: one warning, None forever after, packing falls
+    back byte-identically."""
+    junk = tmp_path / "libsubmitplane.so"
+    junk.write_bytes(b"\x7fNOT-AN-ELF" + b"\x00" * 64)
+    _reset_loader(monkeypatch, str(junk))
+
+    with pytest.warns(RuntimeWarning, match="submit-plane"):
+        assert native.load_submit_plane() is None
+    assert native._SP_FAILED is True
+    assert native.submit_plane_loaded() is False
+
+    # second call: still None, and NO second warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert native.load_submit_plane() is None
+
+    # the frame path keeps working on the fallback
+    from ray_tpu.core.spec_cache import pack_specs
+    recs = _sample_recs()
+    assert bytes(pack_specs(recs)) == bytes(_py_pack(recs))
+    assert unpack_specs(bytes(pack_specs(recs))) == recs
+
+
+def test_failed_build_degrades_with_one_warning(monkeypatch):
+    """No compiler / failed compile: _build_lib yields None — same single
+    warning, import-safe degradation."""
+    _reset_loader(monkeypatch, None)
+    with pytest.warns(RuntimeWarning):
+        assert native.load_submit_plane() is None
+    assert native.submit_plane_loaded() is False
+
+
+def test_stale_build_missing_symbols_degrades(monkeypatch):
+    """An OLD .so that loads but predates sp_pack/sp_scan (AttributeError
+    on symbol lookup) degrades exactly like a wedged one."""
+    other = NATIVE_DIR / "libcrc32c.so"
+    if not other.exists():
+        pytest.skip("no second extension to impersonate a stale build")
+    _reset_loader(monkeypatch, str(other))
+    with pytest.warns(RuntimeWarning):
+        assert native.load_submit_plane() is None
+    assert native.submit_plane_loaded() is False
