@@ -126,6 +126,10 @@ def bench_depth(depth: int, system_config: dict | None = None) -> dict:
             # the busy-fraction windows still reflect steady state
             "saturation": _saturation_snapshot(),
         })
+        # what the health plane made of the drain (EVENTS_SHED /
+        # GCS_HANDLER_HOT raises land here when the depth provokes them)
+        from ray_tpu.util import health
+        out["health"] = health.alert_trail()
     finally:
         ray_tpu.shutdown()
     return out

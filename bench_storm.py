@@ -101,6 +101,7 @@ def main():
     from ray_tpu import serve
     from ray_tpu.core.cluster import Cluster
     from ray_tpu.serve import loadgen
+    from ray_tpu.util import health
 
     # shrink the replicas' rolling SLO window so post-storm recovery is
     # visible inside the cooldown phase (node subprocesses inherit this
@@ -249,6 +250,9 @@ def main():
             "decisions": decisions,
             "chaos": chaos_rec,
             "acceptance": acceptance,
+            # the storm as the health plane saw it (TTFT_BREACH /
+            # SLO_SIGNAL_STALE raises + clears across the phases)
+            "health": health.alert_trail(),
         }
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)

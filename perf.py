@@ -551,6 +551,85 @@ def run_ab_sched_obs(S: float, pairs: int) -> dict:
             "off_config": SCHED_OBS_OFF, "ratio_on_off": ratio}
 
 
+#: both arms of the health-plane A/B run the detectors' tick cadences
+#: HOT (2 Hz health check + scrape, dashboard head up) so the on-arm pays
+#: every cost the plane can impose; the off-arm differs by ONE switch.
+HEALTH_AB_BASE = {"health_check_period_s": 0.5,
+                  "metrics_scrape_period_s": 0.5}
+HEALTH_OFF = {"health_metrics_enabled": False}
+
+
+def _measure_health(S: float, system_config: dict | None) -> dict:
+    """One fresh-cluster measurement of the health-plane A/B arms:
+    submit_churn (window-deep submit/drain — the owner/GCS loops the
+    GCS-side rules watch) + serve_noop req/s (the loop the head-side
+    SLO rules watch), with the dashboard head running so the scrape-loop
+    detector is actually on the clock."""
+    import collections
+    import ray_tpu
+    from ray_tpu import serve
+    cfg = dict(HEALTH_AB_BASE)
+    cfg.update(system_config or {})
+    ray_tpu.init(num_cpus=8, _system_config=cfg)
+    out = {}
+    try:
+        from ray_tpu.dashboard import head as dash_head
+        dash_head.start_dashboard()
+
+        @ray_tpu.remote
+        def noop(_x=None):
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(8)])
+        nc = int(2000 * S)
+        window = 500
+
+        def churn():
+            dq = collections.deque()
+            for _ in range(nc):
+                dq.append(noop.remote())
+                if len(dq) >= window:
+                    ray_tpu.get(dq.popleft())
+            ray_tpu.get(list(dq))
+
+        out["submit_churn"] = max(timeit(churn, nc))
+
+        @serve.deployment(num_replicas=2, max_concurrent_queries=64)
+        def snoop(_x=None):
+            return b"ok"
+
+        h = serve.run(snoop)
+        for _ in range(20):
+            h.remote().result()
+        n = int(300 * S)
+        out["serve_noop_req_s"] = max(timeit(
+            lambda: [h.remote().result() for _ in range(n)], n))
+        serve.shutdown()
+        dash_head.stop_dashboard()
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_health(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: health_metrics_enabled on vs off over
+    submit_churn + serve_noop with hot detector cadences (the ISSUE-17
+    acceptance gate: <= 5% overhead; off restores zero series)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_health(S, None))
+        off_runs.append(_measure_health(S, dict(HEALTH_OFF)))
+        print(f"# health ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {k: round(med([r[k] for r in on_runs])
+                      / max(med([r[k] for r in off_runs]), 1e-9), 3)
+             for k in on_runs[0]}
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "off_config": HEALTH_OFF, "base_config": HEALTH_AB_BASE,
+            "ratio_on_off": ratio}
+
+
 #: the "off" arm of the object-observability A/B: the object plane's one
 #: kill switch — no raytpu_object_*/raytpu_mem_* series, no flight-recorder
 #: events, no copy-ledger accounting, no transfer-ring writes.
@@ -981,6 +1060,11 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of the "
                         "native submission plane (pooled specs + packed "
                         "C frames + sampled events) on vs off")
+    p.add_argument("--ab-health", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of "
+                        "health_metrics_enabled on vs off (submit_churn "
+                        "+ serve_noop with hot detector cadences; the "
+                        "health-plane overhead gate)")
     p.add_argument("--profile-submit", action="store_true",
                    help="profile one warm submission: per-stage µs "
                         "(spec build / encode / events / refcount / "
@@ -1044,6 +1128,8 @@ def main():
     if args.ab_object > 0:
         out["object_obs_ab"] = run_ab_object_obs(args.scale,
                                                  args.ab_object)
+    if args.ab_health > 0:
+        out["health_ab"] = run_ab_health(args.scale, args.ab_health)
     if args.ab_zcput > 0:
         out["zcput_ab"] = run_ab_zcput(args.scale, args.ab_zcput)
     if args.ab_submitplane > 0:

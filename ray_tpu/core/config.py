@@ -486,6 +486,27 @@ class Config:
     #: Ring buffer size for task state-transition events
     #: (reference: TaskEventBuffer, task_event_buffer.h).
     task_events_max_buffer: int = 100_000
+    #: Health plane (util/health.py): rule-based anomaly detection over
+    #: the existing observability surfaces, typed Alerts into a bounded
+    #: GCS ring, ``raytpu_health_alerts_total{rule,severity}`` /
+    #: ``raytpu_health_active_alerts{rule}``.  ONE kill switch: off means
+    #: zero raytpu_health_* series AND no detector CPU (the head scrape
+    #: hook and the GCS snapshot hook skip evaluation entirely); the ring
+    #: stays queryable and ``raytpu doctor`` still evaluates on demand.
+    health_metrics_enabled: bool = True
+    #: Bounded ring of alert transition events kept by the GCS (the
+    #: sched_decision ring pattern applied to health).
+    health_ring_len: int = 512
+    #: Alert transitions older than this age out of the ring.
+    health_alert_max_age_s: float = 3600.0
+    #: Hysteresis: a rule's value must hold at/above raise_at this long
+    #: before an alert raises (rules that ARE their own sustain signal —
+    #: EVENTS_SHED, NODE_FLAPPING — override to 0).
+    health_raise_hold_s: float = 10.0
+    #: Hysteresis: an active alert clears only after its value holds
+    #: at/below clear_at this long (and the alert is at least this old)
+    #: — the min-hold that stops raise/clear flapping.
+    health_min_hold_s: float = 30.0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

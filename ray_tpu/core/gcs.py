@@ -115,6 +115,19 @@ class GcsServer:
         self.object_events: deque = deque(
             maxlen=max(64, cfg.object_event_ring_len))
         self.object_events_dropped = 0
+        # Health plane (util/health.py): bounded age-out ring of alert
+        # transition events (raised/cleared) — the sched_decision ring
+        # pattern applied to health.  The GCS evaluates its two
+        # process-local rules at health-check cadence; the dashboard
+        # head flushes its rule subset here so ``state.health()`` /
+        # ``raytpu doctor`` see ONE trail regardless of who detected.
+        self.health_alerts: deque = deque(
+            maxlen=max(16, cfg.health_ring_len))
+        self._health_detector = None
+        self._health_prev: Dict[str, object] = {}
+        #: latest active-alert list per external detector ("head"), with
+        #: its push timestamp — stale pushes age out of handle_health
+        self._health_active_ext: Dict[str, dict] = {}
         self._handler_busy: Dict[str, float] = {}
         self._handler_calls: Dict[str, int] = {}
         self._gcs_hist_keys: Dict[str, tuple] = {}  # precomputed tag keys
@@ -659,6 +672,49 @@ class GcsServer:
             for nid, n in list(self.nodes.items()):
                 if n.alive and now - self.node_last_seen.get(nid, now) > deadline:
                     await self._mark_node_dead(nid, reason="heartbeat timeout")
+            try:
+                self._health_tick()
+            except Exception:
+                pass  # the detector must never take down liveness checks
+
+    def _health_tick(self):
+        """GCS-side health rules (EVENTS_SHED, GCS_HANDLER_HOT) over
+        process-local counters this object already maintains — dict
+        walks at health-check cadence, no RPCs, no per-task work.  With
+        the kill switch off this is ONE boolean check."""
+        from ray_tpu.util import health as health_plane
+        if not health_plane.enabled():
+            self._health_detector = None  # next enable starts clean
+            return
+        now = time.time()
+        det = self._health_detector
+        if det is None:
+            # first enabled tick: baseline the cumulative counters so
+            # pre-existing sheds don't fire a stale alert
+            self._health_detector = health_plane.gcs_detector()
+            self._health_prev = {"ts": now,
+                                 "shed": self.task_events_dropped,
+                                 "busy": dict(self._handler_busy)}
+            return
+        prev = self._health_prev
+        dt = max(1e-9, now - float(prev.get("ts", now)))
+        shed_delta = self.task_events_dropped - int(prev.get("shed", 0))
+        prev_busy = prev.get("busy") or {}
+        busy_frac = {}
+        for method, busy in self._handler_busy.items():
+            d = busy - prev_busy.get(method, 0.0)
+            if d > 0:
+                busy_frac[method] = d / dt
+        self._health_prev = {"ts": now, "shed": self.task_events_dropped,
+                             "busy": dict(self._handler_busy)}
+        snap = {"now": now, "events_shed": max(0, shed_delta),
+                "events_shed_total": self.task_events_dropped,
+                "handler_busy": busy_frac}
+        events = det.observe(snap, now)
+        health_plane.record_transitions(events, det)
+        if events:
+            self._prune_health_alerts()
+            self.health_alerts.extend(events)
 
     async def _mark_node_dead(self, node_id: str, reason: str):
         n = self.nodes.get(node_id)
@@ -1409,6 +1465,73 @@ class GcsServer:
         out["tiers"] = sorted({e.get("tier") for e in events
                                if e.get("tier")})
         return out
+
+    # ------------------------------------------------------- health plane
+
+    def _prune_health_alerts(self):
+        max_age = get_config().health_alert_max_age_s
+        if max_age <= 0:
+            return
+        cutoff = time.time() - max_age
+        d = self.health_alerts
+        while d and d[0].get("ts", 0.0) < cutoff:
+            d.popleft()
+
+    async def handle_add_health_alerts(self, records: List[dict],
+                                       active: Optional[List[dict]] = None,
+                                       source: str = "head"):
+        """Alert transitions from an external detector (the dashboard
+        head's scrape-loop rule subset) land in the same ring as the
+        GCS's own; ``active`` is that detector's full current active
+        set (latest push wins — handle_health merges it while fresh)."""
+        self._prune_health_alerts()
+        self.health_alerts.extend(records)
+        if active is not None:
+            self._health_active_ext[source] = {"ts": time.time(),
+                                               "active": list(active)}
+        return True
+
+    async def handle_get_health_alerts(self, limit: int = 100,
+                                       rule: Optional[str] = None,
+                                       kind: Optional[str] = None):
+        """Newest-first tail of the alert transition ring."""
+        self._prune_health_alerts()
+        out: List[dict] = []
+        for rec in reversed(self.health_alerts):
+            if rule is not None and rec.get("rule") != rule:
+                continue
+            if kind is not None and rec.get("kind") != kind:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+    async def handle_health(self, limit: int = 50):
+        """The ``state.health()`` / ``GET /api/health`` payload: the
+        deduplicated active-alert set (GCS-side rules merged with the
+        head detector's freshest push) plus the recent transition
+        trail."""
+        from ray_tpu.util import health as health_plane
+        self._prune_health_alerts()
+        active: List[dict] = []
+        det = self._health_detector
+        if det is not None:
+            active.extend(det.active())
+        horizon = time.time() - max(
+            60.0, 4 * get_config().metrics_scrape_period_s)
+        for ent in self._health_active_ext.values():
+            if ent.get("ts", 0.0) >= horizon:
+                active.extend(ent.get("active") or [])
+        active.sort(key=lambda a: (a.get("severity") != "critical",
+                                   a.get("rule", ""), a.get("scope", "")))
+        return {
+            "enabled": health_plane.enabled(),
+            "active": active,
+            "recent": list(self.health_alerts)[-limit:][::-1],
+            "ring_len": len(self.health_alerts),
+            "rules": sorted(health_plane.HealthRule.ALL),
+        }
 
     async def handle_sched_stats(self):
         """Control-plane saturation rollup: per-handler cumulative busy

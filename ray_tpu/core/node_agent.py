@@ -134,6 +134,15 @@ def _build_telemetry_gauges():
             "raytpu_mem_leak_suspects",
             "ref-debt suspects on this node (pins past TTL + deferred "
             "frees stuck behind vanished pins)", tag_keys=("node",)),
+        "disk_used_frac": Gauge(
+            "raytpu_node_disk_used_fraction",
+            "used fraction of the filesystem holding the session dir "
+            "(logs + local spill) — the health plane's DISK_LOW input",
+            tag_keys=("node",)),
+        "disk_free": Gauge(
+            "raytpu_node_disk_free_bytes",
+            "free bytes on the session-dir filesystem",
+            tag_keys=("node",)),
     }
 
 
@@ -2959,6 +2968,18 @@ class NodeAgent:
                 for kinds in per.values() for count in kinds.values()),
             tags)
         g["oom_kills"].set(self._oom_kill_count, tags)
+        try:
+            # session-dir filesystem fullness (statvfs is a syscall, not
+            # a walk): logs + local spill land here, so this is the disk
+            # that takes the cluster down when it fills
+            st = os.statvfs(self.session_dir)
+            total = st.f_blocks * st.f_frsize
+            free = st.f_bavail * st.f_frsize
+            if total > 0:
+                g["disk_used_frac"].set(1.0 - free / total, tags)
+                g["disk_free"].set(free, tags)
+        except (OSError, ValueError):
+            pass
         avail = self.available.to_dict()
         for k, total in self.total.to_dict().items():
             rtags = {"node": tags["node"], "resource": k}

@@ -66,6 +66,11 @@ class DashboardHead:
         #: background scrape loop, serves /api/metrics (+/history)
         self.history = None
         self._scrape_task = None
+        #: health plane (util/health.py): the head-side rule subset runs
+        #: piggybacked on the scrape loop — None while the kill switch
+        #: is off (zero detector CPU, zero raytpu_health_* series)
+        self._health_detector = None
+        self._health_had_active = False
 
     # ---------------------------------------------------------- handlers
 
@@ -373,7 +378,55 @@ class DashboardHead:
                 raise
             except Exception:
                 pass
+            try:
+                # health detector rides the scrape tick it just paid for
+                await self._health_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
             await asyncio.sleep(store.period_s)
+
+    async def _health_tick(self):
+        """Evaluate the head-side health rules over the sample the
+        scrape loop just collected, then flush transitions + the active
+        set to the GCS alert ring.  With ``health_metrics_enabled``
+        off this is one boolean check — no snapshot walk, no detector
+        state, no series."""
+        from ray_tpu.util import health as health_plane
+        if not health_plane.enabled():
+            self._health_detector = None
+            self._health_had_active = False
+            return
+        from ray_tpu.util import state
+        store = self._ensure_history()
+        det = self._health_detector
+        if det is None:
+            det = self._health_detector = health_plane.head_detector()
+
+        def _slo():
+            try:
+                from ray_tpu import serve as serve_api
+                return serve_api.slo_signal()
+            except Exception:
+                return {}
+
+        slo = await _off(_slo)
+        snap = health_plane.build_head_snapshot(store, slo=slo)
+        events = det.observe(snap)
+        health_plane.record_transitions(events, det)
+        active = det.active()
+        if events or active or self._health_had_active:
+            # push on every interesting tick (and one trailing empty
+            # push so handle_health's merged active set drains to zero)
+            def _push():
+                try:
+                    state._gcs_call("add_health_alerts", records=events,
+                                    active=active, source="head")
+                except Exception:
+                    pass
+            await _off(_push)
+        self._health_had_active = bool(active)
 
     async def metrics(self, _req):
         """Freshest parsed /metrics sample per node, served from the
@@ -435,6 +488,20 @@ class DashboardHead:
         return _json({"ts": time.time(), "nodes": nodes,
                       "total_tasks": summary.get("total_tasks", 0),
                       "stage_latency": summary.get("stage_latency", {})})
+
+    async def health_view(self, _req):
+        """Health plane: deduplicated active alerts + the recent
+        transition trail from the GCS ring (``state.health()`` shape) —
+        the Health tab's feed and the REST twin of ``raytpu doctor``."""
+        from ray_tpu.util import state
+
+        def _health():
+            try:
+                return state.health()
+            except Exception as e:  # noqa: BLE001 — surfaced to the API
+                return {"error": str(e)}
+
+        return _json(await _off(_health))
 
     async def sched(self, req):
         """Scheduler explain plane rollup: pending-reason counts, the
@@ -581,6 +648,7 @@ class DashboardHead:
         r.add_get("/api/metrics", self.metrics)
         r.add_get("/api/metrics/history", self.metrics_history)
         r.add_get("/api/telemetry", self.telemetry)
+        r.add_get("/api/health", self.health_view)
         r.add_get("/api/sched", self.sched)
         r.add_get("/api/tasks/summarize", self.tasks_summarize)
         r.add_get("/api/objects", self.objects)

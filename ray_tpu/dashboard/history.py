@@ -87,13 +87,22 @@ class MetricsHistory:
     zero, so the derived rate is ``new_value / dt`` rather than a bogus
     negative."""
 
-    def __init__(self, window_s: float = 600.0, period_s: float = 5.0):
+    def __init__(self, window_s: float = 600.0, period_s: float = 5.0,
+                 stale_after_s: Optional[float] = None):
         self.window_s = float(window_s)
         self.period_s = float(period_s)
+        #: a success gap longer than this marks a node DEPARTED-and-
+        #: REJOINED (vs a blip): its pre-gap sample tail is a previous
+        #: incarnation and ages out rather than being served as history
+        self.stale_after_s = (max(3 * self.period_s, 15.0)
+                              if stale_after_s is None
+                              else float(stale_after_s))
         self._maxlen = max(4, int(self.window_s
                                   / max(self.period_s, 0.1)) + 2)
         #: node -> deque[(ts, samples-or-None, error-or-None)]
         self._samples: Dict[str, Deque[tuple]] = {}
+        #: node -> ts of its newest GOOD sample (rejoin detection)
+        self._last_success: Dict[str, float] = {}
         self._counters: set = set()
         self._lock = threading.Lock()
 
@@ -106,6 +115,15 @@ class MetricsHistory:
             dq = self._samples.get(node)
             if dq is None:
                 dq = self._samples[node] = deque(maxlen=self._maxlen)
+            last_ok = self._last_success.get(node)
+            if last_ok is not None and ts - last_ok > self.stale_after_s:
+                # rejoin after a dark gap: drop the stale good-sample
+                # tail (the error markers stay — they are the flap
+                # evidence); rates re-chain from this fresh sample
+                kept = [e for e in dq if e[1] is None]
+                dq.clear()
+                dq.extend(kept)
+            self._last_success[node] = ts
             dq.append((ts, samples, None))
             if counters:
                 self._counters.update(counters)
@@ -129,6 +147,7 @@ class MetricsHistory:
     def forget(self, node: str) -> None:
         with self._lock:
             self._samples.pop(node, None)
+            self._last_success.pop(node, None)
 
     # ------------------------------------------------------------- reads
 
@@ -209,6 +228,27 @@ class MetricsHistory:
                             [round(ts, 3), delta / dt])
             prev = (ts, samples)
         return out
+
+    def flaps(self, node: str, window_s: Optional[float] = None,
+              now: Optional[float] = None) -> int:
+        """Error->success transitions for a node inside the window — the
+        NODE_FLAPPING evidence.  Trustworthy because ``add_sample`` ages
+        out pre-rejoin tails: every counted recovery happened inside
+        THIS incarnation's retained history."""
+        now = time.time() if now is None else now
+        horizon = now - (self.window_s if window_s is None else window_s)
+        with self._lock:
+            items = list(self._samples.get(node) or ())
+        count = 0
+        prev_err: Optional[bool] = None
+        for ts, _samples, err in items:
+            if ts < horizon:
+                continue
+            is_err = err is not None
+            if prev_err is True and not is_err:
+                count += 1
+            prev_err = is_err
+        return count
 
     def summary(self, node: str) -> dict:
         with self._lock:

@@ -35,9 +35,9 @@ function table(cols, rows) {
 
 function badge(text) {
   const s = String(text || "").toUpperCase();
-  const cls = ["ALIVE", "RUNNING", "FINISHED", "CREATED", "SUCCEEDED", "HEALTHY", "INFO", "DEBUG"].includes(s)
+  const cls = ["ALIVE", "RUNNING", "FINISHED", "CREATED", "SUCCEEDED", "HEALTHY", "INFO", "DEBUG", "CLEARED"].includes(s)
     ? "ok" : ["PENDING", "RESTARTING", "WAITING", "UPDATING", "WARNING"].includes(s)
-    ? "warn" : ["DEAD", "FAILED", "STOPPED", "INFEASIBLE", "UNHEALTHY", "ERROR", "FATAL"].includes(s)
+    ? "warn" : ["DEAD", "FAILED", "STOPPED", "INFEASIBLE", "UNHEALTHY", "ERROR", "FATAL", "CRITICAL", "RAISED"].includes(s)
     ? "err" : "";
   const el = h("span", { class: "badge " + cls }, s || "?");
   return el;
@@ -198,6 +198,36 @@ const pages = {
           Object.entries(r.rejected || {}).slice(0, 4)
             .map(([n, c]) => `${n.slice(0, 8)}=${c}`).join(" "),
           r.task_count ?? ""])));
+  },
+
+  async health() {
+    /* Health plane (/api/health): deduplicated active alerts + the
+       recent raised/cleared transition ring — the REST twin of
+       `raytpu doctor`. */
+    const d = await api("health");
+    const active = d.active || [];
+    const recent = d.recent || [];
+    const ev2s = (e) =>
+      Object.entries(e || {}).map(([k, v]) => `${k}=${v}`).join(" ");
+    return h("div", {},
+      h("h2", {}, "Health"),
+      h("div", { class: "cards" },
+        card("active alerts", active.length),
+        card("detectors", d.enabled ? "on" : "OFF (doctor on demand)"),
+        card("ring", d.ring_len ?? 0),
+        card("rules", (d.rules || []).length)),
+      h("h2", {}, "Active alerts"),
+      active.length
+        ? table(["severity", "rule", "scope", "since", "evidence", "next step"],
+            active.map((a) => [badge(a.severity), a.rule, a.scope,
+              new Date((a.since_ts || 0) * 1000).toLocaleTimeString(),
+              ev2s(a.evidence), a.next_step || ""]))
+        : h("p", { class: "muted" }, "none — no rule above its raise threshold"),
+      h("h2", {}, `Transitions (${recent.length} newest)`),
+      table(["time", "kind", "rule", "scope", "evidence"],
+        recent.map((ev) => [
+          new Date((ev.ts || 0) * 1000).toLocaleTimeString(),
+          badge(ev.kind), ev.rule, ev.scope, ev2s(ev.evidence)])));
   },
 
   async objects() {

@@ -761,6 +761,28 @@ def _render_top(store, alive_nodes) -> str:
             parts.append(f"{name.split(':', 1)[1]}={(b or 0) * 100:.0f}%")
         if parts:
             lines.append("CONTROL  busy: " + "  ".join(parts))
+
+    # health plane: the deduplicated active-alert set from the GCS ring
+    # (GCS-side + dashboard-head detectors) — top answers "is anything
+    # wrong" without a second command
+    try:
+        from ray_tpu.util import state as _state_api
+        h = _state_api.health()
+    except Exception:
+        h = None
+    if h is not None:
+        active = h.get("active") or []
+        if active:
+            shown = ", ".join(
+                f"{a.get('rule')}({a.get('scope')})" for a in active[:4])
+            more = f" +{len(active) - 4} more" if len(active) > 4 else ""
+            lines.append(f"ALERTS {len(active)} active: {shown}{more}"
+                         "  (raytpu doctor for evidence)")
+        elif h.get("enabled"):
+            lines.append("ALERTS none")
+        else:
+            lines.append("ALERTS (health_metrics_enabled off; "
+                         "raytpu doctor still evaluates on demand)")
     return "\n".join(lines)
 
 
@@ -789,6 +811,234 @@ def cmd_top(args):
             alive = _scrape_cluster_frame(rt, store)
             # clear screen + home, then the frame
             print("\x1b[2J\x1b[H" + _render_top(store, alive), flush=True)
+    except KeyboardInterrupt:
+        pass
+
+
+# ----------------------------------------------------------- health plane
+
+def _doctor_snapshot(rt):
+    """The one-shot evidence snapshot behind ``raytpu doctor``: two
+    metric frames (so rates exist), the serve SLO signal, sched_stats
+    (events shed, hot handlers), and the on-demand leak sweep — the same
+    surfaces the background detectors watch, pulled fresh."""
+    from ray_tpu.dashboard.history import MetricsHistory
+    from ray_tpu.util import health as health_plane
+    from ray_tpu.util import state as state_api
+
+    store = MetricsHistory(window_s=60.0, period_s=1.0)
+    _scrape_cluster_frame(rt, store)
+    time.sleep(1.0)
+    _scrape_cluster_frame(rt, store)
+    try:
+        stats = state_api.sched_stats()
+    except Exception:
+        stats = {}
+    try:
+        from ray_tpu import serve as serve_api
+        slo = serve_api.slo_signal()
+    except Exception:
+        slo = {}
+    snap = health_plane.build_head_snapshot(store, slo=slo,
+                                            sched_stats=stats)
+    snap["oneshot"] = True
+    leak_rows = []
+    try:
+        leak_rows = state_api.memory_leaks()
+    except Exception:
+        pass
+    if leak_rows and not snap.get("leak_suspects"):
+        # agents answered the sweep but their gauge sample is stale or
+        # object telemetry is off — the sweep is the authority
+        snap["leak_suspects"] = {"all": len(leak_rows)}
+    return snap, leak_rows
+
+
+def _print_alert(a, t0=None):
+    sev = a.get("severity", "?").upper()
+    since = a.get("since_ts")
+    age = f" for {time.time() - since:.0f}s" if since else ""
+    print(f"  [{sev:<8}] {a.get('rule')}  scope={a.get('scope')}{age}")
+    ev = a.get("evidence") or {}
+    if ev:
+        print("             evidence: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(ev.items())))
+    if a.get("next_step"):
+        print(f"             next: {a['next_step']}")
+
+
+def cmd_doctor(args):
+    """``raytpu doctor`` — one-shot cluster diagnosis: every health rule
+    evaluated NOW (no hysteresis hold) over a fresh evidence pull,
+    merged with the active alerts the background detectors hold, each
+    with its evidence snapshot and the explain-surface to run next.
+    Works with health_metrics_enabled off (on-demand evaluation is
+    requested work, not background CPU)."""
+    rt = _connect()
+    from ray_tpu.util import health as health_plane
+    from ray_tpu.util import state as state_api
+
+    snap, leak_rows = _doctor_snapshot(rt)
+    findings = health_plane.evaluate_oneshot(snap)
+    try:
+        ring = state_api.health(limit=getattr(args, "limit", 20))
+    except Exception:
+        ring = {}
+    # merge: a background alert for the same (rule, scope) wins — its
+    # since_ts covers the whole episode, not just this probe
+    merged = {(a.get("rule"), a.get("scope")): a
+              for a in findings}
+    for a in (ring.get("active") or []):
+        merged[(a.get("rule"), a.get("scope"))] = a
+    alerts = sorted(merged.values(),
+                    key=lambda a: (a.get("severity") != "critical",
+                                   a.get("rule", ""), a.get("scope", "")))
+    if getattr(args, "json", False):
+        print(json.dumps({"alerts": alerts, "recent": ring.get("recent"),
+                          "leak_rows": leak_rows},
+                         indent=2, default=str))
+        return
+    nodes = [n for n in rt.nodes() if n.get("Alive")]
+    print(f"raytpu doctor — {len(nodes)} alive node(s), "
+          f"{len(alerts)} finding(s)")
+    if not alerts:
+        print("  healthy: no rule above its raise threshold "
+              f"({len(health_plane.HealthRule.ALL)} rules evaluated)")
+        return
+    for a in alerts:
+        _print_alert(a)
+    if leak_rows:
+        print(f"leak sweep detail ({len(leak_rows)} suspect(s)):")
+        for r in leak_rows[:10]:
+            print(f"  {r.get('kind')}: object={str(r.get('object_id'))[:16]} "
+                  f"holder={r.get('holder')} age={r.get('age_s')}s "
+                  f"pins={r.get('pins')}")
+    recent = ring.get("recent") or []
+    if recent:
+        print(f"recent transitions ({len(recent)}):")
+        for ev in recent[:10]:
+            ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+            print(f"  {ts}  {ev.get('kind', '?'):<8} {ev.get('rule')}"
+                  f"  scope={ev.get('scope')}")
+
+
+def cmd_alerts(args):
+    """``raytpu alerts [--follow]`` — the health alert ring: active
+    alerts + recent raised/cleared transitions, newest first.
+    ``--follow`` polls and prints new transitions as they land."""
+    _connect()
+    from ray_tpu.util import state as state_api
+
+    def frame():
+        return state_api.health(limit=args.limit)
+
+    h = frame()
+    if getattr(args, "json", False):
+        print(json.dumps(h, indent=2, default=str))
+        return
+    active = h.get("active") or []
+    print(f"active alerts: {len(active)}"
+          + ("" if h.get("enabled")
+             else "  (health_metrics_enabled off — background detectors "
+                  "idle; ring shows history only)"))
+    for a in active:
+        _print_alert(a)
+    recent = h.get("recent") or []
+    if recent:
+        print(f"recent transitions ({len(recent)}):")
+        for ev in recent:
+            ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+            print(f"  {ts}  {ev.get('kind', '?'):<8} {ev.get('rule')}"
+                  f"  scope={ev.get('scope')}")
+    if not getattr(args, "follow", False):
+        return
+    seen = {(ev.get("ts"), ev.get("kind"), ev.get("rule"), ev.get("scope"))
+            for ev in recent}
+    try:
+        while True:
+            time.sleep(max(args.interval, 0.2))
+            try:
+                h = frame()
+            except Exception:
+                continue
+            for ev in reversed(h.get("recent") or []):  # oldest first
+                key = (ev.get("ts"), ev.get("kind"), ev.get("rule"),
+                       ev.get("scope"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                ts = time.strftime("%H:%M:%S",
+                                   time.localtime(ev.get("ts", 0)))
+                print(f"{ts}  {ev.get('kind', '?'):<8} {ev.get('rule')}"
+                      f"  scope={ev.get('scope')}  "
+                      + ", ".join(f"{k}={v}" for k, v in
+                                  sorted((ev.get("evidence") or {}).items())),
+                      flush=True)
+    except KeyboardInterrupt:
+        pass
+
+
+# -------------------------------------------------------------------- logs
+
+def cmd_logs(args):
+    """``raytpu logs <node-id> [name] [--follow]`` — a node's log files
+    via its agent's list_logs/tail_log RPCs: no name lists them (name +
+    size); with a name, prints the tail (``--follow`` keeps polling and
+    prints what grew) — where a doctor alert's next-step points when the
+    evidence lives in a worker/agent log."""
+    rt = _connect()
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.core.rpc import run_async
+
+    target = None
+    for n in rt.nodes():
+        if not (n.get("Alive") and n.get("AgentAddress")):
+            continue
+        if n["NodeID"].startswith(args.node):
+            target = n
+            break
+    if target is None:
+        raise SystemExit(f"no alive node matching {args.node!r}")
+    client = global_worker().agent_clients.get(target["AgentAddress"])
+
+    if not args.name:
+        rows = run_async(client.call("list_logs"))
+        if not rows:
+            print("(no log files)")
+            return
+        for r in sorted(rows, key=lambda r: r.get("name", "")):
+            print(f"{_fmt_bytes(r.get('size')):>10}  {r.get('name')}")
+        return
+
+    def tail():
+        return run_async(client.call("tail_log", name=args.name,
+                                     nbytes=args.nbytes))
+
+    text = tail()
+    print(text, end="" if text.endswith("\n") else "\n")
+    if not args.follow:
+        return
+    prev = text
+    try:
+        while True:
+            time.sleep(1.0)
+            try:
+                text = tail()
+            except Exception:
+                continue
+            if text == prev:
+                continue
+            if text.startswith(prev):
+                delta = text[len(prev):]
+            else:
+                # the tail window slid: re-anchor on the old tail's end
+                probe = prev[-256:]
+                idx = text.find(probe) if probe else -1
+                delta = text[idx + len(probe):] if idx >= 0 else text
+            if delta:
+                print(delta, end="" if delta.endswith("\n") else "\n",
+                      flush=True)
+            prev = text
     except KeyboardInterrupt:
         pass
 
@@ -1110,6 +1360,35 @@ def main(argv=None):
     s.add_argument("--interval", type=float, default=2.0,
                    help="refresh/scrape period in seconds")
     s.set_defaults(fn=cmd_top)
+
+    s = sub.add_parser("doctor", help="one-shot cluster diagnosis: every "
+                       "health rule evaluated now + active alerts, each "
+                       "with evidence and the explain-surface to run next")
+    s.add_argument("--json", action="store_true")
+    s.add_argument("--limit", type=int, default=20,
+                   help="recent-transition tail length")
+    s.set_defaults(fn=cmd_doctor)
+
+    s = sub.add_parser("alerts", help="health alert ring: active alerts + "
+                       "recent raised/cleared transitions "
+                       "(--follow streams new ones)")
+    s.add_argument("--follow", action="store_true")
+    s.add_argument("--limit", type=int, default=50)
+    s.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll period in seconds")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_alerts)
+
+    s = sub.add_parser("logs", help="list / tail a node's log files via "
+                       "its agent (no name: list; name: tail, "
+                       "--follow streams growth)")
+    s.add_argument("node", help="node id prefix")
+    s.add_argument("name", nargs="?", default=None,
+                   help="log file name from the listing")
+    s.add_argument("--follow", "-f", action="store_true")
+    s.add_argument("--nbytes", type=int, default=65536,
+                   help="tail window size in bytes")
+    s.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("profile", help="capture an on-demand profile on one "
                                        "node (jax.profiler on TPU, thread-"

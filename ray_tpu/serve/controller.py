@@ -374,6 +374,12 @@ class ServeController:
                 "target_replicas": ds.target_count(),
                 "ts": now,
             }
+            # declared SLO rides the signal so consumers (the health
+            # plane's TTFT_BREACH rule, dashboards) can judge the
+            # percentiles without digging into deployment config
+            auto = ds.config.autoscaling
+            if auto is not None and auto.ttft_p95_target_ms is not None:
+                out[name]["ttft_p95_target_ms"] = auto.ttft_p95_target_ms
         return out
 
     async def set_http_config(self, config: dict):

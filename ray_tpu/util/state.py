@@ -225,6 +225,22 @@ def sched_decisions(limit: int = 200, id: str | None = None,
     return _gcs_call("get_sched_decisions", limit=limit, id=id, kind=kind)
 
 
+def health(limit: int = 50) -> Dict[str, Any]:
+    """Cluster health plane (util/health.py): the deduplicated
+    active-alert set plus the recent raised/cleared transition trail
+    from the GCS ring — what ``raytpu doctor`` / ``raytpu alerts`` /
+    ``GET /api/health`` render.  Queryable whether or not
+    ``health_metrics_enabled`` is on (the switch gates the background
+    detectors and the raytpu_health_* series, not the ring)."""
+    return _gcs_call("health", limit=limit)
+
+
+def health_alerts(limit: int = 100, rule: str | None = None,
+                  kind: str | None = None) -> List[Dict[str, Any]]:
+    """Newest-first tail of the health alert transition ring."""
+    return _gcs_call("get_health_alerts", limit=limit, rule=rule, kind=kind)
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Task-state rollup + per-stage latency percentiles + pending-reason
     rollup.

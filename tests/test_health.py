@@ -1,0 +1,554 @@
+"""Cluster health plane (util/health.py + GCS alert ring + raytpu doctor).
+
+The rule engine must be a pure, test-drivable hysteresis loop (explicit
+``now``); alerts must dedup structurally by (rule, scope) and age out of
+a bounded GCS ring; the one kill switch must mean zero ``raytpu_health_*``
+series AND no background detector — while ``raytpu doctor`` still
+evaluates on demand.  Acceptance: two manufactured degradations (event
+shed + pin leak) are both NAMED by doctor with evidence and an
+explain-surface pointer, and a healthy idle cluster raises nothing.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.rpc import run_async
+from ray_tpu.scripts import cli
+from ray_tpu.util import health
+from ray_tpu.util.health import (
+    Alert, HealthRule, HealthDetector, Rule, SEV_CRITICAL, SEV_WARNING,
+    default_rules, evaluate_oneshot, next_step,
+)
+
+MB = 1 << 20
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def _rule(name, raise_at=1.0, clear_at=0.0, key="x", severity=SEV_WARNING,
+          hold_s=None, min_hold_s=None):
+    """A rule reading snap[key]: {scope: value} — synthetic surfaces."""
+    def check(snap):
+        return {s: (v, {key: v}) for s, v in (snap.get(key) or {}).items()}
+    return Rule(name, check, raise_at=raise_at, clear_at=clear_at,
+                severity=severity, hold_s=hold_s, min_hold_s=min_hold_s)
+
+
+# ------------------------------------------------------------- vocabulary
+
+def test_rule_vocabulary_complete_and_valid():
+    """Every HealthRule constant has exactly one default rule, a legal
+    severity, clear_at <= raise_at, and a next-step pointer."""
+    rules = default_rules()
+    names = [r.name for r in rules]
+    assert sorted(names) == sorted(HealthRule.ALL)
+    assert len(names) == len(set(names))
+    for r in rules:
+        assert r.severity in (SEV_WARNING, SEV_CRITICAL)
+        assert r.clear_at <= r.raise_at
+        assert next_step(r.name)  # every rule points somewhere next
+
+
+def test_rule_constructor_validates():
+    check = lambda snap: {}
+    with pytest.raises(ValueError):
+        Rule("NOT_A_RULE", check, raise_at=1.0, clear_at=0.0,
+             severity=SEV_WARNING)
+    with pytest.raises(ValueError):
+        Rule(HealthRule.EVENTS_SHED, check, raise_at=1.0, clear_at=0.0,
+             severity="panic")
+    with pytest.raises(ValueError):
+        Rule(HealthRule.EVENTS_SHED, check, raise_at=1.0, clear_at=2.0,
+             severity=SEV_WARNING)
+
+
+def test_head_gcs_rule_split_disjoint_and_complete():
+    """One (rule, scope) never has two writers: the GCS and head rule
+    subsets partition the vocabulary."""
+    assert health.GCS_RULE_NAMES <= HealthRule.ALL
+    assert health.GCS_RULE_NAMES & health.HEAD_RULE_NAMES == frozenset()
+    assert health.GCS_RULE_NAMES | health.HEAD_RULE_NAMES == HealthRule.ALL
+
+
+# ------------------------------------------------------------- hysteresis
+
+def test_raise_needs_sustained_breach():
+    det = HealthDetector([_rule(HealthRule.DISK_LOW, raise_at=0.9,
+                                clear_at=0.8)],
+                         hold_s=10.0, min_hold_s=30.0)
+    assert det.observe({"x": {"node:a": 0.95}}, now=100.0) == []
+    assert det.observe({"x": {"node:a": 0.96}}, now=105.0) == []
+    ev = det.observe({"x": {"node:a": 0.97}}, now=110.0)
+    assert [e["kind"] for e in ev] == ["raised"]
+    assert ev[0]["rule"] == HealthRule.DISK_LOW
+    assert ev[0]["scope"] == "node:a"
+    assert ev[0]["since_ts"] == 100.0  # breach start, not raise time
+    assert det.active_counts() == {HealthRule.DISK_LOW: 1}
+
+
+def test_dip_before_hold_forgets_the_breach():
+    det = HealthDetector([_rule(HealthRule.DISK_LOW, raise_at=0.9,
+                                clear_at=0.8)],
+                         hold_s=10.0, min_hold_s=30.0)
+    det.observe({"x": {"node:a": 0.95}}, now=100.0)
+    det.observe({"x": {"node:a": 0.5}}, now=105.0)   # dip: forget
+    det.observe({"x": {"node:a": 0.95}}, now=108.0)  # breach restarts
+    assert det.observe({"x": {"node:a": 0.95}}, now=112.0) == []
+    ev = det.observe({"x": {"node:a": 0.95}}, now=118.0)
+    assert [e["kind"] for e in ev] == ["raised"]
+
+
+def test_clear_needs_sustained_recovery_and_min_age():
+    det = HealthDetector([_rule(HealthRule.ARENA_FRAG_HIGH, raise_at=0.75,
+                                clear_at=0.5, hold_s=0.0)],
+                         hold_s=10.0, min_hold_s=30.0)
+    ev = det.observe({"x": {"node:a": 0.9}}, now=100.0)
+    assert [e["kind"] for e in ev] == ["raised"]
+    # between clear_at and raise_at: neither clears nor re-raises
+    assert det.observe({"x": {"node:a": 0.6}}, now=110.0) == []
+    # below clear_at but not sustained long enough
+    assert det.observe({"x": {"node:a": 0.1}}, now=120.0) == []
+    # bounce above clear_at resets the pending clear
+    assert det.observe({"x": {"node:a": 0.6}}, now=140.0) == []
+    assert det.observe({"x": {"node:a": 0.1}}, now=145.0) == []
+    assert det.observe({"x": {"node:a": 0.1}}, now=170.0) == []
+    ev = det.observe({"x": {"node:a": 0.1}}, now=176.0)  # 31s below
+    assert [e["kind"] for e in ev] == ["cleared"]
+    assert det.active() == []
+    assert det._tracks == {}  # no state left behind
+
+
+def test_active_alert_dedups_and_updates_in_place():
+    det = HealthDetector([_rule(HealthRule.LEAK_SUSPECTS, raise_at=1.0,
+                                clear_at=0.0, hold_s=0.0)],
+                         hold_s=10.0, min_hold_s=30.0)
+    ev = det.observe({"x": {"node:a": 1.0}}, now=100.0)
+    assert [e["kind"] for e in ev] == ["raised"]
+    # still breaching: NO new event, but value/evidence refresh
+    assert det.observe({"x": {"node:a": 3.0}}, now=110.0) == []
+    a = det.active()[0]
+    assert a["value"] == 3.0 and a["evidence"] == {"x": 3.0}
+    assert a["since_ts"] == 100.0  # episode start preserved
+
+
+def test_absent_scope_reads_zero_and_clears():
+    """A deleted deployment / vanished node stops appearing in the
+    snapshot — its open alert must still clear, not dangle forever."""
+    det = HealthDetector([_rule(HealthRule.SLO_SIGNAL_STALE, raise_at=1.0,
+                                clear_at=0.0, hold_s=0.0,
+                                min_hold_s=5.0)],
+                         hold_s=10.0, min_hold_s=30.0)
+    det.observe({"x": {"deployment:d": 2.0}}, now=100.0)
+    assert det.observe({"x": {}}, now=110.0) == []  # pending clear
+    ev = det.observe({"x": {}}, now=116.0)
+    assert [e["kind"] for e in ev] == ["cleared"]
+
+
+def test_per_scope_independence():
+    det = HealthDetector([_rule(HealthRule.NODE_FLAPPING, raise_at=2.0,
+                                clear_at=1.0, hold_s=0.0,
+                                severity=SEV_CRITICAL)],
+                         hold_s=10.0, min_hold_s=30.0)
+    ev = det.observe({"x": {"node:a": 3.0, "node:b": 0.0}}, now=100.0)
+    assert len(ev) == 1 and ev[0]["scope"] == "node:a"
+    assert ev[0]["severity"] == SEV_CRITICAL
+    ev = det.observe({"x": {"node:a": 3.0, "node:b": 5.0}}, now=105.0)
+    assert len(ev) == 1 and ev[0]["scope"] == "node:b"
+    assert det.active_counts() == {HealthRule.NODE_FLAPPING: 2}
+
+
+def test_broken_check_does_not_kill_the_tick():
+    def boom(snap):
+        raise RuntimeError("surface gone")
+    det = HealthDetector([
+        Rule(HealthRule.GOODPUT_DROP, boom, raise_at=0.4, clear_at=0.25,
+             severity=SEV_WARNING),
+        _rule(HealthRule.DISK_LOW, raise_at=0.9, clear_at=0.8,
+              hold_s=0.0)],
+        hold_s=10.0, min_hold_s=30.0)
+    ev = det.observe({"x": {"node:a": 0.95}}, now=100.0)
+    assert [e["rule"] for e in ev] == [HealthRule.DISK_LOW]
+
+
+def test_oneshot_skips_hysteresis():
+    rules = [_rule(HealthRule.DISK_LOW, raise_at=0.9, clear_at=0.8,
+                   severity=SEV_CRITICAL),
+             _rule(HealthRule.LEAK_SUSPECTS, raise_at=1.0, key="y")]
+    out = evaluate_oneshot({"x": {"node:a": 0.95, "node:b": 0.2},
+                            "y": {"node:a": 2.0}}, rules)
+    got = {(a["rule"], a["scope"]) for a in out}
+    assert got == {(HealthRule.DISK_LOW, "node:a"),
+                   (HealthRule.LEAK_SUSPECTS, "node:a")}
+    # critical sorts first; every finding carries its next step
+    assert out[0]["severity"] == SEV_CRITICAL
+    assert all(a["next_step"] for a in out)
+
+
+# -------------------------------------------------------- check functions
+
+def test_check_functions_map_surfaces_to_scopes():
+    snap = {
+        "loop_busy": {"n1/gcs": 0.97}, "loop_stalls": {"n1/gcs": 3},
+        "slo": {"d": {"stale_replicas": 2, "ttft_p95_ms": 240.0,
+                      "ttft_p95_target_ms": 100.0,
+                      "running_replicas": 1}},
+        "arena_frag": {"n1": 0.8}, "leak_suspects": {"n1": 2},
+        "goodput": {"n1": 0.5}, "flaps": {"n1": 3},
+        "handler_busy": {"add_task_events": 0.7},
+        "spill_rate": {"n1": 100 * MB}, "backpressure_rate": {"n1": 4.5},
+        "disk_used_frac": {"n1": 0.97},
+        "events_shed": 10, "events_shed_total": 40,
+    }
+    out = evaluate_oneshot(snap)
+    by_rule = {a["rule"]: a for a in out}
+    assert set(by_rule) == HealthRule.ALL  # every rule fires on this snap
+    assert by_rule[HealthRule.OWNER_LOOP_SATURATED]["scope"] == "loop:n1/gcs"
+    assert by_rule[HealthRule.TTFT_BREACH]["scope"] == "deployment:d"
+    assert by_rule[HealthRule.TTFT_BREACH]["value"] == pytest.approx(2.4)
+    assert by_rule[HealthRule.EVENTS_SHED]["evidence"]["shed_total"] == 40
+    assert by_rule[HealthRule.GCS_HANDLER_HOT]["scope"] == \
+        "gcs:add_task_events"
+    # GOODPUT_DROP value is 1 - goodput ("higher is worse" everywhere)
+    assert by_rule[HealthRule.GOODPUT_DROP]["value"] == pytest.approx(0.5)
+
+
+def test_build_head_snapshot_from_fake_store():
+    now = 1000.0
+
+    class FakeStore:
+        def latest(self):
+            return now, {
+                "n1": {
+                    'raytpu_loop_busy_fraction{process="worker:1"}': 0.98,
+                    'raytpu_event_loop_stalls{process="worker:1"}': 2.0,
+                    "raytpu_mem_arena_frag_fraction": 0.9,
+                    "raytpu_object_store_bytes": 0.0,  # EMPTY pool
+                    "raytpu_mem_leak_suspects": 1.0,
+                    "raytpu_train_goodput_fraction": 0.3,
+                    "raytpu_node_disk_used_fraction": 0.95,
+                },
+                "n2": {"error": "unreachable"},
+            }
+
+        def flaps(self, node):
+            return 2 if node == "n1" else 0
+
+        def rates(self, node, prefix=""):
+            return {'raytpu_spill_bytes_total{tier="local"}':
+                    [[now - 2, 80 * MB], [now - 1, 80 * MB]]}
+
+    snap = health.build_head_snapshot(FakeStore(), now=now)
+    assert snap["loop_busy"] == {"n1/worker:1": 0.98}
+    assert snap["loop_stalls"] == {"n1/worker:1": 2.0}
+    assert snap["arena_frag"] == {}  # frag of an empty pool is noise
+    assert snap["leak_suspects"] == {"n1": 1}
+    assert snap["goodput"] == {"n1": 0.3}
+    assert snap["flaps"] == {"n1": 2}
+    assert snap["disk_used_frac"] == {"n1": 0.95}
+    assert snap["spill_rate"]["n1"] == pytest.approx(80 * MB)
+
+
+# ----------------------------------------------------- metrics discipline
+
+def test_gauge_series_only_for_rules_that_raised():
+    """Cardinality discipline: never-fired rules contribute zero series
+    (not zero-valued series); cleared rules read 0."""
+    from ray_tpu.util.metrics import get_metric
+
+    det = HealthDetector([_rule(HealthRule.SPILL_STORM, raise_at=1.0,
+                                clear_at=0.0, hold_s=0.0, min_hold_s=0.0),
+                          _rule(HealthRule.DISK_LOW, raise_at=0.9,
+                                clear_at=0.8, key="y")],
+                         hold_s=0.0, min_hold_s=0.0)
+    ev = det.observe({"x": {"node:a": 5.0}, "y": {}}, now=100.0)
+    health.record_transitions(ev, det)
+    g = get_metric("raytpu_health_active_alerts")
+    assert g is not None
+    vals = {k: v for k, v in g.snapshot()["values"].items()}
+    assert (("rule", HealthRule.SPILL_STORM),) in vals
+    # DISK_LOW never raised -> no series at all
+    assert (("rule", HealthRule.DISK_LOW),) not in vals
+
+    c = get_metric("raytpu_health_alerts_total")
+    before = dict(c.snapshot()["values"])
+    # clear: gauge for the raised rule drops to 0, counter unchanged
+    ev = det.observe({"x": {"node:a": 0.0}, "y": {}}, now=200.0)
+    assert [e["kind"] for e in ev] == ["cleared"]
+    health.record_transitions(ev, det)
+    assert g.snapshot()["values"][(("rule", HealthRule.SPILL_STORM),)] == 0
+    assert dict(c.snapshot()["values"]) == before
+
+
+# ------------------------------------------------------ ring (live GCS)
+
+def test_alert_ring_bounds_ageout_and_filters(ray_start_regular):
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.util import state
+
+    w = global_worker()
+
+    def push(records, active=None):
+        return run_async(w.gcs.call("add_health_alerts", records=records,
+                                    active=active, source="test"))
+
+    old_ts = time.time() - 100_000  # far beyond health_alert_max_age_s
+    push([{"kind": "raised", "ts": old_ts, "rule": HealthRule.DISK_LOW,
+           "scope": "node:old", "severity": "critical"}])
+    fresh = [{"kind": "raised" if i % 2 == 0 else "cleared",
+              "ts": time.time(), "rule": HealthRule.SPILL_STORM,
+              "scope": f"node:{i}", "severity": "warning"}
+             for i in range(600)]
+    push(fresh)
+
+    recent = state.health_alerts(limit=1000)
+    assert len(recent) <= 512  # ring bound (health_ring_len default)
+    # the stale record aged out on the next write
+    assert not [r for r in recent if r["scope"] == "node:old"]
+    # newest-first
+    assert recent[0]["scope"] == "node:599"
+    only_raised = state.health_alerts(limit=10, kind="raised")
+    assert all(r["kind"] == "raised" for r in only_raised)
+    assert state.health_alerts(limit=10, rule=HealthRule.DISK_LOW) == []
+
+
+def test_state_health_merges_head_push(ray_start_regular):
+    from ray_tpu.core.core_worker import global_worker
+    from ray_tpu.util import state
+
+    w = global_worker()
+    a = Alert(HealthRule.TTFT_BREACH, SEV_CRITICAL, "deployment:d",
+              2.4, {"ttft_p95_ms": 240.0}, since_ts=time.time(),
+              last_ts=time.time()).to_dict()
+    run_async(w.gcs.call("add_health_alerts",
+                         records=[{"kind": "raised", "ts": time.time(),
+                                   **a}],
+                         active=[a], source="head"))
+    h = state.health()
+    assert h["enabled"] is True
+    assert sorted(h["rules"]) == sorted(HealthRule.ALL)
+    mine = [x for x in h["active"] if x["rule"] == HealthRule.TTFT_BREACH]
+    assert mine and mine[0]["scope"] == "deployment:d"
+    assert [r for r in h["recent"] if r.get("rule") ==
+            HealthRule.TTFT_BREACH]
+
+
+# ----------------------------------------------------- bench alert trail
+
+def test_alert_trail_schema_and_bench_wiring(ray_start_regular):
+    """The rollup benches attach to their JSON: stable keys, and both
+    harnesses actually record it."""
+    import pathlib
+
+    trail = health.alert_trail()
+    assert set(trail) >= {"enabled", "active", "transitions"}
+    assert trail["enabled"] is True
+    assert isinstance(trail["active"], list)
+    assert isinstance(trail["transitions"], list)
+    for bench in ("bench_storm.py", "bench_scale.py"):
+        src = (pathlib.Path(__file__).resolve().parent.parent
+               / bench).read_text()
+        assert "alert_trail()" in src, f"{bench} lost its alert trail"
+
+
+def test_alert_trail_never_raises_without_cluster():
+    assert not ray_tpu.is_initialized()
+    trail = health.alert_trail()
+    assert trail["active"] == [] and trail["transitions"] == []
+    assert trail["enabled"] is None and "error" in trail
+
+
+# ------------------------------------------------------------ kill switch
+
+@pytest.mark.timeout(120)
+def test_kill_switch_zero_series_no_detector():
+    """health_metrics_enabled=False ⇒ no raytpu_health_* series appear,
+    the GCS never instantiates a detector, and the ring stays queryable
+    (empty) — while doctor still evaluates on demand."""
+    from ray_tpu.util.metrics import get_metric
+
+    def fp():
+        out = {}
+        for name in ("raytpu_health_alerts_total",
+                     "raytpu_health_active_alerts"):
+            m = get_metric(name)
+            out[name] = dict(m.snapshot()["values"]) if m else None
+        return out
+
+    before = fp()
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"health_metrics_enabled": False,
+                                 "health_check_period_s": 0.5,
+                                 "task_events_max_buffer": 8})
+    try:
+        @ray_tpu.remote
+        def f(i):
+            return i
+
+        assert sum(ray_tpu.get([f.remote(i) for i in range(60)])) == 1770
+        time.sleep(1.0)  # several would-be detector ticks
+
+        from ray_tpu.util import state
+        h = state.health()
+        assert h["enabled"] is False
+        assert h["active"] == [] and h["recent"] == []  # ring queryable
+        from ray_tpu.core.api import _state
+        assert _state.gcs_server._health_detector is None
+        assert fp() == before  # zero new series
+
+        # on-demand diagnosis still works — and still names the shed
+        import io
+        import contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli.main(["doctor", "--json"])
+        doc = json.loads(buf.getvalue())
+        assert HealthRule.EVENTS_SHED in {a["rule"] for a in doc["alerts"]}
+        assert fp() == before  # doctor emitted no series either
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- acceptance
+
+@pytest.mark.timeout(180)
+def test_doctor_names_seeded_degradations(capsys):
+    """Acceptance: manufacture an event shed (tiny owner buffer) and a
+    pin leak (held zero-copy view past a tiny TTL); ``raytpu doctor``
+    must NAME both rules with evidence and a next-step pointer, and the
+    background detector must hold them in ``state.health()``."""
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"task_events_max_buffer": 8,
+                                 "object_pin_leak_ttl_s": 0.2,
+                                 "health_check_period_s": 0.5,
+                                 "health_raise_hold_s": 0.0,
+                                 "health_min_hold_s": 60.0})
+    try:
+        @ray_tpu.remote
+        def f(i):
+            return i
+
+        ray_tpu.get([f.remote(i) for i in range(120)])  # sheds at 8
+        ref = ray_tpu.put(np.arange(4 * MB, dtype=np.uint8))
+        view = ray_tpu.get(ref)  # held read pin -> leak suspect
+        assert view[1] == 1
+        time.sleep(0.5)
+
+        capsys.readouterr()
+        cli.main(["doctor"])
+        out = capsys.readouterr().out
+        assert HealthRule.EVENTS_SHED in out
+        assert HealthRule.LEAK_SUSPECTS in out
+        assert "shed_total=" in out            # evidence
+        assert "task_events_max_buffer" in out  # next step names the knob
+        assert "raytpu memory --leaks" in out   # explain-surface pointer
+        assert "pin_ttl" in out                 # sweep detail rows
+
+        cli.main(["doctor", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        rules = {a["rule"] for a in doc["alerts"]}
+        assert {HealthRule.EVENTS_SHED, HealthRule.LEAK_SUSPECTS} <= rules
+        for a in doc["alerts"]:
+            assert a["evidence"] and a["next_step"]
+
+        # the background GCS detector raised EVENTS_SHED into the ring
+        from ray_tpu.util import state
+        _wait_for(lambda: [r for r in state.health()["recent"]
+                           if r["rule"] == HealthRule.EVENTS_SHED
+                           and r["kind"] == "raised"],
+                  what="EVENTS_SHED in the alert ring")
+
+        # alerts CLI renders the same trail
+        cli.main(["alerts"])
+        out = capsys.readouterr().out
+        assert "EVENTS_SHED" in out
+        del view, ref
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.timeout(120)
+def test_healthy_idle_cluster_raises_nothing(ray_start_regular, capsys):
+    """Zero alerts on a healthy idle cluster: doctor reports healthy and
+    the active set stays empty (no flapping)."""
+    from ray_tpu.util import state
+
+    # Rules that read HOST state rather than cluster workload state:
+    # DISK_LOW watches the box's filesystem, and the load-pressure rules
+    # (loop saturation, handler heat, goodput) legitimately fire in a
+    # one-shot probe while a 1-core CI box is still digesting init +
+    # worker spawn — the no-hysteresis doctor is SUPPOSED to see those
+    # in the moment.  They must settle once the box goes quiet; the
+    # workload-state rules must never appear at all.
+    host_transient = {
+        HealthRule.DISK_LOW, HealthRule.OWNER_LOOP_SATURATED,
+        HealthRule.GCS_HANDLER_HOT, HealthRule.GOODPUT_DROP,
+    }
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+    time.sleep(1.0)
+
+    def doctor_findings():
+        capsys.readouterr()
+        cli.main(["doctor", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        return [a for a in doc["alerts"] if a["rule"] != HealthRule.DISK_LOW]
+
+    findings = doctor_findings()
+    # workload-state rules fail immediately — they indicate a real bug
+    assert [a for a in findings if a["rule"] not in host_transient] == []
+    # load-pressure transients get time to settle after the init burst
+    deadline = time.monotonic() + 45.0
+    while findings and time.monotonic() < deadline:
+        time.sleep(2.0)
+        findings = doctor_findings()
+        assert [a for a in findings
+                if a["rule"] not in host_transient] == []
+    assert findings == [], f"doctor findings never settled: {findings}"
+    h = state.health()
+    assert [a for a in h["active"] if a["rule"] not in host_transient] == []
+    # no raise/clear churn of workload-state rules
+    assert [e for e in h["recent"] if e["rule"] not in host_transient] == []
+
+
+# ------------------------------------------------------------------- logs
+
+def test_logs_cli_list_and_tail(ray_start_regular, capsys):
+    """``raytpu logs <node>`` lists the node's log files; with a name it
+    prints the tail."""
+    import os
+
+    from ray_tpu.core.api import _state
+
+    logdir = os.path.join(_state.node_agent.session_dir, "logs")
+    os.makedirs(logdir, exist_ok=True)
+    with open(os.path.join(logdir, "raylet.out"), "w") as f:
+        f.write("line one\nthe smoking gun\n")
+    info = [n for n in ray_tpu.nodes() if n.get("Alive")][0]
+    nid = info["NodeID"]
+
+    cli.main(["logs", nid[:8]])
+    listing = capsys.readouterr().out
+    assert "raylet.out" in listing
+
+    cli.main(["logs", nid[:8], "raylet.out"])
+    out = capsys.readouterr().out
+    assert "the smoking gun" in out
+
+    with pytest.raises(SystemExit):
+        cli.main(["logs", "deadbeef00"])

@@ -649,3 +649,83 @@ def test_all_runtime_metrics_use_raytpu_namespace():
     # the scan must actually see the instrumentation plane's metrics —
     # zero matches would mean the alias-following logic silently broke
     assert scanned >= 5, f"scan only found {scanned} metric constructions"
+
+
+# ------------------------------------------------- health-plane cardinality
+
+#: the label-set bound for the health plane: rule (closed HealthRule
+#: vocabulary) and severity (warning/critical) ONLY — scope strings live
+#: in the alert ring, never as a label value (node would be tolerable,
+#: nothing in-tree needs it yet).
+ALLOWED_HEALTH_TAG_KEYS = {"rule", "severity", "node"}
+HEALTH_PREFIX = "raytpu_health_"
+
+
+def test_health_metric_tag_keys_are_bounded():
+    """Every ``raytpu_health_*`` metric anywhere in the runtime declares
+    only allowlisted tag keys (rule/severity/node)."""
+    problems = []
+    seen = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path.name == "metrics.py" and path.parent.name == "util":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for call, cls in _metric_calls(tree):
+            name_node = call.args[0] if call.args else None
+            if not (isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str)
+                    and name_node.value.startswith(HEALTH_PREFIX)):
+                continue
+            seen += 1
+            where = f"{path.relative_to(PKG_ROOT.parent)}:{call.lineno}"
+            for kw in call.keywords:
+                if kw.arg != "tag_keys" or not isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    continue
+                for el in kw.value.elts:
+                    if (isinstance(el, ast.Constant)
+                            and el.value not in ALLOWED_HEALTH_TAG_KEYS):
+                        problems.append(
+                            f"{where}: {cls} {name_node.value!r} declares "
+                            f"tag key {el.value!r} outside "
+                            f"{sorted(ALLOWED_HEALTH_TAG_KEYS)}")
+    assert not problems, "\n".join(problems)
+    # the transition counter + the active gauge at minimum
+    assert seen >= 2, f"only {seen} health metrics found"
+
+
+# ------------------------------------------------- health-rule stamp lint
+
+def test_health_rules_use_typed_vocabulary():
+    """Every ``Rule(...)`` construction in the runtime names its rule via
+    ``HealthRule.<CONSTANT>`` — a free-form string would mint an alert
+    type no doctor table, dashboard view, or metric label understands."""
+    import ray_tpu.util.health as hp
+    enum_names = set(hp.HealthRule.ALL)
+    problems = []
+    stamps = 0
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_rule = (isinstance(fn, ast.Name) and fn.id == "Rule") or (
+                isinstance(fn, ast.Attribute) and fn.attr == "Rule")
+            if not is_rule or not node.args:
+                continue
+            name_arg = node.args[0]
+            stamps += 1
+            ok = (isinstance(name_arg, ast.Attribute)
+                  and name_arg.attr in enum_names
+                  and isinstance(name_arg.value, ast.Name)
+                  and name_arg.value.id == "HealthRule")
+            if not ok:
+                problems.append(
+                    f"{path.relative_to(PKG_ROOT.parent)}:{node.lineno}: "
+                    "Rule() name is not a HealthRule constant (free-form "
+                    "strings mint untyped alert vocabulary)")
+    assert not problems, "\n".join(problems)
+    # the full default vocabulary must be registered through the lint
+    assert stamps >= len(enum_names), (
+        f"only {stamps} Rule() sites found for {len(enum_names)} rules")

@@ -138,3 +138,43 @@ def test_dashboard_scrape_records_unreachable_nodes(monkeypatch):
     _ts, latest = head.history.latest()
     assert "a" * 12 not in latest
     assert "b" * 12 in latest
+
+
+def test_rejoin_after_dark_gap_drops_stale_tail():
+    """A node that errored out and rejoined past stale_after_s must not
+    serve its pre-outage samples as history: the good tail is purged
+    (error markers stay — they are the flap evidence), and rates
+    re-chain from the fresh incarnation only."""
+    st = MetricsHistory(window_s=1000.0, period_s=1.0, stale_after_s=15.0)
+    st.add_sample("n1", {"raytpu_req_total": 10.0},
+                  counters={"raytpu_req_total"}, ts=100.0)
+    st.add_sample("n1", {"raytpu_req_total": 20.0}, ts=101.0)
+    st.record_error("n1", "heartbeat timeout", ts=103.0)
+    # dark for 100s >> stale_after_s, then the node comes back
+    st.add_sample("n1", {"raytpu_req_total": 5.0}, ts=203.0)
+    st.add_sample("n1", {"raytpu_req_total": 9.0}, ts=204.0)
+    _ts, latest = st.latest()
+    assert latest["n1"]["raytpu_req_total"] == 9.0
+    # rates span ONLY the new incarnation (one 203->204 delta) — the
+    # stale 100/101s tail is gone, so no rate bridges the outage
+    pts = st.rates("n1")["raytpu_req_total"]
+    assert len(pts) == 1 and pts[0][0] == 204.0 and pts[0][1] == 4.0
+    # within stale_after_s the tail is NOT purged (normal scrape cadence)
+    st.add_sample("n1", {"raytpu_req_total": 12.0}, ts=206.0)
+    assert len(st.rates("n1")["raytpu_req_total"]) == 2
+
+
+def test_flaps_counts_recoveries_in_window():
+    st = MetricsHistory(window_s=1000.0, period_s=1.0, stale_after_s=1e9)
+    assert st.flaps("ghost") == 0
+    st.add_sample("n1", {"raytpu_g": 1.0}, ts=100.0)
+    st.record_error("n1", "boom", ts=101.0)
+    st.add_sample("n1", {"raytpu_g": 1.0}, ts=102.0)   # flap 1
+    st.record_error("n1", "boom", ts=103.0)
+    st.record_error("n1", "boom", ts=104.0)            # still down: no flap
+    st.add_sample("n1", {"raytpu_g": 1.0}, ts=105.0)   # flap 2
+    assert st.flaps("n1", now=110.0) == 2
+    # a narrow window only sees the second recovery
+    assert st.flaps("n1", window_s=6.0, now=110.0) == 1
+    st.forget("n1")
+    assert st.flaps("n1", now=110.0) == 0
