@@ -481,6 +481,140 @@ def run_ab_train_obs(S: float, pairs: int) -> dict:
             "off_config": TRAIN_OBS_OFF, "ratio_on_off": ratio}
 
 
+def _measure_elastic(S: float, mode: str) -> dict:
+    """One fresh-cluster run of a fixed training workload (epochs x 100 ms
+    of "compute", checkpoint every epoch) under a seeded mid-run
+    preemption, for the elastic-vs-restart A/B arms:
+
+    - ``elastic``:  ScalingConfig(min_workers=1) — the drain notice
+      resizes the group 2 -> 1 in place, then back up when the
+      replacement node lands;
+    - ``restart``:  rigid world size + FailureConfig retries — the same
+      preemption kills the run, which restarts from the latest
+      checkpoint once the replacement node can host the full group;
+    - ``baseline``: same cluster and workload, no chaos (the undisturbed
+      goodput yardstick).
+
+    The chaos schedule (seed, after_s, notice_s) and the 2 s
+    replacement-node lag are identical for elastic and restart, so the
+    measured gap is exactly the recovery-path cost."""
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.rpc import run_async
+
+    epochs = max(int(240 * S), 30)
+    sleep_s = 0.1
+    cluster = Cluster(initialize_head=False)
+    out = {}
+    try:
+        n1 = cluster.add_node(num_cpus=4)
+        n2 = cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(2)
+        info = cluster.connect_driver()
+        from ray_tpu.core.core_worker import global_worker
+        from ray_tpu.train import (Checkpoint, DataParallelTrainer,
+                                   FailureConfig, RunConfig, ScalingConfig)
+        # info["node_id"] is None when joining an existing cluster: identify
+        # the driver by its attached agent's address instead
+        victim = n2 if n1.address == global_worker().agent_address else n1
+        if mode != "baseline":
+            spec = {"seed": 23, "kills": [
+                {"kind": "preempt_node", "after_s": 3.0, "notice_s": 2.0,
+                 "node": victim.node_id[:8]}]}
+            run_async(global_worker().gcs.call("chaos_set", spec=spec))
+
+            def _replace():  # the spot market delivers a replacement node
+                deadline = time.monotonic() + 120
+                while (victim.proc.poll() is None
+                       and time.monotonic() < deadline):
+                    time.sleep(0.1)
+                time.sleep(2.0)  # provisioning lag, identical for both arms
+                cluster.add_node(num_cpus=4)
+
+            threading.Thread(target=_replace, daemon=True).start()
+
+        def loop(config):
+            import json as _json
+            import os as _os
+            import tempfile as _tmp
+            import time as _t
+
+            from ray_tpu import train
+            from ray_tpu.train import Checkpoint as _Ckpt
+            rank0 = train.get_context().get_world_rank() == 0
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt:
+                with open(_os.path.join(ckpt.path, "e.json")) as f:
+                    start = _json.load(f)["epoch"] + 1
+            for e in range(start, config["epochs"]):
+                _t.sleep(config["sleep_s"])
+                ck = None
+                if rank0:
+                    d = _tmp.mkdtemp()
+                    with open(_os.path.join(d, "e.json"), "w") as f:
+                        _json.dump({"epoch": e}, f)
+                    ck = _Ckpt(d)
+                train.report({"epoch": e}, checkpoint=ck)
+
+        scaling = ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 3.0},
+            min_workers=1 if mode == "elastic" else None)
+        failures = FailureConfig(max_failures=5 if mode == "restart" else 0)
+        trainer = DataParallelTrainer(
+            train_loop_per_worker=loop,
+            train_loop_config={"epochs": epochs, "sleep_s": sleep_s},
+            scaling_config=scaling,
+            run_config=RunConfig(name=f"ab-elastic-{mode}",
+                                 storage_path=tempfile.mkdtemp(),
+                                 failure_config=failures))
+        t0 = time.perf_counter()
+        result = trainer.fit()
+        wall = time.perf_counter() - t0
+        assert result.error is None, f"{mode} arm failed: {result.error!r}"
+        assert result.metrics["epoch"] == epochs - 1
+        out["wall_s"] = round(wall, 3)
+        # the workload's intrinsic productive time over actual wall clock:
+        # one comparable goodput number for all three arms
+        out["goodput"] = round(epochs * sleep_s / wall, 4)
+        out["resizes"] = result.num_resizes
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+    return out
+
+
+def run_ab_elastic(S: float, pairs: int) -> dict:
+    """Elastic resize vs restart-from-checkpoint on the SAME seeded chaos
+    schedule, plus an undisturbed baseline (the ISSUE-18 acceptance
+    gates: elastic goodput >= 80% of undisturbed; resize strictly
+    cheaper than restart)."""
+    arms = {"elastic": [], "restart": [], "baseline": []}
+    for i in range(pairs):
+        for mode in ("elastic", "restart", "baseline"):
+            arms[mode].append(_measure_elastic(S, mode))
+        print(f"# elastic ab pair {i + 1}/{pairs}: "
+              f"elastic={arms['elastic'][-1]} "
+              f"restart={arms['restart'][-1]} "
+              f"baseline={arms['baseline'][-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    g = {m: med([r["goodput"] for r in arms[m]]) for m in arms}
+    w = {m: med([r["wall_s"] for r in arms[m]]) for m in arms}
+    return {"pairs": arms,
+            "goodput": {m: round(v, 4) for m, v in g.items()},
+            "wall_s": {m: round(v, 3) for m, v in w.items()},
+            "elastic_vs_baseline_goodput": round(
+                g["elastic"] / max(g["baseline"], 1e-9), 3),
+            "elastic_vs_restart_wall": round(
+                w["elastic"] / max(w["restart"], 1e-9), 3)}
+
+
 #: the "off" arm of the scheduler-observability A/B: the kill switch sheds
 #: loop busy-fraction sampling, per-GCS-handler busy attribution, the
 #: owner serialize/flush histograms and the backpressure counters —
@@ -1036,6 +1170,11 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of "
                         "train_metrics_enabled on vs off (CPU train-loop "
                         "steps/s; the train-observability overhead gate)")
+    p.add_argument("--ab-elastic", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS triples of a fixed train workload "
+                        "under the same seeded mid-run preemption: elastic "
+                        "resize vs restart-from-checkpoint vs undisturbed "
+                        "baseline (the elastic-training recovery-cost gate)")
     p.add_argument("--ab-sched", type=int, default=0, metavar="PAIRS",
                    help="also run PAIRS interleaved A/B pairs of "
                         "sched_metrics_enabled on vs off (tasks_async + "
@@ -1120,6 +1259,8 @@ def main():
     if args.ab_train_obs > 0:
         out["train_obs_ab"] = run_ab_train_obs(args.scale,
                                                args.ab_train_obs)
+    if args.ab_elastic > 0:
+        out["elastic_ab"] = run_ab_elastic(args.scale, args.ab_elastic)
     if args.ab_sched > 0:
         out["sched_obs_ab"] = run_ab_sched_obs(args.scale, args.ab_sched)
     if args.ab_autoscale > 0:

@@ -115,8 +115,13 @@ class StandardAutoscaler:
                        extra: List[Dict[str, float]]) -> List[Dict[str, float]]:
         """Pending demand shapes no node can currently satisfy, minus what
         free capacity could absorb (simulated placement like
-        resource_demand_scheduler)."""
-        free = {nid: dict(n["available"]) for nid, n in alive.items()}
+        resource_demand_scheduler).  Draining nodes contribute NO free
+        capacity: a node under a preemption notice is about to take its
+        resources with it, and letting it absorb simulated demand would
+        suppress exactly the scale-up an elastic trainer (reporting its
+        missing workers as pending demand) is waiting on."""
+        free = {nid: dict(n["available"]) for nid, n in alive.items()
+                if not n.get("draining")}
         demands = list(extra)
         for n in alive.values():
             for entry in n.get("queued_demands", []):

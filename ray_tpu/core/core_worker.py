@@ -1585,7 +1585,8 @@ class CoreWorker:
         view = {nid: NodeView(nid, d["address"], d["total"], d["available"],
                               d.get("labels", {}), d.get("alive", True),
                               d.get("queue_len", 0),
-                              draining=d.get("draining", False))
+                              draining=d.get("draining", False),
+                              task_leased=d.get("task_leased", {}))
                 for nid, d in payload.items()}
         self._view_cache = (now, view)
         return view

@@ -136,6 +136,21 @@ class GcsServer:
         # (and anyone else via the "chaos" pubsub topic).
         self._chaos_spec: Optional[dict] = None
         self._chaos_version = 0
+        # Elastic train plane: active drain notices (node agents report at
+        # drain START, seconds before the node dies — the advance warning
+        # elastic trainers resize on) and the bounded completed-resize
+        # ring + in-progress map the doctor/state surfaces read back.
+        self._drain_notices: Dict[str, dict] = {}
+        self._train_resizes: deque = deque(maxlen=256)
+        self._train_resizing: Dict[str, dict] = {}
+        # Dead lease-owner broadcast: worker addresses whose process is
+        # confirmed gone (actor killed/crashed, node died under it).  Agents
+        # pick these up on heartbeat and reclaim any task-worker lease that
+        # owner still holds — without this an orphaned lease pins CPUs until
+        # the pin sweep's 3-strike liveness probe (~30s), which stalls an
+        # elastic re-form racing the reclamation for the freed slot.
+        self._dead_owner_seq = 0
+        self._dead_owners: deque = deque(maxlen=256)
         self._job_counter = 0
         self._bg: List[asyncio.Task] = []
         self.persistence_path = persistence_path
@@ -430,6 +445,16 @@ class GcsServer:
         self._actors_by_node.discard(info.get("node_id"), aid)
         self._live_actors_by_job.discard(info.get("job_id"), aid)
 
+    def _note_dead_owner(self, addr: Optional[str]):
+        """Record a confirmed-dead worker address for heartbeat broadcast
+        (see _dead_owners above).  seq-tagged so each agent only replays
+        entries it has not seen; the deque bound means an agent that falls
+        >256 entries behind misses some — the pin sweep backstops those."""
+        if not addr:
+            return
+        self._dead_owner_seq += 1
+        self._dead_owners.append((self._dead_owner_seq, addr))
+
     # ---------------------------------------------------------------- pubsub
     #
     # Long-poll pubsub (reference: GCS pubsub long-polling,
@@ -546,7 +571,16 @@ class GcsServer:
         self._publish("nodes", {"event": "alive", "node_id": node_id, "address": address})
         return {"node_id": node_id, "cluster_view": self._view_payload(),
                 "shard_map": {"version": self._shard_map_version,
-                              "shards": list(self._shard_addrs)}}
+                              "shards": list(self._shard_addrs)},
+                # the dead-owner broadcast seq is in-memory: after a GCS
+                # restart it re-counts from 0, below any seq the agents
+                # remember, and the `seq < ours` heartbeat check would
+                # silently skip every new broadcast until it caught up.
+                # Re-registration (the unknown-node heartbeat path) is
+                # exactly when an agent meets a restarted GCS — hand it
+                # the current seq so it resyncs instead of comparing
+                # against a counter from a previous incarnation.
+                "dead_owners_seq": self._dead_owner_seq}
 
     async def handle_update_node_resources(self, node_id: str,
                                            total: Dict[str, float],
@@ -570,11 +604,17 @@ class GcsServer:
                                total: Dict[str, float] | None = None,
                                chaos_version: int | None = None,
                                draining: bool = False,
-                               shard_map_version: int | None = None):
+                               shard_map_version: int | None = None,
+                               dead_owners_seq: int | None = None,
+                               task_leased: Dict[str, float] | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             return {"unknown": True}  # agent should re-register
         n.available = dict(available)
+        # short-lived task-lease usage: elastic sizing treats it as
+        # reclaimable headroom (the leases idle-return within seconds once
+        # their submitter stops), unlike actor/bundle holds
+        n.task_leased = dict(task_leased or {})
         if total is not None:
             n.total = dict(total)
         n.queue_len = queue_len
@@ -608,11 +648,91 @@ class GcsServer:
             # shard's new address reaches every agent within a heartbeat
             res["shard_map"] = {"version": self._shard_map_version,
                                 "shards": list(self._shard_addrs)}
+        if (dead_owners_seq is not None
+                and dead_owners_seq < self._dead_owner_seq):
+            # confirmed-dead lease owners this agent has not yet replayed:
+            # it reclaims their leased task workers on receipt (the ~30s
+            # pin-sweep probe remains the backstop for owners the GCS
+            # never tracked, e.g. a SIGKILLed driver)
+            res["dead_owners"] = {
+                "seq": self._dead_owner_seq,
+                "addrs": [a for s, a in self._dead_owners
+                          if s > dead_owners_seq]}
         return res
 
     async def handle_drain_node(self, node_id: str):
         await self._mark_node_dead(node_id, reason="drained")
         return True
+
+    async def handle_report_drain_notice(self, node_id: str,
+                                         notice_s: float = 0.0):
+        """A node agent received a preemption notice and started draining
+        — recorded at drain START so elastic trainers (and the doctor)
+        see the warning while the notice window is still open, not after
+        the node is gone.  Also flips the node's draining flag
+        immediately: waiting one heartbeat to route schedulers around a
+        dying node wastes notice budget."""
+        now = time.time()
+        self._drain_notices[node_id] = {
+            "node_id": node_id, "notice_s": float(notice_s),
+            "reported_at": now, "deadline": now + max(0.0, float(notice_s)),
+        }
+        n = self.nodes.get(node_id)
+        if n is not None and not n.draining:
+            n.draining = True
+            self._publish("nodes", {"event": "draining",
+                                    "node_id": node_id})
+        return True
+
+    async def handle_get_drain_notices(self):
+        """Active + recently-completed drain notices.  ``active`` means
+        the node is still alive (draining); a notice lingers ~60s past
+        its node's death so doctor/timeline surfaces can attribute the
+        death to the drain, then ages out."""
+        now = time.time()
+        out = []
+        for nid, rec in list(self._drain_notices.items()):
+            n = self.nodes.get(nid)
+            alive = bool(n is not None and n.alive)
+            if now - rec["deadline"] > 60.0:
+                if not alive:
+                    self._drain_notices.pop(nid, None)
+                    continue
+                if n is not None and not n.draining:
+                    # drain aborted (preemption cancelled): the node
+                    # outlived its deadline by the full grace window and
+                    # cleared its draining flag — without this the notice
+                    # stays active forever and doctor shows a phantom
+                    # "draining ... expires in 0s" for a healthy node
+                    self._drain_notices.pop(nid, None)
+                    continue
+            out.append({**rec, "active": alive,
+                        "remaining_s": max(0.0, rec["deadline"] - now)})
+        return out
+
+    async def handle_train_resize_started(self, trial: str, record: dict):
+        self._train_resizing[trial or "train"] = {
+            **(record or {}), "ts": time.time()}
+        return True
+
+    async def handle_add_train_resize(self, record: dict):
+        """One completed elastic resize (direction/from/to/wall_s/...) —
+        appended to the bounded ring behind ``raytpu train`` / doctor."""
+        trial = (record or {}).get("trial") or "train"
+        self._train_resizing.pop(trial, None)
+        self._train_resizes.append(dict(record or {}))
+        self._publish("train", {"event": "resize", **(record or {})})
+        return True
+
+    async def handle_get_train_resizes(self, limit: int = 100):
+        # an in-progress entry older than 5 min is a dead driver, not a
+        # resize — age it out rather than alarming forever
+        now = time.time()
+        for t, rec in list(self._train_resizing.items()):
+            if now - rec.get("ts", now) > 300.0:
+                self._train_resizing.pop(t, None)
+        return {"records": list(self._train_resizes)[-max(1, int(limit)):],
+                "in_progress": dict(self._train_resizing)}
 
     async def handle_report_pending_demand(self, reporter: str, shape: dict,
                                            count: int = 1):
@@ -641,6 +761,10 @@ class GcsServer:
             "nodes": {
                 nid: {
                     "alive": n.alive,
+                    # a draining node's free capacity is a mirage — the
+                    # autoscaler must not let it absorb simulated demand
+                    # (its replacement IS the demand)
+                    "draining": n.draining,
                     "total": n.total,
                     "available": n.available,
                     "queue_len": n.queue_len,
@@ -657,7 +781,7 @@ class GcsServer:
                       "available": n.available, "labels": {k: v for k, v in n.labels.items()
                                                            if not k.startswith("_")},
                       "alive": n.alive, "queue_len": n.queue_len,
-                      "draining": n.draining}
+                      "draining": n.draining, "task_leased": n.task_leased}
                 for nid, n in self.nodes.items()}
 
     async def handle_get_cluster_view(self):
@@ -709,7 +833,20 @@ class GcsServer:
                              "busy": dict(self._handler_busy)}
         snap = {"now": now, "events_shed": max(0, shed_delta),
                 "events_shed_total": self.task_events_dropped,
-                "handler_busy": busy_frac}
+                "handler_busy": busy_frac,
+                # elastic evidence: nodes draining under an active notice
+                # and trains mid-resize — NODE_DRAINING / TRAIN_RESIZING
+                # fire from here so an operator can tell planned churn
+                # from flapping
+                "draining_notices": {
+                    nid: max(0.0, rec["deadline"] - time.time())
+                    for nid, rec in self._drain_notices.items()
+                    if (self.nodes.get(nid) is not None
+                        and self.nodes[nid].alive)},
+                "train_resizing": {
+                    t: {"direction": rec.get("direction"),
+                        "from": rec.get("from")}
+                    for t, rec in self._train_resizing.items()}}
         events = det.observe(snap, now)
         health_plane.record_transitions(events, det)
         if events:
@@ -931,6 +1068,10 @@ class GcsServer:
                 info["restarts_left"] -= 1
             info["num_restarts"] += 1
             self._actor_unplaced(aid, info)
+            # the pre-restart incarnation's process is gone: any task-worker
+            # lease it still owns is orphaned — broadcast before the address
+            # is cleared for the new placement
+            self._note_dead_owner(info.get("address"))
             info.update(state="RESTARTING", address=None, node_id=None)
             self._publish("actors", {"actor_id": aid, "state": "RESTARTING"})
             asyncio.ensure_future(self._schedule_actor(aid, delay=0.1))
@@ -942,6 +1083,7 @@ class GcsServer:
         if info is None:
             return
         self._actor_dead(aid, info)
+        self._note_dead_owner(info.get("address"))
         info.update(state="DEAD", death_cause=reason)
         self._persist_soon()
         self._publish("actors", {"actor_id": aid, "state": "DEAD", "reason": reason})

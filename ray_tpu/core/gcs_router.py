@@ -215,7 +215,13 @@ class ShardedGcsClient:
             return await client.call(method, _timeout=_timeout, **kwargs)
         except (ConnectionError, OSError, RpcError,
                 asyncio.TimeoutError) as e:
-            if client in self._shard_clients and not isinstance(
+            # "was this a shard connection?" must not be answered by
+            # membership in self._shard_clients: a CONCURRENT call that hit
+            # the same dead shard may have run _shard_failed() first and
+            # cleared/rebuilt the list, making the in-flight client look
+            # foreign and re-raising instead of falling back.  Router
+            # clients are the stable set — anything else is a shard.
+            if client not in self._routers and not isinstance(
                     e, RemoteError):
                 self._shard_failed()
                 return await self._router().call(
@@ -231,7 +237,9 @@ class ShardedGcsClient:
                 method, _timeout=_timeout, _attempts=_attempts,
                 _idempotent=_idempotent, **kwargs)
         except (ConnectionError, OSError, RpcError, asyncio.TimeoutError) as e:
-            if client in self._shard_clients and not isinstance(
+            # same membership race as call() above: router identity, not
+            # _shard_clients membership, decides the fallback.
+            if client not in self._routers and not isinstance(
                     e, RemoteError):
                 self._shard_failed()
                 return await self._router().call_retry(

@@ -234,6 +234,9 @@ class NodeAgent:
         self.worker_clients = ClientPool()
         self.agent_clients = ClientPool()
         self.cluster_view: Dict[str, NodeView] = {}
+        #: last replayed seq of the GCS dead-lease-owner broadcast (heartbeat
+        #: piggyback, same convergence pattern as chaos/shard_map)
+        self._dead_owners_seq = 0
         self.session_dir = session_dir
         self.worker_env = dict(worker_env or {})
         self._bg: List[asyncio.Task] = []
@@ -376,6 +379,10 @@ class NodeAgent:
             resources=self.total.to_dict(), labels=self.labels)
         self._apply_view(res["cluster_view"])
         self.gcs.apply_shard_map(res.get("shard_map"))
+        # start at the GCS's current dead-owner seq: everything before it
+        # predates this node (no leases to reclaim), and a fresh agent
+        # heartbeating seq=0 would otherwise replay the whole deque
+        self._dead_owners_seq = int(res.get("dead_owners_seq", 0))
         # config/env chaos spec: arm the kill schedule (if any) at boot
         self._arm_chaos_schedule()
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -432,11 +439,27 @@ class NodeAgent:
             counts[key] = counts.get(key, 0) + 1
         return [[dict(k), c] for k, c in list(counts.items())[:max_shapes]]
 
+    def _aggregate_task_leases(self) -> Dict[str, float]:
+        """Resources held by short-lived task leases (non-actor, outside any
+        PG bundle; blocked leases already released theirs).  Rides the
+        heartbeat so elastic capacity probes can treat this slice of a
+        busy node as reclaimable headroom rather than permanent load."""
+        out: Dict[str, float] = {}
+        for w in self.workers.values():
+            if (w.state == "LEASED" and w.lease_id and not w.is_actor
+                    and not w.blocked
+                    and w.lease_id not in self._bundle_of_lease):
+                for k, v in (self._lease_resources.get(w.lease_id)
+                             or {}).items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
     def _apply_view(self, payload: Dict[str, dict]):
         self.cluster_view = {
             nid: NodeView(nid, d["address"], d["total"], d["available"],
                           d.get("labels", {}), d.get("alive", True),
-                          d.get("queue_len", 0), d.get("draining", False))
+                          d.get("queue_len", 0), d.get("draining", False),
+                          d.get("task_leased", {}))
             for nid, d in payload.items()}
 
     async def _heartbeat_loop(self):
@@ -455,7 +478,9 @@ class NodeAgent:
                     store_stats=self.store.stats(),
                     chaos_version=self._chaos_version,
                     draining=self._draining,
-                    shard_map_version=self.gcs.shard_map_version)
+                    shard_map_version=self.gcs.shard_map_version,
+                    dead_owners_seq=self._dead_owners_seq,
+                    task_leased=self._aggregate_task_leases())
                 if res.get("unknown"):
                     res2 = await self.gcs.call_retry(
                         "register_node", node_id=self.node_id.hex(),
@@ -463,6 +488,12 @@ class NodeAgent:
                         resources=self.total.to_dict(), labels=self.labels)
                     self._apply_view(res2["cluster_view"])
                     self.gcs.apply_shard_map(res2.get("shard_map"))
+                    # adopt the (possibly restarted) GCS's dead-owner seq:
+                    # keeping our old, higher counter would make the
+                    # heartbeat's `seq < gcs_seq` check silently skip
+                    # every new dead-owner broadcast until it caught up
+                    self._dead_owners_seq = int(
+                        res2.get("dead_owners_seq", 0))
                 elif "view" in res:
                     self._apply_view(res["view"])
                 if "shard_map" in res:
@@ -475,6 +506,15 @@ class NodeAgent:
                     # chaos_clear): converge via the heartbeat piggyback
                     await self._apply_chaos(res["chaos"]["spec"],
                                             res["chaos"]["version"])
+                if "dead_owners" in res:
+                    # confirmed-dead lease owners (killed/crashed actors):
+                    # reclaim their orphaned task-worker leases NOW instead
+                    # of waiting out the pin sweep's 3-strike probe — an
+                    # elastic re-form may be queued on the freed slot
+                    self._dead_owners_seq = res["dead_owners"]["seq"]
+                    for addr in res["dead_owners"]["addrs"]:
+                        await self._drain_read_pins(addr)
+                        await self._reclaim_dead_owner_leases(addr)
                 if self.lease_queue:
                     await self._process_lease_queue()
             except Exception:
@@ -1326,6 +1366,19 @@ class NodeAgent:
             return
         self._draining = True
         deadline = time.monotonic() + notice_s
+        # tell the GCS at drain START (not the end): the notice is the
+        # elastic train plane's advance warning — a trainer with workers
+        # here resizes DOWN inside the notice window instead of eating an
+        # actor death.  Best-effort: a lost report just means the slower
+        # heartbeat-draining path carries the flag.
+        try:
+            await asyncio.wait_for(
+                self.gcs.call("report_drain_notice",
+                              node_id=self.node_id.hex(),
+                              notice_s=notice_s),
+                timeout=min(2.0, notice_s / 2))
+        except Exception:
+            pass
         # shed queued lease requests NOW: every parked owner re-picks a
         # node instead of waiting on a grant that will never come
         cfg = get_config()

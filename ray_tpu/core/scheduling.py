@@ -38,6 +38,11 @@ class NodeView:
     #: preemption notice received — still alive (finishing leases, spilling
     #: objects) but not schedulable: pick_node/pack_bundles skip it
     draining: bool = False
+    #: resources currently held by short-lived TASK leases (non-actor,
+    #: non-bundle) — capacity that returns to the pool within an idle-return
+    #: window.  Elastic sizing counts it as reclaimable headroom: a node
+    #: churning 1-CPU dataset tasks is not "full" to a worker-group probe.
+    task_leased: Dict[str, float] = field(default_factory=dict)
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)

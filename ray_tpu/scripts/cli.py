@@ -839,8 +839,25 @@ def _doctor_snapshot(rt):
         slo = serve_api.slo_signal()
     except Exception:
         slo = {}
+    # elastic evidence: active drain notices + in-progress resizes feed
+    # the NODE_DRAINING/TRAIN_RESIZING rules AND suppress NODE_FLAPPING
+    # for nodes that are dying on purpose
+    try:
+        notices = state_api.drain_notices()
+    except Exception:
+        notices = []
+    try:
+        resizes = state_api.train_resizes()
+    except Exception:
+        resizes = {}
     snap = health_plane.build_head_snapshot(store, slo=slo,
-                                            sched_stats=stats)
+                                            sched_stats=stats,
+                                            drain_notices=notices)
+    snap["draining_notices"] = {
+        str(n.get("node_id"))[:12]: n.get("remaining_s", 0.0)
+        for n in notices if n.get("active")}
+    snap["train_resizing"] = resizes.get("in_progress") or {}
+    snap["resize_records"] = resizes.get("records") or []
     snap["oneshot"] = True
     leak_rows = []
     try:
@@ -901,6 +918,26 @@ def cmd_doctor(args):
     nodes = [n for n in rt.nodes() if n.get("Alive")]
     print(f"raytpu doctor — {len(nodes)} alive node(s), "
           f"{len(alerts)} finding(s)")
+    # elastic plane: planned churn, rendered apart from the alert list so
+    # an operator reads "resizing" before they read "unhealthy"
+    draining = snap.get("draining_notices") or {}
+    resizing = snap.get("train_resizing") or {}
+    records = snap.get("resize_records") or []
+    if draining or resizing or records:
+        print("elastic:")
+        for nid, left in sorted(draining.items()):
+            print(f"  draining  node={nid}  notice expires in {left:.0f}s "
+                  "(scheduler routing around it)")
+        for trial, rec in sorted(resizing.items()):
+            print(f"  resizing  trial={trial}  {rec.get('direction', '?')} "
+                  f"from world={rec.get('from', '?')} (re-form in flight)")
+        for rec in records[-3:]:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(rec.get("ts", 0)))
+            print(f"  resized   {ts}  trial={rec.get('trial', '?')}  "
+                  f"{rec.get('direction', '?')}: world {rec.get('from', '?')}"
+                  f" -> {rec.get('to', '?')} in {rec.get('wall_s', 0):.1f}s"
+                  f" ({rec.get('reason', '?')})")
     if not alerts:
         print("  healthy: no rule above its raise threshold "
               f"({len(health_plane.HealthRule.ALL)} rules evaluated)")

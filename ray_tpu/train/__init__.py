@@ -18,6 +18,7 @@ from .backend import (Backend, BackendConfig, JaxBackendConfig,
                       TorchBackendConfig, prepare_torch_model)
 from .worker_group import WorkerGroup
 from .backend_executor import BackendExecutor, TrainingFailedError
+from .elastic import ElasticWatcher, ResizeSignal
 from .trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from .jax_utils import load_pytree, save_pytree
 from .observability import StepTracker, status
@@ -27,7 +28,7 @@ __all__ = [
     "ScalingConfig", "TrainContext", "get_context", "get_checkpoint",
     "get_dataset_shard", "report", "Result", "Backend", "BackendConfig",
     "JaxBackendConfig", "TorchBackendConfig", "prepare_torch_model",
-    "WorkerGroup", "BackendExecutor",
+    "WorkerGroup", "BackendExecutor", "ElasticWatcher", "ResizeSignal",
     "TrainingFailedError", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "save_pytree", "load_pytree", "StepTracker", "status",
 ]

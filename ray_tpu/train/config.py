@@ -30,6 +30,15 @@ class ScalingConfig:
     # kwargs). -1 fills with remaining devices.
     mesh: Optional[Dict[str, int]] = None
     topology: Optional[str] = None  # e.g. "v5p-64"; informs ICI-aware placement
+    # Elastic training: when set, a preemption drain notice resizes the
+    # worker group in place (down to min_workers at worst, back up toward
+    # num_workers when capacity returns) instead of failing the run.
+    # None = rigid world size, any worker loss is a TrainingFailedError.
+    min_workers: Optional[int] = None
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
 
     @property
     def _resources_per_worker_not_none(self) -> Dict[str, float]:
@@ -37,9 +46,13 @@ class ScalingConfig:
             return dict(self.resources_per_worker)
         return {"CPU": 1.0, "TPU": 4.0} if self.use_tpu else {"CPU": 1.0}
 
-    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
-        bundles = [self._resources_per_worker_not_none
-                   for _ in range(self.num_workers)]
+    def as_placement_group_bundles(
+            self, num_workers: Optional[int] = None) -> List[Dict[str, float]]:
+        """Bundle list for ``num_workers`` workers (default: the
+        configured target — elastic re-forms pass the current world
+        size)."""
+        n = self.num_workers if num_workers is None else num_workers
+        bundles = [self._resources_per_worker_not_none for _ in range(n)]
         trainer = self.trainer_resources
         if trainer:
             bundles = [dict(trainer)] + bundles

@@ -121,6 +121,10 @@ def _build():
         "mem_limit": Gauge(
             "raytpu_train_device_bytes_limit",
             "accelerator memory capacity", tag_keys=("rank",)),
+        "resizes": Counter(
+            "raytpu_train_resizes_total",
+            "elastic worker-group resizes (in place, no job restart)",
+            tag_keys=("direction",)),
     }
 
 
@@ -429,6 +433,12 @@ class StepTracker:
         return {
             "rank": self.rank,
             "steps": self._steps,
+            # raw goodput numerator/denominator: the executor sums
+            # productive seconds ACROSS elastic resizes (each generation's
+            # tracker restarts its clocks), which a pre-divided fraction
+            # can't support
+            "productive_s": self._productive_s,
+            "wall_s": max(time.monotonic() - self._train_t0, 0.0),
             "compile_s": self._compile_s,
             "step_time_s": latency_summary(list(self._step_walls)),
             "stage_totals_s": dict(self._stage_totals),
@@ -485,10 +495,21 @@ def aggregate(snaps: Dict[int, Optional[dict]]) -> Optional[dict]:
         "step_time_p50_s": mean(p50s),
         "mfu": mean(vals("mfu")),
         "goodput": mean(vals("goodput")),
+        "productive_s": mean(vals("productive_s")),
         "tokens_total": sum(vals("tokens_total")) or 0,
         "workers": {int(r): s for r, s in live.items()},
     }
     return out
+
+
+def record_resize(direction: str) -> None:
+    """Bump ``raytpu_train_resizes_total{direction}`` (direction is the
+    closed up/down vocabulary — never a node id or world size)."""
+    if not enabled():
+        return
+    m = _metrics()
+    if m is not None:
+        m["resizes"].inc_key((("direction", str(direction)),))
 
 
 #: trial name -> latest rollup, updated by BackendExecutor.fetch_next on

@@ -19,8 +19,16 @@ class Result:
     best_checkpoints: Optional[List[Tuple[Checkpoint, Dict[str, Any]]]] = None
     config: Optional[Dict[str, Any]] = None  # the trial's hyperparameters
     #: training-observability rollup (train/observability.py aggregate):
-    #: steps, compile_s, step-time p50, MFU, goodput, per-rank snapshots
+    #: steps, compile_s, step-time p50, MFU, goodput, per-rank snapshots;
+    #: elastic runs add "resizes" (per-transition records) and
+    #: "run_goodput" (productive seconds / wall across every resize)
     train_obs: Optional[Dict[str, Any]] = None
+    #: elastic worker-group transitions, newest last (empty for rigid runs)
+    resizes: Optional[List[Dict[str, Any]]] = None
+
+    @property
+    def num_resizes(self) -> int:
+        return len(self.resizes or [])
 
     @property
     def metrics_dataframe(self):

@@ -4,9 +4,17 @@
 
 The reference routes ``fit()`` through a single-trial Tune run; here the
 driver loop is direct (Tune integrates the other way: a trainer can be passed
-to ``ray_tpu.tune.Tuner``).  Elastic fault tolerance: on worker-group failure
-the group is torn down, re-created, and the loop restarts from the latest
-registered checkpoint, up to ``FailureConfig.max_failures`` times.
+to ``ray_tpu.tune.Tuner``).  Fault tolerance comes in two tiers:
+
+* **Elastic resize** (``ScalingConfig.min_workers``): a preemption drain
+  notice or worker death RESIZES the group in place — the executor
+  checkpoints at the barrier, re-forms at the new world size, re-splits
+  the data shards, and resumes; ``fit()`` never sees a failure and the
+  resize ledger lands on ``Result.resizes``.
+* **Restart from checkpoint** (FailureConfig): when the resize path is
+  off — or a resize itself fails (capacity below ``min_workers``) — the
+  group is torn down, re-created, and the loop restarts from the latest
+  registered checkpoint, up to ``FailureConfig.max_failures`` times.
 
 ``JaxTrainer`` is the TorchTrainer-equivalent (``train/torch/torch_trainer.py``)
 with the jax.distributed backend (see backend.py) — the worker loop builds the
@@ -119,7 +127,8 @@ class BaseTrainer:
                         checkpoint=best or latest or checkpoint,
                         path=trial_dir, error=error,
                         metrics_history=history,
-                        train_obs=executor.train_obs)
+                        train_obs=executor.train_obs,
+                        resizes=list(executor.resize_records))
         if error is not None and not getattr(self, "_suppress_errors", False):
             raise TrainingFailedError(
                 f"training failed after {failures} failure(s)") from error

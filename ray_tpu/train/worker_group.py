@@ -145,7 +145,8 @@ class WorkerGroup:
                  worker_env: Optional[Dict[str, str]] = None,
                  bundle_offset: int = 0,
                  pg=None,
-                 owns_pg: Optional[bool] = None):
+                 owns_pg: Optional[bool] = None,
+                 pg_timeout_s: float = 120.0):
         self.num_workers = num_workers
         self._own_pg = (pg is None) if owns_pg is None else owns_pg
         self.workers = []
@@ -155,11 +156,11 @@ class WorkerGroup:
             bundle_offset = 0
         self.pg = pg
         try:
-            if not pg.ready(timeout=120.0):
+            if not pg.ready(timeout=pg_timeout_s):
                 raise TimeoutError(
                     f"placement group for {num_workers} train workers "
-                    f"({resources_per_worker} each) not ready after 120s — "
-                    f"insufficient cluster resources?")
+                    f"({resources_per_worker} each) not ready after "
+                    f"{pg_timeout_s:.0f}s — insufficient cluster resources?")
             cls = ray_tpu.remote(TrainWorker)
             num_cpus = resources_per_worker.get("CPU", 1)
             extra = {k: v for k, v in resources_per_worker.items()
@@ -197,6 +198,14 @@ class WorkerGroup:
 
     def __len__(self) -> int:
         return self.num_workers
+
+    def workers_per_node(self) -> Dict[str, int]:
+        """node_id -> how many of our ranks live there (the elastic
+        watcher matches drain notices against this map)."""
+        out: Dict[str, int] = {}
+        for info in self.worker_infos:
+            out[info["node_id"]] = out.get(info["node_id"], 0) + 1
+        return out
 
     def execute_async(self, fn: Callable, *args, **kwargs):
         return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]

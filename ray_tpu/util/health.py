@@ -93,6 +93,12 @@ class HealthRule:
     GOODPUT_DROP = "GOODPUT_DROP"
     #: a node's /metrics scrape flipped error<->ok repeatedly in window
     NODE_FLAPPING = "NODE_FLAPPING"
+    #: a node is draining under an active preemption notice — planned
+    #: churn (elastic trainers resize on it), distinct from flapping
+    NODE_DRAINING = "NODE_DRAINING"
+    #: a training run is mid elastic resize (worker group torn down,
+    #: re-form in flight) — expected to clear within seconds
+    TRAIN_RESIZING = "TRAIN_RESIZING"
     #: one GCS handler is eating a large fraction of a shard's loop
     GCS_HANDLER_HOT = "GCS_HANDLER_HOT"
     #: sustained heavy spill traffic out of the shm store
@@ -105,7 +111,8 @@ class HealthRule:
     ALL = frozenset({
         "OWNER_LOOP_SATURATED", "EVENTS_SHED", "SLO_SIGNAL_STALE",
         "TTFT_BREACH", "ARENA_FRAG_HIGH", "LEAK_SUSPECTS", "GOODPUT_DROP",
-        "NODE_FLAPPING", "GCS_HANDLER_HOT", "SPILL_STORM",
+        "NODE_FLAPPING", "NODE_DRAINING", "TRAIN_RESIZING",
+        "GCS_HANDLER_HOT", "SPILL_STORM",
         "BACKPRESSURE_SUSTAINED", "DISK_LOW",
     })
 
@@ -137,6 +144,13 @@ _NEXT_STEP: Dict[str, str] = {
     HealthRule.NODE_FLAPPING:
         "run `raytpu status` and `raytpu logs <node-id>`; a flapping "
         "agent usually means OOM kills or a dying host",
+    HealthRule.NODE_DRAINING:
+        "planned churn: the node is draining under a preemption notice "
+        "(`raytpu doctor` shows the remaining window); elastic trainers "
+        "resize around it — only act if it never clears",
+    HealthRule.TRAIN_RESIZING:
+        "a trainer is re-forming its worker group (`raytpu train` shows "
+        "the transition ledger); investigate only if stuck >5 min",
     HealthRule.GCS_HANDLER_HOT:
         "run `raytpu explain --stats` (top_handlers); raise gcs_shards "
         "or batch the offending call path",
@@ -284,6 +298,16 @@ def _check_flapping(snap: dict) -> Dict[str, tuple]:
             for n, c in (snap.get("flaps") or {}).items()}
 
 
+def _check_draining(snap: dict) -> Dict[str, tuple]:
+    return {f"node:{n}": (1.0, {"notice_remaining_s": round(float(r), 1)})
+            for n, r in (snap.get("draining_notices") or {}).items()}
+
+
+def _check_resizing(snap: dict) -> Dict[str, tuple]:
+    return {f"trial:{t}": (1.0, dict(info or {}))
+            for t, info in (snap.get("train_resizing") or {}).items()}
+
+
 def _check_handler_hot(snap: dict) -> Dict[str, tuple]:
     return {f"gcs:{m}": (frac, {"busy_fraction": round(frac, 3)})
             for m, frac in (snap.get("handler_busy") or {}).items()}
@@ -330,6 +354,12 @@ def default_rules() -> List[Rule]:
         Rule(HealthRule.NODE_FLAPPING, _check_flapping,
              raise_at=2.0, clear_at=1.0, severity=SEV_CRITICAL,
              hold_s=0.0),  # >=2 flips in window IS the sustained signal
+        Rule(HealthRule.NODE_DRAINING, _check_draining,
+             raise_at=1.0, clear_at=0.0, severity=SEV_WARNING,
+             hold_s=0.0, min_hold_s=0.0),  # the notice IS the condition
+        Rule(HealthRule.TRAIN_RESIZING, _check_resizing,
+             raise_at=1.0, clear_at=0.0, severity=SEV_WARNING,
+             hold_s=0.0, min_hold_s=0.0),  # clears when the re-form lands
         Rule(HealthRule.GCS_HANDLER_HOT, _check_handler_hot,
              raise_at=0.50, clear_at=0.25, severity=SEV_WARNING),
         Rule(HealthRule.SPILL_STORM, _check_spill_storm,
@@ -343,8 +373,10 @@ def default_rules() -> List[Rule]:
 
 
 #: rules the GCS evaluates from process-local state at snapshot cadence
+#: (drain notices and the in-progress resize map live in GCS memory)
 GCS_RULE_NAMES = frozenset({
     HealthRule.EVENTS_SHED, HealthRule.GCS_HANDLER_HOT,
+    HealthRule.NODE_DRAINING, HealthRule.TRAIN_RESIZING,
 })
 
 #: rules the dashboard head evaluates per scrape tick.  Disjoint from
@@ -649,11 +681,18 @@ def _sum_positive_deltas(points: List[list], window_s: float,
 def build_head_snapshot(store, slo: Optional[dict] = None,
                         sched_stats: Optional[dict] = None,
                         now: Optional[float] = None,
-                        window_s: float = 60.0) -> dict:
+                        window_s: float = 60.0,
+                        drain_notices: Optional[List[dict]] = None) -> dict:
     """Evidence snapshot for the HEAD rule subset, read entirely from
     the MetricsHistory the scrape loop already maintains (plus the serve
     signal / sched_stats dicts the caller may already hold).  Cost: dict
-    walks over the freshest sample per node — no new RPCs."""
+    walks over the freshest sample per node — no new RPCs.
+
+    ``drain_notices`` (the GCS get_drain_notices rows, when the caller
+    holds them) suppresses NODE_FLAPPING for nodes under an active
+    preemption notice: a drained node's scrape going dark is planned
+    elastic churn, and alarming it as flapping sends the operator
+    chasing a healthy mechanism."""
     now = time.time() if now is None else now
     snap: Dict[str, Any] = {"now": now}
     loop_busy: Dict[str, float] = {}
@@ -712,6 +751,15 @@ def build_head_snapshot(store, slo: Optional[dict] = None,
                 spill[node] = spill.get(node, 0.0) + rate
             elif name == "raytpu_sched_backpressure_total":
                 bp[node] = bp.get(node, 0.0) + rate
+
+    if flaps and drain_notices:
+        # scrape-target names and GCS node ids may be different lengths
+        # (short vs full hex) — match on either containing the other
+        draining_ids = [str(n.get("node_id") or "") for n in drain_notices
+                        if n.get("active")]
+        flaps = {node: c for node, c in flaps.items()
+                 if not any(d and (d in str(node) or str(node) in d)
+                            for d in draining_ids)}
 
     snap["loop_busy"] = loop_busy
     snap["loop_stalls"] = loop_stalls

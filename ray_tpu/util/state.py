@@ -241,6 +241,21 @@ def health_alerts(limit: int = 100, rule: str | None = None,
     return _gcs_call("get_health_alerts", limit=limit, rule=rule, kind=kind)
 
 
+def drain_notices() -> List[Dict[str, Any]]:
+    """Active + recently-completed preemption drain notices (node agents
+    report at drain START; ``active`` = the node is still alive).  The
+    elastic train plane resizes on these; ``raytpu doctor`` renders them
+    so planned churn never reads as node flapping."""
+    return _gcs_call("get_drain_notices") or []
+
+
+def train_resizes(limit: int = 100) -> Dict[str, Any]:
+    """The elastic-resize ledger: ``records`` (completed transitions,
+    oldest first — direction/from/to/wall_s/trigger nodes) and
+    ``in_progress`` (trial -> the transition currently re-forming)."""
+    return _gcs_call("get_train_resizes", limit=limit) or {}
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Task-state rollup + per-stage latency percentiles + pending-reason
     rollup.

@@ -1,0 +1,492 @@
+"""Elastic training: a preemption drain notice resizes the worker group
+in place (down to ``min_workers``, back up toward ``num_workers`` when
+capacity returns) instead of failing the run.
+
+The integration test drives the full production signal path: seeded
+``preempt_node`` chaos -> node agent drain notice -> GCS notice registry
+-> ElasticWatcher -> BackendExecutor barrier-point resize -> dataset
+shard re-split -> resume from the coordinated checkpoint.  The trainer
+driver runs in its own process (like the workflow driver-loss tests) so
+this test process can lose and regain nodes mid-run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.rpc import RpcClient, run_async
+from ray_tpu.train.elastic import ElasticWatcher, ResizeSignal, fit_world_size
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    assert cond(), f"timed out waiting for {msg}"
+
+
+# ------------------------------------------------------------ unit: sizing
+
+def test_fit_world_size_excludes_draining_and_dead():
+    view = {
+        "a": {"alive": True, "draining": False, "available": {"CPU": 3.0}},
+        "b": {"alive": True, "draining": True, "available": {"CPU": 16.0}},
+        "c": {"alive": False, "draining": False, "available": {"CPU": 16.0}},
+    }
+    # only node a counts: 3 CPU hosts one {CPU: 3} bundle
+    assert fit_world_size(view, {"CPU": 3.0}, lo=1, hi=4) == 1
+    # lo is a floor even when nothing fits
+    assert fit_world_size(view, {"CPU": 8.0}, lo=2, hi=4) == 2
+    # hi caps abundant capacity
+    assert fit_world_size(view, {"CPU": 1.0}, lo=1, hi=2) == 2
+
+
+def test_fit_world_size_reclaims_own_bundles():
+    # a same-size re-form on a fully-packed surviving node must not look
+    # infeasible: the resize itself frees our bundles
+    view = {"a": {"alive": True, "draining": False,
+                  "available": {"CPU": 0.0}}}
+    assert fit_world_size(view, {"CPU": 3.0}, lo=1, hi=2) == 1  # floor
+    assert fit_world_size(view, {"CPU": 3.0}, lo=1, hi=2,
+                          reclaim={"a": 2}) == 2
+
+
+# ----------------------------------------------------------- unit: watcher
+
+def test_watcher_down_signal_and_dedup(monkeypatch):
+    from ray_tpu.train import elastic
+
+    calls = {}
+
+    def fake_gcs(method, **kw):
+        calls[method] = calls.get(method, 0) + 1
+        if method == "get_drain_notices":
+            return [{"node_id": "n1", "active": True}]
+        if method == "get_cluster_view":
+            return {}
+        return None
+
+    monkeypatch.setattr(elastic, "_gcs_call", fake_gcs)
+    w = ElasticWatcher(target_workers=4, min_workers=2,
+                       bundle={"CPU": 1.0}, trial="t", poll_s=0.0)
+    sig = w.poll({"n1": 2, "n2": 2}, 4)
+    assert isinstance(sig, ResizeSignal)
+    assert sig.direction == "down" and sig.reason == "drain"
+    assert sig.node_ids == ["n1"]
+    assert sig.target_world_size == 2  # max(min_workers, 4 - 2 lost)
+    # the notice is consumed: no re-signal loop, and while below target
+    # the watcher feeds the autoscaler its missing-worker demand
+    assert w.poll({"n2": 2}, 2) is None
+    assert calls.get("report_pending_demand", 0) >= 1
+
+
+def test_watcher_up_signal_on_fresh_capacity(monkeypatch):
+    from ray_tpu.train import elastic
+
+    view = {"old": {"alive": True, "draining": False,
+                    "available": {"CPU": 0.0}},
+            "new": {"alive": True, "draining": False,
+                    "available": {"CPU": 2.0}}}
+
+    def fake_gcs(method, **kw):
+        if method == "get_drain_notices":
+            return []
+        if method == "get_cluster_view":
+            return view
+        return None
+
+    monkeypatch.setattr(elastic, "_gcs_call", fake_gcs)
+    w = ElasticWatcher(target_workers=2, min_workers=1,
+                       bundle={"CPU": 1.0}, trial="t", poll_s=0.0,
+                       demand_every_s=0.0)
+    sig = w.poll({"old": 1}, 1)
+    assert sig is not None and sig.direction == "up"
+    assert sig.reason == "capacity" and sig.target_world_size == 2
+    assert "new" in sig.node_ids
+    # at target: no signal either way
+    assert w.poll({"old": 1, "new": 1}, 2) is None
+
+
+# ------------------------------------- unit: executor failure/fallback paths
+
+def _make_executor(tmp_path, num_workers=4, min_workers=1):
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.backend_executor import BackendExecutor
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+    return BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=num_workers, min_workers=min_workers,
+                      resources_per_worker={"CPU": 1.0}),
+        RunConfig(name="t"), trial_name="t", trial_dir=str(tmp_path))
+
+
+class _FakeRef:
+    def __init__(self, kind):
+        self.kind = kind
+
+
+class _FakeMethod:
+    def __init__(self, kind):
+        self.kind = kind
+
+    def remote(self, *a, **kw):
+        return _FakeRef(self.kind)
+
+
+class _FakeWorker:
+    def __init__(self):
+        self.next_result = _FakeMethod("next_result")
+        self.resume = _FakeMethod("resume")
+
+
+class _FakeGroup:
+    def __init__(self, n):
+        self.workers = [_FakeWorker() for _ in range(n)]
+
+    def workers_per_node(self):
+        return {"node": len(self.workers)}
+
+
+def test_barrier_resize_failure_raises_typed_error(tmp_path, monkeypatch):
+    """A barrier-time resize that tears the group down but cannot re-form
+    must surface as TrainingFailedError (so the trainer's FailureConfig
+    restart-from-checkpoint path fires) — NOT fall through to resume()
+    on the already-killed workers, which would crash fit() with a raw
+    ActorDiedError."""
+    from ray_tpu.train import backend_executor as be
+    ex = _make_executor(tmp_path)
+    ex._train_fn = lambda cfg: None
+    ex.worker_group = _FakeGroup(2)
+
+    def fake_get(refs, timeout=None):
+        refs = refs if isinstance(refs, list) else [refs]
+        if any(r.kind == "resume" for r in refs):
+            # the pre-fix failure mode: resuming a torn-down group dies
+            # with a raw (non-TrainingFailedError) actor error
+            raise ray_tpu.ActorDiedError("resumed a torn-down group")
+        return [("report", {"loss": 1.0}, None, None) for _ in refs]
+
+    monkeypatch.setattr(be.ray_tpu, "get", fake_get)
+    monkeypatch.setattr(
+        ex._watcher, "poll",
+        lambda *a, **kw: ResizeSignal(direction="down", reason="drain",
+                                      target_world_size=1))
+    monkeypatch.setattr(ex, "_resize", lambda sig: False)
+    with pytest.raises(be.TrainingFailedError, match="re-form failed"):
+        ex.fetch_next(timeout=5)
+
+
+def test_failure_resize_shrinks_and_caps(tmp_path, monkeypatch):
+    """No-notice worker death re-forms ONE SMALLER, and a worker that
+    dies every round escapes to the rigid TrainingFailedError path after
+    a bounded number of consecutive resizes instead of tearing down and
+    re-forming forever."""
+    from ray_tpu.train import backend_executor as be
+    ex = _make_executor(tmp_path, num_workers=4, min_workers=1)
+    ex._train_fn = lambda cfg: None
+    ex.worker_group = _FakeGroup(4)
+
+    def fake_get(refs, timeout=None):
+        raise ray_tpu.ActorDiedError("worker died")
+
+    monkeypatch.setattr(be.ray_tpu, "get", fake_get)
+    sigs = []
+
+    def fake_resize(sig):
+        sigs.append(sig)
+        ex._current_workers = sig.target_world_size
+        ex.worker_group = _FakeGroup(sig.target_world_size)
+        return True
+
+    monkeypatch.setattr(ex, "_resize", fake_resize)
+    with pytest.raises(be.TrainingFailedError):
+        ex.fetch_next(timeout=5)
+    assert [s.target_world_size for s in sigs] == [3, 2, 1]
+    assert all(s.reason == "failure" for s in sigs)
+
+
+# ------------------------------------ unit: gcs drain/dead-owner registry
+
+def test_aborted_drain_notice_expires():
+    """A node that outlives its drain deadline past the grace window and
+    clears its draining flag (preemption cancelled) must not keep an
+    active notice forever — while a node still draining past its
+    deadline keeps its notice."""
+    from ray_tpu.core.gcs import GcsServer
+    gcs = GcsServer()
+    run_async(gcs.handle_register_node("n1", "addr:1", {"CPU": 4.0}, {}))
+    run_async(gcs.handle_report_drain_notice("n1", notice_s=5.0))
+    notices = run_async(gcs.handle_get_drain_notices())
+    assert notices and notices[0]["active"]
+    # drain aborted: the agent heartbeats draining=False and the deadline
+    # slides past the 60s grace window
+    gcs.nodes["n1"].draining = False
+    gcs._drain_notices["n1"]["deadline"] -= 120.0
+    assert run_async(gcs.handle_get_drain_notices()) == []
+    # still-draining nodes keep their (late) notice
+    run_async(gcs.handle_report_drain_notice("n1", notice_s=5.0))
+    gcs._drain_notices["n1"]["deadline"] -= 120.0
+    notices = run_async(gcs.handle_get_drain_notices())
+    assert notices and notices[0]["active"]
+
+
+def test_register_node_resyncs_dead_owner_seq():
+    """register_node hands back the GCS's current dead-owner seq so an
+    agent that outlived a GCS restart (its remembered seq now HIGHER
+    than the restarted counter) resyncs instead of silently skipping
+    every new broadcast until the counter catches up."""
+    from ray_tpu.core.gcs import GcsServer
+    gcs = GcsServer()
+    gcs._note_dead_owner("w:1")
+    gcs._note_dead_owner("w:2")
+    res = run_async(gcs.handle_register_node("n1", "addr:1",
+                                             {"CPU": 1.0}, {}))
+    assert res["dead_owners_seq"] == 2
+    # in-sync agent: no replay
+    hb = run_async(gcs.handle_heartbeat("n1", {"CPU": 1.0},
+                                        dead_owners_seq=2))
+    assert "dead_owners" not in hb
+    # a new death after the resync reaches the agent
+    gcs._note_dead_owner("w:3")
+    hb = run_async(gcs.handle_heartbeat("n1", {"CPU": 1.0},
+                                        dead_owners_seq=2))
+    assert hb["dead_owners"] == {"seq": 3, "addrs": ["w:3"]}
+
+
+# ------------------------------------------- integration: lose one, regain one
+
+# Trainer driver: 2 elastic workers ({CPU: 3} each), one 64-row dataset
+# shard ledger per (epoch, world_size, rank), checkpoint every epoch.  The
+# orchestrating test preempts one worker node with a graceful notice and
+# later adds a fresh node; the run must resize 2 -> 1 -> 2 without a
+# single job restart.
+_ELASTIC_DRIVER = """
+import json
+import sys
+
+import ray_tpu
+import ray_tpu.data as rdata
+from ray_tpu.train import (Checkpoint, DataParallelTrainer, FailureConfig,
+                           RunConfig, ScalingConfig)
+
+gcs_address, storage, ids_dir, stop_path, out_path = sys.argv[1:6]
+info = ray_tpu.init(address=gcs_address, log_to_driver=False)
+# joining an existing cluster leaves info["node_id"] None: the driver is
+# identified by the agent it attached to, so report that address
+from ray_tpu.core.core_worker import global_worker
+print("DRIVER_AGENT", global_worker().agent_address, flush=True)
+
+N_ROWS = 64
+
+
+def loop(config):
+    import json as _json
+    import os as _os
+    import tempfile as _tmp
+    import time as _time
+    from ray_tpu import train as _train
+    from ray_tpu.train import Checkpoint as _Ckpt
+    ctx = _train.get_context()
+    start = 0
+    ckpt = _train.get_checkpoint()
+    if ckpt:
+        with open(_os.path.join(ckpt.path, "state.json")) as f:
+            start = _json.load(f)["epoch"] + 1
+    shard = _train.get_dataset_shard("train")
+    for epoch in range(start, 300):
+        # orchestrator-controlled stop: the file names a stop epoch a few
+        # barrier rounds ahead, so every rank (lockstepped by the report
+        # barrier) reads the same decision at the same epoch
+        if _os.path.exists(config["stop"]):
+            with open(config["stop"]) as f:
+                if epoch >= int(f.read().strip() or 10**9):
+                    break
+        ids = []
+        for batch in shard.iter_batches(batch_size=16,
+                                        batch_format="numpy"):
+            ids.extend(int(x) for x in batch["id"])
+        _time.sleep(0.05)
+        # consumed-id ledger BEFORE report: an aborted rank never reaches
+        # report for this epoch, so a ledger file pins an epoch pass the
+        # rank actually finished
+        p = _os.path.join(
+            config["ids_dir"],
+            "epoch%03d-of%d-rank%d.json" % (epoch, ctx.get_world_size(),
+                                            ctx.get_world_rank()))
+        with open(p + ".tmp", "w") as f:
+            _json.dump(sorted(ids), f)
+        _os.replace(p + ".tmp", p)
+        ck = None
+        if ctx.get_world_rank() == 0:
+            d = _tmp.mkdtemp()
+            with open(_os.path.join(d, "state.json"), "w") as f:
+                _json.dump({"epoch": epoch}, f)
+            ck = _Ckpt(d)
+        _train.report({"epoch": epoch, "world_size": ctx.get_world_size()},
+                      checkpoint=ck)
+
+
+trainer = DataParallelTrainer(
+    train_loop_per_worker=loop,
+    train_loop_config={"ids_dir": ids_dir, "stop": stop_path},
+    datasets={"train": rdata.range(N_ROWS)},
+    scaling_config=ScalingConfig(num_workers=2, min_workers=1,
+                                 resources_per_worker={"CPU": 3.0}),
+    run_config=RunConfig(name="elastic", storage_path=storage,
+                         failure_config=FailureConfig(max_failures=0)))
+result = trainer.fit()
+out = {
+    "error": repr(result.error) if result.error else None,
+    "metrics": result.metrics,
+    "resizes": result.resizes,
+    "train_obs": result.train_obs,
+}
+with open(out_path, "w") as f:
+    json.dump(out, f, default=str)
+print("ELASTIC_DONE", flush=True)
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(240)
+def test_elastic_resize_down_then_up(ray_start_cluster, tmp_path):
+    """Lose one node mid-run (graceful seeded preemption), regain one
+    later: the job never restarts, the world size changes twice
+    (2 -> 1 -> 2), every completed epoch's shard union is exactly the
+    dataset (no loss, no duplication), and goodput is recorded across
+    both transitions."""
+    cluster = ray_start_cluster
+    # 4 CPUs per node vs {CPU: 3} workers: each node hosts exactly one
+    # worker (forced spread) with one slot of slack for the split
+    # coordinator / slice tasks
+    n1 = cluster.add_node(num_cpus=4)
+    n2 = cluster.add_node(num_cpus=4)
+    assert cluster.wait_for_nodes(2)
+
+    ids_dir = tmp_path / "ids"
+    ids_dir.mkdir()
+    stop_path = tmp_path / "stop.txt"
+    out_path = tmp_path / "result.json"
+    script = tmp_path / "elastic_driver.py"
+    script.write_text(_ELASTIC_DRIVER)
+
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), cluster.address,
+         str(tmp_path / "storage"), str(ids_dir), str(stop_path),
+         str(out_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+    client = RpcClient(cluster.address)
+
+    def _resizes():
+        try:
+            res = run_async(client.call("get_train_resizes"), timeout=10)
+            return res.get("records", [])
+        except Exception:
+            return []
+
+    try:
+        line = proc.stdout.readline().decode()
+        assert line.startswith("DRIVER_AGENT"), line
+        driver_agent = line.split()[1]
+        # the victim must host a worker but not the driver's agent (killing
+        # the agent the driver is attached to would sever the driver itself)
+        victim = n2 if n1.address == driver_agent else n1
+
+        _wait(lambda: len(list(ids_dir.glob("epoch000-of2-rank*.json"))) == 2,
+              90, "first epoch to complete on both ranks")
+
+        # ---- lose one node: seeded graceful preemption (6 s notice) ----
+        spec = {"seed": 11, "kills": [
+            {"kind": "preempt_node", "after_s": 0.1, "notice_s": 6.0,
+             "node": victim.node_id[:8]}]}
+        run_async(client.call("chaos_set", spec=spec))
+
+        _wait(lambda: any(r["direction"] == "down" for r in _resizes()),
+              60, "down-resize record in the GCS ring")
+        down = [r for r in _resizes() if r["direction"] == "down"][0]
+        assert down["reason"] == "drain", down
+        assert down["from"] == 2 and down["to"] == 1, down
+        assert victim.node_id in down["node_ids"], down
+
+        # ---- regain one node: fresh capacity joins the cluster ----------
+        n3 = cluster.add_node(num_cpus=4)
+        _wait(lambda: run_async(client.call("get_cluster_view"))
+              .get(n3.node_id, {}).get("alive"), 30, "new node to register")
+
+        _wait(lambda: any(r["direction"] == "up" for r in _resizes()),
+              90, "up-resize record in the GCS ring")
+        up = [r for r in _resizes() if r["direction"] == "up"][0]
+        assert up["reason"] == "capacity", up
+        assert up["from"] == 1 and up["to"] == 2, up
+
+        # let the regrown world complete a couple of epochs, then stop a
+        # few barrier rounds ahead of the newest ledger entry
+        cur = max(int(p.name[5:8]) for p in ids_dir.glob("epoch*.json"))
+        stop_epoch = cur + 4
+        tmp = stop_path.with_suffix(".tmp")
+        tmp.write_text(str(stop_epoch))
+        os.replace(tmp, stop_path)
+
+        assert proc.wait(timeout=120) == 0, "trainer driver failed"
+    finally:
+        try:
+            run_async(client.close(), timeout=5)
+        except Exception:
+            pass
+        if proc.poll() is None:
+            proc.kill()
+
+    out = json.loads(out_path.read_text())
+    # no job restart: max_failures=0 means a single restart attempt would
+    # have surfaced as result.error
+    assert out["error"] is None, out["error"]
+
+    # world size changed twice, down then up, through the typed records
+    directions = [r["direction"] for r in out["resizes"]]
+    assert directions[0] == "down" and "up" in directions, directions
+    assert out["metrics"]["world_size"] == 2  # finished at full size
+
+    # ---- shard rebalance: every completed epoch pass consumed the whole
+    # dataset exactly once (ledger grouped by the world size that ran it;
+    # a replayed pass beyond the checkpoint boundary must itself be exact)
+    by_epoch = {}
+    for p in ids_dir.glob("epoch*.json"):
+        stem = p.name[:-len(".json")]
+        epoch_part, of_part, rank_part = stem.split("-")
+        e, n, r = (int(epoch_part[5:]), int(of_part[2:]),
+                   int(rank_part[4:]))
+        by_epoch.setdefault(e, {}).setdefault(n, {})[r] = \
+            json.loads(p.read_text())
+    last = max(by_epoch)
+    assert last == stop_epoch - 1
+    world_sizes_seen = set()
+    for e in range(last + 1):
+        groups = by_epoch.get(e, {})
+        complete = {n: ranks for n, ranks in groups.items()
+                    if set(ranks) == set(range(n))}
+        assert complete, f"epoch {e} has no complete shard pass: {groups}"
+        for n, ranks in complete.items():
+            world_sizes_seen.add(n)
+            all_ids = [i for r in sorted(ranks) for i in ranks[r]]
+            assert len(all_ids) == len(set(all_ids)), \
+                f"epoch {e} (world {n}): duplicated samples"
+            assert set(all_ids) == set(range(64)), \
+                f"epoch {e} (world {n}): lost samples"
+    assert world_sizes_seen == {1, 2}  # epochs ran at both world sizes
+
+    # ---- goodput carried across both transitions --------------------
+    obs = out["train_obs"]
+    assert obs is not None
+    assert len(obs["resizes"]) >= 2
+    assert 0.0 < obs["run_goodput"] <= 1.0, obs.get("run_goodput")

@@ -210,10 +210,14 @@ def test_check_functions_map_surfaces_to_scopes():
         "spill_rate": {"n1": 100 * MB}, "backpressure_rate": {"n1": 4.5},
         "disk_used_frac": {"n1": 0.97},
         "events_shed": 10, "events_shed_total": 40,
+        "draining_notices": {"n1": 4.0},
+        "train_resizing": {"t1": {"direction": "down", "from": 4}},
     }
     out = evaluate_oneshot(snap)
     by_rule = {a["rule"]: a for a in out}
     assert set(by_rule) == HealthRule.ALL  # every rule fires on this snap
+    assert by_rule[HealthRule.NODE_DRAINING]["scope"] == "node:n1"
+    assert by_rule[HealthRule.TRAIN_RESIZING]["scope"] == "trial:t1"
     assert by_rule[HealthRule.OWNER_LOOP_SATURATED]["scope"] == "loop:n1/gcs"
     assert by_rule[HealthRule.TTFT_BREACH]["scope"] == "deployment:d"
     assert by_rule[HealthRule.TTFT_BREACH]["value"] == pytest.approx(2.4)

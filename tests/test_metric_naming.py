@@ -274,10 +274,11 @@ def test_autoscale_reasons_are_closed_vocabulary():
 # -------------------------------------------------------- train cardinality
 
 TRAIN_OBS_FILE = PKG_ROOT / "train" / "observability.py"
-#: the label-set bound for the train plane: rank (bounded by world size)
-#: and stage (the fixed decomposition names) ONLY — never worker
-#: hostnames, trial names, or anything else unbounded.
-ALLOWED_TRAIN_TAG_KEYS = {"rank", "stage"}
+#: the label-set bound for the train plane: rank (bounded by world size),
+#: stage (the fixed decomposition names), and direction (the closed
+#: up/down elastic-resize vocabulary) ONLY — never worker hostnames,
+#: trial names, or anything else unbounded.
+ALLOWED_TRAIN_TAG_KEYS = {"rank", "stage", "direction"}
 
 
 def test_train_metric_tag_keys_are_bounded():
