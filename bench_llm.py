@@ -20,7 +20,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
+import subprocess
+import sys
 import threading
 import time
 
@@ -68,6 +71,35 @@ def request_rollup(samples, wall_s: float) -> dict:
     }
 
 
+class PhaseAborted(RuntimeError):
+    """One configuration failed to become servable; carries the
+    controller's view of why (per-replica states) so the checkpoint
+    records a diagnosable reason instead of a bare timeout."""
+
+    def __init__(self, msg: str, detail: dict):
+        super().__init__(msg)
+        self.detail = detail
+
+
+def probe_devices(timeout_s: float = 120.0):
+    """Bounded accelerator probe in a SUBPROCESS.  A wedged TPU tunnel
+    makes ``jax.devices()`` hang forever *in-process* — the round-4/5
+    failure mode where the whole benchmark (and its collected numbers)
+    died with the probe.  A child process gives us a kill switch; the
+    parent never imports jax.  Returns None when healthy, else a short
+    skip reason for the structured ``{"skipped": ...}`` exit."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return "tunnel wedged"
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        return "probe failed: " + (tail[-1] if tail else "no output")
+    return None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="llama-1b")
@@ -85,7 +117,25 @@ def main():
                         "through a burst at fixed chip capacity")
     p.add_argument("--storm-rate", type=float, default=2.0,
                    help="storm base arrivals/s (spike is 4x)")
+    p.add_argument("--deploy-timeout", type=float, default=300.0,
+                   help="seconds to wait for a configuration's replica to "
+                        "go HEALTHY before aborting that phase (the old "
+                        "blind 900 s wait is gone: we poll serve.status() "
+                        "and record the stuck replica's state instead)")
+    p.add_argument("--probe-timeout", type=float, default=120.0,
+                   help="subprocess jax.devices() probe bound")
+    p.add_argument("--fresh", action="store_true",
+                   help="ignore an existing BENCH_LLM_partial.json instead "
+                        "of resuming from its checkpointed phases")
     args = p.parse_args()
+
+    # Accelerator probe BEFORE touching the cluster: a wedged tunnel must
+    # produce a structured skip (the driver keys on it), not a hang.
+    reason = probe_devices(args.probe_timeout)
+    if reason is not None:
+        print(json.dumps({"metric": "serve_llm_req_per_s",
+                          "skipped": reason}))
+        return
 
     import ray_tpu
     from ray_tpu import serve
@@ -167,8 +217,31 @@ def main():
         out["ttft_p95_series"] = loadgen.windowed_p95_series(storm_samples)
         return out
 
+    def wait_servable(name: str, timeout_s: float):
+        """Poll serve.status() until ``name`` is HEALTHY.  The old path
+        blocked 900 s inside serve.run with zero visibility — when a
+        replica wedged in STARTING (phase-3 failure mode) the whole run
+        burned its budget and reported nothing.  On timeout, raise with
+        the controller's per-replica states so the checkpoint says WHY."""
+        deadline = time.monotonic() + timeout_s
+        last: dict = {}
+        while time.monotonic() < deadline:
+            try:
+                last = serve.status().get(name, {})
+            except Exception as e:  # noqa: BLE001 — controller booting
+                last = {"error": repr(e)}
+            if last.get("status") == "HEALTHY":
+                return
+            time.sleep(2.0)
+        pending = [{"name": r.get("name"), "state": r.get("state")}
+                   for r in last.get("replicas", [])]
+        raise PhaseAborted(
+            f"{name} not HEALTHY after {timeout_s:.0f}s",
+            {"status": last.get("status"), "replicas": pending,
+             **({"error": last["error"]} if "error" in last else {})})
+
     def run_serve(paged: bool, make_prompt, label: str,
-                  storm: bool = False):
+                  storm: bool = False, extra_engine: dict | None = None):
         """One full cluster lifecycle per configuration: the TPU is held
         exclusively by the replica process, so the next configuration's
         replica can only initialize after a complete teardown."""
@@ -179,8 +252,10 @@ def main():
                 args.preset, num_slots=args.num_slots, max_len=args.max_len,
                 max_concurrent_queries=256, health_check_timeout_s=600.0,
                 engine_kwargs={"buckets": buckets, "warmup_buckets": True,
-                               "paged": paged})
-            h = serve.run(dep, timeout_s=900)
+                               "paged": paged, **(extra_engine or {})})
+            h = serve.run(dep, timeout_s=args.deploy_timeout,
+                          _blocking=False)
+            wait_servable(f"llm-{args.preset}", args.deploy_timeout)
             list(h.stream({"tokens": make_prompt(), "max_tokens": 4}))
             res = drive_storm(h) if storm else drive(h, make_prompt)
             # engine-side serving picture: batch occupancy/padding waste,
@@ -201,46 +276,94 @@ def main():
             # (the tunnel-side lock can take O(10s) to clear after the
             # worker exits; 5 s proved too short in the round-5 run)
 
+    # Resume from the checkpoint file: a re-run after a mid-bench tunnel
+    # death replays only the missing phases (each phase persists its
+    # numbers the moment it completes).  --fresh starts over.
     partial = {}
+    if not args.fresh and os.path.exists("BENCH_LLM_partial.json"):
+        try:
+            with open("BENCH_LLM_partial.json") as f:
+                partial = json.load(f)
+            done = [k for k, v in partial.items()
+                    if not (isinstance(v, dict) and "aborted" in v)]
+            if done:
+                print(f"# resuming: phases {done} checkpointed, skipping",
+                      flush=True)
+        except Exception:  # noqa: BLE001 — corrupt checkpoint: start over
+            partial = {}
 
-    def phase(key, *a):
+    def phase(key, *a, **kw):
         """Run one configuration and persist its numbers IMMEDIATELY — a
         later phase wedging the TPU tunnel must not lose earlier results
         (the round-4/5 lesson: phase 3 hung for 900 s and phases 1-2's
-        numbers evaporated with it)."""
-        res = run_serve(*a)
+        numbers evaporated with it).  A checkpointed phase is skipped on
+        resume; an aborted one (deploy never went HEALTHY) records its
+        reason and re-runs next time."""
+        cached = partial.get(key)
+        if isinstance(cached, dict) and "aborted" not in cached:
+            print(f"# {key}: checkpointed, skipping", flush=True)
+            return cached
+        try:
+            res = run_serve(*a, **kw)
+        except PhaseAborted as e:
+            res = {"aborted": str(e), **e.detail}
         partial[key] = res
         print(f"# {key}: {json.dumps(res)}", flush=True)
         with open("BENCH_LLM_partial.json", "w") as f:
             json.dump(partial, f, indent=1)
+        if "aborted" in res:
+            # a wedged tunnel poisons every later phase too — probe, and
+            # bail out structured (checkpoint keeps what we have)
+            reason = probe_devices(args.probe_timeout)
+            if reason is not None:
+                print(json.dumps({"metric": "serve_llm_req_per_s",
+                                  "skipped": reason, "partial": partial}))
+                raise SystemExit(0)
         return res
+
+    def ok(res):
+        return isinstance(res, dict) and "aborted" not in res \
+            and "req_per_s" in res
 
     try:
         dense = phase("dense", False, mixed_prompt, "dense")
         paged = phase("paged", True, mixed_prompt, "paged")
         prefix = phase("paged_prefix", True, prefix_prompt, "paged+prefix")
+        # speculative decoding under the same continuous-batching paged
+        # config: 1-layer draft, verify-window target step (the PR-19
+        # serving path; acceptance + rollback stats land in res["engine"])
+        spec = phase("paged_spec", True, mixed_prompt, "paged+spec",
+                     extra_engine={"spec_decode_enabled": True, "spec_k": 4,
+                                   "spec_draft_layers": 1})
         storm = None
         if args.storm:
             # checkpointed like every phase: a tunnel death after the
             # headline numbers must not lose them
             storm = phase("storm", True, mixed_prompt, "storm", True)
-        print(json.dumps({
+        out = {
             "metric": "serve_llm_req_per_s",
-            "value": paged["req_per_s"],
+            "value": paged.get("req_per_s"),
             "unit": "req/s",
-            # paging must at least match dense on the same long-prompt mix
-            "vs_baseline": round(
-                paged["req_per_s"] / max(dense["req_per_s"], 1e-9), 3),
             "dense": dense,
             "paged": paged,
             "paged_prefix_hit": prefix,
+            "paged_spec": spec,
             **({"storm": storm} if storm is not None else {}),
             "model": args.preset,
             "clients": args.clients, "requests": args.requests,
             "prompt_mix": [args.prompt_len // 4, args.prompt_len],
             "max_tokens": args.max_tokens,
             "num_slots": args.num_slots, "max_len": args.max_len,
-        }))
+        }
+        if ok(dense) and ok(paged):
+            # paging must at least match dense on the same long-prompt mix
+            out["vs_baseline"] = round(
+                paged["req_per_s"] / max(dense["req_per_s"], 1e-9), 3)
+        if ok(paged) and ok(spec):
+            out["spec_vs_paged"] = round(
+                spec["decode_tok_per_s"]
+                / max(paged["decode_tok_per_s"], 1e-9), 3)
+        print(json.dumps(out))
     finally:
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()

@@ -56,7 +56,9 @@ def llm_tiny_deployment(autoscaling: dict):
         "tiny", num_slots=8, max_concurrent_queries=64,
         health_check_period_s=0.5, graceful_shutdown_timeout_s=60.0,
         autoscaling_config=autoscaling,
-        engine_kwargs={"paged": True})
+        # page_size must not exceed the shared-prefix length or the prefix
+        # cache never holds a full page and the routing digest stays empty
+        engine_kwargs={"paged": True, "page_size": 16})
 
 
 def split_phase(samples, t0: float, t1: float):
@@ -89,13 +91,32 @@ def main():
                         "(p95 at max_concurrent=4 x service-ms is ~2x the "
                         "service time even with zero queueing)")
     p.add_argument("--max-replicas", type=int, default=6)
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="floor replicas (the prefix-routing A/B wants >= 2 "
+                        "so the router actually has a choice to make)")
     p.add_argument("--chaos", action="store_true",
                    help="seeded preempt_node of the second node mid-storm")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prefix-routing", choices=["on", "off"], default="on",
+                   help="cache-aware replica routing (llm-tiny mode): the "
+                        "on/off pair is the storm A/B — same seed, same "
+                        "shared-prefix traffic, routing as the only delta; "
+                        "compare acceptance.prefix_hit_rate")
+    p.add_argument("--prefix-pool", type=int, default=8,
+                   help="number of distinct shared prefixes in the llm "
+                        "traffic (0 disables shared prefixes)")
+    p.add_argument("--prefix-len", type=int, default=32,
+                   help="shared prefix length in tokens (>= page size so "
+                        "the prefix cache can hold full pages)")
     p.add_argument("--out", default="BENCH_STORM.json")
     args = p.parse_args()
 
     import os
+
+    # before any ray_tpu import: the driver's Config snapshot is what the
+    # router reads, and worker nodes inherit the env
+    os.environ["RAYTPU_SERVE_PREFIX_ROUTING_ENABLED"] = (
+        "1" if args.prefix_routing == "on" else "0")
 
     import ray_tpu
     from ray_tpu import serve
@@ -116,7 +137,8 @@ def main():
                                       storm_t0, storm_t1, total_s, rng)
 
     autoscaling = dict(
-        policy="slo", min_replicas=1, max_replicas=args.max_replicas,
+        policy="slo", min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
         target_ongoing_requests=2.0, ttft_p95_target_ms=args.ttft_target_ms,
         upscale_delay_s=1.0, downscale_delay_s=5.0, min_window_n=8)
 
@@ -146,7 +168,9 @@ def main():
             def payload(idx: int):
                 return loadgen.llm_payload(args.seed, idx, prompt_median=48,
                                            prompt_lo=8, prompt_hi=256,
-                                           decode_median=16)
+                                           decode_median=16,
+                                           prefix_pool=args.prefix_pool,
+                                           prefix_len=args.prefix_len)
             fire = loadgen.stream_fire(h, payload, timeout_s=300.0)
 
         runner = loadgen.StormRunner(fire, max_outstanding=512)
@@ -182,6 +206,28 @@ def main():
         t_wall = time.time()
         samples = runner.run(arrivals)
         wall = time.time() - t_wall
+
+        # per-replica prefix-cache stats straight off each engine, read
+        # BEFORE the drain-down (a retired replica's counters die with
+        # it).  Aggregate hit rate is the storm A/B's headline: same
+        # seed + traffic, --prefix-routing the only delta.
+        prefix_per_replica: dict = {}
+        if args.mode == "llm-tiny":
+            from ray_tpu.serve.router import get_router
+            router = get_router()
+            try:
+                router._refresh(force=True)
+            except Exception:
+                pass
+            for rep in list(router._table.get(name, [])):
+                try:
+                    rh = router._replica_handle(rep)
+                    st = ray_tpu.get(
+                        rh.handle_request.remote((), {}, "stats"),
+                        timeout=30)
+                    prefix_per_replica[rep] = st.get("prefix_cache") or {}
+                except Exception as e:  # noqa: BLE001 — replica mid-drain
+                    prefix_per_replica[rep] = {"error": repr(e)}
 
         # let the autoscaler drain back down before sampling the end state
         deadline = time.monotonic() + args.cool_s + 30
@@ -231,6 +277,15 @@ def main():
             "signal_gaps": sampler.gaps(),
             "capped_decisions": [d for d in decisions if d["capped"]],
         }
+        if args.mode == "llm-tiny":
+            vals = [v for v in prefix_per_replica.values()
+                    if isinstance(v, dict) and "lookups" in v]
+            lookups = sum(int(v["lookups"]) for v in vals)
+            hits = sum(int(v["hits"]) for v in vals)
+            acceptance["prefix_routing"] = args.prefix_routing
+            acceptance["prefix_hit_rate"] = (
+                round(hits / lookups, 4) if lookups else None)
+            acceptance["prefix_lookups"] = lookups
 
         out = {
             "metric": "serve_storm",
@@ -240,6 +295,9 @@ def main():
             "config": {"base_rate": args.base_rate, "spike": args.spike,
                        "warm_s": args.warm_s, "storm_s": args.storm_s,
                        "cool_s": args.cool_s, "service_ms": args.service_ms,
+                       "prefix_routing": args.prefix_routing,
+                       "prefix_pool": args.prefix_pool,
+                       "prefix_len": args.prefix_len,
                        "autoscaling": autoscaling},
             "phases": phases,
             "series": {
@@ -250,6 +308,8 @@ def main():
             "decisions": decisions,
             "chaos": chaos_rec,
             "acceptance": acceptance,
+            **({"prefix_per_replica": prefix_per_replica}
+               if prefix_per_replica else {}),
             # the storm as the health plane saw it (TTFT_BREACH /
             # SLO_SIGNAL_STALE raises + clears across the phases)
             "health": health.alert_trail(),

@@ -338,6 +338,180 @@ def run_ab_serve_metrics(S: float, pairs: int) -> dict:
             "off_config": off_cfg, "ratio_on_off": ratio}
 
 
+def _measure_specroute(S: float, on: bool) -> dict:
+    """One fresh-cluster LLM serving measurement for the speculative +
+    cache-routed A/B (PR-19 gate): 2 replicas of a compute-bound CPU toy
+    model behind the real handle -> router -> replica -> engine path.
+
+    ON arm: speculative decoding (1-layer draft, verify-window target
+    step) + prefix-cache-aware routing.  OFF arm: dense decode + pure
+    power-of-two-choices.  Both arms serve the SAME damped checkpoint and
+    the SAME seeded shared-prefix traffic — the decode/routing planes are
+    the only delta.  The model is deliberately deeper/wider than the
+    'tiny' preset: speculation pays when layer compute dominates the
+    per-step fixed cost (embed + lm_head + dispatch), which is also the
+    regime real targets live in; on a toy-tiny config the fixed cost
+    swamps the drafted layers and speculation measures slower."""
+    import os
+    import queue
+    import threading
+    import time as _time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import loadgen
+
+    sys_cfg = None if on else {"serve_prefix_routing_enabled": False}
+    ray_tpu.init(num_cpus=8, _system_config=sys_cfg)
+    out = {"arm": "spec+routed" if on else "dense+p2c"}
+    try:
+        @serve.deployment(name="specbench", num_replicas=2,
+                          max_concurrent_queries=64,
+                          health_check_timeout_s=600.0)
+        class SpecBench:
+            """LLM replica over a damped checkpoint (speculative.py's
+            honest-about-itself benchmark trick: tail layers' output
+            projections scaled so target ~= draft + small residual while
+            the target still pays full depth)."""
+
+            def __init__(self, spec: bool):
+                import jax
+                import jax.numpy as jnp
+                from ray_tpu.models import speculative as specmod
+                from ray_tpu.models import transformer
+                from ray_tpu.models.config import TransformerConfig
+                from ray_tpu.serve.llm import LLMEngine
+                cfg = TransformerConfig(
+                    vocab_size=512, num_layers=8, hidden_size=256,
+                    num_heads=8, num_kv_heads=4, mlp_size=1024,
+                    max_seq_len=512)
+                params = transformer.init_params(
+                    jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+                params = specmod.damp_block_outputs(params, 0.02,
+                                                    from_layer=1)
+                kw = dict(paged=True, page_size=16, buckets=(64, 128),
+                          warmup_buckets=True, steps_per_dispatch=12)
+                if spec:
+                    kw.update(spec_decode_enabled=True, spec_k=6,
+                              spec_draft_layers=1)
+                self.engine = LLMEngine(cfg, params, num_slots=16,
+                                        max_len=512, **kw)
+
+            async def __call__(self, request):
+                import asyncio
+                from ray_tpu.serve.llm import _FLUSH  # noqa: F401
+                body = (request.json() if hasattr(request, "json")
+                        else request)
+                req = self.engine.submit(
+                    body["tokens"],
+                    max_tokens=int(body.get("max_tokens", 32)))
+                loop = asyncio.get_event_loop()
+                while True:
+                    item = await loop.run_in_executor(None, req.out.get)
+                    if not isinstance(item, int):
+                        if isinstance(item, BaseException):
+                            raise item
+                        return
+                    yield item
+
+            def stats(self) -> dict:
+                return self.engine.breakdown()
+
+            def prefix_digest(self):
+                from ray_tpu.core.config import get_config
+                cap = int(getattr(get_config(),
+                                  "serve_prefix_digest_max", 32))
+                return self.engine.prefix_digest(cap)
+
+        h = serve.run(SpecBench.bind(spec=on), timeout_s=600)
+        n = max(12, int(24 * S))
+        payloads = [loadgen.llm_payload(
+            1234, i, prompt_median=64, prompt_lo=48, prompt_hi=96,
+            decode_median=24, decode_lo=16, decode_hi=32, vocab=500,
+            prefix_pool=6, prefix_len=64) for i in range(n)]
+        # warm both replicas' decode/spec programs before timing
+        for _ in range(4):
+            sum(1 for _ in h.stream({"tokens": payloads[0]["tokens"][:],
+                                     "max_tokens": 4}))
+        work: queue.Queue = queue.Queue()
+        for pl in payloads:
+            work.put(pl)
+        counts = []
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                try:
+                    pl = work.get_nowait()
+                except queue.Empty:
+                    return
+                ntok = sum(1 for _ in h.stream(dict(pl), timeout_s=600.0))
+                with lock:
+                    counts.append(ntok)
+
+        t0 = _time.monotonic()
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _time.monotonic() - t0
+        out["tok_s"] = round(sum(counts) / wall, 2)
+        out["n_requests"] = len(counts)
+        out["wall_s"] = round(wall, 2)
+        # per-replica engine stats: spec acceptance + prefix hit rate
+        from ray_tpu.serve.router import get_router
+        router = get_router()
+        router._refresh(force=True)
+        spec_tot = {"tokens": 0, "drafted": 0, "accepted": 0, "rounds": 0}
+        lookups = hits = 0
+        for rep in list(router._table.get("specbench", [])):
+            try:
+                st = ray_tpu.get(router._replica_handle(rep)
+                                 .handle_request.remote((), {}, "stats"),
+                                 timeout=60)
+            except Exception:  # noqa: BLE001 — stats are additive
+                continue
+            sp = st.get("spec")
+            if sp:
+                for k in spec_tot:
+                    spec_tot[k] += int(sp.get(k, 0))
+            pc = st.get("prefix_cache") or {}
+            lookups += int(pc.get("lookups", 0))
+            hits += int(pc.get("hits", 0))
+        if spec_tot["drafted"]:
+            out["spec_acceptance"] = round(
+                spec_tot["accepted"] / spec_tot["drafted"], 4)
+            out["spec_tokens_per_round"] = round(
+                spec_tot["tokens"] / max(spec_tot["rounds"], 1), 2)
+        out["prefix_hit_rate"] = (round(hits / lookups, 4)
+                                  if lookups else None)
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def run_ab_specroute(S: float, pairs: int) -> dict:
+    """Interleaved same-box A/B: speculative decode + prefix-cache-aware
+    routing ON vs dense decode + load-only p2c (the PR-19 acceptance
+    gate: spec+routed decode tokens/s >= 1.3x the dense arm on the same
+    damped CPU model + seeded shared-prefix traffic)."""
+    on_runs, off_runs = [], []
+    for i in range(pairs):
+        on_runs.append(_measure_specroute(S, True))
+        off_runs.append(_measure_specroute(S, False))
+        print(f"# specroute ab pair {i + 1}/{pairs}: on={on_runs[-1]} "
+              f"off={off_runs[-1]}", flush=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = round(med([r["tok_s"] for r in on_runs])
+                  / max(med([r["tok_s"] for r in off_runs]), 1e-9), 3)
+    return {"pairs_on": on_runs, "pairs_off": off_runs,
+            "ratio_on_off": {"tok_s": ratio},
+            "gate": {"min_ratio": 1.3, "passed": ratio >= 1.3}}
+
+
 def _measure_autoscale_reqs(S: float, slo_policy: bool) -> dict:
     """One fresh-cluster serve request-throughput measurement for the
     autoscaler A/B: a steady 2-replica noop deployment — the ON arm runs
@@ -1162,6 +1336,12 @@ def main():
                    help="also run PAIRS interleaved A/B pairs of "
                         "serve_metrics_enabled on vs off (serve request "
                         "throughput; the serve-observability overhead gate)")
+    p.add_argument("--ab-specroute", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved A/B pairs of "
+                        "speculative decode + cache-aware routing on vs "
+                        "dense decode + pure p2c over the same damped CPU "
+                        "model and seeded shared-prefix traffic (the "
+                        "spec-serving >= 1.3x gate)")
     p.add_argument("--ab-submit", type=int, default=0, metavar="PAIRS",
                    help="also run PAIRS interleaved A/B pairs of batched "
                         "submission on vs off (push/lease/actor-call "
@@ -1253,6 +1433,9 @@ def main():
     if args.ab_serve > 0:
         out["serve_metrics_ab"] = run_ab_serve_metrics(args.scale,
                                                        args.ab_serve)
+    if args.ab_specroute > 0:
+        out["specroute_ab"] = run_ab_specroute(args.scale,
+                                               args.ab_specroute)
     if args.ab_submit > 0:
         out["submit_batching_ab"] = run_ab_submit_batching(args.scale,
                                                            args.ab_submit)

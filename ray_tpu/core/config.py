@@ -387,6 +387,20 @@ class Config:
     #: it (the serve hot path keeps only a boolean check per request) for
     #: A/B overhead measurement — same discipline as rpc_metrics_enabled.
     serve_metrics_enabled: bool = True
+    #: Prefix-cache-aware routing: replica heartbeats carry a bounded
+    #: digest of the prefix cache's first-page block hashes; the router
+    #: scores its two power-of-two-choices candidates by estimated prefix
+    #: overlap x in-flight load.  Off (or on stale/absent digests) the
+    #: router falls back to pure p2c — identical to the pre-digest path.
+    serve_prefix_routing_enabled: bool = True
+    #: Cap on first-page block hashes carried per heartbeat digest.  Keeps
+    #: the health-check payload and the router's membership set O(small);
+    #: the newest entries win (most recently inserted prefixes).
+    serve_prefix_digest_max: int = 32
+    #: How strongly a digest hit discounts a candidate's load score:
+    #: score = (inflight + 1) * (1 - weight * hit).  0 disables the
+    #: discount (pure p2c); 1 makes any hit beat any miss at equal load.
+    serve_prefix_routing_weight: float = 0.5
     #: Rolling window over which each replica computes its TTFT
     #: percentiles + queue-depth signal for the controller (the SLO
     #: autoscaler input).  Samples older than this age out.

@@ -222,6 +222,92 @@ def paged_decode_step(params: Params, cache: PagedKVCache,
     return cache, logits
 
 
+def paged_verify_window(params: Params, cache: PagedKVCache,
+                        tokens: jnp.ndarray, active: jnp.ndarray,
+                        cfg: TransformerConfig, compute_dtype=jnp.bfloat16
+                        ) -> Tuple[PagedKVCache, jnp.ndarray]:
+    """Speculative-decode verify: a k-token window per slot over the paged
+    cache (``speculative.verify_window`` generalized to block tables).
+
+    tokens: [slots, k] int32 — token j sits at absolute position
+    ``length[s] + j``, scattered through slot s's block-table row.
+    Returns (cache, logits [slots, k, V] f32); ``length`` advances by k
+    for active slots.  Callers roll ``length`` back to the accepted
+    prefix afterwards — rollback is a length reset ONLY, and it is
+    page-exact by construction: every window position lands in a page
+    the slot's block table already owns (private pages at index >= the
+    shared-prefix boundary), so rejected positions become unread garbage
+    the next round overwrites.  Writes for inactive slots and positions
+    past the block-table span are dumped into the reserved null page 0
+    (same discipline as ``paged_decode_step``) — an inactive slot's old
+    pages may already belong to another sequence.
+    """
+    n_slots, kwin = tokens.shape
+    page = cache["k"].shape[2]
+    max_pages = cache["block_table"].shape[1]
+    kv_span = max_pages * page
+    cast = compute_dtype
+    lengths = cache["length"]                                    # [slots]
+    bt = cache["block_table"]                                    # [S, MP]
+    x = params["embed"]["tokens"][tokens].astype(cast)           # [S,k,H]
+    positions = lengths[:, None] + jnp.arange(kwin)[None]        # [S,k]
+    if not cfg.use_rope:
+        x = x + params["embed"]["pos"][
+            jnp.minimum(positions, cfg.max_seq_len - 1)].astype(cast)
+    scale = cfg.head_dim ** -0.5
+    reps = cfg.num_heads // cfg.num_kv_heads
+    row = jnp.arange(n_slots)[:, None]
+    page_idx = bt[row, jnp.minimum(positions // page, max_pages - 1)]
+    page_off = positions % page
+    valid = active[:, None] & (positions < kv_span)              # [S,k]
+    safe_pi = jnp.where(valid, page_idx, 0).reshape(-1)
+    flat_po = page_off.reshape(-1)
+    # query j may read absolute positions <= length+j (its own position)
+    causal = (jnp.arange(kv_span)[None, None]
+              <= positions[:, :, None])            # [slots, k, span]
+
+    def body(x, layer):
+        lp, k_pages, v_pages = layer
+        y = _norm(x, lp["attn_norm"], cfg)
+        q, kk, vv = _qkv(y, lp["attn"], cfg, positions)  # [S,k,N*,D]
+        k_pages = k_pages.at[safe_pi, flat_po].set(
+            kk.reshape(n_slots * kwin, cfg.num_kv_heads,
+                       -1).astype(k_pages.dtype), mode="drop")
+        v_pages = v_pages.at[safe_pi, flat_po].set(
+            vv.reshape(n_slots * kwin, cfg.num_kv_heads,
+                       -1).astype(v_pages.dtype), mode="drop")
+        kg = jnp.take(k_pages, bt, axis=0).reshape(
+            n_slots, kv_span, cfg.num_kv_heads, cfg.head_dim)
+        vg = jnp.take(v_pages, bt, axis=0).reshape(
+            n_slots, kv_span, cfg.num_kv_heads, cfg.head_dim)
+        qh = q.reshape(n_slots, kwin, cfg.num_kv_heads, reps, cfg.head_dim)
+        scores = jnp.einsum("skgrd,smgd->skgrm", qh.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+        if cfg.attn_logit_softcap:
+            c = cfg.attn_logit_softcap
+            scores = c * jnp.tanh(scores / c)
+        scores = jnp.where(causal[:, :, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("skgrm,smgd->skgrd", probs,
+                          vg.astype(jnp.float32))
+        attn = attn.reshape(n_slots, kwin, cfg.num_heads * cfg.head_dim)
+        x = x + _proj_out(attn.astype(cast), lp["attn"], cast)
+        x = x + _mlp(_norm(x, lp["mlp_norm"], cfg), lp, cfg)
+        return x, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    logits = (x @ lm_head_weight(params, cfg, cast)).astype(jnp.float32)
+    cache = {
+        "k": k_new, "v": v_new,
+        "block_table": bt,
+        "length": jnp.where(active,
+                            jnp.minimum(lengths + kwin, kv_span), lengths),
+    }
+    return cache, logits
+
+
 def paged_decode_loop(params: Params, cache: PagedKVCache,
                       tokens: jnp.ndarray, active: jnp.ndarray,
                       temperature: jnp.ndarray, key: jax.Array,
@@ -349,6 +435,10 @@ class PrefixCache:
         self.page = page_size
         self._map: Dict[bytes, int] = {}        # chunk hash -> page id
         self._lru: List[bytes] = []
+        # FIRST-page chunk keys (insertion-ordered): the bounded routing
+        # digest reads these — a request can only start reusing at page 0,
+        # so deeper chunks add no routing signal
+        self._first: Dict[bytes, None] = {}
         # lookup accounting (serve observability + bench_llm read these):
         # a lookup is a hit when >= 1 page was reused
         self.lookups = 0
@@ -414,6 +504,8 @@ class PrefixCache:
             self._map[key] = page_ids[i]
             self.alloc.incref([page_ids[i]])
             self._lru.append(key)
+            if i == 0:
+                self._first[key] = None
 
     def evict_some(self, n: int = 8) -> int:
         """Drop up to n oldest cached chunks (returns pages whose only ref
@@ -422,8 +514,20 @@ class PrefixCache:
         while self._lru and dropped < n:
             key = self._lru.pop(0)
             pid = self._map.pop(key, None)
+            self._first.pop(key, None)
             if pid is not None:
                 self.alloc.release([pid])
                 dropped += 1
         self.evictions += dropped
         return dropped
+
+    def first_page_digest(self, cap: int = 32) -> List[str]:
+        """Bounded digest of the hot first-page chunks for cache-aware
+        routing: the NEWEST ``cap`` first-page keys as 8-hex-char (32-bit)
+        prefixes of the chunk hash.  A router computes the same truncated
+        hash over a request's first ``page`` tokens and scores replicas by
+        membership — 32 bits keeps the heartbeat payload small while
+        making a cross-prompt collision (a spurious routing *preference*,
+        never a correctness issue) vanishingly rare at digest sizes."""
+        keys = list(self._first)[-max(0, cap):]
+        return [k.hex()[:8] for k in keys]

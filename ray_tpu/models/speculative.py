@@ -33,9 +33,11 @@ import jax.numpy as jnp
 
 from .config import TransformerConfig
 from .decode import (KVCache, Params, _mlp, _norm, _proj_out, _qkv,
-                     decode_step, lm_head_weight)
+                     decode_step, lm_head_weight, sample_per_slot)
 
-__all__ = ["verify_window", "speculative_round", "speculative_decode_loop"]
+__all__ = ["verify_window", "speculative_round", "speculative_decode_loop",
+           "spec_state_round", "spec_decode_state_loop", "make_draft_params",
+           "damp_block_outputs"]
 
 
 def verify_window(params: Params, cache: KVCache, tokens: jnp.ndarray,
@@ -224,3 +226,217 @@ def speculative_decode_loop(target_params: Params, target_cache: KVCache,
             "target_cache": target_cache, "draft_cache": draft_cache,
             "last_tokens": last_tokens, "active": active,
             "rounds_accepted": accs.T}
+
+
+# ---------------------------------------------------------------------------
+# Serving-engine integration: decode-state rounds (continuous batching)
+# ---------------------------------------------------------------------------
+
+def spec_state_round(target_params: Params, target_cache, draft_params:
+                     Params, draft_cache: KVCache, state: Dict[str, Any],
+                     k: int, target_cfg: TransformerConfig,
+                     draft_cfg: TransformerConfig, paged: bool = False,
+                     top_k: int = 0, compute_dtype=jnp.bfloat16):
+    """One speculative round against the engine's device-resident decode
+    state (``decode.init_decode_state`` layout) — the serving twin of
+    ``speculative_round``, run inside LLMEngine's scheduler thread.
+
+    Differences from the standalone round (tier-1 tests pin all three):
+
+    * **Sampling-aware.**  Greedy slots (temperature 0) take the classic
+      accept-while-matching path; sampled slots accept NO drafts and emit
+      exactly one token drawn from the target's own first-position logits
+      via ``sample_per_slot`` — the identical distribution a vanilla
+      decode step would sample, so turning speculation on never changes
+      sampling semantics (it just wastes the drafts for hot slots).
+    * **Budget/EOS exact.**  ``emit_count`` is clamped to the remaining
+      budget and truncated at the first emitted EOS (inclusive), then
+      budget and active decay on device by the same predicate
+      ``decode_state_loop`` applies per step — the host scheduling mirror
+      stays byte-consistent with the plain decode path.
+    * **Paged or dense target.**  ``paged=True`` verifies through
+      ``paged_decode.paged_verify_window``; either way rollback is a
+      length reset to ``len0 + emit_count`` (the cache then covers
+      ``last, e_1..e_{cnt-1}`` and ``e_cnt`` is fed back next round).
+
+    The draft cache is always DENSE (the paged HBM win matters for the
+    big target; the draft is layers-sliced and small).  Returns
+    (target_cache, draft_cache, state, emitted [slots, k],
+    emit_count [slots]).
+    """
+    n_slots = state["tokens"].shape[0]
+    last = state["tokens"]
+    active = state["active"]
+    temps = state["temps"]
+    key = state["key"]
+
+    # -- draft rollout: k-1 small-model greedy steps -----------------------
+    def draft_body(carry, _):
+        dc, tok = carry
+        dc, logits = decode_step(draft_params, dc, tok, active, draft_cfg,
+                                 compute_dtype)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (dc, nxt), nxt
+
+    (draft_cache, last_d), drafts = jax.lax.scan(
+        draft_body, (draft_cache, last), None, length=k - 1)
+    drafts = drafts.T if k > 1 else jnp.zeros((n_slots, 0), jnp.int32)
+    # KV-only extra step so a fully-accepted round leaves d_{k-1}'s row in
+    # the draft cache (fixed price of fixed shapes, as speculative_round)
+    draft_cache, _ = decode_step(draft_params, draft_cache, last_d, active,
+                                 draft_cfg, compute_dtype)
+
+    # -- target verify: ONE k-token window ---------------------------------
+    window = jnp.concatenate([last[:, None], drafts], axis=1)
+    t_len0 = target_cache["length"]
+    if paged:
+        from .paged_decode import paged_verify_window
+        target_cache, logits = paged_verify_window(
+            target_params, target_cache, window, active, target_cfg,
+            compute_dtype)
+    else:
+        target_cache, logits = verify_window(target_params, target_cache,
+                                             window, active, target_cfg,
+                                             compute_dtype)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [slots, k]
+
+    # -- acceptance --------------------------------------------------------
+    match = (drafts == greedy[:, :-1]) if k > 1 \
+        else jnp.zeros((n_slots, 0), bool)
+    accepted = jnp.argmin(
+        jnp.concatenate([match, jnp.zeros((n_slots, 1), bool)], 1), axis=1)
+    is_greedy = temps <= 0.0
+    accepted = jnp.where(is_greedy, accepted, 0)
+    # sampled slots draw token 0 from the target's own next-token logits
+    samp = sample_per_slot(logits[:, 0], jax.random.fold_in(key, 0xD1CE),
+                           temps, top_k)
+    correction = jnp.take_along_axis(greedy, accepted[:, None], 1)[:, 0]
+    first_tok = jnp.where(is_greedy, correction, samp)
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((n_slots, 1), jnp.int32)], 1)
+    emitted = jnp.where(jnp.arange(k)[None] < accepted[:, None],
+                        drafts_pad, first_tok[:, None])     # [slots, k]
+
+    # -- budget clamp + EOS truncation (device mirrors the host retire) ----
+    emit_count = jnp.where(active, accepted + 1, 0)
+    emit_count = jnp.minimum(emit_count, jnp.maximum(state["budget"], 0))
+    in_window = jnp.arange(k)[None] < emit_count[:, None]
+    eos_hits = (emitted == state["eos"][:, None]) & in_window
+    has_eos = eos_hits.any(axis=1)
+    emit_count = jnp.where(has_eos, jnp.argmax(eos_hits, axis=1) + 1,
+                           emit_count)
+
+    # -- roll caches back to the verified prefix ---------------------------
+    # cache now ends with ...last, e_1..e_{cnt-1}; the last emitted token
+    # (correction or budget-cut draft) is fed next round
+    new_len = t_len0 + emit_count
+    target_cache = dict(target_cache,
+                        length=jnp.where(active, new_len, t_len0))
+    draft_cache = dict(draft_cache,
+                       length=jnp.where(active, new_len,
+                                        draft_cache["length"]))
+
+    new_last = jnp.take_along_axis(
+        emitted, jnp.maximum(emit_count - 1, 0)[:, None], 1)[:, 0]
+    new_last = jnp.where(active & (emit_count > 0), new_last, last)
+    new_budget = jnp.where(active, state["budget"] - emit_count,
+                           state["budget"])
+    new_active = active & (new_budget > 0) & ~has_eos
+    state = {"tokens": new_last, "active": new_active, "temps": temps,
+             "budget": new_budget, "eos": state["eos"],
+             "key": jax.random.fold_in(key, 0x5BEC)}
+    return target_cache, draft_cache, state, emitted, emit_count
+
+
+def spec_decode_state_loop(target_params: Params, target_cache,
+                           draft_params: Params, draft_cache: KVCache,
+                           state: Dict[str, Any], k: int, num_rounds: int,
+                           target_cfg: TransformerConfig,
+                           draft_cfg: TransformerConfig, paged: bool = False,
+                           top_k: int = 0, compute_dtype=jnp.bfloat16
+                           ) -> Dict[str, Any]:
+    """``num_rounds`` decode-state spec rounds under one ``lax.scan`` —
+    the engine's speculative twin of ``decode_state_loop`` (one dispatch,
+    no host sync between rounds).
+
+    Returns {tokens: [slots, num_rounds*k] (per-slot emit buffer; entries
+    beyond counts are garbage), counts: [slots], emit_counts:
+    [num_rounds, slots] (per-round acceptance accounting — the host
+    derives drafted/accepted/rollback tallies from these alone),
+    target_cache, draft_cache, state}.
+    """
+    n_slots = state["tokens"].shape[0]
+    out = jnp.zeros((n_slots, num_rounds * k), jnp.int32)
+    counts = jnp.zeros((n_slots,), jnp.int32)
+    row = jnp.arange(n_slots)[:, None]
+
+    def body(carry, _):
+        tc, dc, st, out, counts = carry
+        tc, dc, st, emitted, n_emit = spec_state_round(
+            target_params, tc, draft_params, dc, st, k, target_cfg,
+            draft_cfg, paged, top_k, compute_dtype)
+        idx = jnp.minimum(counts[:, None] + jnp.arange(k)[None],
+                          out.shape[1] - 1)
+        keep = jnp.arange(k)[None] < n_emit[:, None]
+        out = out.at[row, idx].set(jnp.where(keep, emitted, out[row, idx]))
+        counts = counts + n_emit
+        return (tc, dc, st, out, counts), n_emit
+
+    (target_cache, draft_cache, state, out, counts), emits = jax.lax.scan(
+        body, (target_cache, draft_cache, state, out, counts), None,
+        length=num_rounds)
+    return {"tokens": out, "counts": counts, "emit_counts": emits,
+            "target_cache": target_cache, "draft_cache": draft_cache,
+            "state": state}
+
+
+# ---------------------------------------------------------------------------
+# Draft-model construction
+# ---------------------------------------------------------------------------
+
+def make_draft_params(params: Params, num_layers: int) -> Params:
+    """Layers-sliced draft: the leading ``num_layers`` blocks of the
+    stacked target params, SHARING embed/final_norm/lm_head (no copy —
+    block params are stacked [L, ...] for the layer scan, so a slice is
+    one gather).  This is the zero-training draft the serving engine
+    defaults to: acceptance then measures how far the truncated trunk
+    agrees with the full one, and greedy acceptance keeps the output
+    exact regardless."""
+    import jax as _jax
+    return {key: (_jax.tree_util.tree_map(lambda a: a[:num_layers], val)
+                  if key == "blocks" else val)
+            for key, val in params.items()}
+
+
+def damp_block_outputs(params: Params, scale: float = 0.05,
+                       from_layer: int = 0) -> Params:
+    """Benchmark/test param surgery for SYNTHETIC (randomly initialized)
+    weights: scale the output projections (attention ``wo``, MLP
+    ``w_out`` + their biases) of every block with index >= ``from_layer``
+    by ``scale``.  With ``from_layer = draft_layers`` the target's deep
+    tail contributes only a small residual perturbation on top of the
+    layers a sliced draft shares, so the pair agrees at the acceptance
+    rates a TRAINED draft/target pair exhibits — while the target still
+    pays its full depth per step, which is the cost speculation saves.
+    Untrained random blocks otherwise give a sliced draft ~chance
+    acceptance, which benchmarks the overhead of speculation but none of
+    its win.  The acceptance rate is recorded honestly either way, the
+    SAME damped model runs in BOTH arms of the perf A/B (fair
+    comparison), and this is never applied to real checkpoints."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    def _scale(keypath, leaf):
+        path = "/".join(str(getattr(p, "key", p)) for p in keypath)
+        tail = path.rsplit("/", 1)[-1]
+        if tail in ("wo", "bo", "w_out", "b_out"):
+            # stacked block params carry the leading layer dim
+            mult = _jnp.where(_jnp.arange(leaf.shape[0]) >= from_layer,
+                              _jnp.asarray(scale, leaf.dtype),
+                              _jnp.asarray(1.0, leaf.dtype))
+            return leaf * mult.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return leaf
+    out = dict(params)
+    out["blocks"] = _jax.tree_util.tree_map_with_path(
+        _scale, params["blocks"])
+    return out

@@ -48,7 +48,7 @@ HEALTH_FAILURE_THRESHOLD = 3
 class _Replica:
     __slots__ = ("name", "handle", "version", "state", "failures",
                  "started_at", "last_ongoing", "code_hash", "last_probe",
-                 "last_slo", "last_slo_ts")
+                 "last_slo", "last_slo_ts", "last_prefix")
 
     def __init__(self, name: str, handle, version: str,
                  code_hash: Optional[str] = None):
@@ -70,6 +70,11 @@ class _Replica:
         #: period from the deployment rollup (a wedged replica's frozen
         #: p95 must not pollute the aggregate forever)
         self.last_slo_ts = 0.0
+        #: prefix-cache digest piggybacked on the same heartbeat
+        #: ({page, blocks: [hex block hashes]} — LLMServer.prefix_digest);
+        #: None when the deployment doesn't expose one.  Shares
+        #: last_slo_ts as its freshness stamp.
+        self.last_prefix: Optional[dict] = None
 
 
 class _DeploymentState:
@@ -292,6 +297,32 @@ class ServeController:
                  for name, ds in self._deployments.items() if not ds.deleting}
         return self._table_version, table
 
+    async def get_routing_info(self):
+        """(version, table, digests) — the routing table plus each running
+        replica's last heartbeat prefix-cache digest, for cache-aware
+        routing.  Digests ride the SAME freshness stamp as the SLO
+        snapshot and share slo_rollup's staleness horizon: a wedged
+        replica's frozen digest would otherwise keep attracting the
+        prefixes it can no longer serve quickly.  Replicas without a
+        digest (non-LLM deployments, prefix cache off) simply don't
+        appear — the router falls back to pure p2c for them."""
+        now = time.monotonic()
+        table: Dict[str, List[str]] = {}
+        digests: Dict[str, dict] = {}
+        for name, ds in self._deployments.items():
+            if ds.deleting:
+                continue
+            running = ds.running()
+            table[name] = [r.name for r in running]
+            cfg = ds.config
+            horizon = now - max(3.0 * cfg.health_check_period_s,
+                                cfg.health_check_timeout_s
+                                + cfg.health_check_period_s)
+            for r in running:
+                if r.last_prefix and r.last_slo_ts >= horizon:
+                    digests[r.name] = r.last_prefix
+        return self._table_version, table, digests
+
     async def wait_for_table_change(self, known_version: int,
                                     timeout_s: float = 10.0):
         """Long-poll: return as soon as the table moves past known_version
@@ -503,6 +534,7 @@ class ServeController:
                 r.last_ongoing = int(res.get("ongoing", 0))
                 r.last_slo = res.get("slo") or {}
                 r.last_slo_ts = time.monotonic()
+                r.last_prefix = res.get("prefix")
                 if r.state == STARTING:
                     r.state = RUNNING
                     self._bump_table()

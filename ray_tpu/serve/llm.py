@@ -87,7 +87,9 @@ class LLMEngine:
                  warmup_buckets: bool = False,
                  paged: bool = False, page_size: int = 64,
                  num_pages: Optional[int] = None, prefix_cache: bool = True,
-                 tp: int = 1):
+                 tp: int = 1, spec_decode_enabled: bool = False,
+                 spec_k: int = 4, spec_draft_layers: int = 1,
+                 spec_adaptive: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -182,6 +184,48 @@ class LLMEngine:
                     self.compute_dtype),
                 donate_argnums=(1, 2))
         self._prefill_fns: Dict[int, Any] = {}
+
+        # Speculative decoding (spec_decode_enabled=False => today's path
+        # exactly: no draft state exists and _dispatch_step never branches).
+        # A layers-sliced draft shares embed/lm_head with the target and
+        # keeps a DENSE cache (the paged HBM win matters for the big
+        # target); per dispatch the adaptive controller picks k from
+        # occupancy — speculation pays when slots are idle, so k shrinks
+        # as the batch fills (min k=2 rather than a plain-decode fallback,
+        # which would let the draft cache diverge from the target's).
+        self.spec_enabled = bool(spec_decode_enabled)
+        if self.spec_enabled:
+            if tp > 1:
+                raise ValueError("spec_decode_enabled does not compose with "
+                                 "tp>1 yet (draft params are unsharded)")
+            import dataclasses as _dc
+
+            from ray_tpu.models import speculative as spec_mod
+            self._spec = spec_mod
+            d = max(1, min(int(spec_draft_layers), cfg.num_layers - 1))
+            self.spec_k = max(2, int(spec_k))
+            self.spec_adaptive = bool(spec_adaptive)
+            self.spec_draft_layers = d
+            self._spec_draft_cfg = _dc.replace(cfg, num_layers=d)
+            self._draft_params = spec_mod.make_draft_params(self.params, d)
+            self._draft_cache = dec.init_kv_cache(
+                self._spec_draft_cfg, num_slots + 1, self.max_len,
+                self.compute_dtype)
+            self._spec_fns: Dict[int, Any] = {}
+            self._draft_prefill_fns: Dict[int, Any] = {}
+            self._spec_ks = sorted({self.spec_k,
+                                    max(2, (self.spec_k + 1) // 2), 2},
+                                   reverse=True)
+            # accounting (breakdown()["spec"] + raytpu_serve_spec_* read
+            # these; derived host-side from per-round emit counts only)
+            self.spec_rounds = 0
+            self.spec_tokens = 0
+            self.spec_drafted = 0
+            self.spec_accepted = 0
+            self.spec_draft_errors = 0
+            self.spec_dispatch_k: Dict[int, int] = {}
+        else:
+            self._spec = None
 
         # scheduler state
         self._pending: "queue.Queue[GenRequest]" = queue.Queue()
@@ -280,7 +324,33 @@ class LLMEngine:
             }
             out["prefix_cache"] = (self.prefix.stats()
                                    if self.prefix is not None else None)
+        if self.spec_enabled:
+            out["spec"] = {
+                "k": self.spec_k,
+                "draft_layers": self.spec_draft_layers,
+                "rounds": self.spec_rounds,
+                "tokens": self.spec_tokens,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (self.spec_accepted / self.spec_drafted
+                                    if self.spec_drafted else 0.0),
+                "rollback_tokens": self.spec_drafted - self.spec_accepted,
+                "tokens_per_round": (self.spec_tokens / self.spec_rounds
+                                     if self.spec_rounds else 0.0),
+                "dispatch_k": dict(self.spec_dispatch_k),
+                "draft_errors": self.spec_draft_errors,
+            }
         return out
+
+    def prefix_digest(self, cap: int = 32) -> Optional[dict]:
+        """Bounded digest of this engine's hot first-page prefix chunks
+        for cache-aware routing: ``{"page": page_size, "blocks": [8-hex
+        truncated chunk hashes]}``.  None when the engine is dense or
+        prefix caching is off — the router falls back to pure p2c."""
+        if not self.paged or self.prefix is None:
+            return None
+        return {"page": self.page_size,
+                "blocks": self.prefix.first_page_digest(cap)}
 
     def warmup(self, bucket: Optional[int] = None):
         """Compile prefill(bucket)+decode ahead of traffic."""
@@ -439,6 +509,81 @@ class LLMEngine:
             self._prefill_fns[bucket] = fn
         return fn
 
+    # ------------------------------------------------- speculative decode
+
+    def _draft_prefill_fn(self, bucket: int):
+        """Draft-cache prefill (KV only, logits discarded): the draft has
+        no prefix cache, so it always ingests the FULL prompt from
+        position 0 — one small compiled program per length bucket."""
+        fn = self._draft_prefill_fns.get(bucket)
+        if fn is None:
+            dcfg, dt = self._spec_draft_cfg, self.compute_dtype
+            dec = self._dec
+
+            def f(p, c, t, ln, sl):
+                return dec.prefill(p, c, t, ln, sl, dcfg, dt)[0]
+
+            fn = self._jax.jit(f, donate_argnums=(1,))
+            self._draft_prefill_fns[bucket] = fn
+        return fn
+
+    def _draft_prefill(self, reqs: List[GenRequest], slots: List[int]):
+        """Ingest the admitted prompts into the draft cache.  Failure here
+        never fails the requests: greedy acceptance keeps the OUTPUT exact
+        even with a garbage draft (acceptance just collapses), so degrade
+        and count instead of unwinding a half-done admit."""
+        import numpy as np
+        bucket = self._bucket_for(max(len(r.tokens) for r in reqs))
+        n_pad = self.prefill_batch - len(reqs)
+        toks = np.zeros((self.prefill_batch, bucket), np.int32)
+        for i, r in enumerate(reqs):
+            toks[i, :len(r.tokens)] = r.tokens
+        lengths = np.asarray([len(r.tokens) for r in reqs] + [1] * n_pad,
+                             np.int32)
+        slots_arr = np.asarray(slots + [self._scratch_slot] * n_pad,
+                               np.int32)
+        try:
+            self._draft_cache = self._draft_prefill_fn(bucket)(
+                self._draft_params, self._draft_cache, toks, lengths,
+                slots_arr)
+        except BaseException:  # noqa: BLE001
+            self.spec_draft_errors += 1
+
+    def _spec_k_now(self) -> int:
+        """Adaptive k: speculation pays when slots are idle (the verify
+        matmul rides free on weight traffic the batch already pays for),
+        so shrink the window as occupancy rises.  Never falls back to the
+        plain decode program — that would stop feeding the draft cache
+        and strand its KV behind the target's for every in-flight
+        request."""
+        if not self.spec_adaptive or len(self._spec_ks) == 1:
+            return self.spec_k
+        occ = len(self._active) / max(1, self.num_slots)
+        if occ <= 0.5:
+            return self._spec_ks[0]
+        if occ <= 0.85:
+            return self._spec_ks[min(1, len(self._spec_ks) - 1)]
+        return self._spec_ks[-1]
+
+    def _spec_fn(self, k: int):
+        """One compiled spec-decode program per window size k (static
+        shapes; rounds chosen so a dispatch emits at most about
+        steps_per_dispatch tokens per slot, matching the plain path's
+        readback cadence)."""
+        ent = self._spec_fns.get(k)
+        if ent is None:
+            rounds = max(1, self.steps_per_dispatch // k)
+            spec, cfg, dcfg = self._spec, self.cfg, self._spec_draft_cfg
+            tk, dt, paged = self.top_k, self.compute_dtype, self.paged
+
+            def run(tp, tc, dp, dc, st):
+                return spec.spec_decode_state_loop(
+                    tp, tc, dp, dc, st, k, rounds, cfg, dcfg, paged, tk, dt)
+
+            ent = (self._jax.jit(run, donate_argnums=(1, 3, 4)), rounds)
+            self._spec_fns[k] = ent
+        return ent
+
     def _loop(self):
         while not self._stop:
             did_work = False
@@ -520,6 +665,8 @@ class LLMEngine:
             r.slot = s
             self._active[s] = r
             snapshot[s] = r
+        if self._spec is not None:
+            self._draft_prefill(reqs, slots)
         self._unfetched.append((first, snapshot, slots))
         self.steps += 1
         self._obs_admit(reqs)
@@ -600,19 +747,76 @@ class LLMEngine:
                 # register this prompt's full pages for future reuse
                 self.prefix.insert(r.tokens,
                                    r.pages[:len(r.tokens) // self.page_size])
+        if self._spec is not None:
+            self._draft_prefill(preqs, slots)
         self._unfetched.append((first, snapshot, slots))
         self.steps += 1
         self._obs_admit(preqs)
 
     def _dispatch_step(self):
+        if self._spec is not None:
+            k = self._spec_k_now()
+            fn, rounds = self._spec_fn(k)
+            res = fn(self.params, self.cache, self._draft_params,
+                     self._draft_cache, self._state)
+            self.cache = res["target_cache"]
+            self._draft_cache = res["draft_cache"]
+            self._state = res["state"]
+            self._unfetched.append(
+                ((res["tokens"], res["counts"], res["emit_counts"], k),
+                 dict(self._active), "spec"))
+            self.steps += rounds
+            self.spec_dispatch_k[k] = self.spec_dispatch_k.get(k, 0) + 1
+            return
         self.cache, self._state, emitted = self._decode_fn(
             self.params, self.cache, self._state)
         self._unfetched.append((emitted, dict(self._active), None))
         self.steps += self.steps_per_dispatch
 
+    def _drain_spec(self, payload, snapshot):
+        """Fetch one speculative dispatch: emit each slot's accepted
+        window and fold the per-round emit counts into the acceptance
+        tallies (a round's emit_count e in 1..k means e-1 drafts accepted
+        + one verified correction; the k-1-e rejected drafts are the
+        rollback)."""
+        import numpy as np
+        tokens_dev, counts_dev, round_counts_dev, k = payload
+        tokens = np.asarray(tokens_dev)   # blocks until the dispatch ran
+        counts = np.asarray(counts_dev)
+        rounds = np.asarray(round_counts_dev)  # [num_rounds, slots]
+        d_tok = d_round = d_draft = d_acc = 0
+        for row in rounds:
+            act = int((row > 0).sum())
+            if not act:
+                continue
+            d_round += act
+            d_tok += int(row.sum())
+            d_draft += (k - 1) * act
+            d_acc += int(np.minimum(np.maximum(row - 1, 0), k - 1).sum())
+        self.spec_rounds += d_round
+        self.spec_tokens += d_tok
+        self.spec_drafted += d_draft
+        self.spec_accepted += d_acc
+        if d_round:
+            obs.record_spec_dispatch(self._obs_dep, d_round, d_tok,
+                                     d_draft, d_acc)
+        now = time.monotonic()
+        for s, r in snapshot.items():
+            if r.slot != s or self._active.get(s) is not r:
+                continue
+            for j in range(int(counts[s])):
+                if self._active.get(s) is not r:
+                    break
+                if r.first_token_at is None:
+                    r.first_token_at = now
+                self._emit(r, int(tokens[s, j]))
+
     def _drain_one(self):
         import numpy as np
         tokens_dev, snapshot, prefill_slots = self._unfetched.pop(0)
+        if prefill_slots == "spec":
+            self._drain_spec(tokens_dev, snapshot)
+            return
         tokens = np.asarray(tokens_dev)   # blocks until the step finished
         now = time.monotonic()
         if prefill_slots is not None:
@@ -708,6 +912,16 @@ class LLMServer:
                 "active": len(self.engine._active),
                 "free_slots": len(self.engine._free_slots),
                 **self.engine.breakdown()}
+
+    def prefix_digest(self) -> Optional[dict]:
+        """Replica heartbeat hook (replica.py health_check attaches this
+        next to the SLO snapshot): the engine's bounded first-page prefix
+        digest for cache-aware routing.  Size-capped by the
+        ``serve_prefix_digest_max`` knob; None (dense engine / prefix
+        cache off) means the router uses pure p2c for this replica."""
+        from ray_tpu.core.config import get_config
+        cap = int(getattr(get_config(), "serve_prefix_digest_max", 32))
+        return self.engine.prefix_digest(cap)
 
 
 def llm_deployment(preset: str = "tiny", *, num_replicas: int = 1,

@@ -92,14 +92,26 @@ def heavy_tail_len(rng: random.Random, median: int, sigma: float = 0.8,
 
 def llm_payload(seed: int, idx: int, *, prompt_median: int, prompt_lo: int,
                 prompt_hi: int, decode_median: int, decode_lo: int = 4,
-                decode_hi: int = 64, vocab: int = 1000) -> dict:
+                decode_hi: int = 64, vocab: int = 1000,
+                prefix_pool: int = 0, prefix_len: int = 0) -> dict:
     """One LLM storm request — heavy-tailed prompt + decode lengths as a
     PURE function of (seed, idx), so per-request shapes are reproducible
     no matter how the firing pool's threads interleave (int-derived
-    seed: tuple seeding is a TypeError from Python 3.11)."""
+    seed: tuple seeding is a TypeError from Python 3.11).
+
+    ``prefix_pool``/``prefix_len`` model multi-turn / system-prompt
+    traffic: each request draws one of ``prefix_pool`` shared prefixes
+    (``prefix_len`` tokens, a pure function of seed + pool index) and
+    appends its unique heavy-tailed tail.  Requests sharing a prefix hit
+    the paged prefix cache — and give cache-aware routing something to
+    route ON (the storm A/B's hit-rate lift comes from exactly this)."""
     rng = random.Random(seed * 1_000_003 + idx)
+    head: list = []
+    if prefix_pool > 0 and prefix_len > 0:
+        prng = random.Random(seed * 7_368_787 + rng.randrange(prefix_pool))
+        head = [prng.randint(1, vocab) for _ in range(prefix_len)]
     return {
-        "tokens": [rng.randint(1, vocab) for _ in range(
+        "tokens": head + [rng.randint(1, vocab) for _ in range(
             heavy_tail_len(rng, prompt_median, lo=prompt_lo,
                            hi=prompt_hi))],
         "max_tokens": heavy_tail_len(rng, decode_median, lo=decode_lo,

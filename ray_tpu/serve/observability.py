@@ -163,6 +163,23 @@ def _build():
             "raytpu_serve_prefix_cache_tokens_reused_total",
             "prompt tokens whose KV was served from the prefix cache",
             tag_keys=("deployment",)),
+        "spec_accept": Histogram(
+            "raytpu_serve_spec_acceptance_rate",
+            "draft-token acceptance fraction per speculative dispatch",
+            boundaries=_FRACTION_BOUNDS, tag_keys=("deployment",)),
+        "spec_tokens_round": Histogram(
+            "raytpu_serve_spec_tokens_per_round",
+            "tokens emitted per speculative round (1..k)",
+            boundaries=(1, 2, 3, 4, 6, 8, 12, 16),
+            tag_keys=("deployment",)),
+        "spec_rollbacks": Counter(
+            "raytpu_serve_spec_rollback_tokens_total",
+            "draft tokens rejected by verification and rolled back",
+            tag_keys=("deployment",)),
+        "prefix_route": Counter(
+            "raytpu_serve_prefix_route_total",
+            "cache-aware routing decisions by result (hit|miss|fallback)",
+            tag_keys=("deployment", "result")),
     }
 
 
@@ -285,6 +302,35 @@ def record_prefix_lookup(deployment: str, hit: bool, tokens_reused: int):
     if tokens_reused > 0:
         m["prefix_tokens"].inc_key(_key(deployment=deployment),
                                    tokens_reused)
+
+
+def record_spec_dispatch(deployment: str, rounds: int, tokens: int,
+                         drafted: int, accepted: int):
+    """One drained speculative dispatch: acceptance fraction, emitted
+    tokens per round, and rejected (rolled-back) draft tokens."""
+    if not enabled():
+        return
+    m = _metrics()
+    if m is None:
+        return
+    dk = _key(deployment=deployment)
+    if drafted > 0:
+        m["spec_accept"].observe_key(dk, accepted / drafted)
+    if rounds > 0:
+        m["spec_tokens_round"].observe_key(dk, tokens / rounds)
+    rolled = drafted - accepted
+    if rolled > 0:
+        m["spec_rollbacks"].inc_key(dk, rolled)
+
+
+def record_prefix_route(deployment: str, result: str):
+    """Cache-aware routing decision; result is hit|miss|fallback."""
+    if not enabled():
+        return
+    m = _metrics()
+    if m is None:
+        return
+    m["prefix_route"].inc_key(_key(deployment=deployment, result=result))
 
 
 def stamp_span(name: str, t0: float, dur: float, *,

@@ -316,10 +316,21 @@ class ReplicaActor:
         # SLO heartbeat piggyback: the rolling TTFT percentiles + queue
         # depth ride the health check the controller already runs — no
         # extra RPC, and the controller aggregates per deployment.
-        return {"ongoing": self.num_ongoing, "processed": self.num_processed,
-                "draining": self._draining,
-                "slo": obs.slo_snapshot(self.deployment_name,
-                                        self.num_ongoing)}
+        out = {"ongoing": self.num_ongoing, "processed": self.num_processed,
+               "draining": self._draining,
+               "slo": obs.slo_snapshot(self.deployment_name,
+                                       self.num_ongoing)}
+        # Prefix-cache digest piggyback (cache-aware routing): deployments
+        # exposing prefix_digest() (LLMServer over a paged engine) ship a
+        # bounded set of first-page block hashes the router can score
+        # candidates against.  A broken hook must not fail the health
+        # check — routing just falls back to pure p2c for this replica.
+        if target is not None and hasattr(target, "prefix_digest"):
+            try:
+                out["prefix"] = target.prefix_digest()
+            except Exception:
+                out["prefix"] = None
+        return out
 
     async def queue_len(self) -> int:
         return self.num_ongoing

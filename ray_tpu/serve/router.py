@@ -11,12 +11,13 @@ refresh; calls retry on another replica.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import random
 import re
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import ray_tpu
 from ray_tpu.core.common import (ActorDiedError, ActorUnavailableError,
@@ -57,6 +58,32 @@ def _controller():
     return ray_tpu.get_actor(CONTROLLER_NAME)
 
 
+def _block_hash(tokens: Sequence[int], page: int) -> str:
+    """First-page block hash, truncated exactly as the replica digest is:
+    MUST stay in lockstep with PrefixCache._hash (4-byte-LE token stream,
+    16-byte blake2b) + first_page_digest's hex[:8] — a drift here silently
+    turns every routing decision into a miss."""
+    return hashlib.blake2b(
+        b"".join(int(t).to_bytes(4, "little") for t in tokens[:page]),
+        digest_size=16).digest().hex()[:8]
+
+
+def _hint_tokens(args: tuple, kwargs: dict) -> Optional[list]:
+    """Prompt tokens for cache-aware routing, when the payload looks like
+    an LLM request ({"tokens": [...]} first arg, or a tokens= kwarg).
+    Anything else — HTTP Request objects, non-LLM deployments — yields no
+    hint and the router stays pure p2c."""
+    cand = None
+    if args and isinstance(args[0], dict):
+        cand = args[0].get("tokens")
+    if cand is None:
+        cand = kwargs.get("tokens")
+    if isinstance(cand, (list, tuple)) and cand \
+            and all(isinstance(t, int) for t in cand[:4]):
+        return list(cand)
+    return None
+
+
 class Router:
     """Caches the controller's routing table; assigns requests to replicas."""
 
@@ -66,6 +93,11 @@ class Router:
         self._handles: Dict[str, Any] = {}           # replica name -> handle
         self._inflight: Dict[str, int] = {}          # replica name -> local count
         self._dep_inflight: Dict[str, int] = {}      # queue-depth gauge feed
+        #: replica name -> (page_size, frozenset of first-page block
+        #: hashes) from the controller's heartbeat-fed digest view; absent
+        #: entries (non-LLM replicas, stale heartbeats, routing disabled)
+        #: fall back to pure p2c
+        self._digests: Dict[str, Tuple[int, frozenset]] = {}
         self._last_refresh = 0.0
         self._table_version = -1
         self._lock = threading.Lock()
@@ -90,16 +122,34 @@ class Router:
         if not force and now - self._last_refresh < self.refresh_interval_s:
             return
         ctrl = _controller()
-        version, table = ray_tpu.get(
-            ctrl.get_routing_table.remote(), timeout=30)
+        if self._prefix_routing_enabled():
+            version, table, digests = ray_tpu.get(
+                ctrl.get_routing_info.remote(), timeout=30)
+        else:
+            version, table = ray_tpu.get(
+                ctrl.get_routing_table.remote(), timeout=30)
+            digests = {}
         with self._lock:
             self._last_refresh = now
+            # digests refresh every poll (they age independently of table
+            # membership — a version check would freeze them)
+            self._digests = {
+                name: (int(d.get("page", 0)),
+                       frozenset(d.get("blocks") or ()))
+                for name, d in digests.items()
+                if isinstance(d, dict) and d.get("page")}
             if version != self._table_version:
                 self._table_version = version
                 self._table = table
                 live = {r for reps in table.values() for r in reps}
                 self._handles = {k: v for k, v in self._handles.items()
                                  if k in live}
+
+    @staticmethod
+    def _prefix_routing_enabled() -> bool:
+        from ray_tpu.core.config import get_config
+        return bool(getattr(get_config(), "serve_prefix_routing_enabled",
+                            True))
 
     def _replica_handle(self, replica_name: str):
         h = self._handles.get(replica_name)
@@ -133,7 +183,8 @@ class Router:
 
     # ------------------------------------------------------- p2c selection
 
-    def choose_replica(self, deployment: str) -> str:
+    def choose_replica(self, deployment: str,
+                       hint_tokens: Optional[Sequence[int]] = None) -> str:
         self._refresh()
         replicas = self._table.get(deployment)
         if not replicas:
@@ -146,7 +197,52 @@ class Router:
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
-        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+        la, lb = self._inflight.get(a, 0), self._inflight.get(b, 0)
+        p2c = a if la <= lb else b
+        if hint_tokens is None or not self._prefix_routing_enabled():
+            return p2c
+        return self._score_candidates(deployment, (a, la), (b, lb), p2c,
+                                      hint_tokens)
+
+    def _score_candidates(self, deployment: str, ca, cb, p2c: str,
+                          hint_tokens: Sequence[int]) -> str:
+        """Prefix-overlap x load scoring over the two p2c candidates:
+        ``score = (inflight + 1) * (1 - weight * hit)`` where ``hit`` is
+        membership of the request's first-page block hash in the
+        candidate's heartbeat digest.  Absent digests on both candidates
+        mean no signal — pure p2c, recorded as ``fallback``.  Ties keep
+        the p2c pick so weight=0 degrades to exactly today's behavior."""
+        from . import observability as obs
+        from ray_tpu.core.config import get_config
+        (a, la), (b, lb) = ca, cb
+        da, db = self._digests.get(a), self._digests.get(b)
+        if da is None and db is None:
+            obs.record_prefix_route(deployment, "fallback")
+            return p2c
+        w = min(1.0, max(0.0, float(getattr(
+            get_config(), "serve_prefix_routing_weight", 0.5))))
+        hashes: Dict[int, str] = {}  # page size -> request block hash
+
+        def hit(load_digest) -> bool:
+            if load_digest is None:
+                return False
+            page, blocks = load_digest
+            if len(hint_tokens) < page:
+                return False  # no full first page -> nothing reusable
+            if page not in hashes:
+                hashes[page] = _block_hash(hint_tokens, page)
+            return hashes[page] in blocks
+        ha, hb = hit(da), hit(db)
+        sa = (la + 1) * (1.0 - w * ha)
+        sb = (lb + 1) * (1.0 - w * hb)
+        if sa == sb:
+            chosen, was_hit = p2c, (ha if p2c == a else hb)
+        elif sa < sb:
+            chosen, was_hit = a, ha
+        else:
+            chosen, was_hit = b, hb
+        obs.record_prefix_route(deployment, "hit" if was_hit else "miss")
+        return chosen
 
     # ------------------------------------------------------------- calling
 
@@ -157,8 +253,9 @@ class Router:
         A replica whose name no longer resolves (actor died and was
         deregistered) is evicted and the request re-routed."""
         last_err: Optional[Exception] = None
+        hint = _hint_tokens(args, kwargs)
         for _ in range(5):
-            name = self.choose_replica(deployment)
+            name = self.choose_replica(deployment, hint_tokens=hint)
             try:
                 h = self._replica_handle(name)
                 ref = h.handle_request.remote(args, kwargs, method)
@@ -189,8 +286,9 @@ class Router:
         """Kick off a streaming request; returns (replica_name, stream_id,
         completion ref)."""
         last: Optional[Exception] = None
+        hint = _hint_tokens(args, kwargs)
         for _ in range(5):
-            name = self.choose_replica(deployment)
+            name = self.choose_replica(deployment, hint_tokens=hint)
             stream_id = uuid.uuid4().hex
             try:
                 h = self._replica_handle(name)

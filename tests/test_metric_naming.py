@@ -184,6 +184,68 @@ def test_serve_metric_tag_keys_are_bounded():
     assert seen >= 8, f"only {seen} raytpu_serve_ metrics found"
 
 
+# -------------------------------------- speculative-serving cardinality
+
+#: the result tag's closed value domain for the cache-aware routing
+#: decision counter (router.py feeds it; anything else would be an
+#: unbounded label value)
+PREFIX_ROUTE_RESULTS = {"hit", "miss", "fallback"}
+
+
+def test_spec_serving_metrics_are_declared_and_bounded():
+    """The speculative-serving series (acceptance rate, tokens/round,
+    rollback tokens) and the prefix-routing decision counter exist in
+    the serve observability table as the declared metric classes — the
+    tag-key allowlist above then bounds their label sets."""
+    tree = ast.parse((SERVE_DIR / "observability.py").read_text())
+    found = {}
+    for call, cls in _metric_calls(tree):
+        name_node = call.args[0] if call.args else None
+        if isinstance(name_node, ast.Constant):
+            found[name_node.value] = cls
+    assert found.get("raytpu_serve_spec_acceptance_rate") == "Histogram"
+    assert found.get("raytpu_serve_spec_tokens_per_round") == "Histogram"
+    assert found.get("raytpu_serve_spec_rollback_tokens_total") == "Counter"
+    assert found.get("raytpu_serve_prefix_route_total") == "Counter"
+
+
+def test_prefix_route_results_are_closed_vocabulary():
+    """Every ``record_prefix_route(...)`` call site passes a result that
+    is provably in {hit, miss, fallback} — a literal, or an IfExp whose
+    both branches are literals from the set (free-form strings would be
+    unbounded values for the ``result`` tag)."""
+    problems = []
+    sites = 0
+    for path in sorted(SERVE_DIR.rglob("*.py")):
+        if path.name == "observability.py":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "record_prefix_route"):
+                continue
+            sites += 1
+            if len(node.args) < 2:
+                continue  # a *args forward — not a literal stamp site
+            arg = node.args[1]
+            ok = (isinstance(arg, ast.Constant)
+                  and arg.value in PREFIX_ROUTE_RESULTS) or (
+                isinstance(arg, ast.IfExp)
+                and isinstance(arg.body, ast.Constant)
+                and arg.body.value in PREFIX_ROUTE_RESULTS
+                and isinstance(arg.orelse, ast.Constant)
+                and arg.orelse.value in PREFIX_ROUTE_RESULTS)
+            if not ok:
+                problems.append(
+                    f"{path.relative_to(PKG_ROOT.parent)}:{node.lineno}: "
+                    "record_prefix_route result is not a literal from "
+                    f"{sorted(PREFIX_ROUTE_RESULTS)}")
+    assert not problems, "\n".join(problems)
+    # the router's fallback + hit/miss decision sites at minimum
+    assert sites >= 2, f"only {sites} record_prefix_route sites found"
+
+
 # ---------------------------------------------------- autoscale cardinality
 
 #: the label-set bound for the autoscaler plane: deployment (config-
