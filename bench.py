@@ -79,7 +79,8 @@ def pick_config(args, n_devices: int, hbm_bytes: float):
     return mcfg.tiny(), 8, 64
 
 
-def _devices_or_skip(jax, timeout_s: float):
+def _devices_or_skip(jax, timeout_s: float,
+                     metric: str = "train_tokens_per_sec_per_chip"):
     """jax.devices(), or emit a structured skip and exit 0.
 
     The BENCH_r05 failure mode was an rc=1 traceback when the TPU plugin
@@ -106,13 +107,159 @@ def _devices_or_skip(jax, timeout_s: float):
         return box["devices"]
     err = box.get("error")
     print(json.dumps({
-        "metric": "train_tokens_per_sec_per_chip",
+        "metric": metric,
         "skipped": "no TPU",
         "error": (str(err).splitlines()[0][:300] if err is not None
                   else f"backend init exceeded {timeout_s:.0f}s"),
     }), flush=True)
     # os._exit: a wedged plugin thread must not block interpreter teardown
     os._exit(0)
+
+
+# ------------------------------------------------------- chipspeed (>=1B)
+
+#: every (splash, quant, zero) combination, off-arm first
+CHIPSPEED_ARMS = [(s, q, z) for s in (False, True) for q in (False, True)
+                  for z in (False, True)]
+
+
+def _arm_name(splash: bool, quant: bool, zero: bool) -> str:
+    on = [n for n, f in (("splash", splash), ("quant", quant),
+                         ("zero", zero)) if f]
+    return "+".join(on) if on else "off"
+
+
+def _run_chipspeed_arm(jax, devices, splash, quant, zero, args):
+    from ray_tpu.models import config as mcfg
+    from ray_tpu.parallel import (MeshSpec, OptimizerSpec,
+                                  init_sharded_state, init_zero_state,
+                                  make_train_step)
+    n = len(devices)
+    if args.preset == "debug":
+        base, batch, seq = mcfg.tiny(), max(8, n), 64
+    else:
+        # the >=1B config ROADMAP item 2 names (llama_1b is ~1.2B params)
+        base = mcfg.llama_1b()
+        seq = args.seq or base.max_seq_len
+        batch = max(args.batch, n)
+    batch -= batch % n
+    cfg = mcfg.TransformerConfig(
+        **{**base.__dict__, "max_seq_len": seq,
+           "attention_impl": "splash" if splash else "auto"})
+    spec = OptimizerSpec(total_steps=max(args.steps + args.warmup, 10))
+    # quant/zero schedule their own dp collectives; the off arms keep
+    # today's fsdp-sharded auto path exactly
+    mesh = (MeshSpec(dp=-1, fsdp=1) if (quant or zero)
+            else MeshSpec(fsdp=-1)).build(devices)
+    remat = None if args.remat in ("none", "None") else args.remat
+
+    t0 = time.time()
+    if zero:
+        state, sh = init_zero_state(cfg, mesh, spec)
+    else:
+        state, sh = init_sharded_state(cfg, mesh, spec.build())
+    step = make_train_step(cfg, mesh, spec.build(), sh, remat=remat,
+                           grad_quant_enabled=quant,
+                           zero_sharded_update=zero, opt_spec=spec)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
+                              cfg.vocab_size)
+    batch_dict = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+    for _ in range(max(args.warmup, 1)):
+        state, metrics = step(state, batch_dict)
+    float(metrics["loss"])  # force (relay-safe host read)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, metrics = step(state, batch_dict)
+    final_loss = float(metrics["loss"])
+    dt = time.time() - t0
+    memory = None
+    try:
+        ms = devices[0].memory_stats() or {}
+        memory = {k: int(ms[k]) for k in
+                  ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                  if k in ms} or None
+    except Exception:
+        pass
+    tok_chip = batch * seq * args.steps / dt / n
+    mfu = tok_chip * cfg.flops_per_token(seq) / detect_peak_flops(devices[0])
+    return {
+        "mfu": round(mfu, 4),
+        "tokens_per_sec_per_chip": round(tok_chip, 2),
+        "step_ms": round(dt / args.steps * 1000, 1),
+        "compile_s": round(compile_s, 1),
+        "loss": round(final_loss, 4),
+        "memory": memory,
+        "wire_bytes_per_step": {f"{op}/{wd}": v for (op, wd), v
+                                in step.collective_bytes.items()},
+        "opt_state_bytes": step.opt_state_bytes,
+        "model": f"{cfg.num_params() / 1e6:.0f}M",
+        "batch": batch, "seq": seq, "n_devices": n,
+    }
+
+
+def run_chipspeed(args, jax):
+    """The >=1B arm matrix: (splash, quant, zero) x {on, off}, per-phase
+    checkpointing (the bench_llm pattern — a dying tunnel loses nothing),
+    one final JSON line + BENCH_CHIPSPEED.json."""
+    metric = "chipspeed_1b_mfu"
+    devices = _devices_or_skip(jax, timeout_s=args.backend_timeout,
+                               metric=metric)
+    if devices[0].platform == "cpu" and args.preset != "debug" \
+            and not args.allow_cpu:
+        print(json.dumps({
+            "metric": metric, "skipped": "no TPU",
+            "error": f"only CPU devices visible "
+                     f"(platform={devices[0].platform}, n={len(devices)})",
+        }), flush=True)
+        return
+    ckpt = "BENCH_CHIPSPEED_partial.json"
+    partial = {}
+    if not args.fresh and os.path.exists(ckpt):
+        try:
+            with open(ckpt) as f:
+                partial = json.load(f)
+            done = [k for k, v in partial.items()
+                    if isinstance(v, dict) and "aborted" not in v]
+            if done:
+                print(f"# resuming: arms {done} checkpointed, skipping",
+                      flush=True)
+        except Exception:
+            partial = {}
+    for splash, quant, zero in CHIPSPEED_ARMS:
+        key = _arm_name(splash, quant, zero)
+        cached = partial.get(key)
+        if isinstance(cached, dict) and "aborted" not in cached:
+            print(f"# {key}: checkpointed, skipping", flush=True)
+            continue
+        try:
+            res = _run_chipspeed_arm(jax, devices, splash, quant, zero, args)
+        except Exception as e:  # an OOM/abort must not lose earlier arms
+            res = {"aborted": str(e).splitlines()[0][:300]}
+        partial[key] = res
+        print(f"# {key}: {json.dumps(res)}", flush=True)
+        with open(ckpt, "w") as f:
+            json.dump(partial, f, indent=1)
+    complete = {k: v for k, v in partial.items()
+                if isinstance(v, dict) and "aborted" not in v}
+    best_key = max(complete, key=lambda k: complete[k].get("mfu", 0.0),
+                   default=None)
+    out = {
+        "metric": metric,
+        "value": complete[best_key]["mfu"] if best_key else None,
+        "unit": "mfu",
+        "best_arm": best_key,
+        "vs_off": (round(complete[best_key]["mfu"]
+                         / complete["off"]["mfu"], 4)
+                   if best_key and complete.get("off", {}).get("mfu")
+                   else None),
+        "arms": partial,
+        "device": getattr(devices[0], "device_kind", "cpu"),
+        "n_devices": len(devices),
+    }
+    with open("BENCH_CHIPSPEED.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
 
 
 def main():
@@ -132,6 +279,12 @@ def main():
     p.add_argument("--allow-cpu", action="store_true",
                    help="run on CPU devices instead of skipping (still "
                         "CPU-sized via --preset; auto on CPU is unwise)")
+    p.add_argument("--chipspeed", action="store_true",
+                   help="run the >=1B (splash, quant, zero) arm matrix "
+                        "with per-arm checkpointing instead of the single "
+                        "headline config")
+    p.add_argument("--fresh", action="store_true",
+                   help="ignore the chipspeed checkpoint and rerun all arms")
     args = p.parse_args()
 
     try:
@@ -139,10 +292,15 @@ def main():
         import jax.numpy as jnp  # noqa: F401
     except Exception as e:  # a TPU-terminal plugin can raise at import
         print(json.dumps({
-            "metric": "train_tokens_per_sec_per_chip",
+            "metric": ("chipspeed_1b_mfu" if args.chipspeed
+                       else "train_tokens_per_sec_per_chip"),
             "skipped": "no TPU",
             "error": f"jax import failed: {str(e).splitlines()[0][:300]}",
         }), flush=True)
+        return
+
+    if args.chipspeed:
+        run_chipspeed(args, jax)
         return
 
     devices = _devices_or_skip(jax, timeout_s=args.backend_timeout)

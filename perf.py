@@ -1197,6 +1197,159 @@ def run_ab_submitplane(S: float, pairs: int) -> dict:
             "ratio_on_off": ratio}
 
 
+def _chipspeed_jax():
+    """Import jax for the chip-speed A/B: CPU backend, 8 forced host
+    devices so the dp=4 collectives in parallel/zero.py are real (must
+    run before the first jax import in this process)."""
+    import os
+    import sys
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    return jax
+
+
+def _measure_chipspeed(S: float, arm: str, steps: int) -> dict:
+    """One fresh-jit run of the tiny-config dp=4 CPU train loop for one
+    knob combination (``arm``: '+'-joined subset of splash/quant/zero, or
+    'off').  Fixed seed and fixed batch schedule so arms are comparable
+    numerically, not just in time."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from ray_tpu.models import config as mcfg
+    from ray_tpu.parallel import (OptimizerSpec, init_sharded_state,
+                                  init_zero_state, make_mesh, make_train_step)
+
+    cfg = mcfg.tiny()
+    if "splash" in arm:
+        cfg = mcfg.TransformerConfig(
+            **{**cfg.__dict__, "attention_impl": "splash"})
+    mesh = make_mesh(4, dp=4, fsdp=1)
+    spec = OptimizerSpec(total_steps=1000, warmup_steps=5)
+    opt = spec.build()
+    zero, quant = "zero" in arm, "quant" in arm
+    if zero:
+        state, sh = init_zero_state(cfg, mesh, spec)
+    else:
+        state, sh = init_sharded_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, sh, compute_dtype=jnp.float32,
+                           grad_quant_enabled=quant,
+                           zero_sharded_update=zero, opt_spec=spec)
+    rng = np.random.RandomState(0)
+    batches = [{"tokens": rng.randint(0, cfg.vocab_size,
+                                      (8, cfg.max_seq_len + 1))}
+               for _ in range(steps)]
+    losses = []
+    state, m = step(state, batches[0])  # compile step, untimed
+    jax_block = jnp.asarray(m["total_loss"]).block_until_ready()
+    losses.append(float(jax_block))
+    t0 = time.perf_counter()
+    for b in batches[1:]:
+        state, m = step(state, b)
+        losses.append(float(m["total_loss"]))  # forces the step
+    wall = time.perf_counter() - t0
+    return {"arm": arm, "steps_per_s": round((steps - 1) / wall, 2),
+            "final_loss": round(losses[-1], 6),
+            "opt_state_bytes": step.opt_state_bytes,
+            "wire_int8": any(d == "int8" for _, d in step.collective_bytes),
+            "_losses": losses}
+
+
+def run_ab_chipspeed(S: float, pairs: int) -> dict:
+    """Interleaved CPU A/B of the chip-speed knobs (ISSUE-20 gates):
+
+    - numerics: the ZeRO-sharded arm's per-step losses allclose to the
+      replicated arm (same seed/batches, fp32); the int8 quantized
+      round-trip stays inside the analytical amax/254-per-rank bound;
+      splash interpret-mode forward parity vs ops/flash_attention.
+    - <= 5% no-TPU overhead discipline: ``attention_impl="splash"`` on a
+      box with no usable kernel must fall back to an identical compiled
+      graph — its steps/s within 5% of the off arm.
+
+    The quant/zero arms change the computation by design, so they get
+    numerics bounds, not overhead bounds; their steps/s ratios are
+    recorded for the record only (CPU time is not the TPU win).
+    """
+    jax = _chipspeed_jax()
+    if len(jax.devices()) < 4:
+        return {"skipped": f"need >= 4 devices, have {len(jax.devices())}"}
+    import jax.numpy as jnp
+
+    steps = max(int(10 * S), 6)
+    arms = ("off", "splash", "splash+quant+zero")
+    runs = {a: [] for a in arms}
+    for i in range(pairs):
+        for a in arms:
+            runs[a].append(_measure_chipspeed(S, a, steps))
+        print(f"# chipspeed ab pair {i + 1}/{pairs}: " +
+              " ".join(f"{a}={runs[a][-1]['steps_per_s']}/s" for a in arms),
+              flush=True)
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    ratio = {a: round(med([r["steps_per_s"] for r in runs[a]])
+                      / max(med([r["steps_per_s"] for r in runs["off"]]),
+                            1e-9), 3)
+             for a in arms if a != "off"}
+
+    # numerics gate 1: ZeRO == replicated, step for step (one fresh run
+    # each, same batch schedule as the timed arms)
+    l_ref = runs["off"][0]["_losses"]
+    l_zero = _measure_chipspeed(S, "zero", steps)["_losses"]
+    zero_err = max(abs(a - b) / max(abs(a), 1e-9)
+                   for a, b in zip(l_ref, l_zero))
+    zero_ok = zero_err < 1e-5
+
+    # numerics gate 2: int8 block round-trip inside amax/254 per element
+    from ray_tpu.parallel.quant_collectives import (dequantize_int8_block,
+                                                    quantize_int8_block)
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 4096), jnp.float32) * 8
+    q, s = quantize_int8_block(x, block=256)
+    back = dequantize_int8_block(q, s, block=256)
+    amax = jnp.max(jnp.abs(x.reshape(64, 16, 256)), -1, keepdims=True)
+    bound = jnp.broadcast_to(amax / 254.0 + 1e-7, (64, 16, 256))
+    quant_ok = bool(jnp.all(jnp.abs(back - x).reshape(64, 16, 256) <= bound))
+    quant_max_err = float(jnp.max(jnp.abs(back - x)))
+
+    # numerics gate 3: splash interpret-mode forward parity (recorded even
+    # though the timed splash arm falls back on the tiny head_dim)
+    from ray_tpu.ops.splash_attention import splash_mha
+    from ray_tpu.ops.flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    qq = jax.random.normal(ks[0], (1, 256, 4, 128), jnp.float32)
+    kk = jax.random.normal(ks[1], (1, 256, 2, 128), jnp.float32)
+    vv = jax.random.normal(ks[2], (1, 256, 2, 128), jnp.float32)
+    sp = splash_mha(qq, kk, vv, causal=True)
+    splash_err = (float(jnp.max(jnp.abs(
+        sp - flash_attention(qq, kk, vv, causal=True))))
+        if sp is not None else None)
+    splash_ok = splash_err is not None and splash_err < 1e-4
+
+    overhead_ok = ratio["splash"] >= 0.95
+    strip = lambda r: {k: v for k, v in r.items()  # noqa: E731
+                       if k != "_losses"}
+    return {"pairs_on": [strip(r) for r in runs["splash+quant+zero"]],
+            "pairs_off": [strip(r) for r in runs["off"]],
+            "pairs_splash_fallback": [strip(r) for r in runs["splash"]],
+            "ratio_on_off": {"steps_per_s": ratio["splash+quant+zero"]},
+            "gate": {"zero_allclose_rtol": 1e-5,
+                     "zero_max_rel_err": round(zero_err, 9),
+                     "zero_allclose": zero_ok,
+                     "quant_max_err": round(quant_max_err, 6),
+                     "quant_bounded": quant_ok,
+                     "splash_fwd_max_err": splash_err,
+                     "splash_parity": splash_ok,
+                     "max_overhead": 0.05,
+                     "splash_fallback_ratio": ratio["splash"],
+                     "overhead_ok": overhead_ok,
+                     "passed": bool(zero_ok and quant_ok and splash_ok
+                                    and overhead_ok)}}
+
+
 def run_profile_submit(S: float) -> dict:
     """Per-stage µs breakdown of one WARM submission: spec build / encode
     / events / refcount measured in isolation on live runtime objects,
@@ -1384,6 +1537,12 @@ def main():
                         "health_metrics_enabled on vs off (submit_churn "
                         "+ serve_noop with hot detector cadences; the "
                         "health-plane overhead gate)")
+    p.add_argument("--ab-chipspeed", type=int, default=0, metavar="PAIRS",
+                   help="also run PAIRS interleaved CPU A/B triples of the "
+                        "chip-speed knobs (splash attention / int8 grad "
+                        "quant / ZeRO-sharded update) on vs off on a tiny "
+                        "dp=4 config, gating numerics equivalence and the "
+                        "<= 5% no-TPU fallback overhead")
     p.add_argument("--profile-submit", action="store_true",
                    help="profile one warm submission: per-stage µs "
                         "(spec build / encode / events / refcount / "
@@ -1459,6 +1618,9 @@ def main():
     if args.ab_submitplane > 0:
         out["submitplane_ab"] = run_ab_submitplane(args.scale,
                                                    args.ab_submitplane)
+    if args.ab_chipspeed > 0:
+        out["chipspeed_ab"] = run_ab_chipspeed(args.scale,
+                                               args.ab_chipspeed)
     if args.profile_submit:
         out["submit_profile"] = run_profile_submit(args.scale)
     if args.ab_cpshard > 0:

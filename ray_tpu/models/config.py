@@ -61,6 +61,11 @@ class TransformerConfig:
     # attention
     causal: bool = True
     attn_logit_softcap: float = 0.0
+    #: "auto" (mha dispatcher: flash on TPU, plain elsewhere), "plain",
+    #: "flash" (ops/flash_attention), or "splash" (the pallas splash kernel
+    #: with explicit backward block sizes; degrades to "auto" with one
+    #: RuntimeWarning when unavailable or the shape doesn't qualify).
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:

@@ -208,8 +208,23 @@ def _attention_block(x, p, cfg: TransformerConfig, positions, pctx: ParallelCont
                              causal=cfg.causal, batch_axes=pctx.batch_axes,
                              logit_softcap=cfg.attn_logit_softcap)
     else:
-        out = mha(q, k, v, causal=cfg.causal,
-                  logit_softcap=cfg.attn_logit_softcap)
+        out = None
+        impl = getattr(cfg, "attention_impl", "auto")
+        if impl == "splash":
+            from ..ops.splash_attention import splash_mha
+            out = splash_mha(q, k, v, causal=cfg.causal,
+                             logit_softcap=cfg.attn_logit_softcap,
+                             mesh=pctx.mesh, batch_axes=pctx.batch_axes,
+                             manual=pctx.manual_collectives)
+        elif impl == "plain":
+            out = attend(q, k, v, causal=cfg.causal,
+                         logit_softcap=cfg.attn_logit_softcap)
+        elif impl == "flash" and cfg.attn_logit_softcap == 0.0:
+            from ..ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=cfg.causal)
+        if out is None:  # "auto", or splash/flash declined this call
+            out = mha(q, k, v, causal=cfg.causal,
+                      logit_softcap=cfg.attn_logit_softcap)
     out = checkpoint_name(out, "attn_out")
     out = out.reshape(b, s, nh * hd) @ p["wo"].astype(cast)
     if "bo" in p:

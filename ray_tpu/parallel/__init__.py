@@ -6,9 +6,11 @@ from .pipeline import (init_pp_state, make_pp_train_step, merge_layers,
                        partition_layers)
 from .train_step import (TrainState, init_sharded_state, make_eval_step,
                          make_optimizer, make_train_step, state_shardings)
+from .zero import OptimizerSpec, init_zero_state, make_dp_train_step
 
 __all__ = ["MeshSpec", "make_mesh", "named_sharding", "AXIS_ORDER",
            "TrainState", "make_optimizer", "init_sharded_state",
            "make_train_step", "make_eval_step", "state_shardings",
            "init_pp_state", "make_pp_train_step", "partition_layers",
-           "merge_layers"]
+           "merge_layers", "OptimizerSpec", "init_zero_state",
+           "make_dp_train_step"]

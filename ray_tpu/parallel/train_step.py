@@ -106,8 +106,28 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
                     state_sh: TrainState,
                     compute_dtype=jnp.bfloat16,
                     sp_axis: Optional[str] = None,
-                    remat: Union[bool, str, None] = True) -> Callable:
-    """Returns jitted (state, batch) -> (state, metrics)."""
+                    remat: Union[bool, str, None] = True, *,
+                    grad_quant_enabled: bool = False,
+                    quant_block: Optional[int] = None,
+                    quant_stochastic: bool = False,
+                    zero_sharded_update: bool = False,
+                    opt_spec=None) -> Callable:
+    """Returns jitted (state, batch) -> (state, metrics).
+
+    With ``grad_quant_enabled`` and/or ``zero_sharded_update`` the step is
+    built by ``zero.make_dp_train_step`` instead: an explicit dp-manual
+    reduce-scatter / update / all-gather schedule with optional int8
+    block-scaled wire payloads (see parallel/zero.py).  Both knobs off —
+    the default — is byte-for-byte today's path.
+    """
+    if grad_quant_enabled or zero_sharded_update:
+        from . import zero
+        return zero.make_dp_train_step(
+            cfg, mesh, optimizer, state_sh, compute_dtype=compute_dtype,
+            sp_axis=sp_axis, remat=remat, grad_quant=grad_quant_enabled,
+            quant_block=quant_block or zero.DEFAULT_BLOCK,
+            quant_stochastic=quant_stochastic,
+            zero_update=zero_sharded_update, opt_spec=opt_spec)
     pctx = ParallelContext(mesh=mesh, sp_axis=sp_axis,
                            batch_axes=shard_rules.BATCH_AXES)
     batch_sh = NamedSharding(mesh, shard_rules.batch_spec())
@@ -149,6 +169,18 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
 
     step._jitted = jitted
     step.batch_sharding = batch_sh
+    # wire/HBM accounting for the observability plane: the compiler-placed
+    # fp32 gradient all-reduce over dp, and fully-replicated Adam state.
+    # A ring all-reduce moves ~2x the payload per device (reduce-scatter
+    # phase + all-gather phase) — counted as such so the number is
+    # comparable with the explicit RS/AG schedule of parallel/zero.py.
+    dp = 1
+    for ax in ("dp", "fsdp"):
+        dp *= mesh.shape.get(ax, 1)
+    n_params = cfg.num_params()
+    step.collective_bytes = (
+        {("all_reduce", "float32"): 2 * n_params * 4} if dp > 1 else {})
+    step.opt_state_bytes = 2 * n_params * 4 + 8
     return step
 
 

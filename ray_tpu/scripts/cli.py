@@ -647,6 +647,7 @@ def _render_top(store, alive_nodes) -> str:
     # train rollup: raytpu_train_* series land on the agent of whichever
     # node the train workers run on — aggregate across all nodes
     mfus, goodputs, steps_s, step_mean, compile_s = [], [], 0.0, [], []
+    opt_bytes, wire_rate = [], 0.0
     any_train = False
     for nid, _row in alive_nodes:
         s = latest.get(nid) or {}
@@ -654,11 +655,15 @@ def _render_top(store, alive_nodes) -> str:
             continue
         mfus += find_samples(s, "raytpu_train_mfu")
         goodputs += find_samples(s, "raytpu_train_goodput_fraction")
+        opt_bytes += find_samples(s, "raytpu_train_opt_state_bytes")
         if find_samples(s, "raytpu_train_steps_total"):
             any_train = True
         r = _sum_rate(store, nid, "raytpu_train_steps_total")
         if r:
             steps_s += r
+        w = _sum_rate(store, nid, "raytpu_train_collective_bytes_total")
+        if w:
+            wire_rate += w
         m = _hist_mean_rate(store, nid, "raytpu_train_step_seconds")
         if m is not None:
             step_mean.append(m)
@@ -674,6 +679,8 @@ def _render_top(store, alive_nodes) -> str:
             + (f"step={st * 1e3:.1f}ms  " if st is not None else "")
             + (f"mfu={mfu:.3f}  " if mfu is not None else "mfu=-  ")
             + (f"goodput={gp:.3f}  " if gp is not None else "goodput=-  ")
+            + (f"wire={wire_rate / 1e6:.1f}MB/s  " if wire_rate else "")
+            + (f"opt={sum(opt_bytes) / 1e6:.0f}MB  " if opt_bytes else "")
             + (f"compile={max(compile_s):.1f}s" if compile_s else ""))
     else:
         lines.append("TRAIN  (no raytpu_train_* series; is a run live and "

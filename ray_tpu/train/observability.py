@@ -125,6 +125,17 @@ def _build():
             "raytpu_train_resizes_total",
             "elastic worker-group resizes (in place, no job restart)",
             tag_keys=("direction",)),
+        "collective_bytes": Counter(
+            "raytpu_train_collective_bytes_total",
+            "per-device bytes this rank put on the wire in gradient/param "
+            "collectives, by collective op and wire dtype — the series "
+            "that shows the int8 quantized-reduce win (parallel/zero.py)",
+            tag_keys=("rank", "op", "dtype")),
+        "opt_bytes": Gauge(
+            "raytpu_train_opt_state_bytes",
+            "resident optimizer-state bytes on this rank (ZeRO sharding "
+            "divides this by the dp world size)",
+            tag_keys=("rank",)),
     }
 
 
@@ -207,6 +218,10 @@ class StepTracker:
         self._phases: Dict[str, float] = {}
         self._phase_spans: List[Tuple[str, float, float]] = []
         self._tokens_total = 0
+        #: precomputed ((tag_key_tuple, bytes), ...) incremented per step
+        self._collective_rates: Tuple[Tuple[tuple, int], ...] = ()
+        self._collective_per_step: Optional[Dict[str, int]] = None
+        self._opt_state_bytes: Optional[int] = None
         self._flops_per_token: Optional[float] = None
         self._tokens_per_step: Optional[int] = None
         self._peak_flops: Optional[float] = None
@@ -244,6 +259,36 @@ class StepTracker:
             self._peak_flops = float(peak_flops)
         elif self._peak_flops is None:
             self._peak_flops = self._detect_peak()
+        return self
+
+    def set_collectives(self, bytes_per_step: Optional[Dict[Any, int]] = None,
+                        opt_state_bytes: Optional[int] = None) -> "StepTracker":
+        """Teach the tracker the step's wire/HBM accounting.
+
+        ``bytes_per_step``: {(op, dtype): per-device bytes each step puts
+        on the wire} — the ``step.collective_bytes`` attribute of the
+        train-step builders.  Tag keys are precomputed here so the hot
+        path only increments.  ``opt_state_bytes`` (the builders'
+        ``step.opt_state_bytes``) sets the resident-optimizer gauge once.
+        """
+        if bytes_per_step is not None:
+            rates = []
+            snap: Dict[str, int] = {}
+            for (op, dtype), nbytes in sorted(bytes_per_step.items()):
+                key = tuple(sorted((("rank", str(self.rank)),
+                                    ("op", str(op)), ("dtype", str(dtype)))))
+                rates.append((key, int(nbytes)))
+                snap[f"{op}/{dtype}"] = int(nbytes)
+            with self._lock:
+                self._collective_rates = tuple(rates)
+                self._collective_per_step = snap
+        if opt_state_bytes is not None:
+            with self._lock:
+                self._opt_state_bytes = int(opt_state_bytes)
+            if enabled():
+                m = _metrics()
+                if m is not None:
+                    m["opt_bytes"].set_key(self._k_rank, int(opt_state_bytes))
         return self
 
     @staticmethod
@@ -340,6 +385,9 @@ class StepTracker:
                 self._tokens_total += self._tokens_per_step
                 if m is not None:
                     m["tokens"].inc_key(self._k_rank, self._tokens_per_step)
+            if self._collective_rates and not first and m is not None:
+                for key, nbytes in self._collective_rates:
+                    m["collective_bytes"].inc_key(key, nbytes)
             # running MFU: average token rate over the recent window
             # (running sum — O(1) per step, not O(window))
             if (self._flops_per_token and self._peak_flops
@@ -446,6 +494,8 @@ class StepTracker:
             "goodput": self._goodput,
             "tokens_total": self._tokens_total,
             "memory": self._memory,
+            "collective_bytes_per_step": self._collective_per_step,
+            "opt_state_bytes": self._opt_state_bytes,
             "last_step": self._last_step,
         }
 
@@ -497,6 +547,9 @@ def aggregate(snaps: Dict[int, Optional[dict]]) -> Optional[dict]:
         "goodput": mean(vals("goodput")),
         "productive_s": mean(vals("productive_s")),
         "tokens_total": sum(vals("tokens_total")) or 0,
+        # fleet-total resident optimizer HBM: the ZeRO win reads directly
+        # off this (replicated: n_ranks * full state; sharded: ~1x)
+        "opt_state_bytes": sum(vals("opt_state_bytes")) or None,
         "workers": {int(r): s for r, s in live.items()},
     }
     return out

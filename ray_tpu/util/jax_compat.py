@@ -27,6 +27,28 @@ def has_native_shard_map() -> bool:
     return hasattr(jax, "shard_map")
 
 
+_HAS_SPLASH: Optional[bool] = None
+
+
+def has_splash_attention() -> bool:
+    """True when ``jax.experimental.pallas.ops.tpu.splash_attention`` imports.
+
+    Pure import probe, cached after the first call.  Some jax builds ship
+    without the pallas TPU ops tree (or with a broken one); callers that
+    want the splash kernel gate on this and degrade to the in-repo flash
+    attention path instead of surfacing an ImportError at dispatch time.
+    """
+    global _HAS_SPLASH
+    if _HAS_SPLASH is None:
+        try:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (  # noqa: F401
+                splash_attention_kernel, splash_attention_mask)
+            _HAS_SPLASH = hasattr(splash_attention_kernel, "make_splash_mha")
+        except Exception:
+            _HAS_SPLASH = False
+    return _HAS_SPLASH
+
+
 def enable_cpu_multiprocess_collectives() -> bool:
     """Make multiprocess collectives work on the CPU backend.
 

@@ -57,6 +57,30 @@ def test_bench_backend_init_failure_emits_structured_skip(tmp_path,
     assert "UNAVAILABLE" in out.get("error", "")
 
 
+def test_bench_chipspeed_emits_structured_skip(tmp_path):
+    """``--chipspeed`` must degrade exactly like the headline path: a dead
+    backend yields one parseable skip line (under its own metric name, so
+    the harness can tell which phase was skipped) and rc=0 — never a
+    traceback, never a partial checkpoint."""
+    _write_stub_jax(tmp_path, "raise RuntimeError(\"Unable to initialize "
+                              "backend 'tpu': UNAVAILABLE\")")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--chipspeed",
+         "--backend-timeout", "20"],
+        capture_output=True, text=True, timeout=120,
+        cwd=str(REPO),
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(tmp_path),
+             "HOME": "/tmp"})
+    assert proc.returncode == 0, \
+        f"bench.py exited rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no output: stderr={proc.stderr[-500:]}"
+    out = json.loads(lines[-1])
+    assert out.get("skipped") == "no TPU", out
+    assert out["metric"] == "chipspeed_1b_mfu"
+    assert not (REPO / "BENCH_CHIPSPEED_partial.json").exists()
+
+
 def test_bench_wedged_backend_init_times_out_to_skip(tmp_path):
     """A plugin that WEDGES (never returns, never raises) inside
     ``jax.devices()`` must also resolve to the structured skip once the

@@ -337,10 +337,12 @@ def test_autoscale_reasons_are_closed_vocabulary():
 
 TRAIN_OBS_FILE = PKG_ROOT / "train" / "observability.py"
 #: the label-set bound for the train plane: rank (bounded by world size),
-#: stage (the fixed decomposition names), and direction (the closed
-#: up/down elastic-resize vocabulary) ONLY — never worker hostnames,
-#: trial names, or anything else unbounded.
-ALLOWED_TRAIN_TAG_KEYS = {"rank", "stage", "direction"}
+#: stage (the fixed decomposition names), direction (the closed up/down
+#: elastic-resize vocabulary), op (the collective-op vocabulary:
+#: all_reduce/reduce_scatter/all_gather) and dtype (wire dtypes:
+#: float32/int8) ONLY — never worker hostnames, trial names, or anything
+#: else unbounded.
+ALLOWED_TRAIN_TAG_KEYS = {"rank", "stage", "direction", "op", "dtype"}
 
 
 def test_train_metric_tag_keys_are_bounded():

@@ -78,6 +78,45 @@ def test_step_tracker_mfu_math():
     assert t2._flops_per_token == tiny().flops_per_token(32)
 
 
+def test_step_tracker_collective_bytes_and_opt_gauge():
+    """set_collectives wires the step builders' wire/HBM accounting into
+    raytpu_train_collective_bytes_total{op,dtype} (counted per completed
+    step, compile excluded) and the opt-state gauge, and both ride the
+    snapshot to the driver (ISSUE 20 satellite)."""
+    from ray_tpu.train.observability import StepTracker
+    from ray_tpu.util.metrics import get_metric
+
+    t = StepTracker(555)
+    t.SNAPSHOT_PERIOD_S = 0.0
+    t.set_collectives({("reduce_scatter", "int8"): 1000,
+                       ("all_gather", "float32"): 64},
+                      opt_state_bytes=4096)
+    t.start()
+    t.on_report()  # compile step: no collective counts
+    t.on_resume()
+    for _ in range(3):
+        t.on_report()
+        t.on_resume()
+    snap = t.snapshot()
+    assert snap["collective_bytes_per_step"] == {
+        "reduce_scatter/int8": 1000, "all_gather/float32": 64}
+    assert snap["opt_state_bytes"] == 4096
+
+    key_rs = tuple(sorted((("rank", "555"), ("op", "reduce_scatter"),
+                           ("dtype", "int8"))))
+    key_ag = tuple(sorted((("rank", "555"), ("op", "all_gather"),
+                           ("dtype", "float32"))))
+    vals = get_metric("raytpu_train_collective_bytes_total") \
+        .snapshot()["values"]
+    assert vals[key_rs] == 3000 and vals[key_ag] == 192
+    gauge = get_metric("raytpu_train_opt_state_bytes").snapshot()["values"]
+    assert gauge[(("rank", "555"),)] == 4096
+    # the driver rollup sums resident optimizer HBM across ranks
+    from ray_tpu.train.observability import aggregate
+    roll = aggregate({0: snap, 1: dict(snap, opt_state_bytes=4096)})
+    assert roll["opt_state_bytes"] == 8192
+
+
 def test_kill_switch_sheds_all_train_series():
     """train_metrics_enabled=False => zero raytpu_train_* series for this
     tracker's rank, no snapshot piggyback; flipping back on records."""
